@@ -1,0 +1,31 @@
+"""apnea_uq_tpu — TPU-native sleep-apnea uncertainty-quantification framework.
+
+A ground-up JAX/XLA/Flax re-design of the capabilities of
+``TrondVQ/UncertaintyQuantification-SleepApnea-1DCNN`` (a Keras/TF research
+pipeline): SHHS2 ingestion, the Alarcón 1D-CNN apnea classifier, MC-Dropout
+and Deep-Ensemble uncertainty quantification with total/aleatoric/epistemic
+decomposition, bootstrap confidence intervals, and patient/window-level
+analysis — all built TPU-first:
+
+- the model and every UQ metric run on device under ``jit``;
+- MC Dropout's T stochastic passes are a ``vmap`` over dropout RNG keys
+  (reference: a Python loop of full-set passes, uq_techniques.py:22);
+- Deep-Ensemble members train concurrently on an ``(ensemble, data)``
+  ``jax.sharding.Mesh`` axis (reference: a sequential Python loop,
+  train_deep_ensemble_cnns.py:125-177);
+- the bootstrap CI engine is one vectorized gather+reduce (reference:
+  a B×Python-loop recompute, uq_techniques.py:137-165).
+
+Subpackages
+-----------
+- ``models``     — Flax model definitions (Alarcón 1D-CNN and variants)
+- ``ops``        — low-level device ops (entropy, losses)
+- ``training``   — train states, single-model trainer, early stopping
+- ``uq``         — MC-Dropout / Deep-Ensemble prediction, UQ metric engine,
+                   vectorized bootstrap, orchestration
+- ``evaluation`` — classification metric suite
+- ``cli``        — command-line entry points, one per pipeline stage
+- ``utils``      — PRNG, timing, small helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,60 @@
+"""``apnea-uq`` — one CLI covering every pipeline stage.
+
+The reference uses a separate argparse block (or hand-edited constants) per
+script (SURVEY §5.6).  Here each stage is a subcommand; all of them accept
+``--config`` (a JSON ExperimentConfig) plus targeted overrides.
+
+Subcommands grow as stages land; ``apnea-uq <cmd> --help`` is the contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from apnea_uq_tpu import __version__
+from apnea_uq_tpu.config import ExperimentConfig, load_config, save_config
+
+
+def _add_config_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", type=str, default=None,
+                   help="Path to an ExperimentConfig JSON (see `init-config`).")
+
+
+def _load(args) -> ExperimentConfig:
+    return load_config(args.config) if args.config else ExperimentConfig()
+
+
+def cmd_init_config(args) -> int:
+    save_config(ExperimentConfig(), args.out)
+    print(f"wrote default config to {args.out}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="apnea-uq",
+        description="TPU-native sleep-apnea UQ pipeline (JAX/Flax).",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("init-config", help="Write the default config JSON.")
+    p.add_argument("--out", type=str, default="apnea_uq_config.json")
+    p.set_defaults(fn=cmd_init_config)
+
+    # Stage subcommands are registered lazily by their modules to keep
+    # CLI startup free of jax/pandas imports until a stage actually runs.
+    from apnea_uq_tpu.cli import stages
+
+    stages.register(sub, _add_config_arg, _load)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

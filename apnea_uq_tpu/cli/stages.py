@@ -1,0 +1,13 @@
+"""Stage subcommand registry for the ``apnea-uq`` CLI.
+
+Each pipeline stage contributes one subcommand; a stage registers here in
+the same change that adds its runner.  Handlers import their heavy
+dependencies (jax, pandas) lazily so ``--help`` stays instant.
+"""
+
+from __future__ import annotations
+
+
+def register(sub, add_config_arg, load_config_fn) -> None:
+    # Stage subcommands land together with their runner implementations.
+    del sub, add_config_arg, load_config_fn

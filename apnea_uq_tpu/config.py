@@ -1,0 +1,215 @@
+"""Unified configuration for every pipeline stage.
+
+The reference scatters configuration across per-script module constants and
+argparse blocks (e.g. cnn_baseline_train.py:16-32, prepare_numpy_datasets.py:45-57,
+analyze_mcd_patient_level.py:15-30), and several analysis scripts are switched
+MCD<->DE by hand-editing paths (aggregate_patient_uq_metrics.py:7).  Here one
+dataclass tree covers all stages and serializes to/from JSON, so every run is
+reproducible from a single config artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+# Canonical seed of the reference pipeline (cnn_baseline_train.py:18,
+# prepare_numpy_datasets.py:50, train_deep_ensemble_cnns.py:13).
+DEFAULT_SEED = 2025
+
+# SHHS2 window geometry (preprocess_shhs_raw.py:194, prepare_numpy_datasets.py:55).
+TIME_STEPS = 60
+NUM_CHANNELS = 4
+CHANNELS = ("SaO2", "PR", "THOR RES", "ABDO RES")
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Alarcón et al. 1D-CNN architecture (cnn_baseline_train.py:37-104).
+
+    Six Conv1D->ReLU->BatchNorm->Dropout blocks, global average pooling over
+    time, and a single-logit head.  ``compute_dtype='bfloat16'`` runs conv/
+    dense math on the MXU in bf16 with float32 params and float32 batch-norm
+    statistics; use ``'float32'`` for strict numerical parity work.
+    """
+
+    features: Sequence[int] = (128, 192, 224, 96, 256, 96)
+    kernel_sizes: Sequence[int] = (7, 5, 3, 7, 9, 9)
+    dropout_rates: Sequence[float] = (0.3, 0.3, 0.4, 0.2, 0.3, 0.5)
+    time_steps: int = TIME_STEPS
+    num_channels: int = NUM_CHANNELS
+    bn_momentum: float = 0.99  # Keras BatchNormalization default
+    bn_epsilon: float = 1e-3   # Keras BatchNormalization default
+    compute_dtype: str = "float32"
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training loop settings (cnn_baseline_train.py:28-32,204-217)."""
+
+    batch_size: int = 1024
+    num_epochs: int = 30
+    learning_rate: float = 1e-3
+    validation_split: float = 0.1
+    early_stopping_patience: int = 5
+    restore_best_weights: bool = True
+    seed: int = DEFAULT_SEED
+    shuffle: bool = True
+
+
+@dataclass(frozen=True)
+class EnsembleConfig:
+    """Deep-Ensemble training (train_deep_ensemble_cnns.py:13-21,125-177)."""
+
+    num_members: int = 5
+    seed_base: int = DEFAULT_SEED  # member i uses seed_base + i
+    num_epochs: int = 50
+    batch_size: int = 1024
+    learning_rate: float = 1e-3
+    validation_split: float = 0.1
+    early_stopping_patience: int = 5
+
+
+@dataclass(frozen=True)
+class UQConfig:
+    """Uncertainty quantification (analyze_mcd_patient_level.py:21-23).
+
+    ``mcd_mode`` selects the stochastic-pass semantics:
+
+    - ``'parity'``: dropout on AND batch-norm in batch-statistics mode —
+      byte-for-byte the reference's ``model(x, training=True)``
+      (uq_techniques.py:22), the regime behind its ~77% MCD accuracy.
+    - ``'clean'``: dropout on, batch-norm frozen at running statistics —
+      the methodologically standard MC Dropout.  Accuracy stays near the
+      deterministic ~88%.
+    """
+
+    mc_passes: int = 50
+    n_bootstrap: int = 100
+    bootstrap_alpha: float = 0.05
+    mcd_mode: str = "clean"
+    inference_batch_size: int = 8192
+    entropy_eps: float = 1e-10  # uq_techniques.py:35
+    decision_threshold: float = 0.5
+
+
+@dataclass(frozen=True)
+class IngestConfig:
+    """Raw SHHS2 EDF+XML ingestion (preprocess_shhs_raw.py)."""
+
+    channels: Sequence[str] = CHANNELS
+    pr_alt_names: Sequence[str] = ("H.R.",)  # preprocess_shhs_raw.py:141
+    target_rate_hz: float = 1.0
+    window_size_s: int = TIME_STEPS
+    overlap_s: int = 0
+    min_event_overlap_s: float = 10.0
+    apnea_event_concepts: Sequence[str] = (
+        "Obstructive apnea|Obstructive Apnea",
+        "Hypopnea|Hypopnea",
+    )
+    sao2_valid_range: tuple[float, float] = (80.0, 100.0)
+    pr_valid_range: tuple[float, float] = (40.0, 200.0)
+    max_nan_fraction: float = 0.1
+    min_sleep_time_s: float = 300.0 * 60.0
+    # Reference parity: stop collecting XML events at the first
+    # 'Stages|Stages' event (preprocess_shhs_raw.py:176-177).
+    stop_at_first_stage_event: bool = True
+
+
+@dataclass(frozen=True)
+class PrepareConfig:
+    """Dataset finalization (prepare_numpy_datasets.py).
+
+    ``nan_fill='train'`` computes imputation means from the training split
+    only, fixing the reference's global-mean train->test leak
+    (prepare_numpy_datasets.py:126-128); ``'global'`` reproduces the
+    reference behavior for parity experiments.
+    """
+
+    test_size: float = 0.20
+    seed: int = DEFAULT_SEED
+    standardize_eps: float = 1e-8
+    smote: bool = True
+    smote_k_neighbors: int = 5
+    rus: bool = True
+    nan_fill: str = "train"
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for ensemble/data parallel execution."""
+
+    ensemble_axis: int = 0  # 0 -> auto: min(num_members, device_count)
+    data_axis: int = 1      # devices per ensemble shard for DP sub-axis
+    axis_names: tuple[str, str] = ("ensemble", "data")
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    """Top-level bundle covering the whole pipeline."""
+
+    model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    ensemble: EnsembleConfig = field(default_factory=EnsembleConfig)
+    uq: UQConfig = field(default_factory=UQConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
+    prepare: PrepareConfig = field(default_factory=PrepareConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _to_jsonable(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    return obj
+
+
+def _from_dict(cls: type, data: dict) -> Any:
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        # Nested-dataclass fields are dispatched via _NESTED (annotations
+        # are strings under `from __future__ import annotations`, so the
+        # field type itself cannot be inspected without re-resolution).
+        if f.name in _NESTED:
+            kwargs[f.name] = _from_dict(_NESTED[f.name], v)
+        elif isinstance(v, list):
+            kwargs[f.name] = tuple(v)
+        else:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+def _check_nested_covers_experiment() -> None:
+    """Every dataclass-typed ExperimentConfig field must be in _NESTED."""
+    for f in dataclasses.fields(ExperimentConfig):
+        assert f.name in _NESTED, f"_NESTED is missing ExperimentConfig.{f.name}"
+
+
+_NESTED = {
+    "model": ModelConfig,
+    "train": TrainConfig,
+    "ensemble": EnsembleConfig,
+    "uq": UQConfig,
+    "ingest": IngestConfig,
+    "prepare": PrepareConfig,
+    "mesh": MeshConfig,
+}
+
+
+_check_nested_covers_experiment()
+
+
+def save_config(config: ExperimentConfig, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(_to_jsonable(config), f, indent=2)
+
+
+def load_config(path: str) -> ExperimentConfig:
+    with open(path) as f:
+        return _from_dict(ExperimentConfig, json.load(f))

@@ -1,0 +1,19 @@
+from apnea_uq_tpu.evaluation.classification import (
+    average_precision,
+    classification_report_dict,
+    cohen_kappa,
+    confusion_matrix_2x2,
+    evaluate_classification,
+    matthews_corrcoef,
+    roc_auc,
+)
+
+__all__ = [
+    "evaluate_classification",
+    "roc_auc",
+    "average_precision",
+    "cohen_kappa",
+    "matthews_corrcoef",
+    "confusion_matrix_2x2",
+    "classification_report_dict",
+]

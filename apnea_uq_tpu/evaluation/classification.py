@@ -1,0 +1,188 @@
+"""Binary classification metric suite (first-party, no sklearn at runtime).
+
+Capability parity with the reference evaluator
+``evaluation/evaluate_classification.py:7-153``: accuracy, per-class
+precision/recall/F1 report, ROC-AUC and PR-AUC with single-class guards
+(:77-86), Cohen's kappa and Matthews correlation (:90-91), a confusion
+matrix always padded to 2x2 (:94-114), and sensitivity/specificity
+(:117-119).  Implementations are closed-form NumPy (rank-statistic ROC-AUC,
+step-interpolated average precision) and are unit-tested against
+scikit-learn in ``tests/test_eval_metrics.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _as1d(a) -> np.ndarray:
+    return np.asarray(a).reshape(-1)
+
+
+def confusion_matrix_2x2(y_true, y_pred) -> np.ndarray:
+    """[[TN, FP], [FN, TP]] — always 2x2 even if a class is absent."""
+    y_true = _as1d(y_true).astype(np.int64)
+    y_pred = _as1d(y_pred).astype(np.int64)
+    cm = np.zeros((2, 2), dtype=np.int64)
+    for t in (0, 1):
+        for p in (0, 1):
+            cm[t, p] = int(np.sum((y_true == t) & (y_pred == p)))
+    return cm
+
+
+def _average_ranks(scores: np.ndarray) -> np.ndarray:
+    """Average ranks (1-based) with ties sharing their mean rank."""
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores), dtype=np.float64)
+    sorted_scores = scores[order]
+    i = 0
+    while i < len(scores):
+        j = i
+        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i : j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    return ranks
+
+
+def roc_auc(y_true, scores) -> Optional[float]:
+    """ROC-AUC via the Mann-Whitney rank statistic; None if single-class."""
+    y_true = _as1d(y_true).astype(np.int64)
+    scores = _as1d(scores).astype(np.float64)
+    n_pos = int(np.sum(y_true == 1))
+    n_neg = int(np.sum(y_true == 0))
+    if n_pos == 0 or n_neg == 0:
+        return None
+    ranks = _average_ranks(scores)
+    r_pos = float(np.sum(ranks[y_true == 1]))
+    return (r_pos - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
+
+
+def average_precision(y_true, scores) -> Optional[float]:
+    """Average precision (sklearn-style step interpolation); None if no positives."""
+    y_true = _as1d(y_true).astype(np.int64)
+    scores = _as1d(scores).astype(np.float64)
+    n_pos = int(np.sum(y_true == 1))
+    if n_pos == 0:
+        return None
+    order = np.argsort(-scores, kind="mergesort")
+    y_sorted = y_true[order]
+    s_sorted = scores[order]
+    tps = np.cumsum(y_sorted)
+    fps = np.cumsum(1 - y_sorted)
+    # evaluate at the last index of each distinct-score group
+    distinct = np.where(np.diff(s_sorted))[0]
+    idx = np.concatenate([distinct, [len(s_sorted) - 1]])
+    precision = tps[idx] / (tps[idx] + fps[idx])
+    recall = tps[idx] / n_pos
+    recall_prev = np.concatenate([[0.0], recall[:-1]])
+    return float(np.sum((recall - recall_prev) * precision))
+
+
+def cohen_kappa(y_true, y_pred) -> float:
+    cm = confusion_matrix_2x2(y_true, y_pred).astype(np.float64)
+    n = cm.sum()
+    if n == 0:
+        return 0.0
+    po = np.trace(cm) / n
+    pe = float(np.sum(cm.sum(axis=0) * cm.sum(axis=1))) / (n * n)
+    if pe == 1.0:
+        return 0.0
+    return float((po - pe) / (1.0 - pe))
+
+
+def matthews_corrcoef(y_true, y_pred) -> float:
+    cm = confusion_matrix_2x2(y_true, y_pred).astype(np.float64)
+    tn, fp, fn, tp = cm[0, 0], cm[0, 1], cm[1, 0], cm[1, 1]
+    denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    if denom == 0:
+        return 0.0
+    return float((tp * tn - fp * fn) / denom)
+
+
+def classification_report_dict(y_true, y_pred) -> Dict[str, Dict[str, float]]:
+    """Per-class precision/recall/F1/support plus macro and weighted averages."""
+    y_true = _as1d(y_true).astype(np.int64)
+    y_pred = _as1d(y_pred).astype(np.int64)
+    report: Dict[str, Dict[str, float]] = {}
+    supports, precisions, recalls, f1s = [], [], [], []
+    for cls in (0, 1):
+        tp = int(np.sum((y_true == cls) & (y_pred == cls)))
+        fp = int(np.sum((y_true != cls) & (y_pred == cls)))
+        fn = int(np.sum((y_true == cls) & (y_pred != cls)))
+        support = int(np.sum(y_true == cls))
+        prec = tp / (tp + fp) if (tp + fp) else 0.0
+        rec = tp / (tp + fn) if (tp + fn) else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if (prec + rec) else 0.0
+        report[str(cls)] = {
+            "precision": prec, "recall": rec, "f1-score": f1, "support": support,
+        }
+        supports.append(support)
+        precisions.append(prec)
+        recalls.append(rec)
+        f1s.append(f1)
+    total = sum(supports) or 1
+    report["macro avg"] = {
+        "precision": float(np.mean(precisions)),
+        "recall": float(np.mean(recalls)),
+        "f1-score": float(np.mean(f1s)),
+        "support": sum(supports),
+    }
+    w = np.asarray(supports, np.float64) / total
+    report["weighted avg"] = {
+        "precision": float(np.sum(w * precisions)),
+        "recall": float(np.sum(w * recalls)),
+        "f1-score": float(np.sum(w * f1s)),
+        "support": sum(supports),
+    }
+    report["accuracy"] = float(np.mean(y_true == y_pred)) if len(y_true) else 0.0
+    return report
+
+
+def evaluate_classification(
+    probs,
+    y_true,
+    *,
+    threshold: float = 0.5,
+    description: str = "",
+    verbose: bool = False,
+) -> Dict:
+    """Full evaluation from positive-class probabilities.
+
+    Mirrors the returned-dict surface of the reference evaluator
+    (evaluate_classification.py:135-147): accuracy, ROC-AUC, PR-AUC (None
+    when undefined), kappa, MCC, confusion matrix, sensitivity/specificity,
+    and the per-class report.
+    """
+    probs = _as1d(probs).astype(np.float64)
+    y_true = _as1d(y_true).astype(np.int64)
+    y_pred = (probs >= threshold).astype(np.int64)
+
+    cm = confusion_matrix_2x2(y_true, y_pred)
+    tn, fp, fn, tp = int(cm[0, 0]), int(cm[0, 1]), int(cm[1, 0]), int(cm[1, 1])
+    sensitivity = tp / (tp + fn) if (tp + fn) else 0.0
+    specificity = tn / (tn + fp) if (tn + fp) else 0.0
+
+    results = {
+        "description": description,
+        "accuracy": float(np.mean(y_true == y_pred)) if len(y_true) else 0.0,
+        "roc_auc": roc_auc(y_true, probs),
+        "pr_auc": average_precision(y_true, probs),
+        "cohen_kappa": cohen_kappa(y_true, y_pred),
+        "mcc": matthews_corrcoef(y_true, y_pred),
+        "confusion_matrix": cm,
+        "sensitivity": sensitivity,
+        "specificity": specificity,
+        "report": classification_report_dict(y_true, y_pred),
+        "threshold": threshold,
+    }
+    if verbose:
+        print(f"=== {description or 'Classification evaluation'} ===")
+        for k in ("accuracy", "roc_auc", "pr_auc", "cohen_kappa", "mcc",
+                  "sensitivity", "specificity"):
+            v = results[k]
+            print(f"  {k}: {v:.4f}" if isinstance(v, float) else f"  {k}: {v}")
+        print(f"  confusion_matrix [[TN FP][FN TP]]:\n{cm}")
+    return results

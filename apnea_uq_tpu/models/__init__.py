@@ -1,0 +1,17 @@
+from apnea_uq_tpu.models.cnn1d import (
+    MODES,
+    AlarconCNN1D,
+    apply_model,
+    init_variables,
+    param_count,
+    predict_proba,
+)
+
+__all__ = [
+    "AlarconCNN1D",
+    "MODES",
+    "apply_model",
+    "init_variables",
+    "param_count",
+    "predict_proba",
+]

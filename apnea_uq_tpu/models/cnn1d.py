@@ -1,0 +1,146 @@
+"""Alarcón et al. 1D-CNN apnea classifier, TPU-first in Flax.
+
+Architecture parity target: reference ``al_1d_cnn_create_model``
+(models/cnn_baseline_train.py:37-104 — duplicated at
+models/train_deep_ensemble_cnns.py:25-77): six Conv1D(ReLU, same-pad) ->
+BatchNorm -> Dropout blocks with (filters, kernel, rate) =
+(128,7,.3)(192,5,.3)(224,3,.4)(96,7,.2)(256,9,.3)(96,9,.5), then global
+average pooling over time and a Dense(1) sigmoid head; ~853K params.
+
+TPU-first design choices (deliberate divergences from the Keras original):
+
+- The head emits a **logit**; the sigmoid lives in the loss
+  (``optax.sigmoid_binary_cross_entropy``) and in ``predict_proba``, which
+  is numerically stabler and fuses better under XLA.
+- Conv/dense math can run in **bfloat16** on the MXU (``compute_dtype``)
+  with float32 parameters and float32 batch-norm statistics.
+- **Inference-mode semantics are explicit.** Keras ``training=True``
+  silently switches BatchNorm to batch statistics as well as enabling
+  dropout — the cause of the reference's ~88% vs ~77% accuracy split
+  (uq_techniques.py:22; SURVEY §6).  Here the four regimes are first-class
+  modes (``MODES``): 'train', 'eval', 'mcd_clean' (dropout on, BN frozen —
+  standard MC Dropout) and 'mcd_parity' (dropout on, BN in batch-stats
+  mode, statistics updates discarded — the reference regime).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apnea_uq_tpu.config import ModelConfig
+
+# mode -> (dropout_on, bn_use_running_average)
+MODES: Mapping[str, Tuple[bool, bool]] = {
+    "train": (True, False),
+    "eval": (False, True),
+    "mcd_clean": (True, True),
+    "mcd_parity": (True, False),
+}
+
+
+class AlarconCNN1D(nn.Module):
+    """1D CNN over (batch, time, channels) windows; returns (batch,) logits."""
+
+    config: ModelConfig = ModelConfig()
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, mode: str = "eval") -> jax.Array:
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {sorted(MODES)}, got {mode!r}")
+        dropout_on, bn_frozen = MODES[mode]
+        cfg = self.config
+        dtype = jnp.dtype(cfg.compute_dtype)
+
+        x = x.astype(dtype)
+        for i, (feat, ksize, rate) in enumerate(
+            zip(cfg.features, cfg.kernel_sizes, cfg.dropout_rates)
+        ):
+            x = nn.Conv(
+                features=feat,
+                kernel_size=(ksize,),
+                padding="SAME",
+                dtype=dtype,
+                param_dtype=jnp.float32,
+                kernel_init=nn.initializers.glorot_uniform(),
+                name=f"conv_{i}",
+            )(x)
+            x = nn.relu(x)
+            x = nn.BatchNorm(
+                use_running_average=bn_frozen,
+                momentum=cfg.bn_momentum,
+                epsilon=cfg.bn_epsilon,
+                dtype=dtype,
+                param_dtype=jnp.float32,
+                name=f"bn_{i}",
+            )(x)
+            x = nn.Dropout(rate=rate, deterministic=not dropout_on, name=f"drop_{i}")(x)
+
+        # Global average pooling over the time axis
+        # (cnn_baseline_train.py:91), then the single-logit head (:94).
+        x = jnp.mean(x, axis=1)
+        x = nn.Dense(
+            features=1,
+            dtype=dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.initializers.glorot_uniform(),
+            name="head",
+        )(x)
+        return x[..., 0].astype(jnp.float32)
+
+
+def init_variables(
+    model: AlarconCNN1D, rng: jax.Array, batch_size: int = 2
+) -> dict:
+    """Initialize {'params', 'batch_stats'} for the model."""
+    cfg = model.config
+    dummy = jnp.zeros((batch_size, cfg.time_steps, cfg.num_channels), jnp.float32)
+    return model.init({"params": rng}, dummy, mode="eval")
+
+
+def apply_model(
+    model: AlarconCNN1D,
+    variables: dict,
+    x: jax.Array,
+    *,
+    mode: str,
+    dropout_rng: Optional[jax.Array] = None,
+    update_batch_stats: bool = False,
+) -> Tuple[jax.Array, dict]:
+    """Apply the model in an explicit mode.
+
+    Returns ``(logits, new_batch_stats)``.  ``new_batch_stats`` is the
+    (possibly unchanged) batch_stats collection: it is updated only when
+    ``mode='train'`` and ``update_batch_stats=True``.  In 'mcd_parity' mode
+    batch statistics are *used* but updates are discarded, matching a Keras
+    inference call with ``training=True`` (no optimizer step, so Keras'
+    moving averages do update there — we deliberately do not persist them;
+    persisting inference-time BN drift is a reference defect not worth
+    keeping).
+    """
+    if mode not in MODES:
+        raise ValueError(f"mode must be one of {sorted(MODES)}, got {mode!r}")
+    dropout_on, bn_frozen = MODES[mode]
+    if dropout_on and dropout_rng is None:
+        raise ValueError(f"mode {mode!r} needs a dropout_rng")
+    rngs = {"dropout": dropout_rng} if dropout_on else None
+    if bn_frozen:
+        logits = model.apply(variables, x, mode=mode, rngs=rngs)
+        return logits, variables["batch_stats"]
+    logits, mutated = model.apply(
+        variables, x, mode=mode, rngs=rngs, mutable=["batch_stats"]
+    )
+    new_stats = mutated["batch_stats"] if update_batch_stats else variables["batch_stats"]
+    return logits, new_stats
+
+
+def predict_proba(logits: jax.Array) -> jax.Array:
+    """Positive-class probability from logits."""
+    return jax.nn.sigmoid(logits)
+
+
+def param_count(variables: dict) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(variables["params"]))

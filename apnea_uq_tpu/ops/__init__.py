@@ -1,0 +1,4 @@
+from apnea_uq_tpu.ops.entropy import binary_entropy
+from apnea_uq_tpu.ops.losses import masked_bce_with_logits
+
+__all__ = ["binary_entropy", "masked_bce_with_logits"]

@@ -1,0 +1,37 @@
+"""Closed-form binary entropy on device.
+
+The reference computes entropy two different ways — scipy ``entropy`` over
+stacked [1-p, p] columns in **nats** (uq_techniques.py:35-38) and a manual
+log2 formula in **bits** (analyze_mcd_patient_level.py:109-115) — with two
+different clipping epsilons (1e-10 vs 1e-9).  Here one jittable closed form
+serves both, with the base and epsilon explicit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import xlogy
+
+_LN2 = 0.6931471805599453
+
+
+def binary_entropy(p, *, base: str = "nats", eps: float = 1e-10):
+    """Entropy of a Bernoulli(p) distribution, elementwise.
+
+    ``base='nats'`` matches scipy.stats.entropy on [1-p, p]
+    (uq_techniques.py:38); ``base='bits'`` matches the reference's manual
+    log2 entropy (analyze_mcd_patient_level.py:114-115).
+
+    Probabilities are clipped to [eps, 1-eps] before the log, mirroring the
+    reference's ``safe_entropy`` clipping (uq_techniques.py:37).
+    """
+    p = jnp.clip(p, eps, 1.0 - eps)
+    # xlogy gives 0*log(0) = 0, which matters in float32 where 1-eps can
+    # round to exactly 1.0 for eps below the float32 ulp.
+    q = 1.0 - p
+    h = -(xlogy(p, p) + xlogy(q, q))
+    if base == "nats":
+        return h
+    if base == "bits":
+        return h / _LN2
+    raise ValueError(f"base must be 'nats' or 'bits', got {base!r}")

@@ -1,0 +1,33 @@
+"""Losses as fused logit-space ops.
+
+The reference uses Keras ``binary_crossentropy`` on post-sigmoid
+probabilities (cnn_baseline_train.py:101).  We keep the model in logit
+space and use the numerically stable sigmoid-BCE, with an optional sample
+mask so padded batches (static shapes for XLA) contribute zero loss.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def masked_bce_with_logits(logits, labels, mask=None):
+    """Mean sigmoid binary cross-entropy over unmasked samples.
+
+    Args:
+      logits: (batch,) float logits.
+      labels: (batch,) {0,1} labels (any float/int dtype).
+      mask:   optional (batch,) {0,1}; 0 entries are excluded from the mean.
+
+    Returns scalar float32 loss.
+    """
+    per_sample = optax.sigmoid_binary_cross_entropy(
+        logits.astype(jnp.float32), labels.astype(jnp.float32)
+    )
+    if mask is None:
+        return jnp.mean(per_sample)
+    mask = mask.astype(jnp.float32)
+    total = jnp.sum(per_sample * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count

@@ -1,0 +1,4 @@
+from apnea_uq_tpu.training.state import TrainState, create_train_state
+from apnea_uq_tpu.training.trainer import FitResult, fit, predict_proba_batched
+
+__all__ = ["TrainState", "create_train_state", "fit", "FitResult", "predict_proba_batched"]

@@ -1,0 +1,50 @@
+"""Training state: {params, batch_stats, opt_state} as one pytree.
+
+The TPU-native counterpart of a compiled Keras model + optimizer
+(cnn_baseline_train.py:100-102): Adam(1e-3) via optax, explicit functional
+state so the whole step jits, vmaps over an ensemble axis, and shards over
+a device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, init_variables
+
+
+class TrainState(flax.struct.PyTreeNode):
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: jax.Array
+
+    def variables(self) -> dict:
+        return {"params": self.params, "batch_stats": self.batch_stats}
+
+
+def make_optimizer(learning_rate: float = 1e-3) -> optax.GradientTransformation:
+    """Adam with Keras-default hyperparameters (cnn_baseline_train.py:100)."""
+    return optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-7)
+
+
+def create_train_state(
+    model: AlarconCNN1D,
+    rng: jax.Array,
+    *,
+    learning_rate: float = 1e-3,
+    tx: Optional[optax.GradientTransformation] = None,
+) -> TrainState:
+    variables = init_variables(model, rng)
+    tx = tx if tx is not None else make_optimizer(learning_rate)
+    return TrainState(
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        opt_state=tx.init(variables["params"]),
+        step=jnp.zeros((), jnp.int32),
+    )

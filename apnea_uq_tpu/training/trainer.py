@@ -1,0 +1,223 @@
+"""Single-model trainer: jitted scan epochs + Keras-parity early stopping.
+
+Functional replacement for ``model.fit(batch_size=1024, epochs<=30,
+validation_split=0.1, EarlyStopping(val_loss, patience=5,
+restore_best_weights=True))`` (cnn_baseline_train.py:204-217):
+
+- the train set lives in HBM once; each epoch is ONE jitted program — a
+  ``lax.scan`` over permuted, padded, fixed-size batches (static shapes, no
+  retrace), with the last partial batch masked out of the loss;
+- validation is the trailing ``validation_split`` fraction of the provided
+  data, evaluated in inference mode — both Keras semantics;
+- early stopping is host logic between device epochs: track best val loss,
+  keep the best parameters on device, restore them when patience runs out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apnea_uq_tpu.config import TrainConfig
+from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
+from apnea_uq_tpu.ops.losses import masked_bce_with_logits
+from apnea_uq_tpu.training.state import TrainState, make_optimizer
+from apnea_uq_tpu.utils import prng
+
+
+@dataclasses.dataclass
+class FitResult:
+    state: TrainState
+    history: Dict[str, List[float]]
+    best_epoch: int
+    stopped_early: bool
+
+
+def make_train_step(model: AlarconCNN1D, tx: optax.GradientTransformation):
+    """One optimizer step on one masked batch. Pure; jit/vmap/shard-safe."""
+
+    def train_step(state: TrainState, xb, yb, mask, dropout_rng):
+        def loss_fn(params):
+            variables = {"params": params, "batch_stats": state.batch_stats}
+            logits, mutated = model.apply(
+                variables, xb, mode="train",
+                rngs={"dropout": dropout_rng}, mutable=["batch_stats"],
+            )
+            loss = masked_bce_with_logits(logits, yb, mask)
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        return (
+            TrainState(
+                params=optax.apply_updates(state.params, updates),
+                batch_stats=new_stats,
+                opt_state=new_opt,
+                step=state.step + 1,
+            ),
+            loss,
+        )
+
+    return train_step
+
+
+def _pad_perm(key, n: int, batch_size: int, shuffle: bool):
+    """Permutation of [0,n) padded to a whole number of batches + mask.
+
+    Padding wraps around the permutation (distinct real windows, not
+    repeats of one sample) so the final batch's BatchNorm statistics stay
+    representative; padded rows are still masked out of the loss.  (Keras
+    instead runs a smaller final batch — impossible under static shapes.)
+    """
+    steps = -(-n // batch_size)
+    total = steps * batch_size
+    perm = jax.random.permutation(key, n) if shuffle else jnp.arange(n)
+    perm = perm.astype(jnp.int32)
+    idx = jnp.take(perm, jnp.arange(total) % n, axis=0).reshape(steps, batch_size)
+    mask = (jnp.arange(total) < n).astype(jnp.float32).reshape(steps, batch_size)
+    return idx, mask
+
+
+@partial(jax.jit, static_argnames=("model", "tx", "batch_size", "shuffle"))
+def _epoch_jit(model, tx, state, x, y, key, batch_size, shuffle):
+    """One full training epoch as a scan over batches. Returns (state, mean_loss)."""
+    train_step = make_train_step(model, tx)
+    n = x.shape[0]
+    shuffle_key, dropout_key = jax.random.split(key)
+    idx, mask = _pad_perm(shuffle_key, n, batch_size, shuffle)
+
+    def body(state, inputs):
+        batch_idx, batch_mask, step_i = inputs
+        xb = jnp.take(x, batch_idx, axis=0)
+        yb = jnp.take(y, batch_idx, axis=0)
+        step_rng = jax.random.fold_in(dropout_key, step_i)
+        state, loss = train_step(state, xb, yb, batch_mask, step_rng)
+        return state, loss * jnp.sum(batch_mask)
+
+    steps = idx.shape[0]
+    state, losses = jax.lax.scan(body, state, (idx, mask, jnp.arange(steps)))
+    return state, jnp.sum(losses) / n
+
+
+@partial(jax.jit, static_argnames=("model", "batch_size"))
+def _eval_loss_jit(model, variables, x, y, batch_size):
+    """Mean inference-mode BCE over a dataset (validation loss)."""
+    n = x.shape[0]
+    steps = -(-n // batch_size)
+    total = steps * batch_size
+    pad = total - n
+    xp = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
+    yp = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)]) if pad else y
+    mask = (jnp.arange(total) < n).astype(jnp.float32)
+
+    def body(carry, inputs):
+        xb, yb, mb = inputs
+        logits, _ = apply_model(model, variables, xb, mode="eval")
+        loss = masked_bce_with_logits(logits, yb, mb)
+        return carry + loss * jnp.sum(mb), None
+
+    shape = lambda a: a.reshape((steps, batch_size) + a.shape[1:])
+    total_loss, _ = jax.lax.scan(
+        body, jnp.zeros(()), (shape(xp), shape(yp), shape(mask))
+    )
+    return total_loss / n
+
+
+@partial(jax.jit, static_argnames=("model", "batch_size"))
+def _predict_jit(model, variables, x, batch_size):
+    n = x.shape[0]
+    steps = -(-n // batch_size)
+    pad = steps * batch_size - n
+    xp = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
+
+    def body(_, xb):
+        logits, _ = apply_model(model, variables, xb, mode="eval")
+        return None, predict_proba(logits)
+
+    _, probs = jax.lax.scan(body, None, xp.reshape((steps, batch_size) + x.shape[1:]))
+    return probs.reshape(-1)[:n]
+
+
+def predict_proba_batched(model, variables, x, *, batch_size: int = 8192):
+    """Deterministic (eval-mode) probabilities, chunked over windows."""
+    return _predict_jit(model, variables, jnp.asarray(x, jnp.float32), batch_size)
+
+
+def fit(
+    model: AlarconCNN1D,
+    state: TrainState,
+    x_train,
+    y_train,
+    config: TrainConfig = TrainConfig(),
+    *,
+    tx: Optional[optax.GradientTransformation] = None,
+    rng: Optional[jax.Array] = None,
+    log_fn: Optional[Callable[[str], None]] = None,
+) -> FitResult:
+    """Train with validation-split early stopping; returns best-weight state."""
+    tx = tx if tx is not None else make_optimizer(config.learning_rate)
+    if rng is None:
+        rng = prng.stream(prng.seed_key(config.seed), prng.STREAM_SHUFFLE)
+
+    x = jnp.asarray(x_train, jnp.float32)
+    y = jnp.asarray(y_train, jnp.float32)
+    n = x.shape[0]
+    n_val = int(round(n * config.validation_split))
+    # Keras validation_split takes the TAIL of the data, pre-shuffle.
+    if n_val > 0:
+        x, x_val = x[: n - n_val], x[n - n_val :]
+        y, y_val = y[: n - n_val], y[n - n_val :]
+    else:
+        x_val = y_val = None
+
+    history: Dict[str, List[float]] = {"loss": [], "val_loss": []}
+    best_val = np.inf
+    best_epoch = -1
+    best_params = state.params
+    best_stats = state.batch_stats
+    patience_left = config.early_stopping_patience
+    stopped_early = False
+
+    for epoch in range(config.num_epochs):
+        epoch_key = jax.random.fold_in(rng, epoch)
+        state, train_loss = _epoch_jit(
+            model, tx, state, x, y, epoch_key, config.batch_size, config.shuffle
+        )
+        history["loss"].append(float(train_loss))
+
+        if x_val is not None:
+            val_loss = float(
+                _eval_loss_jit(model, state.variables(), x_val, y_val, config.batch_size)
+            )
+            history["val_loss"].append(val_loss)
+            if log_fn:
+                log_fn(f"epoch {epoch + 1}/{config.num_epochs} "
+                       f"loss={float(train_loss):.4f} val_loss={val_loss:.4f}")
+            if val_loss < best_val:
+                best_val = val_loss
+                best_epoch = epoch
+                best_params = state.params
+                best_stats = state.batch_stats
+                patience_left = config.early_stopping_patience
+            else:
+                patience_left -= 1
+                if patience_left <= 0:
+                    stopped_early = True
+                    break
+        else:
+            if log_fn:
+                log_fn(f"epoch {epoch + 1}/{config.num_epochs} loss={float(train_loss):.4f}")
+            best_epoch = epoch
+
+    if x_val is not None and config.restore_best_weights and best_epoch >= 0:
+        state = state.replace(params=best_params, batch_stats=best_stats)
+
+    return FitResult(
+        state=state, history=history, best_epoch=best_epoch, stopped_early=stopped_early
+    )

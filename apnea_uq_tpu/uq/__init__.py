@@ -1,0 +1,16 @@
+from apnea_uq_tpu.uq.bootstrap import (
+    bootstrap_aggregates,
+    bootstrap_metrics,
+    compute_confidence_intervals,
+)
+from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
+from apnea_uq_tpu.uq.predict import ensemble_predict, mc_dropout_predict
+
+__all__ = [
+    "uq_evaluation_dist",
+    "bootstrap_aggregates",
+    "bootstrap_metrics",
+    "compute_confidence_intervals",
+    "mc_dropout_predict",
+    "ensemble_predict",
+]

@@ -1,0 +1,107 @@
+"""On-device uncertainty metric engine.
+
+Computes, from a (K, M) matrix of positive-class probabilities (K = MC
+passes or ensemble members, M = windows), the full decomposition the
+reference produces in host NumPy (uq_techniques.py:40-112):
+
+- per-window mean probability and predictive variance,
+- **total** uncertainty  H[E[p]]  (entropy of the mean),
+- **aleatoric** proxy    E[H[p]]  (mean of per-pass entropies),
+- **epistemic** proxy    MI = max(H[E[p]] - E[H[p]], 0),
+- overall and per-true-class mean variance.
+
+The reference computes E[H[p]] with a Python loop over passes
+(uq_techniques.py:83-87); here it is one fused reduction under ``jit``.
+Entropy base is explicit ('nats' matches uq_techniques.py:38; 'bits'
+matches analyze_mcd_patient_level.py:114-115 — the reference silently uses
+both).  Note the reference's inline comments at uq_techniques.py:75-81
+mislabel total/aleatoric; the code (and this module) implement the
+standard decomposition, matching the reference's returned key names.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from apnea_uq_tpu.ops.entropy import binary_entropy
+
+
+@partial(jax.jit, static_argnames=("base",))
+def _uq_core(predictions: jax.Array, y_true: jax.Array, base: str, eps: float) -> Dict[str, jax.Array]:
+    predictions = predictions.astype(jnp.float32)
+    mean_pred = jnp.mean(predictions, axis=0)          # (M,)
+    pred_variance = jnp.var(predictions, axis=0)       # (M,) population variance, np.var parity
+    total = binary_entropy(mean_pred, base=base, eps=eps)               # H[E[p]]
+    aleatoric = jnp.mean(binary_entropy(predictions, base=base, eps=eps), axis=0)  # E[H[p]]
+    mutual_info = jnp.maximum(total - aleatoric, 0.0)  # uq_techniques.py:91
+
+    y = y_true.astype(jnp.int32)
+    mask0 = (y == 0).astype(jnp.float32)
+    mask1 = (y == 1).astype(jnp.float32)
+    n0 = jnp.sum(mask0)
+    n1 = jnp.sum(mask1)
+    # Empty-class guard -> 0.0, matching uq_techniques.py:100-101.
+    mv0 = jnp.where(n0 > 0, jnp.sum(pred_variance * mask0) / jnp.maximum(n0, 1.0), 0.0)
+    mv1 = jnp.where(n1 > 0, jnp.sum(pred_variance * mask1) / jnp.maximum(n1, 1.0), 0.0)
+
+    return {
+        "mean_pred": mean_pred,
+        "pred_variance": pred_variance,
+        "total_pred_entropy": total,
+        "expected_aleatoric_entropy": aleatoric,
+        "mutual_info": mutual_info,
+        "overall_mean_variance": jnp.mean(pred_variance),
+        "mean_variance_class_0": mv0,
+        "mean_variance_class_1": mv1,
+    }
+
+
+def uq_evaluation_dist(
+    predictions,
+    y_true,
+    *,
+    base: str = "nats",
+    eps: float = 1e-10,
+) -> Dict[str, jax.Array]:
+    """UQ metric suite from a (K, M) (or (K, M, 1) / (M,)) prediction stack.
+
+    Degenerate-input handling mirrors uq_techniques.py:61-66: trailing
+    singleton dims are squeezed and a 1-D input is treated as a single
+    pass (variance and MI collapse to zero).
+    """
+    predictions = jnp.asarray(predictions)
+    # Squeeze ONLY a trailing singleton output axis of a (K, M, 1) stack —
+    # a blanket squeeze would misread a (K, 1) single-window stack as
+    # (1, K).  Mirrors evaluate_uq_methods' dimension handling
+    # (uq_techniques.py:316-319).
+    if predictions.ndim == 3 and predictions.shape[-1] == 1:
+        predictions = predictions[..., 0]
+    if predictions.ndim == 1:
+        predictions = predictions[None, :]
+    if predictions.ndim != 2:
+        raise ValueError(f"expected (K, M) predictions, got shape {predictions.shape}")
+    y_true = jnp.asarray(y_true)
+    if y_true.shape[0] != predictions.shape[1]:
+        raise ValueError(
+            f"labels ({y_true.shape[0]}) do not match prediction windows "
+            f"({predictions.shape[1]})"
+        )
+    return _uq_core(predictions, y_true, base, eps)
+
+
+def per_window_frame(metrics: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """The per-window vectors of the metric dict (for CSV emission)."""
+    return {
+        k: metrics[k]
+        for k in (
+            "mean_pred",
+            "pred_variance",
+            "total_pred_entropy",
+            "expected_aleatoric_entropy",
+            "mutual_info",
+        )
+    }

@@ -1,0 +1,132 @@
+"""MC-Dropout and Deep-Ensemble prediction, TPU-first.
+
+Reference behavior being replaced (SURVEY §3.3/3.4 hot loops):
+
+- ``mc_dropout_predict``: a Python loop of T=50 full-test-set Keras calls
+  with ``training=True`` (uq_techniques.py:22) — the whole test set as one
+  batch per pass.
+- ``deep_ensembles_predict``: N sequential full-set ``model.predict`` calls
+  (uq_techniques.py:29-30).
+
+Here both are a single jitted program: ``vmap`` over dropout RNG keys (or
+over a stacked member-parameter axis) inside, ``lax.map`` over fixed-size
+window chunks outside so HBM holds one chunk of activations at a time.
+The T (or N) axis rides the batch dimension of every conv, keeping the MXU
+fed with one large fused computation instead of T small ones.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
+
+_MCD_MODES = {"clean": "mcd_clean", "parity": "mcd_parity"}
+
+
+def _chunk(x: jax.Array, batch_size: int):
+    """Pad to a multiple of batch_size and reshape to (chunks, bs, ...).
+
+    Padding wraps around the real windows (modular gather) rather than
+    zero-filling: in 'parity' mode BatchNorm uses batch statistics, and
+    zero rows in the final chunk would drag the statistics toward zero
+    and corrupt the real windows sharing that chunk.
+    """
+    m = x.shape[0]
+    n_chunks = -(-m // batch_size)
+    pad = n_chunks * batch_size - m
+    if pad:
+        x = jnp.take(x, jnp.arange(n_chunks * batch_size) % m, axis=0)
+    return x.reshape((n_chunks, batch_size) + x.shape[1:]), m
+
+
+@partial(jax.jit, static_argnames=("model", "n_passes", "mode", "batch_size"))
+def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size):
+    keys = jax.random.split(key, n_passes)
+    chunks, m = _chunk(x, batch_size)
+
+    def one_chunk(chunk):
+        def one_pass(k):
+            logits, _ = apply_model(model, variables, chunk, mode=mode, dropout_rng=k)
+            return predict_proba(logits)
+
+        return jax.vmap(one_pass)(keys)  # (T, bs)
+
+    probs = jax.lax.map(one_chunk, chunks)            # (chunks, T, bs)
+    probs = jnp.transpose(probs, (1, 0, 2)).reshape(n_passes, -1)
+    return probs[:, :m]
+
+
+def mc_dropout_predict(
+    model: AlarconCNN1D,
+    variables: dict,
+    x,
+    *,
+    n_passes: int = 50,
+    mode: str = "clean",
+    batch_size: int = 8192,
+    key: Optional[jax.Array] = None,
+    seed: int = 0,
+) -> jax.Array:
+    """(T, M) positive-class probabilities from T stochastic passes.
+
+    ``mode='parity'`` reproduces the reference's ``training=True`` regime
+    (dropout + batch-statistics BatchNorm, uq_techniques.py:22).  Note that
+    in parity mode batch statistics are computed per ``batch_size`` chunk;
+    the reference used the entire test set as one batch, so pass
+    ``batch_size >= len(x)`` for exact parity of that detail.
+    ``mode='clean'`` freezes BatchNorm at running statistics (standard MC
+    Dropout; SURVEY §6).
+    """
+    if mode not in _MCD_MODES:
+        raise ValueError(f"mode must be 'clean' or 'parity', got {mode!r}")
+    if key is None:
+        key = jax.random.key(seed)
+    x = jnp.asarray(x, jnp.float32)
+    return _mcd_jit(model, variables, x, key, n_passes, _MCD_MODES[mode], batch_size)
+
+
+def stack_member_variables(member_variables: list) -> dict:
+    """Stack per-member variable pytrees along a leading member axis."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves, axis=0), *member_variables)
+
+
+@partial(jax.jit, static_argnames=("model", "batch_size"))
+def _ensemble_jit(model, stacked_variables, x, batch_size):
+    chunks, m = _chunk(x, batch_size)
+
+    def one_chunk(chunk):
+        def one_member(member_vars):
+            logits, _ = apply_model(model, member_vars, chunk, mode="eval")
+            return predict_proba(logits)
+
+        return jax.vmap(one_member)(stacked_variables)  # (N, bs)
+
+    probs = jax.lax.map(one_chunk, chunks)              # (chunks, N, bs)
+    n_members = probs.shape[1]
+    probs = jnp.transpose(probs, (1, 0, 2)).reshape(n_members, -1)
+    return probs[:, :m]
+
+
+def ensemble_predict(
+    model: AlarconCNN1D,
+    member_variables,
+    x,
+    *,
+    batch_size: int = 8192,
+) -> jax.Array:
+    """(N, M) deterministic probabilities from N ensemble members.
+
+    ``member_variables`` is either a list of per-member variable pytrees or
+    an already-stacked pytree with a leading member axis.  Members are
+    vmapped — one batched program instead of the reference's N sequential
+    ``model.predict`` calls (uq_techniques.py:29-30).
+    """
+    if isinstance(member_variables, (list, tuple)):
+        member_variables = stack_member_variables(list(member_variables))
+    x = jnp.asarray(x, jnp.float32)
+    return _ensemble_jit(model, member_variables, x, batch_size)

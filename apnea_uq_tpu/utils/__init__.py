@@ -1,0 +1,4 @@
+from apnea_uq_tpu.utils.prng import member_key, seed_key
+from apnea_uq_tpu.utils.timing import Timer
+
+__all__ = ["seed_key", "member_key", "Timer"]

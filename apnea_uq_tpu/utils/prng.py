@@ -1,0 +1,36 @@
+"""Deterministic PRNG handling.
+
+The reference reseeds global TF/NumPy state per run / per ensemble member
+(cnn_baseline_train.py:138-139, train_deep_ensemble_cnns.py:139-140).  JAX
+keys are explicit; we derive every stream from one root key by folding in
+well-known stream ids, so member i's initialization, shuffling, and dropout
+streams are independent and reproducible regardless of execution order.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Stream ids folded into derived keys.  Arbitrary but fixed constants.
+STREAM_INIT = 0x1A17
+STREAM_SHUFFLE = 0x5487
+STREAM_DROPOUT = 0xD209
+STREAM_BOOTSTRAP = 0xB007
+STREAM_SMOTE = 0x5707E
+STREAM_RUS = 0x4125
+
+
+def seed_key(seed: int) -> jax.Array:
+    """Root key for a run."""
+    return jax.random.key(seed)
+
+
+def member_key(root: jax.Array, member: int) -> jax.Array:
+    """Per-ensemble-member key (reference: per-member seed 2025+i,
+    train_deep_ensemble_cnns.py:126)."""
+    return jax.random.fold_in(root, member)
+
+
+def stream(root: jax.Array, stream_id: int) -> jax.Array:
+    """Named sub-stream of a key."""
+    return jax.random.fold_in(root, stream_id)

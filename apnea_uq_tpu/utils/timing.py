@@ -1,0 +1,51 @@
+"""Wall-clock timing and optional device profiling.
+
+Replaces the reference's ad-hoc ``time.time()`` prints
+(uq_techniques.py:21-23,28-31,339,347) with a reusable context manager that
+blocks on device work (``block_until_ready``) so timings measure compute,
+not dispatch, and can optionally wrap a ``jax.profiler`` trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Optional
+
+import jax
+
+
+class Timer:
+    """Context-manager timer: ``with Timer("mcd") as t: ...; t.elapsed_s``."""
+
+    def __init__(self, name: str = "", verbose: bool = False):
+        self.name = name
+        self.verbose = verbose
+        self.elapsed_s: float = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed_s = time.perf_counter() - self._start
+        if self.verbose:
+            print(f"[{self.name}] {self.elapsed_s:.3f}s")
+
+
+def block(tree: Any) -> Any:
+    """Block until every array in a pytree is computed; returns the tree."""
+    return jax.block_until_ready(tree)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]):
+    """Wrap a block in a jax.profiler trace when ``log_dir`` is set."""
+    if log_dir is None:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,54 @@
+"""Test configuration: run everything on a virtual 8-device CPU platform.
+
+Multi-chip sharding (ensemble/data mesh axes) is exercised without TPU
+hardware via XLA's host-platform device-count override, per SURVEY §4's
+test-strategy gap analysis.  Must run before the first jax import.
+"""
+
+import os
+
+# Must happen before any backend is initialized.  Note the dev image's
+# sitecustomize imports jax and force-registers a TPU-tunnel ("axon")
+# platform at interpreter boot with JAX_PLATFORMS=axon in the environment,
+# so a plain setdefault is not enough: override the env var AND the
+# already-loaded config, and only then is the (lazy) backend selection
+# guaranteed to build the 8-device virtual CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(2025)
+
+
+@pytest.fixture(scope="session")
+def tiny_model():
+    """A small config of the same architecture for fast tests."""
+    from apnea_uq_tpu.config import ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D
+
+    cfg = ModelConfig(
+        features=(8, 12, 8),
+        kernel_sizes=(5, 3, 3),
+        dropout_rates=(0.3, 0.4, 0.5),
+    )
+    return AlarconCNN1D(cfg)
+
+
+@pytest.fixture(scope="session")
+def full_model():
+    from apnea_uq_tpu.models import AlarconCNN1D
+
+    return AlarconCNN1D()

@@ -1,0 +1,73 @@
+"""Vectorized bootstrap: statistical correctness and CI machinery
+(replacing uq_techniques.py:116-206)."""
+
+import numpy as np
+
+from apnea_uq_tpu.uq import (
+    bootstrap_aggregates,
+    bootstrap_metrics,
+    compute_confidence_intervals,
+)
+from apnea_uq_tpu.uq.bootstrap import AGGREGATE_KEYS
+from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
+
+
+def test_shapes_and_keys(rng):
+    preds = rng.uniform(0.1, 0.9, size=(10, 200))
+    y = rng.integers(0, 2, 200)
+    agg = bootstrap_aggregates(preds, y, n_bootstrap=37, seed=0)
+    assert set(agg.keys()) == set(AGGREGATE_KEYS)
+    for v in agg.values():
+        assert v.shape == (37,)
+
+
+def test_bootstrap_mean_tracks_point_estimate(rng):
+    """Bootstrap distribution of a mean must center on the sample mean."""
+    preds = rng.uniform(0.1, 0.9, size=(20, 2000))
+    y = rng.integers(0, 2, 2000)
+    agg = bootstrap_aggregates(preds, y, n_bootstrap=400, seed=1)
+    point = uq_evaluation_dist(preds, y)
+    sample_mean = float(point["overall_mean_variance"])
+    boot_mean = float(np.mean(np.asarray(agg["overall_mean_variance"])))
+    boot_std = float(np.std(np.asarray(agg["overall_mean_variance"])))
+    assert abs(boot_mean - sample_mean) < 4 * boot_std / np.sqrt(400) + 1e-6
+    # spread must be of order sigma/sqrt(M)
+    per_window_var = np.asarray(point["pred_variance"])
+    expected_se = per_window_var.std() / np.sqrt(2000)
+    assert 0.5 * expected_se < boot_std < 2.0 * expected_se
+
+
+def test_confidence_intervals_ordering(rng):
+    preds = rng.uniform(0.1, 0.9, size=(10, 500))
+    y = rng.integers(0, 2, 500)
+    agg = bootstrap_aggregates(preds, y, n_bootstrap=100, seed=2)
+    cis = compute_confidence_intervals(agg, alpha=0.05)
+    for k in AGGREGATE_KEYS:
+        lo, mean, hi = cis[f"{k}_ci_lower"], cis[f"{k}_mean"], cis[f"{k}_ci_upper"]
+        assert lo <= mean <= hi
+
+
+def test_reference_shaped_api(rng):
+    """bootstrap_metrics returns the reference's list-of-dicts shape
+    (uq_techniques.py:116-172) and flows into compute_confidence_intervals."""
+    preds = rng.uniform(0.1, 0.9, size=(5, 100))
+    y = rng.integers(0, 2, 100)
+    results = bootstrap_metrics(preds, y, n_bootstrap=12, random_state=3)
+    assert isinstance(results, list) and len(results) == 12
+    assert set(results[0].keys()) == set(AGGREGATE_KEYS)
+    cis = compute_confidence_intervals(results)
+    assert f"{AGGREGATE_KEYS[0]}_mean" in cis
+
+
+def test_deterministic_given_seed(rng):
+    preds = rng.uniform(0.1, 0.9, size=(5, 100))
+    y = rng.integers(0, 2, 100)
+    a = bootstrap_aggregates(preds, y, n_bootstrap=10, seed=7)
+    b = bootstrap_aggregates(preds, y, n_bootstrap=10, seed=7)
+    for k in AGGREGATE_KEYS:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+def test_empty_results_ci():
+    assert compute_confidence_intervals([]) == {}
+    assert compute_confidence_intervals({}) == {}

@@ -1,0 +1,106 @@
+"""Classification metric suite vs scikit-learn (ground truth oracle).
+
+sklearn is available in the dev image and used ONLY as a test oracle; the
+framework's runtime implementations are first-party
+(apnea_uq_tpu/evaluation/classification.py).
+"""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from apnea_uq_tpu.evaluation import (
+    average_precision,
+    classification_report_dict,
+    cohen_kappa,
+    confusion_matrix_2x2,
+    evaluate_classification,
+    matthews_corrcoef,
+    roc_auc,
+)
+
+
+@pytest.fixture
+def data(rng):
+    probs = rng.uniform(size=1000)
+    y = (rng.uniform(size=1000) < probs * 0.8 + 0.1).astype(int)
+    return y, probs, (probs >= 0.5).astype(int)
+
+
+def test_roc_auc_matches_sklearn(data):
+    y, probs, _ = data
+    assert roc_auc(y, probs) == pytest.approx(skm.roc_auc_score(y, probs), abs=1e-10)
+
+
+def test_roc_auc_with_ties(rng):
+    probs = rng.integers(0, 5, 500) / 4.0  # heavy ties
+    y = rng.integers(0, 2, 500)
+    assert roc_auc(y, probs) == pytest.approx(skm.roc_auc_score(y, probs), abs=1e-10)
+
+
+def test_average_precision_matches_sklearn(data):
+    y, probs, _ = data
+    assert average_precision(y, probs) == pytest.approx(
+        skm.average_precision_score(y, probs), abs=1e-10
+    )
+
+
+def test_average_precision_with_ties(rng):
+    probs = rng.integers(0, 8, 600) / 7.0
+    y = rng.integers(0, 2, 600)
+    assert average_precision(y, probs) == pytest.approx(
+        skm.average_precision_score(y, probs), abs=1e-10
+    )
+
+
+def test_kappa_mcc_match_sklearn(data):
+    y, _, pred = data
+    assert cohen_kappa(y, pred) == pytest.approx(skm.cohen_kappa_score(y, pred), abs=1e-10)
+    assert matthews_corrcoef(y, pred) == pytest.approx(
+        skm.matthews_corrcoef(y, pred), abs=1e-10
+    )
+
+
+def test_confusion_matrix(data):
+    y, _, pred = data
+    np.testing.assert_array_equal(
+        confusion_matrix_2x2(y, pred), skm.confusion_matrix(y, pred, labels=[0, 1])
+    )
+
+
+def test_confusion_matrix_single_class_padded():
+    """2x2 padding when a class is absent (evaluate_classification.py:94-114)."""
+    cm = confusion_matrix_2x2([0, 0, 0], [0, 0, 1])
+    assert cm.shape == (2, 2)
+    assert cm[0, 0] == 2 and cm[0, 1] == 1 and cm[1, :].sum() == 0
+
+
+def test_report_matches_sklearn(data):
+    y, _, pred = data
+    ours = classification_report_dict(y, pred)
+    theirs = skm.classification_report(y, pred, output_dict=True, zero_division=0)
+    for cls in ("0", "1", "macro avg", "weighted avg"):
+        for k in ("precision", "recall", "f1-score", "support"):
+            assert ours[cls][k] == pytest.approx(theirs[cls][k], abs=1e-10), (cls, k)
+    assert ours["accuracy"] == pytest.approx(theirs["accuracy"], abs=1e-10)
+
+
+def test_single_class_auc_guard():
+    """ROC/PR AUC unavailable for single-class y (evaluate_classification.py:77-86)."""
+    y = np.zeros(10, int)
+    probs = np.linspace(0, 1, 10)
+    assert roc_auc(y, probs) is None
+    assert average_precision(y, probs) is None
+    res = evaluate_classification(probs, y, description="single class")
+    assert res["roc_auc"] is None and res["pr_auc"] is None
+    assert 0 <= res["accuracy"] <= 1
+
+
+def test_evaluate_classification_surface(data):
+    y, probs, pred = data
+    res = evaluate_classification(probs, y, description="test", verbose=False)
+    assert res["accuracy"] == pytest.approx(skm.accuracy_score(y, pred), abs=1e-12)
+    cm = res["confusion_matrix"]
+    tn, fp, fn, tp = cm[0, 0], cm[0, 1], cm[1, 0], cm[1, 1]
+    assert res["sensitivity"] == pytest.approx(tp / (tp + fn))
+    assert res["specificity"] == pytest.approx(tn / (tn + fp))

@@ -1,0 +1,125 @@
+"""Model contract tests: shapes, parameter count, mode semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apnea_uq_tpu.config import ModelConfig
+from apnea_uq_tpu.models import (
+    AlarconCNN1D,
+    apply_model,
+    init_variables,
+    param_count,
+    predict_proba,
+)
+
+
+def test_output_shape(tiny_model):
+    variables = init_variables(tiny_model, jax.random.key(0))
+    x = jnp.zeros((7, 60, 4))
+    logits, _ = apply_model(tiny_model, variables, x, mode="eval")
+    assert logits.shape == (7,)
+    assert logits.dtype == jnp.float32
+
+
+def test_param_count_matches_reference(full_model):
+    """~853K params per the reference architecture
+    (cnn_baseline_train.py:59-94; SURVEY C3 says ~853K total / 851K trainable).
+    Keras counts BN moving statistics as non-trainable params; Flax stores
+    them in batch_stats.  Trainable params must match exactly."""
+    variables = init_variables(full_model, jax.random.key(0))
+    trainable = param_count(variables)
+    # Conv stack: (4*7+1)*128 + (128*5+1)*192 + (192*3+1)*224 + (224*7+1)*96
+    #             + (96*9+1)*256 + (256*9+1)*96 ; BN gamma+beta: 2*sum(features)
+    # Head: 96+1
+    expected_conv = (
+        (4 * 7 + 1) * 128
+        + (128 * 5 + 1) * 192
+        + (192 * 3 + 1) * 224
+        + (224 * 7 + 1) * 96
+        + (96 * 9 + 1) * 256
+        + (256 * 9 + 1) * 96
+    )
+    expected_bn = 2 * (128 + 192 + 224 + 96 + 256 + 96)
+    expected_head = 96 + 1
+    assert trainable == expected_conv + expected_bn + expected_head
+    assert 840_000 < trainable < 860_000
+
+
+def test_eval_is_deterministic(tiny_model):
+    variables = init_variables(tiny_model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (5, 60, 4))
+    l1, _ = apply_model(tiny_model, variables, x, mode="eval")
+    l2, _ = apply_model(tiny_model, variables, x, mode="eval")
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def test_dropout_modes_are_stochastic(tiny_model):
+    variables = init_variables(tiny_model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (5, 60, 4))
+    for mode in ("mcd_clean", "mcd_parity"):
+        la, _ = apply_model(tiny_model, variables, x, mode=mode,
+                            dropout_rng=jax.random.key(10))
+        lb, _ = apply_model(tiny_model, variables, x, mode=mode,
+                            dropout_rng=jax.random.key(11))
+        assert not np.allclose(np.asarray(la), np.asarray(lb)), mode
+
+
+def test_same_dropout_key_reproduces(tiny_model):
+    variables = init_variables(tiny_model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (5, 60, 4))
+    la, _ = apply_model(tiny_model, variables, x, mode="mcd_clean",
+                        dropout_rng=jax.random.key(7))
+    lb, _ = apply_model(tiny_model, variables, x, mode="mcd_clean",
+                        dropout_rng=jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_mcd_clean_vs_parity_differ_on_shifted_batch(tiny_model):
+    """mcd_parity normalizes with batch statistics, mcd_clean with running
+    statistics — a batch with shifted distribution must produce different
+    outputs between modes (the ~88%% vs ~77%% regime split, SURVEY §6)."""
+    variables = init_variables(tiny_model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (64, 60, 4)) * 3.0 + 5.0
+    key = jax.random.key(3)
+    l_clean, _ = apply_model(tiny_model, variables, x, mode="mcd_clean", dropout_rng=key)
+    l_parity, _ = apply_model(tiny_model, variables, x, mode="mcd_parity", dropout_rng=key)
+    assert not np.allclose(np.asarray(l_clean), np.asarray(l_parity))
+
+
+def test_train_mode_updates_batch_stats(tiny_model):
+    variables = init_variables(tiny_model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (32, 60, 4)) + 2.0
+    _, new_stats = apply_model(
+        tiny_model, variables, x, mode="train",
+        dropout_rng=jax.random.key(2), update_batch_stats=True,
+    )
+    old_flat = jax.tree.leaves(variables["batch_stats"])
+    new_flat = jax.tree.leaves(new_stats)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(old_flat, new_flat)
+    )
+
+
+def test_parity_mode_discards_batch_stats(tiny_model):
+    variables = init_variables(tiny_model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (32, 60, 4)) + 2.0
+    _, stats = apply_model(tiny_model, variables, x, mode="mcd_parity",
+                           dropout_rng=jax.random.key(2))
+    for a, b in zip(jax.tree.leaves(variables["batch_stats"]), jax.tree.leaves(stats)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bfloat16_compute(tiny_model):
+    cfg = ModelConfig(
+        features=(8, 8), kernel_sizes=(3, 3), dropout_rates=(0.1, 0.1),
+        compute_dtype="bfloat16",
+    )
+    model = AlarconCNN1D(cfg)
+    variables = init_variables(model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (4, 60, 4))
+    logits, _ = apply_model(model, variables, x, mode="eval")
+    assert logits.dtype == jnp.float32  # output promoted back
+    probs = predict_proba(logits)
+    assert np.all((np.asarray(probs) >= 0) & (np.asarray(probs) <= 1))

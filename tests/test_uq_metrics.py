@@ -1,0 +1,113 @@
+"""UQ metric engine: closed-form values, decomposition properties, and
+numerical parity with a NumPy/SciPy re-derivation of the reference math
+(uq_techniques.py:40-112)."""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+from apnea_uq_tpu.ops.entropy import binary_entropy
+from apnea_uq_tpu.uq import uq_evaluation_dist
+
+
+def reference_uq(predictions, y_true, eps=1e-10):
+    """Host re-derivation of the reference metric block for parity checks."""
+    mean_pred = predictions.mean(axis=0)
+    pred_var = predictions.var(axis=0)
+    mp = np.clip(np.stack([1 - mean_pred, mean_pred], -1), eps, 1 - eps)
+    total = scipy.stats.entropy(mp, axis=1)
+    ents = []
+    for p in predictions:
+        pp = np.clip(np.stack([1 - p, p], -1), eps, 1 - eps)
+        ents.append(scipy.stats.entropy(pp, axis=1))
+    aleatoric = np.mean(ents, axis=0)
+    mi = np.maximum(total - aleatoric, 0)
+    return mean_pred, pred_var, total, aleatoric, mi
+
+
+def test_binary_entropy_closed_form():
+    assert float(binary_entropy(0.5, base="nats")) == pytest.approx(np.log(2), rel=1e-6)
+    assert float(binary_entropy(0.5, base="bits")) == pytest.approx(1.0, rel=1e-6)
+    assert float(binary_entropy(0.0)) == pytest.approx(0.0, abs=1e-8)
+    assert float(binary_entropy(1.0)) == pytest.approx(0.0, abs=1e-8)
+    # symmetry
+    assert float(binary_entropy(0.2)) == pytest.approx(float(binary_entropy(0.8)), rel=1e-6)
+
+
+def test_parity_with_reference_math(rng):
+    preds = rng.uniform(0.01, 0.99, size=(50, 400))
+    y = (rng.uniform(size=400) > 0.7).astype(int)
+    m = uq_evaluation_dist(preds, y)
+    mean_pred, var, total, ale, mi = reference_uq(preds, y)
+    np.testing.assert_allclose(np.asarray(m["mean_pred"]), mean_pred, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m["pred_variance"]), var, rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(m["total_pred_entropy"]), total, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m["expected_aleatoric_entropy"]), ale, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m["mutual_info"]), mi, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        float(m["mean_variance_class_0"]), var[y == 0].mean(), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(m["mean_variance_class_1"]), var[y == 1].mean(), rtol=1e-5
+    )
+
+
+def test_decomposition_identity(rng):
+    """total = aleatoric + MI whenever MI >= 0 pre-clamp (Jensen: H[E[p]] >= E[H[p]])."""
+    preds = rng.uniform(0.05, 0.95, size=(20, 300))
+    y = rng.integers(0, 2, 300)
+    m = uq_evaluation_dist(preds, y)
+    total = np.asarray(m["total_pred_entropy"])
+    ale = np.asarray(m["expected_aleatoric_entropy"])
+    mi = np.asarray(m["mutual_info"])
+    assert np.all(mi >= 0)
+    # Jensen's inequality for concave entropy: H[E[p]] >= E[H[p]], so the
+    # clamp should (numerics aside) never bite:
+    np.testing.assert_allclose(total, ale + mi, atol=1e-5)
+
+
+def test_single_pass_degenerate(rng):
+    """K=1: variance and MI must be exactly 0 (uq_techniques.py:63-66)."""
+    preds = rng.uniform(0.1, 0.9, size=300)
+    y = rng.integers(0, 2, 300)
+    m = uq_evaluation_dist(preds, y)
+    np.testing.assert_allclose(np.asarray(m["pred_variance"]), 0.0, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(m["mutual_info"]), 0.0, atol=1e-6)
+
+
+def test_trailing_singleton_squeezed(rng):
+    preds = rng.uniform(0.1, 0.9, size=(5, 100, 1))
+    y = rng.integers(0, 2, 100)
+    m = uq_evaluation_dist(preds, y)
+    assert m["mean_pred"].shape == (100,)
+
+
+def test_empty_class_guard(rng):
+    preds = rng.uniform(0.1, 0.9, size=(5, 50))
+    y = np.zeros(50, int)  # no positive windows
+    m = uq_evaluation_dist(preds, y)
+    assert float(m["mean_variance_class_1"]) == 0.0
+    assert float(m["mean_variance_class_0"]) > 0.0
+
+
+def test_identical_passes_zero_epistemic(rng):
+    p = rng.uniform(0.1, 0.9, size=200)
+    preds = np.tile(p, (30, 1))
+    y = rng.integers(0, 2, 200)
+    m = uq_evaluation_dist(preds, y)
+    np.testing.assert_allclose(np.asarray(m["pred_variance"]), 0.0, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(m["mutual_info"]), 0.0, atol=1e-5)
+
+
+def test_label_mismatch_raises(rng):
+    with pytest.raises(ValueError):
+        uq_evaluation_dist(rng.uniform(size=(5, 10)), np.zeros(11))
+
+
+def test_bits_vs_nats():
+    preds = np.full((3, 4), 0.5)
+    y = np.zeros(4, int)
+    nats = uq_evaluation_dist(preds, y, base="nats")
+    bits = uq_evaluation_dist(preds, y, base="bits")
+    np.testing.assert_allclose(np.asarray(nats["total_pred_entropy"]), np.log(2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(bits["total_pred_entropy"]), 1.0, rtol=1e-6)

@@ -1,0 +1,87 @@
+"""MC-Dropout / ensemble prediction: shapes, chunking, mode semantics."""
+
+import jax
+import numpy as np
+
+from apnea_uq_tpu.config import ModelConfig
+from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+from apnea_uq_tpu.training import predict_proba_batched
+from apnea_uq_tpu.uq import ensemble_predict, mc_dropout_predict
+from apnea_uq_tpu.uq.predict import stack_member_variables
+
+
+def _tiny():
+    return AlarconCNN1D(ModelConfig(
+        features=(8, 8), kernel_sizes=(5, 3), dropout_rates=(0.3, 0.3)
+    ))
+
+
+def test_mcd_shape_and_range(rng):
+    model = _tiny()
+    variables = init_variables(model, jax.random.key(0))
+    x = rng.normal(size=(37, 60, 4)).astype(np.float32)
+    probs = np.asarray(
+        mc_dropout_predict(model, variables, x, n_passes=9, batch_size=16, seed=1)
+    )
+    assert probs.shape == (9, 37)
+    assert np.all((probs >= 0) & (probs <= 1))
+    # passes must differ (stochastic)
+    assert np.std(probs, axis=0).max() > 0
+
+
+def test_mcd_deterministic_given_key(rng):
+    model = _tiny()
+    variables = init_variables(model, jax.random.key(0))
+    x = rng.normal(size=(10, 60, 4)).astype(np.float32)
+    a = mc_dropout_predict(model, variables, x, n_passes=4, key=jax.random.key(3))
+    b = mc_dropout_predict(model, variables, x, n_passes=4, key=jax.random.key(3))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mcd_clean_chunking_statistical_equivalence(rng):
+    """Chunk size changes which dropout masks are drawn (masks are sampled
+    per chunk), but in clean mode (frozen BN) the *distribution* of MCD
+    outputs must not depend on chunking: per-window mean probabilities over
+    many passes must agree within Monte-Carlo error."""
+    model = _tiny()
+    variables = init_variables(model, jax.random.key(0))
+    x = rng.normal(size=(30, 60, 4)).astype(np.float32)
+    a = np.asarray(mc_dropout_predict(model, variables, x, n_passes=400,
+                                      batch_size=30, key=jax.random.key(5)))
+    b = np.asarray(mc_dropout_predict(model, variables, x, n_passes=400,
+                                      batch_size=7, key=jax.random.key(6)))
+    se = np.sqrt(a.var(axis=0) / 400 + b.var(axis=0) / 400) + 1e-4
+    assert np.all(np.abs(a.mean(axis=0) - b.mean(axis=0)) < 5 * se)
+
+
+def test_parity_mode_differs_from_clean(rng):
+    model = _tiny()
+    variables = init_variables(model, jax.random.key(0))
+    x = (rng.normal(size=(64, 60, 4)) * 2 + 3).astype(np.float32)
+    key = jax.random.key(5)
+    clean = np.asarray(mc_dropout_predict(model, variables, x, n_passes=3,
+                                          mode="clean", batch_size=64, key=key))
+    parity = np.asarray(mc_dropout_predict(model, variables, x, n_passes=3,
+                                           mode="parity", batch_size=64, key=key))
+    assert not np.allclose(clean, parity)
+
+
+def test_ensemble_predict_matches_sequential(rng):
+    """vmapped member axis == per-member eval-mode predictions."""
+    model = _tiny()
+    members = [init_variables(model, jax.random.key(i)) for i in range(3)]
+    x = rng.normal(size=(21, 60, 4)).astype(np.float32)
+    probs = np.asarray(ensemble_predict(model, members, x, batch_size=8))
+    assert probs.shape == (3, 21)
+    for i, mv in enumerate(members):
+        expected = np.asarray(predict_proba_batched(model, mv, x, batch_size=8))
+        np.testing.assert_allclose(probs[i], expected, rtol=2e-5, atol=1e-6)
+
+
+def test_stack_member_variables_roundtrip(rng):
+    model = _tiny()
+    members = [init_variables(model, jax.random.key(i)) for i in range(4)]
+    stacked = stack_member_variables(members)
+    leaf0 = jax.tree.leaves(members[0]["params"])[0]
+    stacked_leaf = jax.tree.leaves(stacked["params"])[0]
+    assert stacked_leaf.shape == (4,) + leaf0.shape
