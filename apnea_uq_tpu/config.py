@@ -168,6 +168,13 @@ def _to_jsonable(obj: Any) -> Any:
 
 
 def _from_dict(cls: type, data: dict) -> Any:
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(data) - known
+    if unknown:
+        raise ValueError(
+            f"unknown key(s) {sorted(unknown)} for {cls.__name__}; "
+            f"valid keys: {sorted(known)}"
+        )
     kwargs = {}
     for f in dataclasses.fields(cls):
         if f.name not in data:

@@ -56,6 +56,11 @@ class AlarconCNN1D(nn.Module):
         dtype = jnp.dtype(cfg.compute_dtype)
 
         x = x.astype(dtype)
+        if not (len(cfg.features) == len(cfg.kernel_sizes) == len(cfg.dropout_rates)):
+            raise ValueError(
+                "features / kernel_sizes / dropout_rates must have equal length, got "
+                f"{len(cfg.features)}/{len(cfg.kernel_sizes)}/{len(cfg.dropout_rates)}"
+            )
         for i, (feat, ksize, rate) in enumerate(
             zip(cfg.features, cfg.kernel_sizes, cfg.dropout_rates)
         ):

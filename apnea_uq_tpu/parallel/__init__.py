@@ -1,0 +1,10 @@
+from apnea_uq_tpu.parallel.mesh import make_mesh, member_sharding, data_sharding
+from apnea_uq_tpu.parallel.ensemble import EnsembleFitResult, fit_ensemble
+
+__all__ = [
+    "make_mesh",
+    "member_sharding",
+    "data_sharding",
+    "fit_ensemble",
+    "EnsembleFitResult",
+]

@@ -1,0 +1,243 @@
+"""Concurrent Deep-Ensemble training over a device mesh.
+
+The reference trains N members **sequentially** — a Python loop building a
+fresh Keras model per seed, fitting, saving, and freeing it
+(train_deep_ensemble_cnns.py:125-177; SURVEY §3.2).  Here all members
+train **simultaneously**: member-stacked parameters are ``vmap``-ed through
+the train step and sharded over the mesh's ``ensemble`` axis, so N members
+cost one member's wall-clock per device group.  Members differ only in
+their RNG streams (init + shuffle + dropout), exactly the reference's
+per-member-seed scheme (``2025+i``, train_deep_ensemble_cnns.py:126).
+
+Per-member early stopping under lockstep execution (SURVEY §7 "hard
+parts"): devices can't exit a vmapped computation at different epochs, so
+every member keeps computing until the *last* active member stops, but a
+member whose patience is exhausted has its state frozen via masked
+updates, and its best-epoch weights are tracked per member on device —
+semantically identical to the reference's independent EarlyStopping(
+restore_best_weights=True) per member.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apnea_uq_tpu.config import EnsembleConfig
+from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, init_variables
+from apnea_uq_tpu.parallel import mesh as mesh_lib
+from apnea_uq_tpu.training.state import TrainState, make_optimizer
+from apnea_uq_tpu.training.trainer import _epoch_jit, _eval_loss_jit
+from apnea_uq_tpu.utils import prng
+
+
+@dataclasses.dataclass
+class EnsembleFitResult:
+    """Stacked member states + per-member training history."""
+
+    state: TrainState                      # leaves have leading member axis
+    history: Dict[str, np.ndarray]         # (epochs_run, N) loss / val_loss
+    best_epoch: np.ndarray                 # (N,)
+    epochs_run: np.ndarray                 # (N,) epochs each member trained
+    num_members: int
+
+    def member_variables(self, i: int) -> dict:
+        return {
+            "params": jax.tree.map(lambda a: a[i], self.state.params),
+            "batch_stats": jax.tree.map(lambda a: a[i], self.state.batch_stats),
+        }
+
+    def stacked_variables(self) -> dict:
+        return {"params": self.state.params, "batch_stats": self.state.batch_stats}
+
+
+def init_ensemble_state(
+    model: AlarconCNN1D,
+    num_members: int,
+    root_key: jax.Array,
+    *,
+    learning_rate: float = 1e-3,
+) -> TrainState:
+    """Member-stacked TrainState; member i's init stream derives from
+    fold_in(root, i) — the vmapped analogue of per-member seeds."""
+    tx = make_optimizer(learning_rate)
+
+    def one(member_idx):
+        k = prng.stream(prng.member_key(root_key, member_idx), prng.STREAM_INIT)
+        variables = init_variables(model, k)
+        return TrainState(
+            params=variables["params"],
+            batch_stats=variables["batch_stats"],
+            opt_state=tx.init(variables["params"]),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    return jax.vmap(one)(jnp.arange(num_members))
+
+
+def _tree_where(cond_vec, new_tree, old_tree):
+    """Per-member select: cond_vec (N,) broadcast over member-axis leaves."""
+
+    def sel(new, old):
+        c = cond_vec.reshape((-1,) + (1,) * (new.ndim - 1))
+        return jnp.where(c, new, old)
+
+    return jax.tree.map(sel, new_tree, old_tree)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "tx", "batch_size", "patience"),
+    donate_argnames=("state", "book"),
+)
+def _ensemble_epoch(
+    model, tx, state, book, x, y, x_val, y_val, epoch_key, batch_size, patience
+):
+    """One lockstep epoch for all members + early-stop bookkeeping.
+
+    ``book`` = (best_val, patience_left, active, best_params, best_stats,
+    best_epoch, epochs_run); all leading-axis-N device arrays.
+    """
+    best_val, patience_left, active, best_params, best_stats, best_epoch, epochs_run = book
+    n_members = best_val.shape[0]
+    member_keys = jax.vmap(lambda i: jax.random.fold_in(epoch_key, i))(
+        jnp.arange(n_members)
+    )
+
+    def member_epoch(member_state, key):
+        return _epoch_jit.__wrapped__(
+            model, tx, member_state, x, y, key, batch_size, True
+        )
+
+    trained, train_loss = jax.vmap(member_epoch)(state, member_keys)
+
+    def member_val(member_state):
+        variables = {"params": member_state.params, "batch_stats": member_state.batch_stats}
+        return _eval_loss_jit.__wrapped__(model, variables, x_val, y_val, batch_size)
+
+    val_loss = jax.vmap(member_val)(trained)
+
+    # Freeze members that already stopped.
+    state = TrainState(
+        params=_tree_where(active, trained.params, state.params),
+        batch_stats=_tree_where(active, trained.batch_stats, state.batch_stats),
+        opt_state=_tree_where(active, trained.opt_state, state.opt_state),
+        step=jnp.where(active, trained.step, state.step),
+    )
+    epochs_run = epochs_run + active.astype(jnp.int32)
+
+    improved = (val_loss < best_val) & active
+    best_params = _tree_where(improved, state.params, best_params)
+    best_stats = _tree_where(improved, state.batch_stats, best_stats)
+    best_val = jnp.where(improved, val_loss, best_val)
+    best_epoch = jnp.where(improved, epochs_run - 1, best_epoch)
+    patience_left = jnp.where(
+        improved, patience, jnp.where(active, patience_left - 1, patience_left)
+    )
+    active = active & (patience_left > 0)
+
+    book = (best_val, patience_left, active, best_params, best_stats, best_epoch, epochs_run)
+    return state, book, train_loss, val_loss, active
+
+
+def fit_ensemble(
+    model: AlarconCNN1D,
+    x_train,
+    y_train,
+    config: EnsembleConfig = EnsembleConfig(),
+    *,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    root_key: Optional[jax.Array] = None,
+    log_fn=None,
+) -> EnsembleFitResult:
+    """Train all N members concurrently over the mesh's ensemble axis."""
+    n_members = config.num_members
+    if mesh is None:
+        mesh = mesh_lib.make_mesh(n_members)
+    if root_key is None:
+        root_key = prng.seed_key(config.seed_base)
+    tx = make_optimizer(config.learning_rate)
+
+    x = jnp.asarray(x_train, jnp.float32)
+    y = jnp.asarray(y_train, jnp.float32)
+    n = x.shape[0]
+    # Keras split arithmetic (see trainer.fit): val gets the tail remainder.
+    n_val = n - int(n * (1.0 - config.validation_split))
+    if n_val <= 0:
+        raise ValueError("ensemble training requires validation_split > 0 "
+                         "(early stopping is per-member val-loss based)")
+    x, x_val = x[: n - n_val], x[n - n_val:]
+    y, y_val = y[: n - n_val], y[n - n_val:]
+
+    # Pad member count to a multiple of the mesh ensemble axis so the
+    # member axis shards evenly; padded members train but are discarded.
+    e_axis = mesh.shape[mesh_lib.AXIS_ENSEMBLE]
+    n_padded = -(-n_members // e_axis) * e_axis
+
+    state = init_ensemble_state(model, n_padded, root_key,
+                                learning_rate=config.learning_rate)
+    state = jax.tree.map(
+        lambda a: jax.device_put(a, mesh_lib.member_sharding(mesh)), state
+    )
+    data_repl = mesh_lib.replicated(mesh)
+    x, y, x_val, y_val = (jax.device_put(a, data_repl) for a in (x, y, x_val, y_val))
+
+    book = (
+        jnp.full((n_padded,), jnp.inf),                      # best_val
+        jnp.full((n_padded,), config.early_stopping_patience, jnp.int32),
+        jnp.ones((n_padded,), bool),                         # active
+        # copies: state and book are both donated to the epoch step, so
+        # they must not alias the same buffers
+        jax.tree.map(jnp.copy, state.params),                # best_params
+        jax.tree.map(jnp.copy, state.batch_stats),           # best_stats
+        jnp.full((n_padded,), -1, jnp.int32),                # best_epoch
+        jnp.zeros((n_padded,), jnp.int32),                   # epochs_run
+    )
+    book = tuple(
+        jax.tree.map(lambda a: jax.device_put(a, mesh_lib.member_sharding(mesh)), b)
+        for b in book
+    )
+
+    shuffle_root = prng.stream(root_key, prng.STREAM_SHUFFLE)
+    losses: List[np.ndarray] = []
+    val_losses: List[np.ndarray] = []
+    with mesh:
+        for epoch in range(config.num_epochs):
+            epoch_key = jax.random.fold_in(shuffle_root, epoch)
+            state, book, train_loss, val_loss, active = _ensemble_epoch(
+                model, tx, state, book, x, y, x_val, y_val, epoch_key,
+                config.batch_size, config.early_stopping_patience,
+            )
+            losses.append(np.asarray(train_loss[:n_members]))
+            val_losses.append(np.asarray(val_loss[:n_members]))
+            n_active = int(np.sum(np.asarray(active[:n_members])))
+            if log_fn:
+                log_fn(
+                    f"epoch {epoch + 1}/{config.num_epochs} "
+                    f"active={n_active}/{n_members} "
+                    f"val_loss={np.asarray(val_loss[:n_members]).round(4).tolist()}"
+                )
+            if n_active == 0:
+                break
+
+    best_val, patience_left, active, best_params, best_stats, best_epoch, epochs_run = book
+    final = TrainState(
+        params=best_params, batch_stats=best_stats,
+        opt_state=state.opt_state, step=state.step,
+    )
+    take = lambda a: jax.tree.map(lambda leaf: leaf[:n_members], a)
+    return EnsembleFitResult(
+        state=take(final),
+        history={
+            "loss": np.stack(losses), "val_loss": np.stack(val_losses),
+        },
+        best_epoch=np.asarray(best_epoch[:n_members]),
+        epochs_run=np.asarray(epochs_run[:n_members]),
+        num_members=n_members,
+    )

@@ -8,6 +8,7 @@ a device mesh.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import flax.struct
@@ -28,8 +29,14 @@ class TrainState(flax.struct.PyTreeNode):
         return {"params": self.params, "batch_stats": self.batch_stats}
 
 
+@functools.lru_cache(maxsize=None)
 def make_optimizer(learning_rate: float = 1e-3) -> optax.GradientTransformation:
-    """Adam with Keras-default hyperparameters (cnn_baseline_train.py:100)."""
+    """Adam with Keras-default hyperparameters (cnn_baseline_train.py:100).
+
+    Cached per learning rate: the returned transformation is a static jit
+    argument of the epoch program, so handing out a fresh closure per call
+    would force a full recompile on every ``fit`` invocation.
+    """
     return optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-7)
 
 

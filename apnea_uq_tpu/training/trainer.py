@@ -168,7 +168,8 @@ def fit(
     x = jnp.asarray(x_train, jnp.float32)
     y = jnp.asarray(y_train, jnp.float32)
     n = x.shape[0]
-    n_val = int(round(n * config.validation_split))
+    # Keras split arithmetic: train gets int(n*(1-split)), val the remainder.
+    n_val = n - int(n * (1.0 - config.validation_split))
     # Keras validation_split takes the TAIL of the data, pre-shuffle.
     if n_val > 0:
         x, x_val = x[: n - n_val], x[n - n_val :]

@@ -49,14 +49,22 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size):
     keys = jax.random.split(key, n_passes)
     chunks, m = _chunk(x, batch_size)
 
-    def one_chunk(chunk):
+    def one_chunk(args):
+        chunk, chunk_idx = args
+
         def one_pass(k):
+            # Fresh noise per (pass, chunk): reusing the per-pass key across
+            # chunks would give windows in different chunks identical dropout
+            # masks (correlated noise the reference does not have).
+            k = jax.random.fold_in(k, chunk_idx)
             logits, _ = apply_model(model, variables, chunk, mode=mode, dropout_rng=k)
             return predict_proba(logits)
 
         return jax.vmap(one_pass)(keys)  # (T, bs)
 
-    probs = jax.lax.map(one_chunk, chunks)            # (chunks, T, bs)
+    probs = jax.lax.map(
+        one_chunk, (chunks, jnp.arange(chunks.shape[0]))
+    )                                                 # (chunks, T, bs)
     probs = jnp.transpose(probs, (1, 0, 2)).reshape(n_passes, -1)
     return probs[:, :m]
 

@@ -1,0 +1,93 @@
+"""Benchmark: MC-Dropout T=50 inference throughput (windows/sec/chip).
+
+North-star metric per BASELINE.json: T=50 stochastic passes of the full
+~851K-param Alarcón 1D-CNN over SHHS2-shaped (60, 4) windows on one TPU
+chip.  The reference has no published numbers (BASELINE.md), so
+``vs_baseline`` is measured against a same-hardware implementation of the
+reference's execution pattern — T sequential full-set float32 passes, one
+Keras-style ``model(x, training=True)`` call per pass
+(uq_techniques.py:22) — versus this framework's fused bf16 vmap-over-keys
+path.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _time(fn, *args, warmup: int = 1, reps: int = 3) -> float:
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    best = np.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    from apnea_uq_tpu.config import ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, apply_model, init_variables, predict_proba
+    from apnea_uq_tpu.uq import mc_dropout_predict
+
+    # Env knobs allow a small-shape smoke run on CPU (BENCH_WINDOWS=256
+    # BENCH_PASSES=4 BENCH_CHUNK=64); defaults are the TPU operating point.
+    n_windows = int(os.environ.get("BENCH_WINDOWS", 32768))
+    n_passes = int(os.environ.get("BENCH_PASSES", 50))
+    chunk = int(os.environ.get("BENCH_CHUNK", 2048))
+
+    rng = np.random.default_rng(2025)
+    x = jnp.asarray(rng.normal(size=(n_windows, 60, 4)), jnp.float32)
+
+    # Framework path: bf16 MXU compute, vmap over dropout keys, chunked.
+    model = AlarconCNN1D(ModelConfig(compute_dtype="bfloat16"))
+    variables = init_variables(model, jax.random.key(0))
+
+    def framework(x):
+        return mc_dropout_predict(
+            model, variables, x, n_passes=n_passes, mode="clean",
+            batch_size=chunk, key=jax.random.key(1),
+        )
+
+    t_framework = _time(framework, x)
+    throughput = n_windows / t_framework
+
+    # Reference-pattern path on the same chip: float32, one jitted full-set
+    # stochastic pass per Python-loop iteration (the sequential np.stack
+    # pattern of uq_techniques.py:22), timed over a subset of passes.
+    ref_model = AlarconCNN1D(ModelConfig(compute_dtype="float32"))
+    ref_vars = init_variables(ref_model, jax.random.key(0))
+
+    @jax.jit
+    def one_pass(x, key):
+        logits, _ = apply_model(ref_model, ref_vars, x, mode="mcd_clean",
+                                dropout_rng=key)
+        return predict_proba(logits)
+
+    naive_passes = 5
+    def naive(x):
+        return [one_pass(x, jax.random.key(t)) for t in range(naive_passes)]
+
+    t_naive_sub = _time(naive, x, warmup=1, reps=2)
+    t_naive = t_naive_sub * (n_passes / naive_passes)
+    naive_throughput = n_windows / t_naive
+
+    print(json.dumps({
+        "metric": "mcd_t50_inference_throughput",
+        "value": round(throughput, 1),
+        "unit": "windows/sec/chip",
+        "vs_baseline": round(throughput / naive_throughput, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
