@@ -160,11 +160,17 @@ class ExperimentConfig:
 
 
 def _to_jsonable(obj: Any) -> Any:
+    """Dataclass/collection tree -> plain JSON values.  Shared by config
+    serialization here and the artifact-registry manifest."""
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {f.name: _to_jsonable(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
     if isinstance(obj, (list, tuple)):
         return [_to_jsonable(v) for v in obj]
-    return obj
+    if isinstance(obj, dict):
+        return {str(k): _to_jsonable(v) for k, v in obj.items()}
+    return repr(obj)
 
 
 def _from_dict(cls: type, data: dict) -> Any:

@@ -1,0 +1,47 @@
+"""Host-side data layer: ingestion, finalization, artifacts, device feed.
+
+Replaces the reference's two preprocessing scripts
+(data_prepocessing/preprocess_shhs_raw.py, prepare_numpy_datasets.py) and
+their file-name drift (SURVEY §1) with one versioned artifact registry and
+library-grade stages.
+"""
+
+from apnea_uq_tpu.data.annotations import (
+    RespiratoryEvents,
+    parse_xml_annotations,
+)
+from apnea_uq_tpu.data.edf import EdfSignal, read_edf
+from apnea_uq_tpu.data.feed import prefetch_to_device
+from apnea_uq_tpu.data.ingest import (
+    WindowSet,
+    ingest_directory,
+    ingest_recording,
+    windows_from_reference_csv,
+    windows_to_reference_csv,
+)
+from apnea_uq_tpu.data.prepare import PreparedDatasets, prepare_datasets
+from apnea_uq_tpu.data.registry import ArtifactRegistry
+from apnea_uq_tpu.data.sampling import (
+    grouped_train_test_split,
+    random_undersample,
+    smote_oversample,
+)
+
+__all__ = [
+    "ArtifactRegistry",
+    "EdfSignal",
+    "PreparedDatasets",
+    "RespiratoryEvents",
+    "WindowSet",
+    "grouped_train_test_split",
+    "ingest_directory",
+    "ingest_recording",
+    "parse_xml_annotations",
+    "prefetch_to_device",
+    "prepare_datasets",
+    "random_undersample",
+    "read_edf",
+    "smote_oversample",
+    "windows_from_reference_csv",
+    "windows_to_reference_csv",
+]

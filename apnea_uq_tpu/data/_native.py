@@ -1,0 +1,135 @@
+"""ctypes loader for the native EDF decode library (native/edfio.cpp).
+
+Loads ``_edfio.so`` from the package directory; if it is absent and a C++
+compiler is on PATH, compiles it once from the in-tree source (build
+artifacts are machine-local, never committed).  All entry points degrade
+gracefully: ``available()`` is False whenever neither path works, and the
+NumPy fallback in edf.py takes over.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LIB_NAME = "_edfio.so"
+_SOURCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "edfio.cpp",
+)
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIB_NAME)
+
+
+def _try_build() -> bool:
+    if not os.path.exists(_SOURCE):
+        return False
+    try:
+        subprocess.run(
+            [
+                os.environ.get("CXX", "g++"),
+                "-O3",
+                "-fPIC",
+                "-shared",
+                "-std=c++17",
+                _SOURCE,
+                "-o",
+                _lib_path(),
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        path = _lib_path()
+        if not os.path.exists(path) and not _try_build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            # AttributeError here means a stale or foreign .so without our
+            # symbols — treat exactly like a failed load so the NumPy
+            # fallback takes over instead of erroring on every read.
+            if lib.edf_native_abi_version() != _ABI_VERSION:
+                _load_failed = True
+                return None
+            lib.edf_decode_signal.argtypes = [
+                ctypes.POINTER(ctypes.c_int16),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_float,
+                ctypes.c_float,
+                ctypes.POINTER(ctypes.c_float),
+            ]
+            lib.edf_decode_signal.restype = None
+            _lib = lib
+        except (OSError, AttributeError):
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_signal(
+    data: np.ndarray,
+    n_records: int,
+    record_words: int,
+    signal_offset: int,
+    spr: int,
+    gain: float,
+    offset: float,
+) -> np.ndarray:
+    """float32 (n_records * spr,) physical samples for one signal.
+
+    ``data`` is the file's full int16 record block (C-contiguous,
+    at least n_records * record_words elements).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native EDF library unavailable")
+    data = np.ascontiguousarray(data, dtype=np.int16)
+    if data.size < n_records * record_words:
+        raise ValueError(
+            f"record block has {data.size} samples, need "
+            f"{n_records} records x {record_words} words"
+        )
+    out = np.empty(n_records * spr, dtype=np.float32)
+    lib.edf_decode_signal(
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        n_records,
+        record_words,
+        signal_offset,
+        spr,
+        float(gain),
+        float(offset),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out

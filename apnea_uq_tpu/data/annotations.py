@@ -1,0 +1,104 @@
+"""NSRR profusion XML annotation parsing for SHHS2 recordings.
+
+Equivalent of preprocess_shhs_raw.py:169-190 (`parse_xml_annotations`) and
+:75-96 (`calculate_sleep_time`): scored respiratory events are read from
+``ScoredEvents/ScoredEvent`` elements, and the recording duration is the
+``Duration`` of the ``Recording Start Time`` event.
+
+Events are returned as structure-of-arrays (NumPy), not a list of dicts:
+downstream window labeling is a vectorized interval-overlap computation
+(ingest.py) instead of the reference's O(windows x events) Python loop
+(preprocess_shhs_raw.py:236-249).
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+RECORDING_START_CONCEPT = "Recording Start Time"
+STAGE_EVENT_TYPE = "Stages|Stages"
+
+
+@dataclass(frozen=True)
+class RespiratoryEvents:
+    """Scored events of one recording, structure-of-arrays."""
+
+    event_type: np.ndarray     # object (E,)
+    event_concept: np.ndarray  # object (E,)
+    start_s: np.ndarray        # float64 (E,)
+    duration_s: np.ndarray     # float64 (E,)
+    recording_duration_s: float
+
+    def __len__(self) -> int:
+        return len(self.start_s)
+
+    def select_concepts(self, concepts) -> "RespiratoryEvents":
+        """Events whose concept is in ``concepts`` (order preserved)."""
+        mask = np.isin(self.event_concept, list(concepts))
+        return RespiratoryEvents(
+            event_type=self.event_type[mask],
+            event_concept=self.event_concept[mask],
+            start_s=self.start_s[mask],
+            duration_s=self.duration_s[mask],
+            recording_duration_s=self.recording_duration_s,
+        )
+
+
+def parse_xml_annotations(
+    xml_path: str,
+    *,
+    stop_at_first_stage_event: bool = True,
+) -> RespiratoryEvents:
+    """Parse a profusion XML annotation file.
+
+    ``stop_at_first_stage_event=True`` reproduces the reference's loop
+    ``break`` on the first ``Stages|Stages`` event
+    (preprocess_shhs_raw.py:176-177) — NSRR files list all scored events
+    before the sleep-stage block, so this skips the (large) stage tail.
+    Set it False to scan every event regardless of ordering.
+
+    The recording duration is taken from the ``Recording Start Time``
+    event wherever it appears among the collected events, 0.0 when absent
+    (preprocess_shhs_raw.py:86-91).
+    """
+    root = ET.parse(xml_path).getroot()
+    types, concepts, starts, durations = [], [], [], []
+    recording_duration = 0.0
+    seen_recording_start = False
+
+    for scored in root.iterfind("ScoredEvents/ScoredEvent"):
+        etype = _text(scored, "EventType")
+        if stop_at_first_stage_event and etype == STAGE_EVENT_TYPE:
+            break
+        concept = _text(scored, "EventConcept")
+        start = _float(scored, "Start")
+        duration = _float(scored, "Duration")
+        if concept == RECORDING_START_CONCEPT and not seen_recording_start:
+            recording_duration = 0.0 if duration is None else duration
+            seen_recording_start = True
+        types.append(etype)
+        concepts.append(concept)
+        starts.append(np.nan if start is None else start)
+        durations.append(np.nan if duration is None else duration)
+
+    return RespiratoryEvents(
+        event_type=np.asarray(types, dtype=object),
+        event_concept=np.asarray(concepts, dtype=object),
+        start_s=np.asarray(starts, dtype=np.float64),
+        duration_s=np.asarray(durations, dtype=np.float64),
+        recording_duration_s=recording_duration,
+    )
+
+
+def _text(element: ET.Element, tag: str) -> Optional[str]:
+    child = element.find(tag)
+    return None if child is None else child.text
+
+
+def _float(element: ET.Element, tag: str) -> Optional[float]:
+    text = _text(element, tag)
+    return None if text is None else float(text)

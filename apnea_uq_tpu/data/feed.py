@@ -1,0 +1,86 @@
+"""Host -> device feed: double-buffered prefetch of batch streams.
+
+The reference loads entire datasets into host memory and hands them to
+Keras whole (cnn_baseline_train.py:145-158), and runs UQ inference with
+the full test set as one batch (uq_techniques.py:22).  On TPU the
+pattern is a bounded pipeline: while the device computes on batch i,
+batch i+1 is already being transferred, so HBM holds a constant number
+of batches and the ICI/PCIe transfer overlaps compute.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Iterator, Optional
+
+import jax
+
+
+def prefetch_to_device(
+    batches: Iterable,
+    *,
+    size: int = 2,
+    device: Optional[jax.Device] = None,
+    sharding: Optional[jax.sharding.Sharding] = None,
+) -> Iterator:
+    """Yield device-resident copies of ``batches``, staying ``size``
+    transfers ahead of the consumer.
+
+    Each batch is a pytree of host arrays; leaves are `device_put` as a
+    whole so nested dict/tuple batches work.  Pass ``sharding`` to place
+    batches onto a mesh (e.g. batch-sharded over the 'data' axis) instead
+    of a single device — transfers then overlap the same way per shard.
+    """
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    target = sharding if sharding is not None else device
+    queue: collections.deque = collections.deque()
+    it = iter(batches)
+
+    def enqueue(n: int) -> None:
+        for _ in range(n):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            if target is None:
+                queue.append(jax.device_put(batch))
+            else:
+                queue.append(jax.device_put(batch, target))
+
+    enqueue(size)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)
+
+
+def batch_iterator(
+    arrays,
+    batch_size: int,
+    *,
+    shuffle: bool = False,
+    seed: int = 0,
+    drop_remainder: bool = False,
+) -> Iterator:
+    """Mini-batches over a pytree of equal-length host arrays.
+
+    The host-side half of the feed: pair with `prefetch_to_device` for
+    the full pipeline.  Shuffling permutes indices once per call
+    (epoch-level reshuffle = one call per epoch with a folded seed).
+    """
+    import numpy as np
+
+    leaves = jax.tree.leaves(arrays)
+    if not leaves:
+        return
+    n = len(leaves[0])
+    for leaf in leaves:
+        if len(leaf) != n:
+            raise ValueError("all arrays must share the leading dimension")
+    order = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(order)
+    stop = n - (n % batch_size) if drop_remainder else n
+    for start in range(0, stop, batch_size):
+        idx = order[start : start + batch_size]
+        yield jax.tree.map(lambda a: a[idx], arrays)

@@ -1,0 +1,222 @@
+"""Dataset finalization: windows -> model-ready array artifacts (L2).
+
+Capability parity with data_prepocessing/prepare_numpy_datasets.py:
+
+- NaN imputation by column means (:126-128) — but computed from the
+  *training* split by default, fixing the reference's global-mean
+  train->test leak; ``nan_fill='global'`` reproduces the reference
+  behavior for parity experiments (PrepareConfig).
+- patient-independent 80/20 split, seed 2025 (:140-152), with the
+  overlap check hardened from a warning to an error (:156-160),
+- per-window standardization over the time axis, eps 1e-8 (:83-95),
+- SMOTE on flattened standardized training windows (:180-196) with
+  fallback to the unbalanced set on failure,
+- RUS-balanced copy of the test set (:202-219), skipped on failure,
+- artifacts under canonical registry keys instead of the drifted file
+  names (SURVEY §1).
+
+Arrays are float32 (the TPU compute dtype) rather than the reference's
+float64 — training casts to bf16/f32 on device either way.
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from apnea_uq_tpu.config import PrepareConfig
+from apnea_uq_tpu.data import registry as reg
+from apnea_uq_tpu.data.ingest import WindowSet
+from apnea_uq_tpu.data.registry import ArtifactRegistry
+from apnea_uq_tpu.data.sampling import (
+    grouped_train_test_split,
+    random_undersample,
+    smote_oversample,
+    verify_no_group_overlap,
+)
+
+
+@dataclass(frozen=True)
+class PreparedDatasets:
+    """The L2 -> L3/L5 artifact bundle."""
+
+    x_train: np.ndarray          # (N, 60, 4) standardized (+SMOTE) float32
+    y_train: np.ndarray          # (N,)
+    x_test: np.ndarray           # (M, 60, 4) standardized, unbalanced
+    y_test: np.ndarray           # (M,)
+    patient_ids_test: np.ndarray # (M,) str
+    x_test_rus: Optional[np.ndarray]  # RUS-balanced copy, None if skipped
+    y_test_rus: Optional[np.ndarray]
+
+
+def standardize_per_window(x: np.ndarray, eps: float = 1e-8) -> np.ndarray:
+    """Standardize each window independently over its time axis
+    (prepare_numpy_datasets.py:83-95): (x - mean) / (std + eps), with
+    mean/std over axis 1 per (window, channel)."""
+    x = np.asarray(x, dtype=np.float32)
+    mean = x.mean(axis=1, keepdims=True)
+    std = x.std(axis=1, keepdims=True)
+    return (x - mean) / (std + np.float32(eps))
+
+
+def nan_column_means(x: np.ndarray) -> np.ndarray:
+    """Per-(time, channel) NaN-ignoring means; all-NaN columns map to 0."""
+    with warnings.catch_warnings():
+        # All-NaN columns are expected and handled below; silence the
+        # "Mean of empty slice" RuntimeWarning they trigger.
+        warnings.simplefilter("ignore", RuntimeWarning)
+        means = np.nanmean(np.asarray(x, dtype=np.float32), axis=0)
+    return np.where(np.isfinite(means), means, 0.0)
+
+
+def fill_nan_with_column_means(
+    x: np.ndarray,
+    fit_on: Optional[np.ndarray] = None,
+    *,
+    means: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Impute NaNs with per-(time, channel) means computed on ``fit_on``
+    (default: x itself), or with precomputed ``means`` — pass the latter
+    when filling several arrays from one source to avoid recomputing the
+    reduction.  The reference computes means over the full dataset before
+    splitting (prepare_numpy_datasets.py:126-128); fitting on the
+    training slice gives the leak-free variant."""
+    x = np.asarray(x, dtype=np.float32)
+    if not np.isnan(x).any():
+        return x
+    if means is None:
+        means = nan_column_means(x if fit_on is None else fit_on)
+    out = x.copy()
+    nan_mask = np.isnan(out)
+    out[nan_mask] = np.broadcast_to(means, out.shape)[nan_mask]
+    return out
+
+
+def prepare_datasets(
+    windows: WindowSet,
+    config: PrepareConfig = PrepareConfig(),
+    *,
+    registry: Optional[ArtifactRegistry] = None,
+) -> PreparedDatasets:
+    """Split, standardize, and balance a WindowSet; optionally persist
+    every artifact into ``registry`` (prepare_final_datasets,
+    prepare_numpy_datasets.py:99-249)."""
+    x_all = np.asarray(windows.x, dtype=np.float32)
+    y_all = np.asarray(windows.y)
+    groups = np.asarray(windows.patient_ids)
+
+    train_idx, test_idx = grouped_train_test_split(
+        groups, test_size=config.test_size, seed=config.seed
+    )
+    verify_no_group_overlap(groups, train_idx, test_idx)
+
+    x_train, x_test = x_all[train_idx], x_all[test_idx]
+    y_train, y_test = y_all[train_idx], y_all[test_idx]
+    ids_test = groups[test_idx]
+
+    # NaN imputation (leak-free by default; 'global' = reference parity).
+    if config.nan_fill == "train":
+        fit = x_train
+    elif config.nan_fill == "global":
+        fit = x_all
+    else:
+        raise ValueError(f"nan_fill must be 'train' or 'global', got {config.nan_fill!r}")
+    if np.isnan(x_train).any() or np.isnan(x_test).any():
+        means = nan_column_means(fit)
+        x_train = fill_nan_with_column_means(x_train, means=means)
+        x_test = fill_nan_with_column_means(x_test, means=means)
+
+    x_train = standardize_per_window(x_train, config.standardize_eps)
+    x_test = standardize_per_window(x_test, config.standardize_eps)
+
+    n_train, steps, feats = x_train.shape
+    if config.smote:
+        try:
+            flat, y_train = smote_oversample(
+                x_train.reshape(n_train, steps * feats),
+                y_train,
+                k_neighbors=config.smote_k_neighbors,
+                seed=config.seed,
+            )
+            x_train = flat.reshape(-1, steps, feats)
+        except ValueError:
+            # Reference falls back to the unbalanced training set when
+            # SMOTE cannot run (prepare_numpy_datasets.py:194-197).
+            pass
+
+    x_test_rus = y_test_rus = None
+    if config.rus:
+        try:
+            flat_rus, y_test_rus, _ = random_undersample(
+                x_test.reshape(len(x_test), steps * feats), y_test, seed=config.seed
+            )
+            x_test_rus = flat_rus.reshape(-1, steps, feats)
+        except ValueError:
+            # Reference skips the balanced test set when RUS fails (:218-220).
+            x_test_rus = y_test_rus = None
+
+    prepared = PreparedDatasets(
+        x_train=x_train,
+        y_train=y_train,
+        x_test=x_test,
+        y_test=y_test,
+        patient_ids_test=ids_test,
+        x_test_rus=x_test_rus,
+        y_test_rus=y_test_rus,
+    )
+
+    if registry is not None:
+        save_prepared(prepared, registry, config)
+    return prepared
+
+
+def save_prepared(
+    prepared: PreparedDatasets,
+    registry: ArtifactRegistry,
+    config: Optional[PrepareConfig] = None,
+) -> None:
+    """Persist the bundle under canonical keys (the save block at
+    prepare_numpy_datasets.py:223-245, minus the name drift)."""
+    registry.save_arrays(
+        reg.TRAIN_STD_SMOTE,
+        {"x": prepared.x_train, "y": prepared.y_train},
+        config=config,
+    )
+    registry.save_arrays(
+        reg.TEST_STD_UNBALANCED,
+        {
+            "x": prepared.x_test,
+            "y": prepared.y_test,
+            "patient_ids": prepared.patient_ids_test.astype(np.str_),
+        },
+        config=config,
+    )
+    if prepared.x_test_rus is not None:
+        registry.save_arrays(
+            reg.TEST_STD_RUS,
+            {"x": prepared.x_test_rus, "y": prepared.y_test_rus},
+            config=config,
+        )
+
+
+def load_prepared(registry: ArtifactRegistry) -> PreparedDatasets:
+    """Load the bundle saved by :func:`save_prepared`."""
+    train = registry.load_arrays(reg.TRAIN_STD_SMOTE)
+    test = registry.load_arrays(reg.TEST_STD_UNBALANCED)
+    if registry.exists(reg.TEST_STD_RUS):
+        rus = registry.load_arrays(reg.TEST_STD_RUS)
+        x_rus, y_rus = rus["x"], rus["y"]
+    else:
+        x_rus = y_rus = None
+    return PreparedDatasets(
+        x_train=train["x"],
+        y_train=train["y"],
+        x_test=test["x"],
+        y_test=test["y"],
+        patient_ids_test=test["patient_ids"].astype(str),
+        x_test_rus=x_rus,
+        y_test_rus=y_rus,
+    )

@@ -1,0 +1,152 @@
+"""Versioned on-disk artifact registry.
+
+The reference pipeline hands artifacts between stages by ad-hoc file
+names, and the names drifted apart between producers and consumers
+(SURVEY §1 "contract drift": SHHS2_ID_all_60.csv vs SHHS2_ID_all.csv,
+X_train_win_std_smote vs X_train_std_smote, seed{21+i} vs seed{i+5}, two
+different default output dirs).  Here every artifact has one canonical
+key, and a JSON manifest records shape, dtype, and the producing config
+so a run is auditable and resumable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from apnea_uq_tpu.config import _to_jsonable
+
+MANIFEST_NAME = "manifest.json"
+
+# Canonical artifact keys (SURVEY §1 boundary table, without the drift).
+WINDOWS = "windows"                            # L1 -> L2: ingested window set (.npz)
+TRAIN_STD_SMOTE = "train_std_smote"            # L2 -> L3: balanced training set
+TEST_STD_UNBALANCED = "test_std_unbalanced"    # L2 -> L3/L5: full test set
+TEST_STD_RUS = "test_std_rus"                  # L2 -> L3/L5: RUS-balanced test set
+RAW_PREDICTIONS = "raw_predictions"            # L5 side: (K, M) probability stack
+DETAILED_WINDOWS = "detailed_windows"          # L5 -> L6: per-window CSV
+PATIENT_SUMMARY = "patient_summary"            # L6 -> L7: per-patient CSV
+CHECKPOINT = "checkpoint"                      # L3 -> L5: model checkpoints (dir)
+
+
+class ArtifactRegistry:
+    """One root directory holding every pipeline artifact plus a manifest.
+
+    Array artifacts are ``.npz`` bundles (arrays keyed by name); tabular
+    artifacts are CSV.  Keys may carry a tag suffix for per-method
+    variants, e.g. ``detailed_windows:CNN_MCD_Unbalanced``.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    # -- manifest ---------------------------------------------------------
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.root, MANIFEST_NAME)
+
+    def manifest(self) -> Dict[str, Any]:
+        path = self._manifest_path()
+        if not os.path.exists(path):
+            return {"version": 1, "artifacts": {}}
+        with open(path) as f:
+            return json.load(f)
+
+    def _record(self, key: str, entry: Dict[str, Any]) -> None:
+        manifest = self.manifest()
+        manifest["artifacts"][key] = entry
+        tmp = self._manifest_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+        os.replace(tmp, self._manifest_path())
+
+    def describe(self, key: str) -> Optional[Dict[str, Any]]:
+        return self.manifest()["artifacts"].get(key)
+
+    def exists(self, key: str) -> bool:
+        entry = self.describe(key)
+        return entry is not None and os.path.exists(
+            os.path.join(self.root, entry["file"])
+        )
+
+    # -- arrays -----------------------------------------------------------
+
+    def path_for(self, key: str, suffix: str) -> str:
+        return os.path.join(self.root, key.replace(":", "__") + suffix)
+
+    def save_arrays(
+        self,
+        key: str,
+        arrays: Dict[str, np.ndarray],
+        *,
+        config: Any = None,
+    ) -> str:
+        path = self.path_for(key, ".npz")
+        np.savez(path, **arrays)
+        self._record(
+            key,
+            {
+                "file": os.path.basename(path),
+                "kind": "arrays",
+                "arrays": {
+                    name: {"shape": list(a.shape), "dtype": str(a.dtype)}
+                    for name, a in arrays.items()
+                },
+                "config": _to_jsonable(config),
+            },
+        )
+        return path
+
+    def load_arrays(self, key: str) -> Dict[str, np.ndarray]:
+        entry = self.describe(key)
+        if entry is None:
+            raise KeyError(
+                f"artifact {key!r} not in registry at {self.root} "
+                f"(have: {sorted(self.manifest()['artifacts'])})"
+            )
+        with np.load(os.path.join(self.root, entry["file"]), allow_pickle=False) as z:
+            return {name: z[name] for name in z.files}
+
+    # -- tables -----------------------------------------------------------
+
+    def save_table(self, key: str, frame, *, config: Any = None) -> str:
+        """Save a pandas DataFrame as CSV."""
+        path = self.path_for(key, ".csv")
+        frame.to_csv(path, index=False)
+        self._record(
+            key,
+            {
+                "file": os.path.basename(path),
+                "kind": "table",
+                "rows": int(len(frame)),
+                "columns": list(map(str, frame.columns)),
+                "config": _to_jsonable(config),
+            },
+        )
+        return path
+
+    def load_table(self, key: str):
+        import pandas as pd
+
+        entry = self.describe(key)
+        if entry is None:
+            raise KeyError(f"artifact {key!r} not in registry at {self.root}")
+        return pd.read_csv(os.path.join(self.root, entry["file"]))
+
+    # -- directories (checkpoints) ---------------------------------------
+
+    def directory_for(self, key: str) -> str:
+        """A managed subdirectory (created) for directory-shaped artifacts."""
+        path = self.path_for(key, "")
+        os.makedirs(path, exist_ok=True)
+        self._record(
+            key,
+            {"file": os.path.basename(path), "kind": "directory"},
+        )
+        return path
+
+

@@ -1,0 +1,178 @@
+"""Patient-grouped splitting and class rebalancing (SMOTE / RUS).
+
+The reference delegates these to scikit-learn / imbalanced-learn
+(prepare_numpy_datasets.py:3-5,140,185,207).  imbalanced-learn is not
+available in this environment, so SMOTE and random undersampling are
+implemented in-tree.  SMOTE's O(n^2) minority k-NN search — the one
+compute-heavy step — runs on device as chunked matmul distance blocks +
+``lax.top_k`` (MXU-shaped), with the synthesis step staying in host
+NumPy where the rest of the data pipeline lives.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def grouped_train_test_split(
+    groups: np.ndarray,
+    *,
+    test_size: float = 0.2,
+    seed: int = 2025,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(train_idx, test_idx) with no group straddling the boundary.
+
+    Same semantics as sklearn's GroupShuffleSplit as used at
+    prepare_numpy_datasets.py:140-142 (and identical output for a given
+    seed, since it delegates to it): test_size is a fraction of *unique
+    groups*, not of rows.
+    """
+    from sklearn.model_selection import GroupShuffleSplit
+
+    splitter = GroupShuffleSplit(n_splits=1, test_size=test_size, random_state=seed)
+    placeholder = np.zeros(len(groups))
+    train_idx, test_idx = next(splitter.split(placeholder, groups=groups))
+    return train_idx, test_idx
+
+
+def verify_no_group_overlap(
+    groups: np.ndarray, train_idx: np.ndarray, test_idx: np.ndarray
+) -> None:
+    """Raise if any group appears on both sides (the reference only
+    printed a warning, prepare_numpy_datasets.py:156-160)."""
+    overlap = np.intersect1d(
+        np.unique(groups[train_idx]), np.unique(groups[test_idx])
+    )
+    if overlap.size:
+        raise ValueError(
+            f"{overlap.size} patient group(s) appear in both train and test, "
+            f"e.g. {overlap[:5].tolist()}"
+        )
+
+
+def _minority_knn(
+    x_min: np.ndarray, k: int, *, chunk: int = 2048
+) -> np.ndarray:
+    """int32 (n_min, k) indices of each minority sample's k nearest
+    minority neighbors (self excluded), squared-L2 metric.
+
+    Distance blocks are |a|^2 + |b|^2 - 2 a.b^T — one (chunk, n) matmul
+    per block, computed under jit so XLA fuses the norm/addition epilogue.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = x_min.shape[0]
+    k = min(k, n - 1)
+    if k <= 0:
+        return np.zeros((n, 0), dtype=np.int32)
+
+    x = jnp.asarray(x_min, jnp.float32)
+    sq = jnp.sum(x * x, axis=1)
+
+    @partial(jax.jit, static_argnames=("k",))
+    def block_topk(rows, row_sq, row_ids, k):
+        d = row_sq[:, None] + sq[None, :] - 2.0 * rows @ x.T
+        d = d.at[jnp.arange(rows.shape[0]), row_ids].set(jnp.inf)  # mask self
+        _, idx = jax.lax.top_k(-d, k)
+        return idx
+
+    out = np.empty((n, k), dtype=np.int32)
+    for start in range(0, n, chunk):
+        stop = min(start + chunk, n)
+        ids = jnp.arange(start, stop)
+        out[start:stop] = np.asarray(
+            block_topk(x[start:stop], sq[start:stop], ids, k)
+        )
+    return out
+
+
+def smote_oversample(
+    x: np.ndarray,
+    y: np.ndarray,
+    *,
+    k_neighbors: int = 5,
+    seed: int = 2025,
+    knn_chunk: int = 2048,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """SMOTE oversampling of the minority class to parity with the
+    majority (the imblearn.SMOTE call at prepare_numpy_datasets.py:185-187).
+
+    x is 2-D (samples, features) — the reference flattens (N, 60, 4)
+    windows to 240-dim vectors first (:183).  Synthetic samples are
+    x_i + u * (x_nn - x_i) with u ~ U(0, 1) and x_nn one of x_i's
+    k nearest minority neighbors, appended after the original rows in
+    imblearn's order.  Returns float and label arrays of the input dtypes.
+    """
+    x = np.asarray(x)
+    y = np.asarray(y)
+    if x.ndim != 2:
+        raise ValueError(f"SMOTE expects 2-D features, got shape {x.shape}")
+    classes, counts = np.unique(y, return_counts=True)
+    if classes.size < 2:
+        raise ValueError("SMOTE needs at least two classes")
+    if classes.size > 2:
+        raise ValueError(f"binary SMOTE only, got classes {classes.tolist()}")
+    minority = classes[np.argmin(counts)]
+    n_needed = int(counts.max() - counts.min())
+    if n_needed == 0:
+        return x.copy(), y.copy()
+
+    min_idx = np.flatnonzero(y == minority)
+    x_min = x[min_idx].astype(np.float32, copy=False)
+    if len(min_idx) <= 1:
+        raise ValueError(
+            f"minority class {minority!r} has {len(min_idx)} sample(s); "
+            "SMOTE needs at least 2"
+        )
+    nn = _minority_knn(x_min, k_neighbors, chunk=knn_chunk)
+
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, len(min_idx), n_needed)
+    neighbor_col = rng.integers(0, nn.shape[1], n_needed)
+    gaps = rng.random((n_needed, 1), dtype=np.float32)
+    x_base = x_min[base]
+    x_nn = x_min[nn[base, neighbor_col]]
+    synthetic = x_base + gaps * (x_nn - x_base)
+
+    x_out = np.concatenate([x, synthetic.astype(x.dtype, copy=False)])
+    y_out = np.concatenate([y, np.full(n_needed, minority, dtype=y.dtype)])
+    return x_out, y_out
+
+
+def random_undersample(
+    x: np.ndarray,
+    y: np.ndarray,
+    *,
+    seed: int = 2025,
+    extras: Tuple[np.ndarray, ...] = (),
+) -> Tuple[np.ndarray, np.ndarray, Tuple[np.ndarray, ...]]:
+    """Balance classes by subsampling each to the minority count without
+    replacement (the RandomUnderSampler call at
+    prepare_numpy_datasets.py:207-211).
+
+    ``extras`` are additional per-row arrays (e.g. patient IDs) gathered
+    with the same kept indices.  Rows keep their original relative order.
+    """
+    y = np.asarray(y)
+    classes, counts = np.unique(y, return_counts=True)
+    if classes.size < 2:
+        raise ValueError(
+            "random undersampling needs at least two classes "
+            f"(got {classes.tolist()})"
+        )
+    n_keep = int(counts.min())
+    rng = np.random.default_rng(seed)
+    kept = []
+    for cls in classes:
+        cls_idx = np.flatnonzero(y == cls)
+        kept.append(rng.choice(cls_idx, size=n_keep, replace=False))
+    keep_idx = np.sort(np.concatenate(kept))
+    return (
+        np.asarray(x)[keep_idx],
+        y[keep_idx],
+        tuple(np.asarray(e)[keep_idx] for e in extras),
+    )

@@ -1,0 +1,42 @@
+// Native EDF record decoding for apnea_uq_tpu.data.edf.
+//
+// EDF data records interleave signals: each record holds
+// samples_per_record[i] little-endian int16 samples for every signal i in
+// order.  Decoding one signal is therefore a strided gather + affine scale
+// over the whole file.  The NumPy fallback does this with a reshape/slice
+// copy plus a separate scale pass; here both fuse into one streaming loop
+// (single read of the int16 block, single write of the float32 output),
+// which is the reference's pyedflib/EDFlib (C) capability re-provided
+// in-tree (preprocess_shhs_raw.py:3,129-137).
+//
+// Build: make -C native   (or apnea_uq_tpu/data/_native.py compiles it on
+// first use with g++ -O3 -march=native -shared -fPIC).
+
+#include <cstdint>
+
+extern "C" {
+
+// De-interleave signal samples from EDF records and scale to physical
+// units.  data: the full int16 record block (n_records * record_words).
+// out: n_records * spr float32 physical samples.
+void edf_decode_signal(const int16_t* data,
+                       int64_t n_records,
+                       int64_t record_words,
+                       int64_t signal_offset,
+                       int64_t spr,
+                       float gain,
+                       float offset,
+                       float* out) {
+  for (int64_t r = 0; r < n_records; ++r) {
+    const int16_t* src = data + r * record_words + signal_offset;
+    float* dst = out + r * spr;
+    for (int64_t s = 0; s < spr; ++s) {
+      dst[s] = static_cast<float>(src[s]) * gain + offset;
+    }
+  }
+}
+
+// ABI/version probe for the ctypes loader.
+int edf_native_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+"""Profusion XML annotation parsing (preprocess_shhs_raw.py:169-190 parity)."""
+
+import numpy as np
+
+from apnea_uq_tpu.data.annotations import parse_xml_annotations
+
+XML = """<?xml version="1.0"?>
+<PSGAnnotation>
+  <ScoredEvents>
+    <ScoredEvent>
+      <EventType>Recording Start Time</EventType>
+      <EventConcept>Recording Start Time</EventConcept>
+      <Start>0.0</Start>
+      <Duration>25200.0</Duration>
+    </ScoredEvent>
+    <ScoredEvent>
+      <EventType>Respiratory|Respiratory</EventType>
+      <EventConcept>Obstructive apnea|Obstructive Apnea</EventConcept>
+      <Start>100.0</Start>
+      <Duration>20.0</Duration>
+    </ScoredEvent>
+    <ScoredEvent>
+      <EventType>Respiratory|Respiratory</EventType>
+      <EventConcept>Hypopnea|Hypopnea</EventConcept>
+      <Start>300.5</Start>
+      <Duration>15.0</Duration>
+    </ScoredEvent>
+    <ScoredEvent>
+      <EventType>Stages|Stages</EventType>
+      <EventConcept>Wake|0</EventConcept>
+      <Start>0.0</Start>
+      <Duration>30.0</Duration>
+    </ScoredEvent>
+    <ScoredEvent>
+      <EventType>Respiratory|Respiratory</EventType>
+      <EventConcept>Hypopnea|Hypopnea</EventConcept>
+      <Start>900.0</Start>
+      <Duration>12.0</Duration>
+    </ScoredEvent>
+  </ScoredEvents>
+</PSGAnnotation>
+"""
+
+
+def write_xml(tmp_path):
+    path = tmp_path / "shhs2-200001-nsrr.xml"
+    path.write_text(XML)
+    return str(path)
+
+
+def test_stop_at_first_stage_event(tmp_path):
+    events = parse_xml_annotations(write_xml(tmp_path))
+    # Parsing stops at the Stages|Stages event: the trailing hypopnea is
+    # not collected (preprocess_shhs_raw.py:176-177).
+    assert len(events) == 3
+    assert events.recording_duration_s == 25200.0
+    np.testing.assert_allclose(events.start_s, [0.0, 100.0, 300.5])
+    np.testing.assert_allclose(events.duration_s, [25200.0, 20.0, 15.0])
+
+
+def test_scan_all_events(tmp_path):
+    events = parse_xml_annotations(
+        write_xml(tmp_path), stop_at_first_stage_event=False
+    )
+    assert len(events) == 5
+
+
+def test_select_concepts(tmp_path):
+    events = parse_xml_annotations(write_xml(tmp_path))
+    apnea = events.select_concepts(
+        ["Obstructive apnea|Obstructive Apnea", "Hypopnea|Hypopnea"]
+    )
+    assert len(apnea) == 2
+    np.testing.assert_allclose(apnea.start_s, [100.0, 300.5])
+
+
+def test_missing_recording_start(tmp_path):
+    path = tmp_path / "x.xml"
+    path.write_text(
+        "<A><ScoredEvents><ScoredEvent>"
+        "<EventType>Respiratory|Respiratory</EventType>"
+        "<EventConcept>Hypopnea|Hypopnea</EventConcept>"
+        "<Start>1</Start><Duration>11</Duration>"
+        "</ScoredEvent></ScoredEvents></A>"
+    )
+    events = parse_xml_annotations(str(path))
+    assert events.recording_duration_s == 0.0  # preprocess_shhs_raw.py:91

@@ -1,0 +1,98 @@
+"""In-tree EDF reader: write/read round-trips and header semantics."""
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.data.edf import EdfSignal, read_edf, read_edf_labels, write_edf
+
+
+def make_signals(rng, n_seconds=30):
+    return [
+        EdfSignal("SaO2", 1.0, (95 + rng.normal(0, 1, n_seconds)).astype(np.float32)),
+        EdfSignal("H.R.", 2.0, (70 + rng.normal(0, 5, 2 * n_seconds)).astype(np.float32)),
+        EdfSignal("THOR RES", 10.0, rng.normal(0, 0.5, 10 * n_seconds).astype(np.float32)),
+    ]
+
+
+def test_roundtrip_values_and_rates(tmp_path, rng):
+    path = str(tmp_path / "a.edf")
+    signals = make_signals(rng)
+    write_edf(path, signals)
+
+    out = read_edf(path)
+    assert set(out) == {"SaO2", "H.R.", "THOR RES"}
+    for s in signals:
+        got = out[s.label]
+        assert got.sampling_rate == pytest.approx(s.sampling_rate)
+        assert got.samples.dtype == np.float32
+        # int16 quantization over the per-signal physical range bounds the
+        # absolute error at ~range/65535.
+        span = float(s.samples.max() - s.samples.min()) or 1.0
+        np.testing.assert_allclose(
+            got.samples, s.samples, atol=2.1 * span / 65535
+        )
+
+
+def test_channel_selection(tmp_path, rng):
+    path = str(tmp_path / "a.edf")
+    write_edf(path, make_signals(rng))
+    out = read_edf(path, ["SaO2", "NOPE"])
+    assert set(out) == {"SaO2"}  # unknown channels silently absent
+
+
+def test_labels_without_decode(tmp_path, rng):
+    path = str(tmp_path / "a.edf")
+    write_edf(path, make_signals(rng))
+    assert read_edf_labels(path) == ["SaO2", "H.R.", "THOR RES"]
+
+
+def test_numpy_and_native_paths_agree(tmp_path, rng):
+    from apnea_uq_tpu.data import _native
+
+    if not _native.available():
+        pytest.skip("native EDF library not built (no C++ toolchain)")
+    path = str(tmp_path / "a.edf")
+    write_edf(path, make_signals(rng))
+    a = read_edf(path, use_native=True)
+    b = read_edf(path, use_native=False)
+    for label in a:
+        np.testing.assert_allclose(
+            a[label].samples, b[label].samples, rtol=0, atol=1e-6
+        )
+
+
+def test_native_decode_direct(rng):
+    """Drive the ctypes contract directly against a NumPy oracle."""
+    from apnea_uq_tpu.data import _native
+
+    if not _native.available():
+        pytest.skip("native EDF library not built (no C++ toolchain)")
+    n_records, record_words = 7, 30
+    data = rng.integers(-32768, 32767, n_records * record_words).astype(np.int16)
+    got = _native.decode_signal(data, n_records, record_words, 10, 5, 0.25, -3.0)
+    oracle = (
+        data.reshape(n_records, record_words)[:, 10:15].astype(np.float32)
+        * np.float32(0.25)
+        - np.float32(3.0)
+    ).reshape(-1)
+    np.testing.assert_allclose(got, oracle, rtol=0, atol=1e-6)
+    with pytest.raises(ValueError, match="record block"):
+        _native.decode_signal(data[:5], n_records, record_words, 0, 5, 1.0, 0.0)
+
+
+def test_truncated_file_raises(tmp_path):
+    path = str(tmp_path / "bad.edf")
+    with open(path, "wb") as f:
+        f.write(b"0" * 100)
+    with pytest.raises(ValueError, match="truncated"):
+        read_edf(path)
+
+
+def test_extreme_physical_ranges(tmp_path, rng):
+    """8-char header numeric fields must survive large/small bounds."""
+    path = str(tmp_path / "x.edf")
+    x = (rng.normal(0, 1, 20) * 1.234567e5).astype(np.float32)
+    write_edf(path, [EdfSignal("BIG", 1.0, x)])
+    got = read_edf(path)["BIG"].samples
+    span = float(x.max() - x.min())
+    np.testing.assert_allclose(got, x, atol=3 * span / 65535)

@@ -1,0 +1,269 @@
+"""Ingestion stage: window labeling closed-form, artifact interpolation,
+exclusion policy, and an end-to-end synthetic EDF+XML run."""
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.config import IngestConfig
+from apnea_uq_tpu.data.annotations import RespiratoryEvents
+from apnea_uq_tpu.data.edf import EdfSignal, write_edf
+from apnea_uq_tpu.data.ingest import (
+    ingest_directory,
+    ingest_recording,
+    interpolate_out_of_range,
+    label_windows,
+    windows_from_reference_csv,
+    windows_to_reference_csv,
+)
+
+APNEA = "Obstructive apnea|Obstructive Apnea"
+HYPO = "Hypopnea|Hypopnea"
+
+
+def events_of(*triples, duration=25200.0):
+    """RespiratoryEvents from (concept, start, dur) triples."""
+    concepts = np.asarray([t[0] for t in triples], dtype=object)
+    return RespiratoryEvents(
+        event_type=np.asarray(["Respiratory|Respiratory"] * len(triples), dtype=object),
+        event_concept=concepts,
+        start_s=np.asarray([t[1] for t in triples], float),
+        duration_s=np.asarray([t[2] for t in triples], float),
+        recording_duration_s=duration,
+    )
+
+
+def reference_label_loop(n_windows, events, window=60, min_overlap=10):
+    """Direct re-derivation of the reference's O(W*E) labeling loop
+    (preprocess_shhs_raw.py:236-249) as the test oracle."""
+    labels = np.zeros(n_windows, dtype=np.int8)
+    for w in range(n_windows):
+        ws, we = w * window, w * window + window
+        for concept, start, dur in zip(
+            events.event_concept, events.start_s, events.duration_s
+        ):
+            if concept not in (APNEA, HYPO):
+                continue
+            overlap = min(we, start + dur) - max(ws, start)
+            if overlap >= min_overlap:
+                labels[w] = 1
+                break
+    return labels
+
+
+class TestLabelWindows:
+    def kwargs(self):
+        return dict(concepts=(APNEA, HYPO), min_overlap_s=10.0)
+
+    def test_simple_containment(self):
+        ev = events_of((APNEA, 70.0, 20.0))
+        labels = label_windows(4, 60, ev, **self.kwargs())
+        np.testing.assert_array_equal(labels, [0, 1, 0, 0])
+
+    def test_boundary_overlap_exactly_10s(self):
+        # Event 50..70: overlaps window 0 by exactly 10 s -> labeled; and
+        # window 1 by 10 s as well.
+        ev = events_of((HYPO, 50.0, 20.0))
+        labels = label_windows(3, 60, ev, **self.kwargs())
+        np.testing.assert_array_equal(labels, [1, 1, 0])
+
+    def test_overlap_just_under_threshold(self):
+        # Event 51..70: 9 s in window 0, 10 s in window 1.
+        ev = events_of((HYPO, 51.0, 19.0))
+        labels = label_windows(3, 60, ev, **self.kwargs())
+        np.testing.assert_array_equal(labels, [0, 1, 0])
+
+    def test_non_apnea_concepts_ignored(self):
+        ev = events_of(("Central apnea|Central Apnea", 70.0, 30.0))
+        labels = label_windows(4, 60, ev, **self.kwargs())
+        assert labels.sum() == 0
+
+    def test_short_events_never_label(self):
+        ev = events_of((APNEA, 65.0, 9.9))
+        labels = label_windows(4, 60, ev, **self.kwargs())
+        assert labels.sum() == 0
+
+    def test_long_event_spans_many_windows(self):
+        ev = events_of((APNEA, 30.0, 300.0))
+        labels = label_windows(8, 60, ev, **self.kwargs())
+        oracle = reference_label_loop(8, ev)
+        np.testing.assert_array_equal(labels, oracle)
+
+    def test_fuzz_against_reference_loop(self, rng):
+        for _ in range(25):
+            n_events = int(rng.integers(0, 12))
+            triples = []
+            concepts = [APNEA, HYPO, "Central apnea|Central Apnea", "SpO2 desaturation|SpO2 desaturation"]
+            for _ in range(n_events):
+                triples.append(
+                    (
+                        concepts[int(rng.integers(0, len(concepts)))],
+                        float(rng.uniform(-50, 700)),
+                        float(rng.uniform(0, 120)),
+                    )
+                )
+            ev = events_of(*triples) if triples else events_of()
+            got = label_windows(10, 60, ev, **self.kwargs())
+            oracle = reference_label_loop(10, ev)
+            np.testing.assert_array_equal(got, oracle)
+
+
+class TestInterpolation:
+    def test_out_of_range_interpolated(self):
+        sig = np.array([95.0, 50.0, 97.0, 101.0, 99.0], np.float32)
+        out = interpolate_out_of_range(sig, 80.0, 100.0)
+        np.testing.assert_allclose(out, [95.0, 96.0, 97.0, 98.0, 99.0])
+
+    def test_edges_extend(self):
+        sig = np.array([200.0, 90.0, 91.0], np.float32)
+        out = interpolate_out_of_range(sig, 80.0, 100.0)
+        np.testing.assert_allclose(out, [90.0, 90.0, 91.0])
+
+    def test_all_invalid_becomes_nan(self):
+        sig = np.array([300.0, 400.0], np.float32)
+        out = interpolate_out_of_range(sig, 80.0, 100.0)
+        assert np.isnan(out).all()
+
+    def test_valid_signal_untouched(self):
+        sig = np.array([85.0, 95.0], np.float32)
+        np.testing.assert_array_equal(
+            interpolate_out_of_range(sig, 80.0, 100.0), sig
+        )
+
+
+XML_TEMPLATE = """<?xml version="1.0"?>
+<PSGAnnotation><ScoredEvents>
+<ScoredEvent><EventType>Recording Start Time</EventType>
+<EventConcept>Recording Start Time</EventConcept>
+<Start>0</Start><Duration>{duration}</Duration></ScoredEvent>
+{events}
+</ScoredEvents></PSGAnnotation>
+"""
+
+EVENT_TEMPLATE = (
+    "<ScoredEvent><EventType>Respiratory|Respiratory</EventType>"
+    "<EventConcept>{concept}</EventConcept>"
+    "<Start>{start}</Start><Duration>{dur}</Duration></ScoredEvent>"
+)
+
+
+def synth_recording(tmp_path, rng, *, n_seconds=360, pr_label="PR",
+                    duration=25200.0, events=((APNEA, 70.0, 25.0),),
+                    patient="200001"):
+    edf_path = str(tmp_path / f"shhs2-{patient}.edf")
+    xml_path = str(tmp_path / f"shhs2-{patient}-nsrr.xml")
+    signals = [
+        EdfSignal("SaO2", 1.0, (95 + rng.normal(0, 1, n_seconds)).astype(np.float32)),
+        EdfSignal(pr_label, 2.0, (70 + rng.normal(0, 5, 2 * n_seconds)).astype(np.float32)),
+        EdfSignal("THOR RES", 10.0, rng.normal(0, 0.5, 10 * n_seconds).astype(np.float32)),
+        EdfSignal("ABDO RES", 10.0, rng.normal(0, 0.5, 10 * n_seconds).astype(np.float32)),
+    ]
+    write_edf(edf_path, signals)
+    body = "".join(
+        EVENT_TEMPLATE.format(concept=c, start=s, dur=d) for c, s, d in events
+    )
+    (tmp_path / f"shhs2-{patient}-nsrr.xml").write_text(
+        XML_TEMPLATE.format(duration=duration, events=body)
+    )
+    return edf_path, xml_path
+
+
+class TestIngestRecording:
+    def test_end_to_end(self, tmp_path, rng):
+        edf, xml = synth_recording(tmp_path, rng)
+        ws, report = ingest_recording(edf, xml, "200001")
+        assert report.excluded is None and report.error is None
+        assert ws.x.shape == (6, 60, 4)  # 360 s -> 6 windows, all 4 channels at 1 Hz
+        assert ws.x.dtype == np.float32
+        # Apnea event 70..95 sits in window 1.
+        np.testing.assert_array_equal(ws.y, [0, 1, 0, 0, 0, 0])
+        assert set(ws.patient_ids) == {"200001"}
+        np.testing.assert_array_equal(ws.start_time_s, np.arange(6) * 60)
+
+    def test_pr_alternative_name(self, tmp_path, rng):
+        edf, xml = synth_recording(tmp_path, rng, pr_label="H.R.")
+        ws, report = ingest_recording(edf, xml, "200001")
+        assert report.excluded is None
+        assert ws.channels == ("SaO2", "PR", "THOR RES", "ABDO RES")
+
+    def test_short_recording_excluded(self, tmp_path, rng):
+        edf, xml = synth_recording(tmp_path, rng, duration=1000.0)
+        ws, report = ingest_recording(edf, xml, "200001")
+        assert ws is None and "duration" in report.excluded
+
+    def test_missing_channel_excluded(self, tmp_path, rng):
+        edf, xml = synth_recording(tmp_path, rng, pr_label="WEIRD")
+        ws, report = ingest_recording(edf, xml, "200001")
+        assert ws is None and "missing channel" in report.excluded
+
+    def test_resampling_to_1hz(self, tmp_path, rng):
+        edf, xml = synth_recording(tmp_path, rng, n_seconds=300)
+        ws, _ = ingest_recording(edf, xml, "200001")
+        assert ws.x.shape == (5, 60, 4)  # 10 Hz channels resampled down
+
+    def test_overlapping_windows(self, tmp_path, rng):
+        edf, xml = synth_recording(tmp_path, rng, n_seconds=360)
+        cfg = IngestConfig(overlap_s=30)
+        ws, report = ingest_recording(edf, xml, "200001", cfg)
+        # stride 30 s: windows at 0,30,...,300 -> 11 windows of 60 s.
+        assert ws.x.shape == (11, 60, 4)
+        np.testing.assert_array_equal(ws.start_time_s, np.arange(11) * 30)
+        # Event 70..95 overlaps >=10 s with windows starting at 30, 60, 90
+        # (overlaps 20, 25, 5 s -> the last misses the threshold) and
+        # window 0 (0..60) by 0 s... compute: overlap(w@30)=min(95,90)-70=20,
+        # w@60: 95-70=25, w@90: 95-90=5.
+        np.testing.assert_array_equal(
+            ws.y, [0, 1, 1, 0, 0, 0, 0, 0, 0, 0, 0]
+        )
+        # Consecutive windows share their overlapping halves.
+        np.testing.assert_array_equal(ws.x[0, 30:], ws.x[1, :30])
+
+
+class TestIngestDirectory:
+    def test_multi_patient(self, tmp_path, rng):
+        synth_recording(tmp_path, rng, patient="200001")
+        synth_recording(tmp_path, rng, patient="200002",
+                        events=((HYPO, 130.0, 15.0),))
+        # A recording that gets excluded (short duration):
+        synth_recording(tmp_path, rng, patient="200003", duration=10.0)
+        ws, reports = ingest_directory(str(tmp_path), str(tmp_path))
+        assert len(reports) == 3
+        included = {r.patient_id for r in reports if r.excluded is None}
+        assert included == {"200001", "200002"}
+        assert set(ws.patient_ids) == {"200001", "200002"}
+        assert len(ws) == 12
+
+    def test_num_files_limit(self, tmp_path, rng):
+        for p in ("200001", "200002", "200003"):
+            synth_recording(tmp_path, rng, patient=p)
+        ws, reports = ingest_directory(
+            str(tmp_path), str(tmp_path), num_files=2
+        )
+        assert len(reports) == 2
+
+    def test_workers_match_sequential(self, tmp_path, rng):
+        for p in ("200001", "200002"):
+            synth_recording(tmp_path, rng, patient=p)
+        ws_seq, _ = ingest_directory(str(tmp_path), str(tmp_path))
+        ws_par, _ = ingest_directory(str(tmp_path), str(tmp_path), workers=4)
+        np.testing.assert_array_equal(ws_seq.x, ws_par.x)
+        np.testing.assert_array_equal(ws_seq.y, ws_par.y)
+
+
+def test_reference_csv_roundtrip(tmp_path, rng):
+    edf, xml = synth_recording(tmp_path, rng)
+    ws, _ = ingest_recording(edf, xml, "200001")
+    path = str(tmp_path / "ref.csv")
+    windows_to_reference_csv(ws, path)
+
+    import pandas as pd
+
+    frame = pd.read_csv(path)
+    # Reference schema: {ch}_t{t} cols time-major + metadata columns
+    # (preprocess_shhs_raw.py:204,253-256).
+    assert list(frame.columns[:4]) == ["SaO2_t0", "PR_t0", "THOR RES_t0", "ABDO RES_t0"]
+    assert "Apnea/Hypopnea" in frame and "Patient_ID" in frame
+
+    back = windows_from_reference_csv(path)
+    np.testing.assert_allclose(back.x, ws.x, rtol=1e-5)
+    np.testing.assert_array_equal(back.y, ws.y)
+    assert list(back.patient_ids) == list(ws.patient_ids)

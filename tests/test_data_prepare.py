@@ -1,0 +1,140 @@
+"""Finalization stage: split/standardize/balance end-to-end + registry."""
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.config import PrepareConfig
+from apnea_uq_tpu.data.ingest import WindowSet
+from apnea_uq_tpu.data.prepare import (
+    fill_nan_with_column_means,
+    load_prepared,
+    prepare_datasets,
+    standardize_per_window,
+)
+from apnea_uq_tpu.data.registry import ArtifactRegistry
+
+
+def make_windows(rng, n_patients=15, per_patient=40, positive_rate=0.25):
+    n = n_patients * per_patient
+    x = rng.normal(size=(n, 60, 4)).astype(np.float32) * 3 + 1
+    y = (rng.uniform(size=n) < positive_rate).astype(np.int8)
+    pids = np.repeat([f"p{i:03d}" for i in range(n_patients)], per_patient)
+    return WindowSet(
+        x=x,
+        y=y,
+        patient_ids=pids.astype(np.str_),
+        start_time_s=np.tile(np.arange(per_patient, dtype=np.int32) * 60, n_patients),
+        channels=("SaO2", "PR", "THOR RES", "ABDO RES"),
+    )
+
+
+class TestStandardize:
+    def test_zero_mean_unit_std_per_window(self, rng):
+        x = rng.normal(size=(10, 60, 4)).astype(np.float32) * 5 + 2
+        z = standardize_per_window(x)
+        np.testing.assert_allclose(z.mean(axis=1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(z.std(axis=1), 1.0, atol=1e-3)
+
+    def test_constant_channel_maps_to_zero(self):
+        x = np.full((2, 60, 4), 7.0, np.float32)
+        z = standardize_per_window(x)
+        np.testing.assert_allclose(z, 0.0, atol=1e-6)  # eps guards div-by-zero
+
+
+class TestNanFill:
+    def test_fill_uses_fit_source(self):
+        x = np.ones((4, 60, 4), np.float32)
+        x[0, 0, 0] = np.nan
+        fit = np.full((2, 60, 4), 5.0, np.float32)
+        out = fill_nan_with_column_means(x, fit_on=fit)
+        assert out[0, 0, 0] == 5.0
+        assert not np.isnan(out).any()
+
+    def test_no_nan_is_noop(self, rng):
+        x = rng.normal(size=(3, 60, 4)).astype(np.float32)
+        np.testing.assert_array_equal(fill_nan_with_column_means(x), x)
+
+    def test_all_nan_column_falls_back_to_zero(self):
+        x = np.ones((3, 60, 4), np.float32)
+        x[:, 5, 2] = np.nan
+        out = fill_nan_with_column_means(x)
+        np.testing.assert_allclose(out[:, 5, 2], 0.0)
+
+
+class TestPrepare:
+    def test_end_to_end_shapes_and_balance(self, rng):
+        ws = make_windows(rng)
+        prepared = prepare_datasets(ws, PrepareConfig(seed=2025))
+        # SMOTE balanced the training classes.
+        assert (prepared.y_train == 0).sum() == (prepared.y_train == 1).sum()
+        assert prepared.x_train.shape[1:] == (60, 4)
+        assert prepared.x_train.dtype == np.float32
+        # RUS balanced the test copy.
+        assert (prepared.y_test_rus == 0).sum() == (prepared.y_test_rus == 1).sum()
+        # Unbalanced test set keeps every split row with aligned IDs.
+        assert len(prepared.x_test) == len(prepared.y_test) == len(prepared.patient_ids_test)
+        # Patient independence: test patients disjoint from train size-wise
+        # (3 of 15 patients at test_size=0.2 -> 120 windows).
+        assert len(np.unique(prepared.patient_ids_test)) == 3
+
+    def test_standardized_outputs(self, rng):
+        prepared = prepare_datasets(make_windows(rng), PrepareConfig())
+        np.testing.assert_allclose(prepared.x_test.mean(axis=1), 0.0, atol=1e-4)
+
+    def test_nan_fill_modes_differ(self, rng):
+        ws = make_windows(rng)
+        x = ws.x.copy()
+        x[::7, 10, 1] = np.nan
+        ws = WindowSet(x=x, y=ws.y, patient_ids=ws.patient_ids,
+                       start_time_s=ws.start_time_s, channels=ws.channels)
+        a = prepare_datasets(ws, PrepareConfig(nan_fill="train", smote=False, rus=False))
+        b = prepare_datasets(ws, PrepareConfig(nan_fill="global", smote=False, rus=False))
+        assert not np.isnan(a.x_train).any() and not np.isnan(b.x_train).any()
+        # Train-only vs global means give (slightly) different imputations.
+        assert not np.allclose(a.x_test, b.x_test)
+
+    def test_smote_disabled_keeps_imbalance(self, rng):
+        prepared = prepare_datasets(make_windows(rng), PrepareConfig(smote=False))
+        assert (prepared.y_train == 0).sum() != (prepared.y_train == 1).sum()
+
+    def test_rus_skipped_on_single_class_test(self, rng):
+        ws = make_windows(rng, positive_rate=0.0)
+        prepared = prepare_datasets(ws, PrepareConfig())  # SMOTE+RUS both fall back
+        assert prepared.x_test_rus is None
+        assert (prepared.y_train == 1).sum() == 0
+
+    def test_registry_roundtrip(self, rng, tmp_path):
+        registry = ArtifactRegistry(str(tmp_path / "artifacts"))
+        prepared = prepare_datasets(
+            make_windows(rng), PrepareConfig(), registry=registry
+        )
+        loaded = load_prepared(registry)
+        np.testing.assert_array_equal(loaded.x_train, prepared.x_train)
+        np.testing.assert_array_equal(loaded.y_test, prepared.y_test)
+        np.testing.assert_array_equal(loaded.x_test_rus, prepared.x_test_rus)
+        assert list(loaded.patient_ids_test) == list(prepared.patient_ids_test)
+        # Manifest records shapes for auditability.
+        entry = registry.describe("train_std_smote")
+        assert entry["arrays"]["x"]["shape"] == list(prepared.x_train.shape)
+
+
+class TestRegistry:
+    def test_missing_key_raises_with_inventory(self, tmp_path):
+        registry = ArtifactRegistry(str(tmp_path))
+        with pytest.raises(KeyError, match="not in registry"):
+            registry.load_arrays("nope")
+
+    def test_table_roundtrip(self, tmp_path):
+        import pandas as pd
+
+        registry = ArtifactRegistry(str(tmp_path))
+        frame = pd.DataFrame({"a": [1, 2], "b": ["x", "y"]})
+        registry.save_table("detailed_windows:TEST", frame)
+        back = registry.load_table("detailed_windows:TEST")
+        pd.testing.assert_frame_equal(back, frame)
+
+    def test_exists(self, tmp_path):
+        registry = ArtifactRegistry(str(tmp_path))
+        assert not registry.exists("windows")
+        registry.save_arrays("windows", {"x": np.zeros(3)})
+        assert registry.exists("windows")

@@ -1,0 +1,149 @@
+"""Grouped splitting and in-tree SMOTE / RUS rebalancing."""
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.data.sampling import (
+    _minority_knn,
+    grouped_train_test_split,
+    random_undersample,
+    smote_oversample,
+    verify_no_group_overlap,
+)
+
+
+def make_grouped(rng, n_patients=20, per_patient=30):
+    groups = np.repeat([f"p{i:03d}" for i in range(n_patients)], per_patient)
+    return groups
+
+
+class TestGroupedSplit:
+    def test_no_patient_overlap(self, rng):
+        groups = make_grouped(rng)
+        tr, te = grouped_train_test_split(groups, test_size=0.2, seed=2025)
+        verify_no_group_overlap(groups, tr, te)  # must not raise
+        assert len(tr) + len(te) == len(groups)
+        assert np.intersect1d(tr, te).size == 0
+
+    def test_deterministic(self, rng):
+        groups = make_grouped(rng)
+        a = grouped_train_test_split(groups, seed=2025)
+        b = grouped_train_test_split(groups, seed=2025)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+        c = grouped_train_test_split(groups, seed=7)
+        assert not np.array_equal(a[1], c[1])
+
+    def test_test_fraction_of_groups(self, rng):
+        groups = make_grouped(rng, n_patients=10)
+        _, te = grouped_train_test_split(groups, test_size=0.2, seed=0)
+        assert len(np.unique(groups[te])) == 2  # 20% of 10 patients
+
+    def test_overlap_check_raises(self):
+        groups = np.array(["a", "a", "b"])
+        with pytest.raises(ValueError, match="both train and test"):
+            verify_no_group_overlap(groups, np.array([0]), np.array([1, 2]))
+
+
+class TestMinorityKnn:
+    def test_matches_brute_force(self, rng):
+        x = rng.normal(size=(50, 12)).astype(np.float32)
+        got = _minority_knn(x, 5, chunk=16)
+        d = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        np.fill_diagonal(d, np.inf)
+        expect = np.argsort(d, axis=1)[:, :5]
+        # Compare as sets per row (ties may order differently).
+        for r in range(50):
+            assert set(got[r].tolist()) == set(expect[r].tolist())
+
+    def test_k_capped_at_n_minus_1(self, rng):
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        got = _minority_knn(x, 10)
+        assert got.shape == (4, 3)
+
+
+class TestSmote:
+    def test_balances_classes(self, rng):
+        x = rng.normal(size=(120, 240)).astype(np.float32)
+        y = np.concatenate([np.zeros(100, np.int8), np.ones(20, np.int8)])
+        xs, ys = smote_oversample(x, y, seed=2025)
+        assert (ys == 0).sum() == (ys == 1).sum() == 100
+        assert xs.shape == (200, 240)
+        # Originals preserved as a prefix (imblearn order).
+        np.testing.assert_array_equal(xs[:120], x)
+        np.testing.assert_array_equal(ys[:120], y)
+
+    def test_synthetic_on_segment_between_minority_points(self, rng):
+        """Every synthetic sample lies on a segment between two minority
+        samples (the SMOTE construction)."""
+        x = rng.normal(size=(40, 3)).astype(np.float32)
+        y = np.concatenate([np.zeros(30, np.int8), np.ones(10, np.int8)])
+        xs, ys = smote_oversample(x, y, seed=0)
+        minority = x[y == 1]
+        for s in xs[40:]:
+            # s = a + u (b - a): the residual from the closest pair model
+            # must vanish for some (a, b) minority pair.
+            ok = False
+            for i in range(len(minority)):
+                for j in range(len(minority)):
+                    if i == j:
+                        continue
+                    a, b = minority[i], minority[j]
+                    denom = ((b - a) ** 2).sum()
+                    if denom == 0:
+                        continue
+                    u = float(((s - a) * (b - a)).sum() / denom)
+                    if -1e-4 <= u <= 1 + 1e-4:
+                        resid = np.abs(s - (a + u * (b - a))).max()
+                        if resid < 1e-4:
+                            ok = True
+                            break
+                if ok:
+                    break
+            assert ok, "synthetic sample not on any minority segment"
+
+    def test_deterministic(self, rng):
+        x = rng.normal(size=(60, 8)).astype(np.float32)
+        y = (rng.uniform(size=60) > 0.75).astype(np.int8)
+        a = smote_oversample(x, y, seed=3)
+        b = smote_oversample(x, y, seed=3)
+        np.testing.assert_array_equal(a[0], b[0])
+
+    def test_already_balanced_is_identity(self, rng):
+        x = rng.normal(size=(40, 5)).astype(np.float32)
+        y = np.concatenate([np.zeros(20, np.int8), np.ones(20, np.int8)])
+        xs, ys = smote_oversample(x, y)
+        np.testing.assert_array_equal(xs, x)
+
+    def test_single_class_raises(self, rng):
+        x = rng.normal(size=(10, 4)).astype(np.float32)
+        with pytest.raises(ValueError, match="two classes"):
+            smote_oversample(x, np.zeros(10, np.int8))
+
+    def test_single_minority_sample_raises(self, rng):
+        x = rng.normal(size=(10, 4)).astype(np.float32)
+        y = np.zeros(10, np.int8)
+        y[0] = 1
+        with pytest.raises(ValueError, match="at least 2"):
+            smote_oversample(x, y)
+
+
+class TestRus:
+    def test_balances_and_preserves_order(self, rng):
+        x = rng.normal(size=(100, 7)).astype(np.float32)
+        y = np.concatenate([np.zeros(80, np.int8), np.ones(20, np.int8)])
+        ids = np.array([f"w{i}" for i in range(100)])
+        xr, yr, (ids_r,) = random_undersample(x, y, seed=2025, extras=(ids,))
+        assert (yr == 0).sum() == (yr == 1).sum() == 20
+        assert xr.shape == (40, 7)
+        # Kept rows appear in original relative order with aligned extras.
+        kept_order = [int(s[1:]) for s in ids_r]
+        assert kept_order == sorted(kept_order)
+        np.testing.assert_array_equal(xr, x[kept_order])
+
+    def test_deterministic(self, rng):
+        x = rng.normal(size=(50, 2)).astype(np.float32)
+        y = (rng.uniform(size=50) > 0.7).astype(np.int8)
+        a = random_undersample(x, y, seed=1)
+        b = random_undersample(x, y, seed=1)
+        np.testing.assert_array_equal(a[0], b[0])
