@@ -1,0 +1,48 @@
+from apnea_uq_tpu.analysis.columns import (
+    COL_CORRECT,
+    COL_ENTROPY,
+    COL_PATIENT,
+    COL_PRED_LABEL,
+    COL_PROB,
+    COL_TRUE_LABEL,
+    COL_VARIANCE,
+    COL_WINDOW,
+    DETAILED_COLUMNS,
+)
+from apnea_uq_tpu.analysis.patient import (
+    aggregate_patients,
+    patient_summary_report,
+)
+from apnea_uq_tpu.analysis.stats import (
+    mann_whitney_u,
+    patient_accuracy_entropy_correlation,
+    pearson_corr,
+    uncertainty_correctness_test,
+)
+from apnea_uq_tpu.analysis.sweep import (
+    de_member_sweep,
+    mcd_pass_sweep,
+)
+from apnea_uq_tpu.analysis.windows import WindowAnalysis, window_level_analysis
+
+__all__ = [
+    "COL_PATIENT",
+    "COL_WINDOW",
+    "COL_TRUE_LABEL",
+    "COL_PRED_LABEL",
+    "COL_PROB",
+    "COL_VARIANCE",
+    "COL_ENTROPY",
+    "COL_CORRECT",
+    "DETAILED_COLUMNS",
+    "aggregate_patients",
+    "patient_summary_report",
+    "window_level_analysis",
+    "WindowAnalysis",
+    "pearson_corr",
+    "mann_whitney_u",
+    "patient_accuracy_entropy_correlation",
+    "uncertainty_correctness_test",
+    "mcd_pass_sweep",
+    "de_member_sweep",
+]

@@ -1,0 +1,176 @@
+"""SHHS2 cohort demographics and signal-quality statistics (C23/C24).
+
+Structured replacements for the two print-only side scripts
+``datasets/SHHS_cohort_analysis.py`` and ``datasets/SHHS_signal_quality.py``:
+the same NSRR metadata CSV goes in, but the results come back as dicts /
+frames (reported via ``format_*``) instead of interleaved prints, so the
+CLI stage, tests, and downstream notebooks all consume one structure.
+
+Cohort definition matches the reference: rows with a non-missing, numeric
+apnea-hypopnea index ``ahi_a0h3a`` (SHHS_cohort_analysis.py:38-51,
+SHHS_signal_quality.py:60-74).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+import pandas as pd
+
+AHI_COL = "ahi_a0h3a"
+AGE_COL = "age_s2"
+GENDER_COL = "gender"
+RACE_COL = "race"
+
+GENDER_LABELS = {1: "Male", 2: "Female"}
+RACE_LABELS = {1: "White", 2: "Black or African American", 3: "Other"}
+
+# Clinical AHI severity thresholds (Berry et al. 2012;
+# SHHS_cohort_analysis.py:139-152).
+AHI_SEVERITY_BINS = (
+    ("Normal (AHI < 5.0)", -np.inf, 5.0),
+    ("Mild OSA (AHI 5.0-14.9)", 5.0, 15.0),
+    ("Moderate OSA (AHI 15.0-29.9)", 15.0, 30.0),
+    ("Severe OSA (AHI >= 30.0)", 30.0, np.inf),
+)
+
+# NSRR 1-5 artifact-free-percentage codes (SHHS_signal_quality.py:29-51).
+QUALITY_CODE_LABELS = {
+    1: "<25% artifact-free",
+    2: "25-49% artifact-free",
+    3: "50-74% artifact-free",
+    4: "75-94% artifact-free",
+    5: ">=95% artifact-free",
+}
+QUALITY_VARS = {
+    "quoxim": "SaO2 Signal Quality (Oximeter)",
+    "quhr": "Heart Rate Signal Quality (Pulse)",
+    "quchest": "Thoracic Effort Signal Quality (Chest Inductance)",
+    "quabdo": "Abdominal Effort Signal Quality (Abdominal Inductance)",
+}
+
+
+def define_cohort(metadata: pd.DataFrame, *, ahi_col: str = AHI_COL) -> pd.DataFrame:
+    """Rows with a numeric, non-missing AHI — the analysis cohort."""
+    if ahi_col not in metadata.columns:
+        raise ValueError(f"metadata is missing AHI column {ahi_col!r}")
+    ahi = pd.to_numeric(metadata[ahi_col], errors="coerce")
+    cohort = metadata.loc[ahi.notna()].copy()
+    cohort[ahi_col] = ahi.loc[ahi.notna()]
+    return cohort
+
+
+def _numeric_summary(series: pd.Series) -> Dict[str, float]:
+    values = pd.to_numeric(series, errors="coerce").dropna()
+    if values.empty:
+        return {"n": 0}
+    return {
+        "n": int(len(values)),
+        "mean": float(values.mean()),
+        "std": float(values.std()),
+        "median": float(values.median()),
+        "min": float(values.min()),
+        "max": float(values.max()),
+    }
+
+
+def _categorical_summary(series: pd.Series, labels: Dict[int, str]) -> Dict[str, Any]:
+    values = series.dropna()
+    counts = values.value_counts().sort_index()
+    total = int(counts.sum())
+    out: Dict[str, Any] = {"n": total, "categories": {}}
+    for code, count in counts.items():
+        try:
+            label = labels.get(int(code), f"Unknown code ({code})")
+        except (TypeError, ValueError):
+            label = f"Unknown code ({code})"
+        out["categories"][label] = {
+            "count": int(count),
+            "percent": 100.0 * count / total if total else 0.0,
+        }
+    return out
+
+
+def ahi_severity_distribution(cohort: pd.DataFrame, *, ahi_col: str = AHI_COL) -> pd.DataFrame:
+    """Counts/percentages per clinical severity category, in clinical order."""
+    ahi = pd.to_numeric(cohort[ahi_col], errors="coerce")
+    total = int(ahi.notna().sum())
+    rows = []
+    for name, lo, hi in AHI_SEVERITY_BINS:
+        count = int(((ahi >= lo) & (ahi < hi)).sum()) if np.isfinite(lo) else int((ahi < hi).sum())
+        rows.append({
+            "category": name,
+            "count": count,
+            "percent": 100.0 * count / total if total else 0.0,
+        })
+    return pd.DataFrame(rows)
+
+
+def analyze_cohort(metadata: pd.DataFrame) -> Dict[str, Any]:
+    """Demographics + AHI stats for the AHI-defined cohort (C23)."""
+    cohort = define_cohort(metadata)
+    out: Dict[str, Any] = {
+        "n_total_records": int(len(metadata)),
+        "n_cohort": int(len(cohort)),
+        "ahi": _numeric_summary(cohort[AHI_COL]),
+        "ahi_severity": ahi_severity_distribution(cohort),
+    }
+    if AGE_COL in cohort.columns:
+        out["age"] = _numeric_summary(cohort[AGE_COL])
+    if GENDER_COL in cohort.columns:
+        out["gender"] = _categorical_summary(cohort[GENDER_COL], GENDER_LABELS)
+    if RACE_COL in cohort.columns:
+        out["race"] = _categorical_summary(cohort[RACE_COL], RACE_LABELS)
+    return out
+
+
+def analyze_signal_quality(metadata: pd.DataFrame) -> Dict[str, Any]:
+    """Per-channel 1-5 quality-code distributions over the cohort (C24)."""
+    cohort = define_cohort(metadata)
+    out: Dict[str, Any] = {"n_cohort": int(len(cohort)), "channels": {}}
+    for var, display in QUALITY_VARS.items():
+        if var not in cohort.columns:
+            continue
+        out["channels"][var] = {
+            "name": display,
+            **_categorical_summary(cohort[var], QUALITY_CODE_LABELS),
+        }
+    return out
+
+
+def format_cohort_report(stats: Dict[str, Any]) -> str:
+    lines = [
+        f"Total records: {stats['n_total_records']}",
+        f"Cohort (non-missing {AHI_COL}): {stats['n_cohort']}",
+    ]
+    if "age" in stats and stats["age"].get("n"):
+        a = stats["age"]
+        lines.append(
+            f"Age: {a['mean']:.1f} ± {a['std']:.1f} y "
+            f"(median {a['median']:.1f}, range {a['min']:.1f}-{a['max']:.1f})"
+        )
+    for key in ("gender", "race"):
+        if key in stats:
+            lines.append(f"{key.capitalize()}:")
+            for label, c in stats[key]["categories"].items():
+                lines.append(f"  {label}: {c['count']} ({c['percent']:.1f}%)")
+    ahi = stats["ahi"]
+    if ahi.get("n"):
+        lines.append(
+            f"AHI: {ahi['mean']:.1f} ± {ahi['std']:.1f} events/h "
+            f"(median {ahi['median']:.1f}, range {ahi['min']:.1f}-{ahi['max']:.1f})"
+        )
+    lines.append("AHI severity distribution:")
+    for _, row in stats["ahi_severity"].iterrows():
+        lines.append(f"  {row['category']}: {row['count']} ({row['percent']:.1f}%)")
+    return "\n".join(lines)
+
+
+def format_signal_quality_report(stats: Dict[str, Any]) -> str:
+    lines = [f"Cohort: {stats['n_cohort']}"]
+    for var, info in stats["channels"].items():
+        lines.append(f"{info['name']} [{var}] (n={info['n']}):")
+        for label, c in info["categories"].items():
+            lines.append(f"  {label}: {c['count']} ({c['percent']:.1f}%)")
+    return "\n".join(lines)

@@ -1,0 +1,28 @@
+"""Canonical column names of the detailed per-window results table.
+
+These match the reference's L5->L6 CSV schema byte-for-byte
+(analyze_mcd_patient_level.py:134-152, analyze_de_patient_level.py:146-164)
+so a user migrating from the reference finds identical artifacts; every
+in-tree producer and consumer imports them from here instead of
+re-spelling strings (the reference re-spells them in five scripts).
+"""
+
+COL_PATIENT = "Patient_ID"
+COL_WINDOW = "Window_Index"
+COL_TRUE_LABEL = "True_Label"
+COL_PRED_LABEL = "Predicted_Label"
+COL_PROB = "Predicted_Probability"
+COL_VARIANCE = "Predictive_Variance"
+COL_ENTROPY = "Predictive_Entropy"
+# Derived, added by analysis stages (aggregate_patient_uq_metrics.py:34).
+COL_CORRECT = "Correct"
+
+DETAILED_COLUMNS = (
+    COL_PATIENT,
+    COL_WINDOW,
+    COL_TRUE_LABEL,
+    COL_PRED_LABEL,
+    COL_PROB,
+    COL_VARIANCE,
+    COL_ENTROPY,
+)

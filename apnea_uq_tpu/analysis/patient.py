@@ -1,0 +1,102 @@
+"""Patient-level aggregation of per-window UQ results (reference C17).
+
+Replaces ``aggregate_patient_uq_metrics.py``: groupby patient -> mean /
+median / std of predictive variance and entropy, per-patient accuracy and
+window count (``:35-44``), with std zeroed for single-window patients
+(``:45-46``).  Unlike the reference — which is switched MCD<->DE by
+hand-editing its input path (``:7``) — this is a pure function over the
+detailed frame, and the CLI stage parameterizes the method tag.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from apnea_uq_tpu.analysis.columns import (
+    COL_CORRECT,
+    COL_ENTROPY,
+    COL_PATIENT,
+    COL_PRED_LABEL,
+    COL_TRUE_LABEL,
+    COL_VARIANCE,
+)
+
+_REQUIRED = (COL_PATIENT, COL_TRUE_LABEL, COL_PRED_LABEL, COL_VARIANCE, COL_ENTROPY)
+
+SUMMARY_METRIC_COLUMNS = (
+    "mean_variance",
+    "median_variance",
+    "std_variance",
+    "mean_entropy",
+    "median_entropy",
+    "std_entropy",
+    "patient_accuracy",
+    "num_windows",
+)
+
+
+def _check_columns(frame: pd.DataFrame) -> None:
+    missing = [c for c in _REQUIRED if c not in frame.columns]
+    if missing:
+        raise ValueError(
+            f"detailed results frame is missing column(s) {missing}; "
+            f"have {list(frame.columns)}"
+        )
+
+
+def aggregate_patients(detailed: pd.DataFrame) -> pd.DataFrame:
+    """Per-patient summary frame from the detailed per-window frame.
+
+    Columns: ``Patient_ID`` + :data:`SUMMARY_METRIC_COLUMNS`, matching the
+    reference's ``patient_summary_metrics_{MCD,DE}.csv`` schema
+    (aggregate_patient_uq_metrics.py:35-54).
+    """
+    _check_columns(detailed)
+    frame = detailed.copy()
+    frame[COL_CORRECT] = frame[COL_TRUE_LABEL] == frame[COL_PRED_LABEL]
+    summary = (
+        frame.groupby(COL_PATIENT)
+        .agg(
+            mean_variance=(COL_VARIANCE, "mean"),
+            median_variance=(COL_VARIANCE, "median"),
+            std_variance=(COL_VARIANCE, "std"),
+            mean_entropy=(COL_ENTROPY, "mean"),
+            median_entropy=(COL_ENTROPY, "median"),
+            std_entropy=(COL_ENTROPY, "std"),
+            patient_accuracy=(COL_CORRECT, "mean"),
+            num_windows=(COL_PATIENT, "size"),
+        )
+        .reset_index()
+    )
+    # pandas .std() is NaN for n=1; the reference zeroes it (:45-46).
+    single = summary["num_windows"] <= 1
+    summary.loc[single, ["std_variance", "std_entropy"]] = 0.0
+    return summary
+
+
+def patient_summary_report(summary: pd.DataFrame, *, n_examples: int = 5) -> str:
+    """Textual report: overall describe + highest/lowest-entropy patients
+    (aggregate_patient_uq_metrics.py:60-83)."""
+    stat_cols = [
+        "mean_entropy", "mean_variance", "std_entropy", "std_variance",
+        "patient_accuracy",
+    ]
+    example_cols = [
+        COL_PATIENT, "mean_entropy", "mean_variance", "patient_accuracy",
+        "num_windows",
+    ]
+    ordered = summary.sort_values("mean_entropy", ascending=False)
+    high, low = ordered.head(n_examples), ordered.tail(n_examples)
+    parts = [
+        f"Patients: {len(summary)}",
+        "",
+        "Overall patient statistics:",
+        summary[stat_cols].describe().to_string(),
+        "",
+        f"Top {n_examples} patients by mean entropy:",
+        high[example_cols].to_string(index=False),
+        "",
+        f"Bottom {n_examples} patients by mean entropy:",
+        low[example_cols].to_string(index=False),
+    ]
+    return "\n".join(parts)

@@ -1,0 +1,234 @@
+"""Plotting suite for UQ results (reference C11, C19, C20).
+
+Covers the reference's three plotting surfaces with one module:
+
+- per-metric window plots, class-mean bar chart, per-class histograms
+  (uq_techniques.py:210-275);
+- the thesis overview figures — patient-entropy histograms, patient
+  accuracy-vs-entropy scatter with Pearson r, correct-vs-incorrect
+  entropy boxplots, binned-accuracy lines
+  (uq_analysis/final_plot_uq_overview_figures.py:57-206);
+- the T/N convergence plot
+  (uq_analysis/hyperparameter_plot_mcd_or_de_pass_convergence.py:30-141),
+  fed by the in-tree sweep runner the reference lacks (SURVEY §5.6).
+
+All functions draw on a non-interactive Agg backend, write a PNG, and
+return the path.  Where the reference hard-codes its MCD-vs-DE method
+pair, these take any {label: frame} mapping.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Mapping, Optional, Sequence
+
+import matplotlib
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+from apnea_uq_tpu.analysis.columns import (  # noqa: E402
+    COL_CORRECT,
+    COL_ENTROPY,
+    COL_PRED_LABEL,
+    COL_TRUE_LABEL,
+)
+from apnea_uq_tpu.analysis.stats import pearson_corr  # noqa: E402
+
+
+def _save(fig, out_path: str) -> str:
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+    return out_path
+
+
+def _with_correct(frame):
+    if COL_CORRECT not in frame.columns:
+        frame = frame.copy()
+        frame[COL_CORRECT] = frame[COL_TRUE_LABEL] == frame[COL_PRED_LABEL]
+    return frame
+
+
+# ---------------------------------------------------------------- C11 ----
+
+def plot_uncertainty_metric(
+    values,
+    metric_name: str,
+    out_path: str,
+    *,
+    max_windows: int = 5000,
+    seed: int = 0,
+) -> str:
+    """Per-window metric line plot, subsampled beyond ``max_windows``
+    (uq_techniques.py:210-239)."""
+    values = np.asarray(values)
+    if values.shape[0] > max_windows:
+        idx = np.sort(
+            np.random.default_rng(seed).choice(
+                values.shape[0], max_windows, replace=False
+            )
+        )
+        values = values[idx]
+    fig, ax = plt.subplots(figsize=(10, 4))
+    ax.plot(values, lw=0.5)
+    ax.set_xlabel("window")
+    ax.set_ylabel(metric_name)
+    ax.set_title(f"{metric_name} across windows")
+    return _save(fig, out_path)
+
+
+def plot_class_uncertainties(
+    class_mean_variances: Mapping[str, float], out_path: str
+) -> str:
+    """Bar chart of per-class mean predictive variance
+    (uq_techniques.py:242-255)."""
+    fig, ax = plt.subplots(figsize=(5, 4))
+    names = list(class_mean_variances)
+    ax.bar(names, [class_mean_variances[n] for n in names])
+    ax.set_ylabel("mean predictive variance")
+    ax.set_title("Mean predictive variance by true class")
+    return _save(fig, out_path)
+
+
+def plot_metric_distribution(
+    values,
+    y_true,
+    metric_name: str,
+    out_path: str,
+    *,
+    bins: int = 50,
+) -> str:
+    """Overlaid per-true-class histograms of one uncertainty metric
+    (uq_techniques.py:258-275)."""
+    values = np.asarray(values)
+    y = np.asarray(y_true).astype(int).reshape(-1)
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for cls in (0, 1):
+        sel = values[y == cls]
+        if sel.size:
+            ax.hist(sel, bins=bins, alpha=0.6, label=f"class {cls}", density=True)
+    ax.set_xlabel(metric_name)
+    ax.set_ylabel("density")
+    ax.set_title(f"{metric_name} distribution by true class")
+    ax.legend()
+    return _save(fig, out_path)
+
+
+# ---------------------------------------------------------------- C19 ----
+
+def plot_patient_entropy_histograms(
+    summaries: Mapping[str, "object"], out_path: str, *, bins: int = 30
+) -> str:
+    """Side-by-side histograms of per-patient mean entropy per method
+    (final_plot_uq_overview_figures.py:58-76)."""
+    n = len(summaries)
+    fig, axes = plt.subplots(1, n, figsize=(5 * n, 4), squeeze=False)
+    for ax, (label, summary) in zip(axes[0], summaries.items()):
+        ax.hist(summary["mean_entropy"].dropna(), bins=bins)
+        ax.set_title(label)
+        ax.set_xlabel("mean predictive entropy")
+        ax.set_ylabel("patients")
+    fig.suptitle("Distribution of mean predictive entropy across patients")
+    return _save(fig, out_path)
+
+
+def plot_accuracy_vs_entropy(
+    summaries: Mapping[str, "object"], out_path: str
+) -> str:
+    """Per-method scatter of patient accuracy vs mean entropy, annotated
+    with Pearson r (final_plot_uq_overview_figures.py:79-109)."""
+    n = len(summaries)
+    fig, axes = plt.subplots(1, n, figsize=(5 * n, 4), squeeze=False)
+    for ax, (label, summary) in zip(axes[0], summaries.items()):
+        sub = summary[["mean_entropy", "patient_accuracy"]].dropna()
+        r, _ = pearson_corr(
+            sub["mean_entropy"].to_numpy(), sub["patient_accuracy"].to_numpy()
+        )
+        ax.scatter(sub["mean_entropy"], sub["patient_accuracy"], s=12, alpha=0.7)
+        ax.set_title(f"{label} (r = {r:.2f})")
+        ax.set_xlabel("mean predictive entropy")
+        ax.set_ylabel("patient accuracy")
+    fig.suptitle("Patient accuracy vs mean predictive entropy")
+    return _save(fig, out_path)
+
+
+def plot_correct_incorrect_box(
+    detailed_frames: Mapping[str, "object"],
+    out_path: str,
+    *,
+    metric: str = COL_ENTROPY,
+) -> str:
+    """Boxplots of window uncertainty for correct vs incorrect predictions
+    per method (final_plot_uq_overview_figures.py:113-140)."""
+    n = len(detailed_frames)
+    fig, axes = plt.subplots(1, n, figsize=(5 * n, 4), squeeze=False)
+    for ax, (label, frame) in zip(axes[0], detailed_frames.items()):
+        frame = _with_correct(frame)
+        groups = [
+            frame.loc[frame[COL_CORRECT], metric].to_numpy(),
+            frame.loc[~frame[COL_CORRECT], metric].to_numpy(),
+        ]
+        ax.boxplot(groups, tick_labels=["correct", "incorrect"], showfliers=False)
+        ax.set_title(label)
+        ax.set_ylabel(metric)
+    fig.suptitle(f"{metric} for correct vs incorrect windows")
+    return _save(fig, out_path)
+
+
+def plot_binned_accuracy(
+    binned_frames: Mapping[str, "object"], out_path: str
+) -> str:
+    """Accuracy across uncertainty bins per method, annotated with the
+    first (most-confident) bin's accuracy
+    (final_plot_uq_overview_figures.py:144-206)."""
+    n = len(binned_frames)
+    fig, axes = plt.subplots(1, n, figsize=(6 * n, 4), squeeze=False)
+    for ax, (label, binned) in zip(axes[0], binned_frames.items()):
+        acc = binned["accuracy"].to_numpy()
+        ax.plot(range(len(acc)), acc, marker="o")
+        ax.set_xticks(range(len(acc)))
+        ax.set_xticklabels(binned.iloc[:, 0].astype(str), rotation=45, ha="right",
+                           fontsize=7)
+        finite = np.isfinite(acc)
+        if finite.any():
+            first = int(np.flatnonzero(finite)[0])
+            ax.annotate(f"{acc[first]:.3f}", (first, acc[first]),
+                        textcoords="offset points", xytext=(6, 6))
+        ax.set_title(label)
+        ax.set_xlabel("uncertainty bin")
+        ax.set_ylabel("accuracy")
+        ax.set_ylim(0.0, 1.05)
+    fig.suptitle("Accuracy across predictive-entropy bins")
+    return _save(fig, out_path)
+
+
+# ---------------------------------------------------------------- C20 ----
+
+def plot_convergence(
+    sweep_frame,
+    out_path: str,
+    *,
+    x_label: str = "K (MC passes / ensemble members)",
+) -> str:
+    """Overall mean variance vs K for balanced/unbalanced sets
+    (hyperparameter_plot_mcd_or_de_pass_convergence.py:30-141).
+
+    Expects the sweep-runner schema: column ``N`` plus one
+    ``Variance_<set>`` column per test set.
+    """
+    fig, ax = plt.subplots(figsize=(7, 4))
+    var_cols = [c for c in sweep_frame.columns if c.startswith("Variance_")]
+    if "N" not in sweep_frame.columns or not var_cols:
+        raise ValueError(
+            "sweep frame must have column 'N' and >=1 'Variance_*' column; "
+            f"got {list(sweep_frame.columns)}"
+        )
+    for col in var_cols:
+        ax.plot(sweep_frame["N"], sweep_frame[col], marker="o",
+                label=col.removeprefix("Variance_"))
+    ax.set_xlabel(x_label)
+    ax.set_ylabel("overall mean predictive variance")
+    ax.set_title("Uncertainty convergence")
+    ax.legend()
+    return _save(fig, out_path)

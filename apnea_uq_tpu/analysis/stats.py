@@ -1,0 +1,167 @@
+"""Statistical tests for the UQ analyses (reference C21/C22).
+
+In-tree implementations of the two tests the reference takes from
+``scipy.stats`` (patient_accuracy_entropy_correlation.py:36-41,
+window_uncertainty_vs_correctness_mannwhitney.py:18) — the core math is
+NumPy here (rank transform, tie correction, t / normal conversion), with
+only the CDF special functions delegated to ``scipy.special`` (the same
+C layer scipy.stats itself sits on).  Both are verified against
+scipy.stats in the test suite.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+from scipy.special import ndtr, stdtr
+
+from apnea_uq_tpu.analysis.columns import (
+    COL_CORRECT,
+    COL_ENTROPY,
+    COL_PRED_LABEL,
+    COL_TRUE_LABEL,
+)
+
+_ALTERNATIVES = ("two-sided", "greater", "less")
+
+
+def pearson_corr(x, y) -> Tuple[float, float]:
+    """Pearson correlation coefficient with two-sided p-value.
+
+    p comes from t = r * sqrt((n-2) / (1-r^2)) under the t(n-2) null,
+    matching ``scipy.stats.pearsonr`` for n > 3.
+    """
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    if x.shape != y.shape or x.ndim != 1:
+        raise ValueError(f"expected equal-length 1-D inputs, got {x.shape}, {y.shape}")
+    n = x.size
+    if n < 2:
+        raise ValueError("pearson_corr requires at least 2 observations")
+    xd = x - x.mean()
+    yd = y - y.mean()
+    denom = np.sqrt((xd * xd).sum() * (yd * yd).sum())
+    if denom == 0.0:
+        # A constant input has undefined correlation.
+        return float("nan"), float("nan")
+    r = float(np.clip((xd * yd).sum() / denom, -1.0, 1.0))
+    if n == 2:
+        return r, 1.0
+    if abs(r) == 1.0:
+        return r, 0.0
+    df = n - 2
+    t = r * np.sqrt(df / (1.0 - r * r))
+    p = 2.0 * stdtr(df, -abs(t))
+    return r, float(p)
+
+
+def _rank_with_ties(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Midranks (1-based) and the sizes of each tie group."""
+    order = np.argsort(values, kind="mergesort")
+    sorted_vals = values[order]
+    # Boundaries of runs of equal values.
+    boundary = np.concatenate(([True], sorted_vals[1:] != sorted_vals[:-1]))
+    group_ids = np.cumsum(boundary) - 1
+    counts = np.bincount(group_ids)
+    ends = np.cumsum(counts)
+    starts = ends - counts
+    midranks_per_group = (starts + 1 + ends) / 2.0
+    ranks = np.empty(values.size, np.float64)
+    ranks[order] = midranks_per_group[group_ids]
+    return ranks, counts.astype(np.float64)
+
+
+def mann_whitney_u(
+    x, y, *, alternative: str = "two-sided", use_continuity: bool = True
+) -> Tuple[float, float]:
+    """Mann-Whitney U rank-sum test, asymptotic normal p with tie correction.
+
+    ``alternative='greater'`` tests that ``x`` is stochastically greater
+    than ``y`` — the direction the reference uses for
+    entropy(incorrect) > entropy(correct)
+    (window_uncertainty_vs_correctness_mannwhitney.py:18).  Matches
+    ``scipy.stats.mannwhitneyu(method='asymptotic')``.
+    """
+    if alternative not in _ALTERNATIVES:
+        raise ValueError(f"alternative must be one of {_ALTERNATIVES}")
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    n1, n2 = x.size, y.size
+    if n1 == 0 or n2 == 0:
+        raise ValueError("both samples must be non-empty")
+
+    ranks, tie_counts = _rank_with_ties(np.concatenate([x, y]))
+    r1 = ranks[:n1].sum()
+    u1 = r1 - n1 * (n1 + 1) / 2.0  # U statistic of x
+
+    n = n1 + n2
+    mean_u = n1 * n2 / 2.0
+    tie_term = ((tie_counts**3 - tie_counts).sum()) / (n * (n - 1.0)) if n > 1 else 0.0
+    var_u = n1 * n2 / 12.0 * ((n + 1.0) - tie_term)
+    if var_u == 0.0:
+        # All observations identical: no evidence either way.
+        return float(u1), 1.0
+
+    cc = 0.5 if use_continuity else 0.0
+    if alternative == "greater":
+        z = (u1 - mean_u - cc) / np.sqrt(var_u)
+        p = float(ndtr(-z))
+    elif alternative == "less":
+        z = (u1 - mean_u + cc) / np.sqrt(var_u)
+        p = float(ndtr(z))
+    else:
+        z = (u1 - mean_u - np.sign(u1 - mean_u) * cc) / np.sqrt(var_u)
+        p = float(min(2.0 * ndtr(-abs(z)), 1.0))
+    return float(u1), p
+
+
+def patient_accuracy_entropy_correlation(summary) -> Dict[str, float]:
+    """Pearson r between per-patient mean entropy and accuracy (C21).
+
+    ``summary`` is the frame from :func:`~apnea_uq_tpu.analysis.patient.
+    aggregate_patients`; mirrors patient_accuracy_entropy_correlation.py:36-41.
+    """
+    for col in ("mean_entropy", "patient_accuracy"):
+        if col not in summary.columns:
+            raise ValueError(f"patient summary frame is missing column {col!r}")
+    r, p = pearson_corr(
+        summary["mean_entropy"].to_numpy(), summary["patient_accuracy"].to_numpy()
+    )
+    return {"pearson_r": r, "p_value": p, "n_patients": int(len(summary))}
+
+
+def uncertainty_correctness_test(
+    detailed, *, metric: str = COL_ENTROPY, alpha: float = 0.05
+) -> Dict[str, float]:
+    """One-sided Mann-Whitney U: uncertainty(incorrect) > uncertainty(correct).
+
+    Mirrors window_uncertainty_vs_correctness_mannwhitney.py:10-28 including
+    its p < alpha significance verdict.
+    """
+    frame = detailed
+    if COL_CORRECT in frame.columns:
+        correct_mask = frame[COL_CORRECT].to_numpy(dtype=bool)
+    else:
+        correct_mask = (
+            frame[COL_TRUE_LABEL].to_numpy() == frame[COL_PRED_LABEL].to_numpy()
+        )
+    values = frame[metric].to_numpy(dtype=np.float64)
+    incorrect = values[~correct_mask]
+    correct = values[correct_mask]
+    if incorrect.size == 0 or correct.size == 0:
+        # All-correct (or all-wrong) predictions: the test is undefined.
+        # The reference would crash here (scipy raises on empty samples);
+        # report "no evidence" instead so pipelines keep running.
+        u, p = float("nan"), float("nan")
+    else:
+        u, p = mann_whitney_u(incorrect, correct, alternative="greater")
+    return {
+        "u_statistic": u,
+        "p_value": p,
+        "significant": bool(p < alpha),
+        "n_incorrect": int(incorrect.size),
+        "n_correct": int(correct.size),
+        "median_incorrect": float(np.median(incorrect)) if incorrect.size else float("nan"),
+        "median_correct": float(np.median(correct)) if correct.size else float("nan"),
+    }

@@ -1,0 +1,105 @@
+"""T/N convergence sweep runner — the piece the reference lacks.
+
+The reference's convergence figure (C20) plots a CSV of overall mean
+variance vs K that was collected by *hand-re-running* the MCD/DE drivers
+with different pass/member counts (SURVEY §5.6: "there is no sweep runner
+in the repo"; hyperparameter_plot_mcd_or_de_pass_convergence.py:13-17
+documents only the CSV schema).  Here the sweep is one prediction run:
+predict once at K_max, then every smaller K is the prefix subset of
+passes/members — distributionally identical to independent runs (passes
+are i.i.d. given the model; members are a fixed ordered pool) and K_max/K
+times cheaper.
+
+Output schema matches the reference plot's input contract: column ``N``
+plus one ``Variance_<set>`` column per test set.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+import pandas as pd
+
+from apnea_uq_tpu.config import UQConfig
+from apnea_uq_tpu.uq.predict import ensemble_predict, mc_dropout_predict
+
+# Reference operating points (BASELINE.json sweep axes).
+DEFAULT_PASS_COUNTS = (10, 25, 50, 100)
+DEFAULT_MEMBER_COUNTS = (5, 10, 20)
+
+
+def _variance_table(
+    predictions_per_set: Mapping[str, np.ndarray],
+    counts: Sequence[int],
+) -> pd.DataFrame:
+    rows = []
+    for k in counts:
+        row = {"N": int(k)}
+        for set_name, preds in predictions_per_set.items():
+            if k > preds.shape[0]:
+                raise ValueError(
+                    f"count {k} exceeds available passes/members {preds.shape[0]}"
+                )
+            row[f"Variance_{set_name}"] = float(preds[:k].var(axis=0).mean())
+        rows.append(row)
+    return pd.DataFrame(rows)
+
+
+def mcd_pass_sweep(
+    model,
+    variables: dict,
+    test_sets: Mapping[str, np.ndarray],
+    *,
+    pass_counts: Sequence[int] = DEFAULT_PASS_COUNTS,
+    config: UQConfig = UQConfig(),
+    key: Optional[jax.Array] = None,
+) -> pd.DataFrame:
+    """Overall mean predictive variance vs number of MC-Dropout passes.
+
+    ``test_sets`` maps a set label (e.g. 'Unbalanced', 'Balanced') to its
+    window array; one T=max(pass_counts) prediction per set feeds every row.
+    """
+    if key is None:
+        key = jax.random.key(0)
+    t_max = max(pass_counts)
+    preds = {}
+    for i, (name, x) in enumerate(test_sets.items()):
+        preds[name] = np.asarray(mc_dropout_predict(
+            model, variables, x,
+            n_passes=t_max,
+            mode=config.mcd_mode,
+            batch_size=config.inference_batch_size,
+            key=jax.random.fold_in(key, i),
+        ))
+    return _variance_table(preds, sorted(pass_counts))
+
+
+def de_member_sweep(
+    model,
+    member_variables,
+    test_sets: Mapping[str, np.ndarray],
+    *,
+    member_counts: Sequence[int] = DEFAULT_MEMBER_COUNTS,
+    config: UQConfig = UQConfig(),
+) -> pd.DataFrame:
+    """Overall mean predictive variance vs ensemble size.
+
+    Ensemble-size K uses the first K members of the pool, mirroring how
+    the reference's N=5 patient-level ensemble is a prefix of its N=20
+    global pool (analyze_de_patient_level.py:18-20, evaluate_de_global.py:11).
+    """
+    preds = {
+        name: np.asarray(ensemble_predict(
+            model, member_variables, x, batch_size=config.inference_batch_size
+        ))
+        for name, x in test_sets.items()
+    }
+    n_members = next(iter(preds.values())).shape[0]
+    counts = sorted(member_counts)
+    if counts[-1] > n_members:
+        raise ValueError(
+            f"member_counts max {counts[-1]} exceeds pool size {n_members}"
+        )
+    return _variance_table(preds, counts)

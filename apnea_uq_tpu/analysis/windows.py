@@ -1,0 +1,98 @@
+"""Window-level uncertainty-vs-correctness analysis (reference C18).
+
+Replaces ``analyze_window_level_uncertainty.py``: correct-vs-incorrect
+descriptive statistics of entropy/variance (``:37-44``) and a 10-equal-
+width-bin table of per-bin window count, accuracy, and error rate over the
+chosen uncertainty metric (``:47-67``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pandas as pd
+
+from apnea_uq_tpu.analysis.columns import (
+    COL_CORRECT,
+    COL_ENTROPY,
+    COL_PRED_LABEL,
+    COL_TRUE_LABEL,
+    COL_VARIANCE,
+)
+
+
+@dataclasses.dataclass
+class WindowAnalysis:
+    overall_accuracy: float
+    num_windows: int
+    correct_stats: pd.DataFrame      # describe() of entropy/variance, correct
+    incorrect_stats: pd.DataFrame    # describe() of entropy/variance, incorrect
+    binned: pd.DataFrame             # per-bin window_count/accuracy/error_rate
+    metric: str
+
+    def report(self) -> str:
+        return "\n".join([
+            f"Windows: {self.num_windows}, overall accuracy "
+            f"{self.overall_accuracy:.4f}",
+            "",
+            "Correctly classified windows:",
+            self.correct_stats.to_string(),
+            "",
+            "Incorrectly classified windows:",
+            self.incorrect_stats.to_string(),
+            "",
+            f"Binned accuracy / error rate vs {self.metric}:",
+            self.binned.to_string(float_format="%.4f"),
+        ])
+
+
+def window_level_analysis(
+    detailed: pd.DataFrame,
+    *,
+    metric: str = COL_ENTROPY,
+    num_bins: int = 10,
+) -> WindowAnalysis:
+    """Correct/incorrect stats + equal-width binned accuracy table.
+
+    Bin edges span [min, max + 1e-9) in ``num_bins`` equal widths with
+    left-closed intervals, matching analyze_window_level_uncertainty.py:52-60;
+    empty bins are kept (``observed=False`` groupby semantics) so the bin
+    axis is always complete.
+    """
+    for col in (COL_TRUE_LABEL, COL_PRED_LABEL, COL_VARIANCE, metric):
+        if col not in detailed.columns:
+            raise ValueError(f"detailed results frame is missing column {col!r}")
+
+    frame = detailed.copy()
+    if COL_CORRECT not in frame.columns:
+        frame[COL_CORRECT] = frame[COL_TRUE_LABEL] == frame[COL_PRED_LABEL]
+
+    stat_cols = [COL_ENTROPY, COL_VARIANCE] if metric == COL_ENTROPY else [metric, COL_VARIANCE]
+    correct_stats = frame.loc[frame[COL_CORRECT], stat_cols].describe()
+    incorrect_stats = frame.loc[~frame[COL_CORRECT], stat_cols].describe()
+
+    values = frame[metric].to_numpy(dtype=np.float64)
+    edges = np.linspace(values.min(), values.max() + 1e-9, num_bins + 1)
+    labels = [f"{edges[i]:.3f}-{edges[i + 1]:.3f}" for i in range(num_bins)]
+    # A tight metric range can make 3-decimal labels collide (which the
+    # reference would crash on); keep the categorical unordered then.
+    ordered = len(set(labels)) == len(labels)
+    frame["_bin"] = pd.cut(
+        frame[metric], bins=edges, labels=labels, right=False, ordered=ordered
+    )
+    binned = frame.groupby("_bin", observed=False).agg(
+        window_count=(COL_CORRECT, "size"),
+        accuracy=(COL_CORRECT, "mean"),
+    )
+    binned["error_rate"] = 1.0 - binned["accuracy"]
+    binned.index.name = f"{metric}_Bin"
+
+    return WindowAnalysis(
+        overall_accuracy=float(frame[COL_CORRECT].mean()),
+        num_windows=int(len(frame)),
+        correct_stats=correct_stats,
+        incorrect_stats=incorrect_stats,
+        binned=binned.reset_index(),
+        metric=metric,
+    )

@@ -1,13 +1,459 @@
 """Stage subcommand registry for the ``apnea-uq`` CLI.
 
-Each pipeline stage contributes one subcommand; a stage registers here in
-the same change that adds its runner.  Handlers import their heavy
-dependencies (jax, pandas) lazily so ``--help`` stays instant.
+One subcommand per pipeline stage, replacing the reference's 18 standalone
+scripts (SURVEY §1): the stage graph is
+
+    ingest -> prepare -> train / train-ensemble
+           -> eval-mcd / eval-de -> aggregate-patients / analyze-windows
+           -> correlate / sweep / figures        (+ cohort, on raw metadata)
+
+Every stage reads/writes the shared :class:`ArtifactRegistry`, so the
+hand-maintained file names the reference drifted on (SURVEY §1) are never
+spelled by the user.  Handlers import heavy dependencies (jax, pandas)
+lazily so ``--help`` stays instant.
 """
 
 from __future__ import annotations
 
+import os
+
+
+def _registry(args):
+    from apnea_uq_tpu.data.registry import ArtifactRegistry
+
+    return ArtifactRegistry(args.registry)
+
+
+def _ckpt_root(args) -> str:
+    if getattr(args, "ckpt_dir", None):
+        return args.ckpt_dir
+    from apnea_uq_tpu.data import registry as reg
+
+    return _registry(args).directory_for(reg.CHECKPOINT)
+
+
+def _model(config):
+    from apnea_uq_tpu.models import AlarconCNN1D
+
+    return AlarconCNN1D(config.model)
+
+
+def _baseline_template(config):
+    """Model + abstract-structure state for restoring checkpoints."""
+    import jax
+
+    from apnea_uq_tpu.training import create_train_state
+
+    model = _model(config)
+    template = create_train_state(
+        model, jax.random.key(0), learning_rate=config.train.learning_rate
+    )
+    return model, template
+
+
+def _load_test_sets(registry, *, include_train: bool = False):
+    """{label: (x, y, patient_ids|None)} for the unbalanced + RUS sets."""
+    from apnea_uq_tpu.data.prepare import load_prepared
+
+    prepared = load_prepared(registry, include_train=include_train)
+    sets = {
+        "Unbalanced": (prepared.x_test, prepared.y_test, prepared.patient_ids_test)
+    }
+    if prepared.x_test_rus is not None:
+        sets["Balanced_RUS"] = (prepared.x_test_rus, prepared.y_test_rus, None)
+    return prepared, sets
+
+
+# ---------------------------------------------------------------- stages --
+
+def cmd_ingest(args, config) -> int:
+    from apnea_uq_tpu.data import ingest_directory
+    from apnea_uq_tpu.data import registry as reg
+
+    windows, reports = ingest_directory(
+        args.edf_dir, args.xml_dir, config.ingest,
+        num_files=args.num_files, workers=args.workers,
+    )
+    excluded = [r for r in reports if r.excluded]
+    print(f"processed {len(reports)} recordings, excluded {len(excluded)}")
+    for r in excluded:
+        print(f"  excluded {r.patient_id}: {r.excluded}")
+    if windows is None:
+        print("no windows produced")
+        return 1
+    registry = _registry(args)
+    registry.save_arrays(reg.WINDOWS, windows.to_arrays(), config=config.ingest)
+    print(f"saved {len(windows)} windows -> {registry.root}")
+    return 0
+
+
+def cmd_prepare(args, config) -> int:
+    from apnea_uq_tpu.data import WindowSet, windows_from_reference_csv
+    from apnea_uq_tpu.data import registry as reg
+    from apnea_uq_tpu.data.prepare import prepare_datasets, save_prepared
+
+    registry = _registry(args)
+    if args.from_csv:
+        windows = windows_from_reference_csv(args.from_csv)
+    else:
+        windows = WindowSet.from_arrays(registry.load_arrays(reg.WINDOWS))
+    prepared = prepare_datasets(windows, config.prepare)
+    save_prepared(prepared, registry, config.prepare)
+    print(
+        f"train {prepared.x_train.shape}, test {prepared.x_test.shape}, "
+        f"rus {None if prepared.x_test_rus is None else prepared.x_test_rus.shape}"
+    )
+    return 0
+
+
+def cmd_train(args, config) -> int:
+    import jax
+
+    from apnea_uq_tpu.evaluation.classification import evaluate_classification
+    from apnea_uq_tpu.training import (
+        create_train_state, fit, predict_proba_batched, save_state,
+    )
+
+    registry = _registry(args)
+    prepared, sets = _load_test_sets(registry, include_train=True)
+    model = _model(config)
+    state = create_train_state(
+        model, jax.random.key(config.train.seed),
+        learning_rate=config.train.learning_rate,
+    )
+    result = fit(
+        model, state, prepared.x_train, prepared.y_train, config.train,
+        log_fn=print,
+    )
+    path = save_state(os.path.join(_ckpt_root(args), "baseline"), result.state)
+    print(f"saved baseline checkpoint -> {path} "
+          f"(best epoch {result.best_epoch + 1}, "
+          f"stopped_early={result.stopped_early})")
+    for label, (x, y, _ids) in sets.items():
+        probs = predict_proba_batched(
+            model, result.state.variables(), x,
+            batch_size=config.uq.inference_batch_size,
+        )
+        evaluate_classification(
+            probs, y, threshold=config.uq.decision_threshold,
+            description=f"baseline on {label}", verbose=True,
+        )
+    return 0
+
+
+def cmd_train_ensemble(args, config) -> int:
+    from apnea_uq_tpu.parallel import fit_ensemble
+    from apnea_uq_tpu.training import EnsembleCheckpointStore, save_ensemble
+
+    registry = _registry(args)
+    prepared, _ = _load_test_sets(registry, include_train=True)
+    model = _model(config)
+    store = EnsembleCheckpointStore(os.path.join(_ckpt_root(args), "ensemble"))
+
+    cfg = config.ensemble
+    all_seeds = [cfg.seed_base + i for i in range(cfg.num_members)]
+    missing = [s for s in all_seeds if not store.member_exists(s)]
+    if not missing:
+        print(f"all {cfg.num_members} members already checkpointed; nothing to do")
+        return 0
+    if len(missing) < len(all_seeds):
+        print(f"resuming: {len(all_seeds) - len(missing)} members exist, "
+              f"training {len(missing)}")
+
+    # Train only the missing members, as one concurrent mesh-parallel run.
+    import dataclasses
+
+    run_cfg = dataclasses.replace(cfg, num_members=len(missing))
+    # Per-member RNG is derived from the member's global index so a resumed
+    # run reproduces exactly the members a fresh run would have produced.
+    result = fit_ensemble(
+        model, prepared.x_train, prepared.y_train, run_cfg,
+        member_indices=[s - cfg.seed_base for s in missing],
+        log_fn=print,
+    )
+    save_ensemble(store, result.state, missing)
+    print(f"saved {len(missing)} members -> {store.root}")
+    return 0
+
+
+def _restore_members(args, config, n_members):
+    from apnea_uq_tpu.training import EnsembleCheckpointStore
+
+    model, template = _baseline_template(config)
+    store = EnsembleCheckpointStore(os.path.join(_ckpt_root(args), "ensemble"))
+    seeds = store.existing_seeds()
+    if len(seeds) < n_members:
+        raise SystemExit(
+            f"need {n_members} ensemble members, found {len(seeds)} "
+            f"in {store.root} — run train-ensemble first"
+        )
+    states = store.restore_members(seeds[:n_members], template)
+    return model, [s.variables() for s in states]
+
+
+def _print_run(result) -> None:
+    ev = result.evaluation
+    print(f"=== {result.label} ===")
+    print(f"predict: {result.predict_seconds:.2f}s for "
+          f"{ev.n_passes}x{ev.n_windows} windows")
+    if result.deterministic_classification is not None:
+        print(f"deterministic accuracy: "
+              f"{result.deterministic_classification['accuracy']:.4f}")
+    print(f"stochastic-mean accuracy: {result.classification['accuracy']:.4f}")
+    for k, v in ev.aggregates.items():
+        ci_lo = ev.confidence_intervals.get(f"{k}_ci_lower")
+        ci_hi = ev.confidence_intervals.get(f"{k}_ci_upper")
+        if ci_lo is not None:
+            print(f"  {k}: {v:.6f}  [{ci_lo:.6f}, {ci_hi:.6f}]")
+        else:
+            print(f"  {k}: {v:.6f}")
+
+
+def cmd_eval_mcd(args, config) -> int:
+    import jax
+
+    from apnea_uq_tpu.training import restore_state
+    from apnea_uq_tpu.uq import run_mcd_analysis, save_run
+
+    registry = _registry(args)
+    model, template = _baseline_template(config)
+    state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
+    _prepared, sets = _load_test_sets(registry)
+    for label, (x, y, ids) in sets.items():
+        result = run_mcd_analysis(
+            model, state.variables(), x, y, patient_ids=ids,
+            config=config.uq, label=f"CNN_MCD_{label}",
+            key=jax.random.key(config.train.seed),
+            detailed=ids is not None,
+        )
+        _print_run(result)
+        save_run(registry, result, config=config.uq)
+    return 0
+
+
+def cmd_eval_de(args, config) -> int:
+    from apnea_uq_tpu.uq import run_de_analysis, save_run
+
+    registry = _registry(args)
+    model, member_variables = _restore_members(args, config, args.num_members)
+    _prepared, sets = _load_test_sets(registry)
+    for label, (x, y, ids) in sets.items():
+        result = run_de_analysis(
+            model, member_variables, x, y, patient_ids=ids,
+            config=config.uq, label=f"CNN_DE_{label}",
+            detailed=ids is not None,
+        )
+        _print_run(result)
+        save_run(registry, result, config=config.uq)
+    return 0
+
+
+def cmd_aggregate_patients(args, config) -> int:
+    from apnea_uq_tpu.analysis import aggregate_patients, patient_summary_report
+    from apnea_uq_tpu.data import registry as reg
+
+    registry = _registry(args)
+    detailed = registry.load_table(f"{reg.DETAILED_WINDOWS}:{args.label}")
+    summary = aggregate_patients(detailed)
+    registry.save_table(f"{reg.PATIENT_SUMMARY}:{args.label}", summary)
+    print(patient_summary_report(summary))
+    return 0
+
+
+def cmd_analyze_windows(args, config) -> int:
+    from apnea_uq_tpu.analysis import window_level_analysis
+    from apnea_uq_tpu.data import registry as reg
+
+    registry = _registry(args)
+    detailed = registry.load_table(f"{reg.DETAILED_WINDOWS}:{args.label}")
+    print(window_level_analysis(detailed, num_bins=args.num_bins).report())
+    return 0
+
+
+def cmd_correlate(args, config) -> int:
+    from apnea_uq_tpu.analysis import (
+        patient_accuracy_entropy_correlation,
+        uncertainty_correctness_test,
+    )
+    from apnea_uq_tpu.data import registry as reg
+
+    from apnea_uq_tpu.analysis import aggregate_patients
+
+    registry = _registry(args)
+    for label in args.labels:
+        detailed = registry.load_table(f"{reg.DETAILED_WINDOWS}:{label}")
+        if registry.exists(f"{reg.PATIENT_SUMMARY}:{label}"):
+            summary = registry.load_table(f"{reg.PATIENT_SUMMARY}:{label}")
+        else:
+            # aggregate-patients hasn't run for this label; derive the
+            # summary on the fly (and don't persist — that stage owns it).
+            summary = aggregate_patients(detailed)
+        corr = patient_accuracy_entropy_correlation(summary)
+        print(f"[{label}] patient accuracy vs mean entropy: "
+              f"r={corr['pearson_r']:.4f} p={corr['p_value']:.2e} "
+              f"(n={corr['n_patients']})")
+        mw = uncertainty_correctness_test(detailed)
+        verdict = "significant" if mw["significant"] else "not significant"
+        print(f"[{label}] entropy(incorrect) > entropy(correct): "
+              f"U={mw['u_statistic']:.0f} p={mw['p_value']:.2e} ({verdict})")
+    return 0
+
+
+def cmd_sweep(args, config) -> int:
+    import jax
+
+    from apnea_uq_tpu.analysis import de_member_sweep, mcd_pass_sweep
+    from apnea_uq_tpu.analysis.plots import plot_convergence
+    from apnea_uq_tpu.training import restore_state
+
+    registry = _registry(args)
+    _prepared, sets = _load_test_sets(registry)
+    test_sets = {label: x for label, (x, _y, _ids) in sets.items()}
+    counts = [int(c) for c in args.counts]
+    if args.method == "mcd":
+        model, template = _baseline_template(config)
+        state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
+        frame = mcd_pass_sweep(
+            model, state.variables(), test_sets,
+            pass_counts=counts, config=config.uq,
+            key=jax.random.key(config.train.seed),
+        )
+    else:
+        model, member_variables = _restore_members(args, config, max(counts))
+        frame = de_member_sweep(
+            model, member_variables, test_sets,
+            member_counts=counts, config=config.uq,
+        )
+    key = f"sweep:{args.method}"
+    registry.save_table(key, frame)
+    print(frame.to_string(index=False))
+    if args.plot:
+        path = plot_convergence(frame, args.plot)
+        print(f"convergence plot -> {path}")
+    return 0
+
+
+def cmd_figures(args, config) -> int:
+    from apnea_uq_tpu.analysis import aggregate_patients, window_level_analysis
+    from apnea_uq_tpu.analysis import plots
+    from apnea_uq_tpu.data import registry as reg
+
+    registry = _registry(args)
+    frames = {
+        label: registry.load_table(f"{reg.DETAILED_WINDOWS}:{label}")
+        for label in args.labels
+    }
+    summaries = {k: aggregate_patients(v) for k, v in frames.items()}
+    binned = {
+        k: window_level_analysis(v, num_bins=args.num_bins).binned
+        for k, v in frames.items()
+    }
+    out = args.out_dir
+    paths = [
+        plots.plot_patient_entropy_histograms(
+            summaries, os.path.join(out, "patient_entropy_hist.png")),
+        plots.plot_accuracy_vs_entropy(
+            summaries, os.path.join(out, "accuracy_vs_entropy.png")),
+        plots.plot_correct_incorrect_box(
+            frames, os.path.join(out, "correct_incorrect_box.png")),
+        plots.plot_binned_accuracy(
+            binned, os.path.join(out, "binned_accuracy.png")),
+    ]
+    for p in paths:
+        print(f"wrote {p}")
+    return 0
+
+
+def cmd_cohort(args, config) -> int:
+    import pandas as pd
+
+    from apnea_uq_tpu.analysis.cohort import (
+        analyze_cohort,
+        analyze_signal_quality,
+        format_cohort_report,
+        format_signal_quality_report,
+    )
+
+    metadata = pd.read_csv(args.metadata_csv, encoding="latin1", low_memory=False)
+    print(format_cohort_report(analyze_cohort(metadata)))
+    if args.signal_quality:
+        print()
+        print(format_signal_quality_report(analyze_signal_quality(metadata)))
+    return 0
+
+
+# -------------------------------------------------------------- registry --
 
 def register(sub, add_config_arg, load_config_fn) -> None:
-    # Stage subcommands land together with their runner implementations.
-    del sub, add_config_arg, load_config_fn
+    def add(name, fn, help_text):
+        p = sub.add_parser(name, help=help_text)
+        add_config_arg(p)
+        p.set_defaults(fn=lambda args: fn(args, load_config_fn(args)))
+        return p
+
+    p = add("ingest", cmd_ingest, "EDF+XML recordings -> labeled windows.")
+    p.add_argument("--edf-dir", required=True)
+    p.add_argument("--xml-dir", required=True)
+    p.add_argument("--registry", required=True)
+    p.add_argument("--num-files", type=int, default=None)
+    p.add_argument("--workers", type=int, default=0)
+
+    p = add("prepare", cmd_prepare,
+            "Windows -> split/standardized/balanced train+test arrays.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--from-csv", default=None,
+                   help="Ingest from a reference-format flattened CSV instead "
+                        "of the registry windows artifact.")
+
+    p = add("train", cmd_train, "Train the baseline 1D-CNN.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--ckpt-dir", default=None)
+
+    p = add("train-ensemble", cmd_train_ensemble,
+            "Train the Deep Ensemble (mesh-parallel, resumable).")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--ckpt-dir", default=None)
+
+    p = add("eval-mcd", cmd_eval_mcd, "MC-Dropout UQ analysis on the test sets.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--ckpt-dir", default=None)
+
+    p = add("eval-de", cmd_eval_de, "Deep-Ensemble UQ analysis on the test sets.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--num-members", type=int, default=5)
+
+    p = add("aggregate-patients", cmd_aggregate_patients,
+            "Detailed windows -> per-patient summary.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--label", required=True,
+                   help="Run label, e.g. CNN_MCD_Unbalanced.")
+
+    p = add("analyze-windows", cmd_analyze_windows,
+            "Window-level uncertainty-vs-correctness analysis.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--label", required=True)
+    p.add_argument("--num-bins", type=int, default=10)
+
+    p = add("correlate", cmd_correlate,
+            "Patient Pearson correlation + window Mann-Whitney tests.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--labels", nargs="+", required=True)
+
+    p = add("sweep", cmd_sweep, "T/N uncertainty-convergence sweep.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--method", choices=("mcd", "de"), required=True)
+    p.add_argument("--counts", nargs="+", required=True)
+    p.add_argument("--plot", default=None, help="Optional output PNG path.")
+
+    p = add("figures", cmd_figures, "Thesis overview figure set.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--labels", nargs="+", required=True)
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--num-bins", type=int, default=10)
+
+    p = add("cohort", cmd_cohort,
+            "SHHS2 cohort demographics (and optional signal quality).")
+    p.add_argument("--metadata-csv", required=True)
+    p.add_argument("--signal-quality", action="store_true")

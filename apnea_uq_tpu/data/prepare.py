@@ -41,10 +41,15 @@ from apnea_uq_tpu.data.sampling import (
 
 @dataclass(frozen=True)
 class PreparedDatasets:
-    """The L2 -> L3/L5 artifact bundle."""
+    """The L2 -> L3/L5 artifact bundle.
 
-    x_train: np.ndarray          # (N, 60, 4) standardized (+SMOTE) float32
-    y_train: np.ndarray          # (N,)
+    ``x_train``/``y_train`` are None when loaded with
+    ``load_prepared(..., include_train=False)`` (inference-only stages skip
+    reading the largest artifact in the registry).
+    """
+
+    x_train: Optional[np.ndarray]  # (N, 60, 4) standardized (+SMOTE) float32
+    y_train: Optional[np.ndarray]  # (N,)
     x_test: np.ndarray           # (M, 60, 4) standardized, unbalanced
     y_test: np.ndarray           # (M,)
     patient_ids_test: np.ndarray # (M,) str
@@ -202,9 +207,15 @@ def save_prepared(
         )
 
 
-def load_prepared(registry: ArtifactRegistry) -> PreparedDatasets:
-    """Load the bundle saved by :func:`save_prepared`."""
-    train = registry.load_arrays(reg.TRAIN_STD_SMOTE)
+def load_prepared(
+    registry: ArtifactRegistry, *, include_train: bool = True
+) -> PreparedDatasets:
+    """Load the bundle saved by :func:`save_prepared`.
+
+    ``include_train=False`` skips the SMOTE-balanced training arrays —
+    the registry's largest artifact — for stages that only evaluate.
+    """
+    train = registry.load_arrays(reg.TRAIN_STD_SMOTE) if include_train else None
     test = registry.load_arrays(reg.TEST_STD_UNBALANCED)
     if registry.exists(reg.TEST_STD_RUS):
         rus = registry.load_arrays(reg.TEST_STD_RUS)
@@ -212,8 +223,8 @@ def load_prepared(registry: ArtifactRegistry) -> PreparedDatasets:
     else:
         x_rus = y_rus = None
     return PreparedDatasets(
-        x_train=train["x"],
-        y_train=train["y"],
+        x_train=train["x"] if train is not None else None,
+        y_train=train["y"] if train is not None else None,
         x_test=test["x"],
         y_test=test["y"],
         patient_ids_test=test["patient_ids"].astype(str),

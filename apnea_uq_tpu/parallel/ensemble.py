@@ -63,10 +63,18 @@ def init_ensemble_state(
     root_key: jax.Array,
     *,
     learning_rate: float = 1e-3,
+    member_indices=None,
 ) -> TrainState:
     """Member-stacked TrainState; member i's init stream derives from
-    fold_in(root, i) — the vmapped analogue of per-member seeds."""
+    fold_in(root, member_indices[i]) — the vmapped analogue of per-member
+    seeds.  ``member_indices`` defaults to 0..num_members-1; a resumed run
+    passes the *global* indices of the members it is re-training so their
+    streams match what a fresh full run would have produced."""
     tx = make_optimizer(learning_rate)
+    if member_indices is None:
+        member_indices = jnp.arange(num_members)
+    else:
+        member_indices = jnp.asarray(member_indices, jnp.int32)
 
     def one(member_idx):
         k = prng.stream(prng.member_key(root_key, member_idx), prng.STREAM_INIT)
@@ -78,7 +86,7 @@ def init_ensemble_state(
             step=jnp.zeros((), jnp.int32),
         )
 
-    return jax.vmap(one)(jnp.arange(num_members))
+    return jax.vmap(one)(member_indices)
 
 
 def _tree_where(cond_vec, new_tree, old_tree):
@@ -97,18 +105,19 @@ def _tree_where(cond_vec, new_tree, old_tree):
     donate_argnames=("state", "book"),
 )
 def _ensemble_epoch(
-    model, tx, state, book, x, y, x_val, y_val, epoch_key, batch_size, patience
+    model, tx, state, book, x, y, x_val, y_val, epoch_key, member_ids,
+    batch_size, patience
 ):
     """One lockstep epoch for all members + early-stop bookkeeping.
 
     ``book`` = (best_val, patience_left, active, best_params, best_stats,
     best_epoch, epochs_run); all leading-axis-N device arrays.
+    ``member_ids`` are the members' global indices — the fold source for
+    their shuffle/dropout streams, so a partial (resumed) run trains
+    bit-identical members to a full run.
     """
     best_val, patience_left, active, best_params, best_stats, best_epoch, epochs_run = book
-    n_members = best_val.shape[0]
-    member_keys = jax.vmap(lambda i: jax.random.fold_in(epoch_key, i))(
-        jnp.arange(n_members)
-    )
+    member_keys = jax.vmap(lambda i: jax.random.fold_in(epoch_key, i))(member_ids)
 
     def member_epoch(member_state, key):
         return _epoch_jit.__wrapped__(
@@ -154,10 +163,25 @@ def fit_ensemble(
     *,
     mesh: Optional[jax.sharding.Mesh] = None,
     root_key: Optional[jax.Array] = None,
+    member_indices=None,
     log_fn=None,
 ) -> EnsembleFitResult:
-    """Train all N members concurrently over the mesh's ensemble axis."""
+    """Train all N members concurrently over the mesh's ensemble axis.
+
+    ``member_indices`` (default 0..N-1) are the members' global indices in
+    the full ensemble; pass the missing subset when resuming so RNG
+    streams match the never-interrupted run (the reference's skip-if-
+    checkpoint-exists resume, train_deep_ensemble_cnns.py:130-132, gets
+    the same property from its seed-per-member scheme).
+    """
     n_members = config.num_members
+    if member_indices is None:
+        member_indices = list(range(n_members))
+    if len(member_indices) != n_members:
+        raise ValueError(
+            f"member_indices has {len(member_indices)} entries for "
+            f"{n_members} members"
+        )
     if mesh is None:
         mesh = mesh_lib.make_mesh(n_members)
     if root_key is None:
@@ -179,9 +203,15 @@ def fit_ensemble(
     # member axis shards evenly; padded members train but are discarded.
     e_axis = mesh.shape[mesh_lib.AXIS_ENSEMBLE]
     n_padded = -(-n_members // e_axis) * e_axis
+    pad_base = max(member_indices) + 1
+    padded_indices = list(member_indices) + [
+        pad_base + j for j in range(n_padded - n_members)
+    ]
+    member_ids = jnp.asarray(padded_indices, jnp.int32)
 
     state = init_ensemble_state(model, n_padded, root_key,
-                                learning_rate=config.learning_rate)
+                                learning_rate=config.learning_rate,
+                                member_indices=member_ids)
     state = jax.tree.map(
         lambda a: jax.device_put(a, mesh_lib.member_sharding(mesh)), state
     )
@@ -212,7 +242,7 @@ def fit_ensemble(
             epoch_key = jax.random.fold_in(shuffle_root, epoch)
             state, book, train_loss, val_loss, active = _ensemble_epoch(
                 model, tx, state, book, x, y, x_val, y_val, epoch_key,
-                config.batch_size, config.early_stopping_patience,
+                member_ids, config.batch_size, config.early_stopping_patience,
             )
             losses.append(np.asarray(train_loss[:n_members]))
             val_losses.append(np.asarray(val_loss[:n_members]))

@@ -1,4 +1,26 @@
+from apnea_uq_tpu.training.checkpoint import (
+    EnsembleCheckpointStore,
+    load_raw_predictions,
+    member_state,
+    restore_state,
+    save_ensemble,
+    save_raw_predictions,
+    save_state,
+)
 from apnea_uq_tpu.training.state import TrainState, create_train_state
 from apnea_uq_tpu.training.trainer import FitResult, fit, predict_proba_batched
 
-__all__ = ["TrainState", "create_train_state", "fit", "FitResult", "predict_proba_batched"]
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "fit",
+    "FitResult",
+    "predict_proba_batched",
+    "EnsembleCheckpointStore",
+    "save_state",
+    "restore_state",
+    "member_state",
+    "save_ensemble",
+    "save_raw_predictions",
+    "load_raw_predictions",
+]

@@ -3,6 +3,15 @@ from apnea_uq_tpu.uq.bootstrap import (
     bootstrap_metrics,
     compute_confidence_intervals,
 )
+from apnea_uq_tpu.uq.drivers import (
+    UQEvaluation,
+    UQRunResult,
+    detailed_frame,
+    evaluate_uq,
+    run_de_analysis,
+    run_mcd_analysis,
+    save_run,
+)
 from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
 from apnea_uq_tpu.uq.predict import ensemble_predict, mc_dropout_predict
 
@@ -13,4 +22,11 @@ __all__ = [
     "compute_confidence_intervals",
     "mc_dropout_predict",
     "ensemble_predict",
+    "evaluate_uq",
+    "detailed_frame",
+    "run_mcd_analysis",
+    "run_de_analysis",
+    "save_run",
+    "UQEvaluation",
+    "UQRunResult",
 ]

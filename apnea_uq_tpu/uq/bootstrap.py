@@ -80,16 +80,20 @@ def bootstrap_aggregates(
     seed: Optional[int] = None,
     base: str = "nats",
     eps: float = 1e-10,
+    metrics: Optional[Dict[str, jax.Array]] = None,
 ) -> Dict[str, jax.Array]:
     """(B,)-vector of each scalar aggregate across B bootstrap resamples.
 
     Matches the aggregates of uq_techniques.py:150-157 exactly (per-window
     metrics are resample-invariant, so recomputing them per resample — as
-    the reference does — is equivalent to gathering them).
+    the reference does — is equivalent to gathering them).  Pass the
+    ``metrics`` dict of a prior :func:`uq_evaluation_dist` call on the
+    same stack to skip recomputing it.
     """
     if key is None:
         key = jax.random.key(0 if seed is None else seed)
-    metrics = uq_evaluation_dist(predictions, y_true, base=base, eps=eps)
+    if metrics is None:
+        metrics = uq_evaluation_dist(predictions, y_true, base=base, eps=eps)
     return _bootstrap_core(
         metrics["pred_variance"],
         metrics["total_pred_entropy"],

@@ -1,0 +1,320 @@
+"""End-to-end UQ evaluation drivers (reference C12-C16).
+
+The reference splits this across five scripts — ``evaluate_uq_methods``
+(uq_techniques.py:278-391) plus four near-duplicate driver scripts
+(analyze_{mcd,de}_patient_level.py, evaluate_{mcd,de}_global.py) that
+differ only in predictor, ensemble size, and whether a per-window CSV is
+written.  Here one parameterized pipeline covers all four:
+
+    predictions -> on-device UQ metrics -> vectorized bootstrap CIs
+                -> detailed per-window frame -> artifacts
+
+``run_mcd_analysis`` / ``run_de_analysis`` correspond to the patient-level
+drivers (C13/C14); calling them with ``patient_ids=None`` and
+``detailed=False`` reproduces the global variants (C15/C16).  The
+reference's double T=50 prediction in evaluate_mcd_global.py:104,118 is
+intentionally not replicated — prediction runs once per test set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+import pandas as pd
+
+from apnea_uq_tpu.analysis.columns import (
+    COL_ENTROPY,
+    COL_PATIENT,
+    COL_PRED_LABEL,
+    COL_PROB,
+    COL_TRUE_LABEL,
+    COL_VARIANCE,
+    COL_WINDOW,
+)
+from apnea_uq_tpu.config import UQConfig
+from apnea_uq_tpu.evaluation.classification import evaluate_classification
+from apnea_uq_tpu.ops.entropy import binary_entropy
+from apnea_uq_tpu.training.trainer import predict_proba_batched
+from apnea_uq_tpu.uq.bootstrap import bootstrap_aggregates, compute_confidence_intervals
+from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
+from apnea_uq_tpu.uq.predict import ensemble_predict, mc_dropout_predict
+from apnea_uq_tpu.utils.timing import Timer, block
+
+# The reference's detailed CSV writes binary entropy of the mean prob in
+# BITS with eps 1e-9 (analyze_mcd_patient_level.py:113-115) while the
+# aggregate engine uses nats/1e-10 (uq_techniques.py:35-38); both are
+# explicit parameters here, defaulting to the per-surface reference values.
+DETAILED_ENTROPY_BASE = "bits"
+DETAILED_ENTROPY_EPS = 1e-9
+
+
+@dataclasses.dataclass
+class UQEvaluation:
+    """Aggregates + bootstrap CIs over one prediction stack (C12 parity)."""
+
+    aggregates: Dict[str, float]          # point estimates (full sample)
+    confidence_intervals: Dict[str, float]
+    per_window: Dict[str, np.ndarray]     # mean/variance/entropies/MI vectors
+    n_passes: int
+    n_windows: int
+
+
+@dataclasses.dataclass
+class UQRunResult:
+    """One driver run on one test set."""
+
+    label: str
+    predictions: np.ndarray               # (K, M) probability stack
+    evaluation: UQEvaluation
+    detailed: Optional[pd.DataFrame]      # reference detailed-CSV schema
+    classification: Dict                  # stochastic-mean-prob metric suite
+    deterministic_classification: Optional[Dict]  # eval-mode sanity check
+    predict_seconds: float
+
+
+def evaluate_uq(
+    predictions,
+    y_true,
+    config: UQConfig = UQConfig(),
+    *,
+    key: Optional[jax.Array] = None,
+    base: str = "nats",
+) -> UQEvaluation:
+    """Metric aggregates + bootstrap CIs from a (K, M) prediction stack.
+
+    One fused on-device computation replacing evaluate_uq_methods'
+    host-NumPy metric pass + B×(metric pass) bootstrap loop
+    (uq_techniques.py:323,341-346).
+    """
+    predictions = np.asarray(predictions)
+    if predictions.ndim == 3 and predictions.shape[-1] == 1:
+        predictions = predictions[..., 0]
+    metrics = uq_evaluation_dist(predictions, y_true, base=base, eps=config.entropy_eps)
+    boot = bootstrap_aggregates(
+        predictions,
+        y_true,
+        n_bootstrap=config.n_bootstrap,
+        key=key,
+        base=base,
+        eps=config.entropy_eps,
+        metrics=metrics,
+    )
+    metrics, boot = block((metrics, boot))
+
+    aggregates = {
+        "overall_mean_variance": float(metrics["overall_mean_variance"]),
+        "mean_variance_class_0": float(metrics["mean_variance_class_0"]),
+        "mean_variance_class_1": float(metrics["mean_variance_class_1"]),
+        "mean_total_pred_entropy": float(np.mean(metrics["total_pred_entropy"])),
+        "mean_expected_aleatoric_entropy": float(
+            np.mean(metrics["expected_aleatoric_entropy"])
+        ),
+        "mean_mutual_info": float(np.mean(metrics["mutual_info"])),
+    }
+    per_window = {
+        k: np.asarray(metrics[k])
+        for k in (
+            "mean_pred",
+            "pred_variance",
+            "total_pred_entropy",
+            "expected_aleatoric_entropy",
+            "mutual_info",
+        )
+    }
+    k_passes, m = (
+        predictions.shape if predictions.ndim >= 2 else (1, predictions.shape[0])
+    )
+    return UQEvaluation(
+        aggregates=aggregates,
+        confidence_intervals=compute_confidence_intervals(
+            boot, alpha=config.bootstrap_alpha
+        ),
+        per_window=per_window,
+        n_passes=int(k_passes),
+        n_windows=int(m),
+    )
+
+
+def detailed_frame(
+    predictions,
+    y_true,
+    patient_ids=None,
+    *,
+    threshold: float = 0.5,
+) -> pd.DataFrame:
+    """Per-window detailed results in the reference CSV schema.
+
+    Columns and semantics match analyze_mcd_patient_level.py:109-152 /
+    analyze_de_patient_level.py:121-164: mean probability over passes,
+    population variance, binary entropy of the mean in bits (eps 1e-9),
+    and the 0.5-threshold label.
+    """
+    predictions = np.asarray(predictions)
+    if predictions.ndim == 3 and predictions.shape[-1] == 1:
+        predictions = predictions[..., 0]
+    mean_prob = predictions.mean(axis=0)
+    variance = predictions.var(axis=0)
+    entropy = np.asarray(
+        binary_entropy(
+            mean_prob, base=DETAILED_ENTROPY_BASE, eps=DETAILED_ENTROPY_EPS
+        )
+    )
+    y_true = np.asarray(y_true).reshape(-1)
+    m = mean_prob.shape[0]
+    if y_true.shape[0] != m:
+        raise ValueError(f"labels ({y_true.shape[0]}) != windows ({m})")
+    if patient_ids is None:
+        patient_ids = np.full(m, "UNKNOWN")
+    patient_ids = np.asarray(patient_ids).reshape(-1)
+    if patient_ids.shape[0] != m:
+        raise ValueError(f"patient_ids ({patient_ids.shape[0]}) != windows ({m})")
+    return pd.DataFrame({
+        COL_PATIENT: patient_ids,
+        COL_WINDOW: np.arange(m),
+        COL_TRUE_LABEL: y_true.astype(np.int64),
+        COL_PRED_LABEL: (mean_prob >= threshold).astype(np.int64),
+        COL_PROB: mean_prob.astype(np.float64),
+        COL_VARIANCE: variance.astype(np.float64),
+        COL_ENTROPY: entropy.astype(np.float64),
+    })
+
+
+def _run_common(
+    label: str,
+    predictions: np.ndarray,
+    y_true,
+    patient_ids,
+    config: UQConfig,
+    deterministic_probs: Optional[np.ndarray],
+    predict_seconds: float,
+    detailed: bool,
+    bootstrap_key: Optional[jax.Array],
+) -> UQRunResult:
+    evaluation = evaluate_uq(predictions, y_true, config, key=bootstrap_key)
+    mean_prob = evaluation.per_window["mean_pred"]
+    classification = evaluate_classification(
+        mean_prob, y_true,
+        threshold=config.decision_threshold,
+        description=f"{label} (mean of {evaluation.n_passes} passes)",
+    )
+    det = None
+    if deterministic_probs is not None:
+        # The reference's pre-MCD sanity probe: eval-mode accuracy should
+        # sit near the deterministic ~88% (analyze_mcd_patient_level.py:203-211).
+        det = evaluate_classification(
+            deterministic_probs, y_true,
+            threshold=config.decision_threshold,
+            description=f"{label} (deterministic)",
+        )
+    frame = (
+        detailed_frame(
+            predictions, y_true, patient_ids, threshold=config.decision_threshold
+        )
+        if detailed
+        else None
+    )
+    return UQRunResult(
+        label=label,
+        predictions=predictions,
+        evaluation=evaluation,
+        detailed=frame,
+        classification=classification,
+        deterministic_classification=det,
+        predict_seconds=predict_seconds,
+    )
+
+
+def run_mcd_analysis(
+    model,
+    variables: dict,
+    x,
+    y_true,
+    *,
+    patient_ids=None,
+    config: UQConfig = UQConfig(),
+    label: str = "CNN_MCD",
+    key: Optional[jax.Array] = None,
+    detailed: bool = True,
+    sanity_check: bool = True,
+) -> UQRunResult:
+    """MC-Dropout UQ analysis of one test set (C13/C15).
+
+    T=``config.mc_passes`` stochastic passes under ``config.mcd_mode``
+    ('clean' frozen-BN MCD or 'parity' = the reference's training=True
+    regime), then the full metric/bootstrap/CSV pipeline.
+    """
+    if key is None:
+        key = jax.random.key(0)
+    predict_key, bootstrap_key = jax.random.split(key)
+    with Timer(f"{label}.predict") as t:
+        predictions = block(mc_dropout_predict(
+            model, variables, x,
+            n_passes=config.mc_passes,
+            mode=config.mcd_mode,
+            batch_size=config.inference_batch_size,
+            key=predict_key,
+        ))
+    det_probs = (
+        np.asarray(predict_proba_batched(
+            model, variables, x, batch_size=config.inference_batch_size
+        ))
+        if sanity_check
+        else None
+    )
+    return _run_common(
+        label, np.asarray(predictions), y_true, patient_ids, config,
+        det_probs, t.elapsed_s, detailed, bootstrap_key,
+    )
+
+
+def run_de_analysis(
+    model,
+    member_variables,
+    x,
+    y_true,
+    *,
+    patient_ids=None,
+    config: UQConfig = UQConfig(),
+    label: str = "CNN_DE",
+    key: Optional[jax.Array] = None,
+    detailed: bool = True,
+) -> UQRunResult:
+    """Deep-Ensemble UQ analysis of one test set (C14/C16).
+
+    Members are vmapped in one program (uq/predict.py) instead of the
+    reference's N sequential full-set predicts (uq_techniques.py:29-30).
+    """
+    bootstrap_key = jax.random.key(0) if key is None else key
+    with Timer(f"{label}.predict") as t:
+        predictions = block(ensemble_predict(
+            model, member_variables, x, batch_size=config.inference_batch_size
+        ))
+    return _run_common(
+        label, np.asarray(predictions), y_true, patient_ids, config,
+        None, t.elapsed_s, detailed, bootstrap_key,
+    )
+
+
+def save_run(registry, result: UQRunResult, *, config=None) -> Dict[str, str]:
+    """Persist a run's artifacts under canonical registry keys.
+
+    raw predictions -> ``raw_predictions:<label>`` (the reference's
+    mc_raw_pred*.npy dump, analyze_mcd_patient_level.py:100) and the
+    detailed frame -> ``detailed_windows:<label>`` (the L5->L6 CSV).
+    """
+    from apnea_uq_tpu.data import registry as reg
+
+    paths = {}
+    paths["raw_predictions"] = registry.save_arrays(
+        f"{reg.RAW_PREDICTIONS}:{result.label}",
+        {"predictions": result.predictions},
+        config=config,
+    )
+    if result.detailed is not None:
+        paths["detailed_windows"] = registry.save_table(
+            f"{reg.DETAILED_WINDOWS}:{result.label}", result.detailed, config=config
+        )
+    return paths

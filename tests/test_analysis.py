@@ -1,0 +1,165 @@
+"""Analysis-layer tests: patient aggregation parity with pandas reference
+semantics, window binning, and the in-tree stats vs scipy.stats."""
+
+import numpy as np
+import pandas as pd
+import pytest
+import scipy.stats
+
+from apnea_uq_tpu.analysis import (
+    COL_ENTROPY,
+    COL_PATIENT,
+    COL_PRED_LABEL,
+    COL_PROB,
+    COL_TRUE_LABEL,
+    COL_VARIANCE,
+    COL_WINDOW,
+    aggregate_patients,
+    mann_whitney_u,
+    patient_accuracy_entropy_correlation,
+    patient_summary_report,
+    pearson_corr,
+    uncertainty_correctness_test,
+    window_level_analysis,
+)
+
+
+def _detailed_frame(rng, n=400, n_patients=20):
+    pids = rng.integers(0, n_patients, n)
+    true = rng.integers(0, 2, n)
+    pred = np.where(rng.uniform(size=n) < 0.8, true, 1 - true)
+    prob = np.clip(pred * 0.8 + rng.normal(0, 0.1, n), 0.01, 0.99)
+    var = rng.uniform(0, 0.25, n)
+    # Incorrect windows get systematically higher entropy.
+    ent = rng.uniform(0, 1, n) + (pred != true) * 0.5
+    return pd.DataFrame({
+        COL_PATIENT: [f"P{i:03d}" for i in pids],
+        COL_WINDOW: np.arange(n),
+        COL_TRUE_LABEL: true,
+        COL_PRED_LABEL: pred,
+        COL_PROB: prob,
+        COL_VARIANCE: var,
+        COL_ENTROPY: ent,
+    })
+
+
+class TestAggregatePatients:
+    def test_schema_and_values(self, rng):
+        frame = _detailed_frame(rng)
+        summary = aggregate_patients(frame)
+        assert list(summary.columns) == [
+            COL_PATIENT, "mean_variance", "median_variance", "std_variance",
+            "mean_entropy", "median_entropy", "std_entropy",
+            "patient_accuracy", "num_windows",
+        ]
+        assert summary["num_windows"].sum() == len(frame)
+        # Spot-check one patient against direct computation.
+        pid = summary[COL_PATIENT].iloc[0]
+        sub = frame[frame[COL_PATIENT] == pid]
+        row = summary[summary[COL_PATIENT] == pid].iloc[0]
+        assert row["mean_variance"] == pytest.approx(sub[COL_VARIANCE].mean())
+        assert row["median_entropy"] == pytest.approx(sub[COL_ENTROPY].median())
+        assert row["patient_accuracy"] == pytest.approx(
+            (sub[COL_TRUE_LABEL] == sub[COL_PRED_LABEL]).mean()
+        )
+
+    def test_single_window_patient_std_zeroed(self, rng):
+        frame = _detailed_frame(rng, n=10, n_patients=3)
+        frame.loc[0, COL_PATIENT] = "SOLO"
+        frame = frame[(frame[COL_PATIENT] != "SOLO") | (frame.index == 0)]
+        summary = aggregate_patients(frame)
+        solo = summary[summary[COL_PATIENT] == "SOLO"].iloc[0]
+        assert solo["num_windows"] == 1
+        assert solo["std_variance"] == 0.0 and solo["std_entropy"] == 0.0
+
+    def test_missing_column_raises(self, rng):
+        frame = _detailed_frame(rng).drop(columns=[COL_VARIANCE])
+        with pytest.raises(ValueError, match="missing column"):
+            aggregate_patients(frame)
+
+    def test_report_runs(self, rng):
+        report = patient_summary_report(aggregate_patients(_detailed_frame(rng)))
+        assert "Top 5 patients" in report
+
+
+class TestWindowAnalysis:
+    def test_bins_cover_all_windows(self, rng):
+        frame = _detailed_frame(rng)
+        wa = window_level_analysis(frame, num_bins=10)
+        assert len(wa.binned) == 10
+        assert wa.binned["window_count"].sum() == len(frame)
+        np.testing.assert_allclose(
+            wa.binned["error_rate"].to_numpy(),
+            1.0 - wa.binned["accuracy"].to_numpy(),
+        )
+        assert wa.num_windows == len(frame)
+        assert 0.0 <= wa.overall_accuracy <= 1.0
+        assert "Binned accuracy" in wa.report()
+
+    def test_incorrect_windows_have_higher_entropy(self, rng):
+        wa = window_level_analysis(_detailed_frame(rng))
+        assert (
+            wa.incorrect_stats.loc["mean", COL_ENTROPY]
+            > wa.correct_stats.loc["mean", COL_ENTROPY]
+        )
+
+
+class TestPearson:
+    @pytest.mark.parametrize("n", [5, 30, 200])
+    def test_matches_scipy(self, rng, n):
+        x = rng.normal(size=n)
+        y = 0.5 * x + rng.normal(size=n)
+        r, p = pearson_corr(x, y)
+        r_ref, p_ref = scipy.stats.pearsonr(x, y)
+        assert r == pytest.approx(r_ref, abs=1e-12)
+        assert p == pytest.approx(p_ref, rel=1e-9)
+
+    def test_perfect_and_constant(self, rng):
+        x = rng.normal(size=20)
+        r, p = pearson_corr(x, 2 * x + 1)
+        assert r == pytest.approx(1.0) and p == 0.0
+        r, p = pearson_corr(x, np.zeros(20))
+        assert np.isnan(r) and np.isnan(p)
+
+
+class TestMannWhitney:
+    @pytest.mark.parametrize("alternative", ["two-sided", "greater", "less"])
+    def test_matches_scipy_asymptotic(self, rng, alternative):
+        x = rng.normal(0.3, 1.0, 80)
+        y = rng.normal(0.0, 1.0, 120)
+        u, p = mann_whitney_u(x, y, alternative=alternative)
+        ref = scipy.stats.mannwhitneyu(x, y, alternative=alternative,
+                                       method="asymptotic")
+        assert u == pytest.approx(ref.statistic)
+        assert p == pytest.approx(ref.pvalue, rel=1e-9)
+
+    def test_ties_match_scipy(self, rng):
+        x = rng.integers(0, 5, 60).astype(float)
+        y = rng.integers(0, 5, 70).astype(float)
+        u, p = mann_whitney_u(x, y, alternative="greater")
+        ref = scipy.stats.mannwhitneyu(x, y, alternative="greater",
+                                       method="asymptotic")
+        assert u == pytest.approx(ref.statistic)
+        assert p == pytest.approx(ref.pvalue, rel=1e-9)
+
+    def test_identical_samples_p_one(self):
+        u, p = mann_whitney_u([1.0, 1.0], [1.0, 1.0, 1.0])
+        assert p == 1.0
+
+
+class TestDrivers:
+    def test_correlation_driver(self, rng):
+        summary = aggregate_patients(_detailed_frame(rng))
+        out = patient_accuracy_entropy_correlation(summary)
+        r_ref, p_ref = scipy.stats.pearsonr(
+            summary["mean_entropy"], summary["patient_accuracy"]
+        )
+        assert out["pearson_r"] == pytest.approx(r_ref)
+        assert out["p_value"] == pytest.approx(p_ref, rel=1e-9)
+        assert out["n_patients"] == len(summary)
+
+    def test_mannwhitney_driver_detects_signal(self, rng):
+        out = uncertainty_correctness_test(_detailed_frame(rng, n=2000))
+        assert out["significant"]
+        assert out["median_incorrect"] > out["median_correct"]
+        assert out["n_incorrect"] + out["n_correct"] == 2000

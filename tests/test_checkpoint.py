@@ -1,0 +1,116 @@
+"""Checkpoint tests: state round-trip, per-member resume semantics,
+ensemble save/unstack, raw-prediction artifacts."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.config import ModelConfig
+from apnea_uq_tpu.models import AlarconCNN1D
+from apnea_uq_tpu.parallel.ensemble import init_ensemble_state
+from apnea_uq_tpu.training import (
+    EnsembleCheckpointStore,
+    create_train_state,
+    load_raw_predictions,
+    member_state,
+    restore_state,
+    save_ensemble,
+    save_raw_predictions,
+    save_state,
+)
+
+
+def _tiny():
+    return AlarconCNN1D(ModelConfig(
+        features=(4, 6), kernel_sizes=(3, 3), dropout_rates=(0.1, 0.1)
+    ))
+
+
+def _tree_allclose(a, b):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for la, lb in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb))
+
+
+def test_state_round_trip(tmp_path):
+    model = _tiny()
+    state = create_train_state(model, jax.random.key(3))
+    path = save_state(str(tmp_path / "ckpt"), state)
+    template = create_train_state(model, jax.random.key(99))  # different values
+    restored = restore_state(path, template)
+    _tree_allclose(state.params, restored.params)
+    _tree_allclose(state.batch_stats, restored.batch_stats)
+    _tree_allclose(state.opt_state, restored.opt_state)
+    assert int(restored.step) == int(state.step)
+
+
+def test_member_store_resume_semantics(tmp_path):
+    model = _tiny()
+    store = EnsembleCheckpointStore(str(tmp_path / "ens"))
+    assert store.existing_seeds() == []
+    assert not store.member_exists(2025)
+
+    s0 = create_train_state(model, jax.random.key(0))
+    s1 = create_train_state(model, jax.random.key(1))
+    store.save_member(2025, s0)
+    store.save_member(2026, s1)
+    assert store.existing_seeds() == [2025, 2026]
+    assert store.member_exists(2025) and not store.member_exists(2030)
+
+    template = create_train_state(model, jax.random.key(42))
+    r0 = store.restore_member(2025, template)
+    _tree_allclose(s0.params, r0.params)
+    # restore_members preserves order
+    r = store.restore_members([2026, 2025], template)
+    _tree_allclose(s1.params, r[0].params)
+    _tree_allclose(s0.params, r[1].params)
+
+
+def test_save_ensemble_unstacks_members(tmp_path):
+    model = _tiny()
+    stacked = init_ensemble_state(model, 3, jax.random.key(7))
+    store = EnsembleCheckpointStore(str(tmp_path / "ens"))
+    seeds = [2025, 2026, 2027]
+    save_ensemble(store, stacked, seeds)
+    assert store.existing_seeds() == seeds
+
+    template = create_train_state(model, jax.random.key(0))
+    for i, seed in enumerate(seeds):
+        restored = store.restore_member(seed, template)
+        _tree_allclose(member_state(stacked, i).params, restored.params)
+
+    # Members have distinct inits (per-member RNG folding).
+    l0 = jax.tree.leaves(member_state(stacked, 0).params)
+    l1 = jax.tree.leaves(member_state(stacked, 1).params)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(l0, l1)
+    )
+
+
+def test_save_ensemble_skip_existing(tmp_path):
+    model = _tiny()
+    store = EnsembleCheckpointStore(str(tmp_path / "ens"))
+    stacked_a = init_ensemble_state(model, 2, jax.random.key(1))
+    stacked_b = init_ensemble_state(model, 2, jax.random.key(2))
+    save_ensemble(store, stacked_a, [10, 11])
+    # With skip_existing, a second save must NOT overwrite member 10.
+    save_ensemble(store, stacked_b, [10, 12], skip_existing=True)
+    template = create_train_state(model, jax.random.key(0))
+    r10 = store.restore_member(10, template)
+    _tree_allclose(member_state(stacked_a, 0).params, r10.params)
+    assert store.existing_seeds() == [10, 11, 12]
+
+
+def test_raw_predictions_round_trip(tmp_path):
+    preds = np.random.default_rng(0).uniform(size=(5, 32)).astype(np.float32)
+    path = save_raw_predictions(str(tmp_path / "raw" / "mc_preds.npy"), preds)
+    assert os.path.exists(path)
+    loaded = load_raw_predictions(path)
+    np.testing.assert_array_equal(preds, loaded)
+    # jax arrays accepted too
+    save_raw_predictions(str(tmp_path / "raw" / "j.npy"), jnp.asarray(preds))

@@ -1,0 +1,214 @@
+"""End-to-end CLI pipeline test: every stage subcommand runs against one
+shared registry, in dependency order, on synthetic data with a tiny model.
+
+This is the integration test the reference never had — its stages were
+hand-run scripts whose file-name contracts drifted apart (SURVEY §1); here
+the whole chain prepare -> train -> train-ensemble -> eval-mcd/eval-de ->
+aggregate/analyze/correlate/sweep/figures runs in-process.
+"""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from apnea_uq_tpu.cli.main import main
+from apnea_uq_tpu.config import (
+    EnsembleConfig,
+    ExperimentConfig,
+    ModelConfig,
+    PrepareConfig,
+    TrainConfig,
+    UQConfig,
+    _to_jsonable,
+)
+from apnea_uq_tpu.data import WindowSet
+from apnea_uq_tpu.data import registry as reg
+from apnea_uq_tpu.data.registry import ArtifactRegistry
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    """Registry pre-seeded with synthetic windows + a tiny config file."""
+    root = tmp_path_factory.mktemp("cli")
+    registry_dir = str(root / "registry")
+    rng = np.random.default_rng(0)
+
+    n, n_patients = 480, 16
+    pids = np.array([f"P{i % n_patients:03d}" for i in range(n)])
+    y = rng.integers(0, 2, n).astype(np.int8)
+    x = rng.normal(size=(n, 60, 4)).astype(np.float32)
+    x[:, :, 0] += (y.astype(np.float32) * 2 - 1)[:, None] * 1.2
+    windows = WindowSet(
+        x=x, y=y, patient_ids=pids,
+        start_time_s=np.arange(n, dtype=np.int32) * 60,
+        channels=("SaO2", "PR", "THOR RES", "ABDO RES"),
+    )
+    ArtifactRegistry(registry_dir).save_arrays(reg.WINDOWS, windows.to_arrays())
+
+    config = ExperimentConfig(
+        model=ModelConfig(features=(4, 6), kernel_sizes=(3, 3),
+                          dropout_rates=(0.2, 0.3)),
+        train=TrainConfig(batch_size=64, num_epochs=2, validation_split=0.1,
+                          seed=1),
+        ensemble=EnsembleConfig(num_members=2, num_epochs=2, batch_size=64,
+                                seed_base=2025),
+        uq=UQConfig(mc_passes=4, n_bootstrap=10, inference_batch_size=128),
+        prepare=PrepareConfig(smote=False),
+    )
+    config_path = str(root / "config.json")
+    with open(config_path, "w") as f:
+        json.dump(_to_jsonable(config), f)
+    return {"root": root, "registry": registry_dir, "config": config_path}
+
+
+def run(*argv) -> int:
+    return main(list(argv))
+
+
+@pytest.mark.parametrize("order", [0])
+def test_full_pipeline(env, order, capsys):
+    registry_dir, config = env["registry"], env["config"]
+    registry = ArtifactRegistry(registry_dir)
+
+    # -- prepare ----------------------------------------------------------
+    assert run("prepare", "--registry", registry_dir, "--config", config) == 0
+    assert registry.exists(reg.TRAIN_STD_SMOTE)
+    assert registry.exists(reg.TEST_STD_UNBALANCED)
+    assert registry.exists(reg.TEST_STD_RUS)
+
+    # -- train baseline ---------------------------------------------------
+    assert run("train", "--registry", registry_dir, "--config", config) == 0
+    out = capsys.readouterr().out
+    assert "saved baseline checkpoint" in out
+    assert "baseline on Unbalanced" in out
+
+    # -- train ensemble + idempotent resume -------------------------------
+    assert run("train-ensemble", "--registry", registry_dir,
+               "--config", config) == 0
+    assert "saved 2 members" in capsys.readouterr().out
+    assert run("train-ensemble", "--registry", registry_dir,
+               "--config", config) == 0
+    assert "nothing to do" in capsys.readouterr().out
+
+    # -- eval-mcd / eval-de -----------------------------------------------
+    assert run("eval-mcd", "--registry", registry_dir, "--config", config) == 0
+    out = capsys.readouterr().out
+    assert "CNN_MCD_Unbalanced" in out and "overall_mean_variance" in out
+    assert registry.exists(f"{reg.DETAILED_WINDOWS}:CNN_MCD_Unbalanced")
+    assert registry.exists(f"{reg.RAW_PREDICTIONS}:CNN_MCD_Balanced_RUS")
+
+    assert run("eval-de", "--registry", registry_dir, "--config", config,
+               "--num-members", "2") == 0
+    capsys.readouterr()
+    assert registry.exists(f"{reg.DETAILED_WINDOWS}:CNN_DE_Unbalanced")
+    preds = registry.load_arrays(f"{reg.RAW_PREDICTIONS}:CNN_DE_Unbalanced")
+    assert preds["predictions"].shape[0] == 2
+
+    # -- aggregate / analyze / correlate ----------------------------------
+    assert run("aggregate-patients", "--registry", registry_dir,
+               "--config", config, "--label", "CNN_MCD_Unbalanced") == 0
+    assert "Top 5 patients" in capsys.readouterr().out
+    summary = registry.load_table(f"{reg.PATIENT_SUMMARY}:CNN_MCD_Unbalanced")
+    detailed = registry.load_table(f"{reg.DETAILED_WINDOWS}:CNN_MCD_Unbalanced")
+    assert summary["num_windows"].sum() == len(detailed)
+
+    assert run("analyze-windows", "--registry", registry_dir,
+               "--config", config, "--label", "CNN_MCD_Unbalanced") == 0
+    assert "Binned accuracy" in capsys.readouterr().out
+
+    assert run("correlate", "--registry", registry_dir, "--config", config,
+               "--labels", "CNN_MCD_Unbalanced") == 0
+    out = capsys.readouterr().out
+    assert "patient accuracy vs mean entropy" in out
+    assert "entropy(incorrect) > entropy(correct)" in out
+
+    # -- sweep -------------------------------------------------------------
+    plot_path = str(env["root"] / "mcd_conv.png")
+    assert run("sweep", "--registry", registry_dir, "--config", config,
+               "--method", "mcd", "--counts", "2", "4",
+               "--plot", plot_path) == 0
+    capsys.readouterr()
+    assert os.path.getsize(plot_path) > 0
+    sweep_frame = registry.load_table("sweep:mcd")
+    assert sweep_frame["N"].tolist() == [2, 4]
+
+    assert run("sweep", "--registry", registry_dir, "--config", config,
+               "--method", "de", "--counts", "1", "2") == 0
+    capsys.readouterr()
+
+    # -- figures ------------------------------------------------------------
+    fig_dir = str(env["root"] / "figs")
+    assert run("figures", "--registry", registry_dir, "--config", config,
+               "--labels", "CNN_MCD_Unbalanced", "CNN_DE_Unbalanced",
+               "--out-dir", fig_dir) == 0
+    capsys.readouterr()
+    assert len(os.listdir(fig_dir)) == 4
+
+
+def test_cohort_stage(env, tmp_path, capsys):
+    rng = np.random.default_rng(1)
+    n = 100
+    pd.DataFrame({
+        "ahi_a0h3a": rng.exponential(10, n),
+        "age_s2": rng.normal(60, 8, n),
+        "gender": rng.choice([1, 2], n),
+        "quoxim": rng.choice([4, 5], n),
+    }).to_csv(tmp_path / "meta.csv", index=False)
+    assert run("cohort", "--metadata-csv", str(tmp_path / "meta.csv"),
+               "--signal-quality") == 0
+    out = capsys.readouterr().out
+    assert "AHI severity distribution" in out and "Oximeter" in out
+
+
+def test_ingest_stage(env, tmp_path, capsys):
+    from apnea_uq_tpu.data.edf import EdfSignal, write_edf
+
+    rng = np.random.default_rng(2)
+    edf_dir = tmp_path / "edf"
+    xml_dir = tmp_path / "xml"
+    edf_dir.mkdir()
+    xml_dir.mkdir()
+    n_seconds = 360
+    for patient in ("200001", "200002"):
+        signals = [
+            EdfSignal("SaO2", 1.0,
+                      (95 + rng.normal(0, 1, n_seconds)).astype(np.float32)),
+            EdfSignal("PR", 1.0,
+                      (70 + rng.normal(0, 5, n_seconds)).astype(np.float32)),
+            EdfSignal("THOR RES", 10.0,
+                      rng.normal(0, 0.5, 10 * n_seconds).astype(np.float32)),
+            EdfSignal("ABDO RES", 10.0,
+                      rng.normal(0, 0.5, 10 * n_seconds).astype(np.float32)),
+        ]
+        write_edf(str(edf_dir / f"shhs2-{patient}.edf"), signals)
+        (xml_dir / f"shhs2-{patient}-nsrr.xml").write_text(
+            """<?xml version="1.0"?>
+<PSGAnnotation><ScoredEvents>
+<ScoredEvent><EventType>Recording Start Time</EventType>
+<EventConcept>Recording Start Time</EventConcept>
+<Start>0</Start><Duration>25200</Duration></ScoredEvent>
+<ScoredEvent><EventType>Respiratory|Respiratory</EventType>
+<EventConcept>Obstructive apnea|Obstructive Apnea</EventConcept>
+<Start>70</Start><Duration>25</Duration></ScoredEvent>
+</ScoredEvents></PSGAnnotation>
+"""
+        )
+    registry_dir = str(tmp_path / "ingest_registry")
+    assert run("ingest", "--edf-dir", str(edf_dir), "--xml-dir", str(xml_dir),
+               "--registry", registry_dir) == 0
+    out = capsys.readouterr().out
+    assert "processed 2 recordings" in out
+    arrays = ArtifactRegistry(registry_dir).load_arrays(reg.WINDOWS)
+    assert arrays["x"].shape[1:] == (60, 4)
+    assert arrays["x"].shape[0] == 12  # 2 recordings x 6 windows
+
+
+def test_init_config(tmp_path, capsys):
+    out_path = str(tmp_path / "cfg.json")
+    assert run("init-config", "--out", out_path) == 0
+    with open(out_path) as f:
+        data = json.load(f)
+    assert set(data) >= {"model", "train", "ensemble", "uq"}

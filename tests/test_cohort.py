@@ -1,0 +1,82 @@
+"""Cohort/signal-quality stats tests over synthetic NSRR-shaped metadata."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from apnea_uq_tpu.analysis.cohort import (
+    ahi_severity_distribution,
+    analyze_cohort,
+    analyze_signal_quality,
+    define_cohort,
+    format_cohort_report,
+    format_signal_quality_report,
+)
+
+
+@pytest.fixture
+def metadata(rng):
+    n = 500
+    ahi = rng.exponential(12.0, n)
+    ahi[rng.uniform(size=n) < 0.1] = np.nan  # 10% missing -> excluded
+    return pd.DataFrame({
+        "nsrrid": np.arange(n),
+        "ahi_a0h3a": ahi,
+        "age_s2": rng.normal(63, 10, n).round(1),
+        "gender": rng.choice([1, 2], n),
+        "race": rng.choice([1, 2, 3], n, p=[0.8, 0.15, 0.05]),
+        "quoxim": rng.choice([1, 2, 3, 4, 5], n),
+        "quhr": rng.choice([3, 4, 5], n),
+        "quchest": rng.choice([4, 5], n),
+        "quabdo": rng.choice([4, 5], n),
+    })
+
+
+def test_cohort_excludes_missing_ahi(metadata):
+    cohort = define_cohort(metadata)
+    assert len(cohort) == metadata["ahi_a0h3a"].notna().sum()
+    assert cohort["ahi_a0h3a"].notna().all()
+
+
+def test_missing_ahi_column_raises():
+    with pytest.raises(ValueError, match="AHI column"):
+        define_cohort(pd.DataFrame({"x": [1]}))
+
+
+def test_severity_bins_partition_cohort(metadata):
+    cohort = define_cohort(metadata)
+    dist = ahi_severity_distribution(cohort)
+    assert dist["count"].sum() == len(cohort)
+    assert dist["percent"].sum() == pytest.approx(100.0)
+    # Direct check of one bin.
+    mild = ((cohort["ahi_a0h3a"] >= 5) & (cohort["ahi_a0h3a"] < 15)).sum()
+    assert dist.loc[dist["category"].str.startswith("Mild"), "count"].iloc[0] == mild
+
+
+def test_analyze_cohort_structure(metadata):
+    stats = analyze_cohort(metadata)
+    assert stats["n_cohort"] < stats["n_total_records"]
+    assert stats["age"]["n"] == stats["n_cohort"]
+    gender_total = sum(c["count"] for c in stats["gender"]["categories"].values())
+    assert gender_total == stats["n_cohort"]
+    assert "Male" in stats["gender"]["categories"]
+    report = format_cohort_report(stats)
+    assert "AHI severity distribution" in report and "Male" in report
+
+
+def test_signal_quality(metadata):
+    stats = analyze_signal_quality(metadata)
+    assert set(stats["channels"]) == {"quoxim", "quhr", "quchest", "quabdo"}
+    ox = stats["channels"]["quoxim"]
+    assert ox["n"] == stats["n_cohort"]
+    assert sum(c["count"] for c in ox["categories"].values()) == ox["n"]
+    # quchest only has codes 4 and 5 in the fixture.
+    chest_labels = set(stats["channels"]["quchest"]["categories"])
+    assert chest_labels == {"75-94% artifact-free", ">=95% artifact-free"}
+    report = format_signal_quality_report(stats)
+    assert "Oximeter" in report
+
+
+def test_signal_quality_missing_columns(metadata):
+    stats = analyze_signal_quality(metadata.drop(columns=["quhr", "quabdo"]))
+    assert set(stats["channels"]) == {"quoxim", "quchest"}

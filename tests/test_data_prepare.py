@@ -116,6 +116,10 @@ class TestPrepare:
         # Manifest records shapes for auditability.
         entry = registry.describe("train_std_smote")
         assert entry["arrays"]["x"]["shape"] == list(prepared.x_train.shape)
+        # Inference-only stages skip the (largest) train artifact.
+        test_only = load_prepared(registry, include_train=False)
+        assert test_only.x_train is None and test_only.y_train is None
+        np.testing.assert_array_equal(test_only.x_test, prepared.x_test)
 
 
 class TestRegistry:

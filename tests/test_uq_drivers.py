@@ -1,0 +1,162 @@
+"""Driver pipeline tests: detailed-frame schema/semantics, evaluate_uq
+aggregates vs direct computation, MCD/DE end-to-end on a tiny model, and
+registry artifact round-trip."""
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from apnea_uq_tpu.analysis.columns import DETAILED_COLUMNS
+from apnea_uq_tpu.config import ModelConfig, UQConfig
+from apnea_uq_tpu.data.registry import ArtifactRegistry
+from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+from apnea_uq_tpu.uq import (
+    detailed_frame,
+    evaluate_uq,
+    run_de_analysis,
+    run_mcd_analysis,
+    save_run,
+)
+
+
+def _tiny():
+    return AlarconCNN1D(ModelConfig(
+        features=(4, 6), kernel_sizes=(3, 3), dropout_rates=(0.3, 0.3)
+    ))
+
+
+@pytest.fixture(scope="module")
+def stack(    ):
+    rng = np.random.default_rng(7)
+    preds = rng.uniform(0.0, 1.0, size=(10, 200)).astype(np.float32)
+    y = rng.integers(0, 2, 200)
+    return preds, y
+
+
+class TestDetailedFrame:
+    def test_schema_and_values(self, stack):
+        preds, y = stack
+        pids = np.array([f"P{i % 5}" for i in range(200)])
+        frame = detailed_frame(preds, y, pids)
+        assert tuple(frame.columns) == DETAILED_COLUMNS
+        np.testing.assert_allclose(
+            frame["Predicted_Probability"], preds.mean(axis=0), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            frame["Predictive_Variance"], preds.var(axis=0), rtol=1e-5
+        )
+        # Entropy is bits: mean prob 0.5 -> 1 bit.
+        const = detailed_frame(np.full((3, 4), 0.5), np.zeros(4))
+        np.testing.assert_allclose(const["Predictive_Entropy"], 1.0, atol=1e-5)
+        # Threshold at 0.5 on the MEAN prob.
+        np.testing.assert_array_equal(
+            frame["Predicted_Label"], (preds.mean(axis=0) >= 0.5).astype(int)
+        )
+
+    def test_squeezes_trailing_axis_and_defaults_ids(self, stack):
+        preds, y = stack
+        frame = detailed_frame(preds[..., None], y)
+        assert (frame["Patient_ID"] == "UNKNOWN").all()
+
+    def test_length_mismatch_raises(self, stack):
+        preds, y = stack
+        with pytest.raises(ValueError, match="labels"):
+            detailed_frame(preds, y[:-1])
+        with pytest.raises(ValueError, match="patient_ids"):
+            detailed_frame(preds, y, np.arange(5))
+
+
+class TestEvaluateUQ:
+    def test_aggregates_match_direct(self, stack):
+        preds, y = stack
+        ev = evaluate_uq(preds, y, UQConfig(n_bootstrap=50))
+        assert ev.n_passes == 10 and ev.n_windows == 200
+        assert ev.aggregates["overall_mean_variance"] == pytest.approx(
+            float(preds.var(axis=0).mean()), rel=1e-5
+        )
+        # Decomposition identity: total ~ aleatoric + MI per window.
+        pw = ev.per_window
+        np.testing.assert_allclose(
+            pw["total_pred_entropy"],
+            pw["expected_aleatoric_entropy"] + pw["mutual_info"],
+            atol=1e-5,
+        )
+
+    def test_accepts_trailing_singleton_axis(self, stack):
+        preds, y = stack
+        ev = evaluate_uq(preds[..., None], y, UQConfig(n_bootstrap=10))
+        assert ev.n_passes == 10 and ev.n_windows == 200
+
+    def test_ci_bounds_ordered_and_cover_point(self, stack):
+        preds, y = stack
+        ev = evaluate_uq(preds, y, UQConfig(n_bootstrap=200))
+        ci = ev.confidence_intervals
+        for name in ("overall_mean_variance", "mean_mutual_info"):
+            lo, hi = ci[f"{name}_ci_lower"], ci[f"{name}_ci_upper"]
+            assert lo <= hi
+            assert lo - 0.05 <= ev.aggregates[name] <= hi + 0.05
+
+
+class TestEndToEnd:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        model = _tiny()
+        variables = init_variables(model, jax.random.key(0))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 60, 4)).astype(np.float32)
+        y = rng.integers(0, 2, 64)
+        pids = np.array([f"P{i % 4}" for i in range(64)])
+        return model, variables, x, y, pids
+
+    def test_mcd_run(self, setup):
+        model, variables, x, y, pids = setup
+        cfg = UQConfig(mc_passes=8, n_bootstrap=20, inference_batch_size=32)
+        result = run_mcd_analysis(
+            model, variables, x, y, patient_ids=pids, config=cfg,
+            key=jax.random.key(1),
+        )
+        assert result.predictions.shape == (8, 64)
+        assert ((result.predictions >= 0) & (result.predictions <= 1)).all()
+        # Stochastic passes actually differ (dropout active).
+        assert result.predictions.std(axis=0).max() > 0
+        assert result.detailed is not None and len(result.detailed) == 64
+        assert result.deterministic_classification is not None
+        assert 0.0 <= result.classification["accuracy"] <= 1.0
+        assert result.predict_seconds > 0
+
+    def test_mcd_parity_mode_runs(self, setup):
+        model, variables, x, y, pids = setup
+        cfg = UQConfig(mc_passes=4, n_bootstrap=10, mcd_mode="parity",
+                       inference_batch_size=64)
+        result = run_mcd_analysis(
+            model, variables, x, y, config=cfg, detailed=False,
+            sanity_check=False,
+        )
+        assert result.detailed is None
+        assert result.deterministic_classification is None
+
+    def test_de_run_and_registry(self, setup, tmp_path):
+        model, variables, x, y, pids = setup
+        members = [init_variables(model, jax.random.key(s)) for s in range(3)]
+        cfg = UQConfig(n_bootstrap=20, inference_batch_size=32)
+        result = run_de_analysis(
+            model, members, x, y, patient_ids=pids, config=cfg,
+            label="DE_test",
+        )
+        assert result.predictions.shape == (3, 64)
+        # Deterministic members: repeat run gives identical predictions.
+        again = run_de_analysis(model, members, x, y, config=cfg, detailed=False)
+        np.testing.assert_allclose(result.predictions, again.predictions, atol=1e-6)
+
+        registry = ArtifactRegistry(str(tmp_path))
+        paths = save_run(registry, result)
+        assert set(paths) == {"raw_predictions", "detailed_windows"}
+        loaded = registry.load_arrays("raw_predictions:DE_test")
+        np.testing.assert_allclose(loaded["predictions"], result.predictions)
+        table = registry.load_table("detailed_windows:DE_test")
+        assert tuple(table.columns) == DETAILED_COLUMNS
+        pd.testing.assert_frame_equal(
+            table, result.detailed, check_dtype=False, check_exact=False,
+            rtol=1e-6,
+        )
