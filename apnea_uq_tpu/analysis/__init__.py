@@ -19,10 +19,11 @@ from apnea_uq_tpu.analysis.stats import (
     pearson_corr,
     uncertainty_correctness_test,
 )
-from apnea_uq_tpu.analysis.sweep import (
-    de_member_sweep,
-    mcd_pass_sweep,
-)
+# NOTE: apnea_uq_tpu.analysis.sweep is intentionally NOT imported here —
+# it pulls in jax/flax via uq.predict, and the pure-pandas analysis stages
+# (aggregate-patients, analyze-windows, correlate, figures) must stay
+# importable and fast without a device runtime.  Import it directly:
+# ``from apnea_uq_tpu.analysis.sweep import mcd_pass_sweep``.
 from apnea_uq_tpu.analysis.windows import WindowAnalysis, window_level_analysis
 
 __all__ = [
@@ -43,6 +44,4 @@ __all__ = [
     "mann_whitney_u",
     "patient_accuracy_entropy_correlation",
     "uncertainty_correctness_test",
-    "mcd_pass_sweep",
-    "de_member_sweep",
 ]

@@ -272,12 +272,11 @@ def cmd_analyze_windows(args, config) -> int:
 
 def cmd_correlate(args, config) -> int:
     from apnea_uq_tpu.analysis import (
+        aggregate_patients,
         patient_accuracy_entropy_correlation,
         uncertainty_correctness_test,
     )
     from apnea_uq_tpu.data import registry as reg
-
-    from apnea_uq_tpu.analysis import aggregate_patients
 
     registry = _registry(args)
     for label in args.labels:
@@ -302,7 +301,7 @@ def cmd_correlate(args, config) -> int:
 def cmd_sweep(args, config) -> int:
     import jax
 
-    from apnea_uq_tpu.analysis import de_member_sweep, mcd_pass_sweep
+    from apnea_uq_tpu.analysis.sweep import de_member_sweep, mcd_pass_sweep
     from apnea_uq_tpu.analysis.plots import plot_convergence
     from apnea_uq_tpu.training import restore_state
 

@@ -1,10 +1,8 @@
 from apnea_uq_tpu.training.checkpoint import (
     EnsembleCheckpointStore,
-    load_raw_predictions,
     member_state,
     restore_state,
     save_ensemble,
-    save_raw_predictions,
     save_state,
 )
 from apnea_uq_tpu.training.state import TrainState, create_train_state
@@ -21,6 +19,4 @@ __all__ = [
     "restore_state",
     "member_state",
     "save_ensemble",
-    "save_raw_predictions",
-    "load_raw_predictions",
 ]

@@ -125,19 +125,3 @@ def save_ensemble(
             continue
         paths.append(store.save_member(seed, member_state(stacked, i)))
     return paths
-
-
-def save_raw_predictions(path: str, predictions) -> str:
-    """Persist a (K, M) prediction stack, the reference's raw-pred artifact
-    (analyze_mcd_patient_level.py:100)."""
-    path = _abspath(path)
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    np.save(path, np.asarray(predictions))
-    return path if path.endswith(".npy") else path + ".npy"
-
-
-def load_raw_predictions(path: str) -> np.ndarray:
-    path = _abspath(path)
-    if not path.endswith(".npy"):
-        path += ".npy"
-    return np.load(path)

@@ -1,5 +1,5 @@
 """Checkpoint tests: state round-trip, per-member resume semantics,
-ensemble save/unstack, raw-prediction artifacts."""
+ensemble save/unstack."""
 
 import os
 
@@ -14,11 +14,9 @@ from apnea_uq_tpu.parallel.ensemble import init_ensemble_state
 from apnea_uq_tpu.training import (
     EnsembleCheckpointStore,
     create_train_state,
-    load_raw_predictions,
     member_state,
     restore_state,
     save_ensemble,
-    save_raw_predictions,
     save_state,
 )
 
@@ -104,13 +102,3 @@ def test_save_ensemble_skip_existing(tmp_path):
     r10 = store.restore_member(10, template)
     _tree_allclose(member_state(stacked_a, 0).params, r10.params)
     assert store.existing_seeds() == [10, 11, 12]
-
-
-def test_raw_predictions_round_trip(tmp_path):
-    preds = np.random.default_rng(0).uniform(size=(5, 32)).astype(np.float32)
-    path = save_raw_predictions(str(tmp_path / "raw" / "mc_preds.npy"), preds)
-    assert os.path.exists(path)
-    loaded = load_raw_predictions(path)
-    np.testing.assert_array_equal(preds, loaded)
-    # jax arrays accepted too
-    save_raw_predictions(str(tmp_path / "raw" / "j.npy"), jnp.asarray(preds))

@@ -9,11 +9,10 @@ import jax
 
 from apnea_uq_tpu.analysis import (
     aggregate_patients,
-    de_member_sweep,
-    mcd_pass_sweep,
     window_level_analysis,
 )
 from apnea_uq_tpu.analysis import plots
+from apnea_uq_tpu.analysis.sweep import de_member_sweep, mcd_pass_sweep
 from apnea_uq_tpu.config import ModelConfig, UQConfig
 from apnea_uq_tpu.models import AlarconCNN1D, init_variables
 
