@@ -1,4 +1,5 @@
 from apnea_uq_tpu.ops.entropy import binary_entropy
 from apnea_uq_tpu.ops.losses import masked_bce_with_logits
+from apnea_uq_tpu.ops.pallas_uq import fused_uq_stats
 
-__all__ = ["binary_entropy", "masked_bce_with_logits"]
+__all__ = ["binary_entropy", "masked_bce_with_logits", "fused_uq_stats"]

@@ -1,0 +1,139 @@
+"""Fused per-window UQ reduction as a Pallas TPU kernel.
+
+The UQ hot op (SURVEY §3.3) reads a (K, M) stack of positive-class
+probabilities (K = MC passes or ensemble members, M = windows, M >> K) and
+produces five per-window vectors: mean, population variance, total
+uncertainty H[E[p]], aleatoric proxy E[H[p]], and the epistemic mutual
+information max(total - aleatoric, 0).  The reference computes these on
+host NumPy with a Python loop over passes (uq_techniques.py:69-91); the
+jnp engine in :mod:`apnea_uq_tpu.uq.metrics` is one jitted reduction; this
+kernel goes one step further and fuses *all five* outputs into a single
+pass over the stack in VMEM — each (K, TILE) column block is read from HBM
+exactly once, and every output row is produced from registers.
+
+Measured on a v5e chip (K=50, M=4.2M, chained-iteration timing): this
+kernel sustains ~92 GB/s vs ~98 GB/s for the jitted jnp engine — XLA's
+own fusion of the same reduction is already near-optimal, and the ~10%
+gap tracks the 50->56 sublane padding of the (K, TILE) input block.  The
+kernel is therefore shipped as a selectable alternate engine
+(``uq_evaluation_dist(engine='pallas')``), not the default.
+
+Layout: windows ride the 128-wide lane dimension (the natural vectorization
+axis — metrics are independent per window), passes ride sublanes and are
+reduced in-register.  Outputs are packed as rows of one (8, M) array so the
+kernel has a single aligned (8, TILE) f32 output tile per grid step.
+
+The kernel runs in interpret mode off-TPU, so the same code path is
+testable on the CPU CI mesh (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_LN2 = 0.6931471805599453
+
+# Output row indices in the packed (8, M) result.
+ROW_MEAN = 0
+ROW_VARIANCE = 1
+ROW_TOTAL_ENTROPY = 2
+ROW_ALEATORIC = 3
+ROW_MUTUAL_INFO = 4
+_N_ROWS = 8  # padded to the f32 (8, 128) sublane tile
+
+
+def _xlogx(v):
+    """x*log(x) with the 0*log(0)=0 convention (f32-safe near p=1)."""
+    return jnp.where(v > 0.0, v * jnp.log(jnp.maximum(v, 1e-38)), 0.0)
+
+
+def _uq_kernel(p_ref, out_ref, *, scale: float, eps: float):
+    p = p_ref[...].astype(jnp.float32)                      # (K, TILE)
+    k = p.shape[0]
+    mean = jnp.mean(p, axis=0, keepdims=True)               # (1, TILE)
+    # Population variance (np.var ddof=0 parity, uq_techniques.py:72).
+    centered = p - mean
+    var = jnp.mean(centered * centered, axis=0, keepdims=True)
+
+    pc = jnp.clip(p, eps, 1.0 - eps)
+    ent = -(_xlogx(pc) + _xlogx(1.0 - pc)) * scale          # (K, TILE)
+    aleatoric = jnp.mean(ent, axis=0, keepdims=True)
+
+    mc = jnp.clip(mean, eps, 1.0 - eps)
+    total = -(_xlogx(mc) + _xlogx(1.0 - mc)) * scale
+    mi = jnp.maximum(total - aleatoric, 0.0)                # uq_techniques.py:91
+
+    pad = jnp.zeros((_N_ROWS - 5, total.shape[1]), jnp.float32)
+    out_ref[...] = jnp.concatenate([mean, var, total, aleatoric, mi, pad], axis=0)
+    del k
+
+
+@partial(jax.jit, static_argnames=("base", "eps", "tile", "interpret"))
+def _fused_call(predictions, base, eps, tile, interpret):
+    k, m = predictions.shape
+    m_padded = ((m + tile - 1) // tile) * tile
+    # Pad windows with 0.5: entropy-safe, and sliced off before returning.
+    p = jnp.pad(predictions.astype(jnp.float32), ((0, 0), (0, m_padded - m)),
+                constant_values=0.5)
+    scale = 1.0 if base == "nats" else 1.0 / _LN2
+    kernel = partial(_uq_kernel, scale=scale, eps=eps)
+    extra = {}
+    if not interpret and pltpu is not None:
+        # Window tiles are independent -> let Mosaic parallelize the grid.
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        )
+    out = pl.pallas_call(
+        kernel,
+        grid=(m_padded // tile,),
+        in_specs=[pl.BlockSpec((k, tile), lambda j: (0, j))],
+        out_specs=pl.BlockSpec((_N_ROWS, tile), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((_N_ROWS, m_padded), jnp.float32),
+        interpret=interpret,
+        **extra,
+    )(p)
+    return out[:, :m]
+
+
+def fused_uq_stats(
+    predictions,
+    *,
+    base: str = "nats",
+    eps: float = 1e-10,
+    tile: int = 2048,
+    interpret: bool | None = None,
+) -> Dict[str, jax.Array]:
+    """Per-window UQ vectors from a (K, M) stack in one fused HBM pass.
+
+    Returns the five per-window keys of
+    :func:`apnea_uq_tpu.uq.metrics.uq_evaluation_dist` (aggregates are
+    cheap O(M) follow-ups and stay in jnp).  ``interpret=None`` auto-selects
+    interpret mode off-TPU so tests run on the CPU mesh.
+    """
+    if base not in ("nats", "bits"):
+        raise ValueError(f"base must be 'nats' or 'bits', got {base!r}")
+    predictions = jnp.asarray(predictions)
+    if predictions.ndim != 2:
+        raise ValueError(f"expected (K, M) predictions, got {predictions.shape}")
+    if tile % 128 != 0:
+        raise ValueError(f"tile must be a multiple of 128 lanes, got {tile}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out = _fused_call(predictions, base, eps, int(tile), bool(interpret))
+    return {
+        "mean_pred": out[ROW_MEAN],
+        "pred_variance": out[ROW_VARIANCE],
+        "total_pred_entropy": out[ROW_TOTAL_ENTROPY],
+        "expected_aleatoric_entropy": out[ROW_ALEATORIC],
+        "mutual_info": out[ROW_MUTUAL_INFO],
+    }

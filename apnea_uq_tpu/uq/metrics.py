@@ -38,7 +38,13 @@ def _uq_core(predictions: jax.Array, y_true: jax.Array, base: str, eps: float) -
     total = binary_entropy(mean_pred, base=base, eps=eps)               # H[E[p]]
     aleatoric = jnp.mean(binary_entropy(predictions, base=base, eps=eps), axis=0)  # E[H[p]]
     mutual_info = jnp.maximum(total - aleatoric, 0.0)  # uq_techniques.py:91
+    return _aggregate(
+        mean_pred, pred_variance, total, aleatoric, mutual_info, y_true
+    )
 
+
+@jax.jit
+def _aggregate(mean_pred, pred_variance, total, aleatoric, mutual_info, y_true):
     y = y_true.astype(jnp.int32)
     mask0 = (y == 0).astype(jnp.float32)
     mask1 = (y == 1).astype(jnp.float32)
@@ -66,13 +72,22 @@ def uq_evaluation_dist(
     *,
     base: str = "nats",
     eps: float = 1e-10,
+    engine: str = "jnp",
 ) -> Dict[str, jax.Array]:
     """UQ metric suite from a (K, M) (or (K, M, 1) / (M,)) prediction stack.
 
     Degenerate-input handling mirrors uq_techniques.py:61-66: trailing
     singleton dims are squeezed and a 1-D input is treated as a single
     pass (variance and MI collapse to zero).
+
+    ``engine`` selects the per-window reduction implementation: ``'jnp'``
+    (default, one jitted XLA fusion) or ``'pallas'`` (the fused Mosaic
+    kernel in :mod:`apnea_uq_tpu.ops.pallas_uq`; runs in interpret mode
+    off-TPU).  Both produce identical results — see the measurement note
+    in ops/pallas_uq.py for why jnp stays the default.
     """
+    if engine not in ("jnp", "pallas"):
+        raise ValueError(f"engine must be 'jnp' or 'pallas', got {engine!r}")
     predictions = jnp.asarray(predictions)
     # Squeeze ONLY a trailing singleton output axis of a (K, M, 1) stack —
     # a blanket squeeze would misread a (K, 1) single-window stack as
@@ -89,6 +104,18 @@ def uq_evaluation_dist(
         raise ValueError(
             f"labels ({y_true.shape[0]}) do not match prediction windows "
             f"({predictions.shape[1]})"
+        )
+    if engine == "pallas":
+        from apnea_uq_tpu.ops.pallas_uq import fused_uq_stats
+
+        per_window = fused_uq_stats(predictions, base=base, eps=eps)
+        return _aggregate(
+            per_window["mean_pred"],
+            per_window["pred_variance"],
+            per_window["total_pred_entropy"],
+            per_window["expected_aleatoric_entropy"],
+            per_window["mutual_info"],
+            y_true,
         )
     return _uq_core(predictions, y_true, base, eps)
 
