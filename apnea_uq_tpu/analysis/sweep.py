@@ -24,6 +24,7 @@ import pandas as pd
 
 from apnea_uq_tpu.config import UQConfig
 from apnea_uq_tpu.uq.predict import ensemble_predict, mc_dropout_predict
+from apnea_uq_tpu.utils import prng
 
 # Reference operating points (BASELINE.json sweep axes).
 DEFAULT_PASS_COUNTS = (10, 25, 50, 100)
@@ -62,7 +63,7 @@ def mcd_pass_sweep(
     window array; one T=max(pass_counts) prediction per set feeds every row.
     """
     if key is None:
-        key = jax.random.key(0)
+        key = prng.stochastic_key(0)
     t_max = max(pass_counts)
     preds = {}
     for i, (name, x) in enumerate(test_sets.items()):
@@ -70,7 +71,7 @@ def mcd_pass_sweep(
             model, variables, x,
             n_passes=t_max,
             mode=config.mcd_mode,
-            batch_size=config.inference_batch_size,
+            batch_size=config.mcd_batch_size,
             key=jax.random.fold_in(key, i),
         ))
     return _variance_table(preds, sorted(pass_counts))

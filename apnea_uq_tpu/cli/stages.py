@@ -210,10 +210,9 @@ def _print_run(result) -> None:
 
 
 def cmd_eval_mcd(args, config) -> int:
-    import jax
-
     from apnea_uq_tpu.training import restore_state
     from apnea_uq_tpu.uq import run_mcd_analysis, save_run
+    from apnea_uq_tpu.utils import prng
 
     registry = _registry(args)
     model, template = _baseline_template(config)
@@ -223,7 +222,7 @@ def cmd_eval_mcd(args, config) -> int:
         result = run_mcd_analysis(
             model, state.variables(), x, y, patient_ids=ids,
             config=config.uq, label=f"CNN_MCD_{label}",
-            key=jax.random.key(config.train.seed),
+            key=prng.stochastic_key(config.train.seed),
             detailed=ids is not None,
         )
         _print_run(result)
@@ -299,11 +298,10 @@ def cmd_correlate(args, config) -> int:
 
 
 def cmd_sweep(args, config) -> int:
-    import jax
-
     from apnea_uq_tpu.analysis.sweep import de_member_sweep, mcd_pass_sweep
     from apnea_uq_tpu.analysis.plots import plot_convergence
     from apnea_uq_tpu.training import restore_state
+    from apnea_uq_tpu.utils import prng
 
     registry = _registry(args)
     _prepared, sets = _load_test_sets(registry)
@@ -315,7 +313,7 @@ def cmd_sweep(args, config) -> int:
         frame = mcd_pass_sweep(
             model, state.variables(), test_sets,
             pass_counts=counts, config=config.uq,
-            key=jax.random.key(config.train.seed),
+            key=prng.stochastic_key(config.train.seed),
         )
     else:
         model, member_variables = _restore_members(args, config, max(counts))

@@ -41,6 +41,7 @@ from apnea_uq_tpu.training.trainer import predict_proba_batched
 from apnea_uq_tpu.uq.bootstrap import bootstrap_aggregates, compute_confidence_intervals
 from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
 from apnea_uq_tpu.uq.predict import ensemble_predict, mc_dropout_predict
+from apnea_uq_tpu.utils import prng
 from apnea_uq_tpu.utils.timing import Timer, block
 
 # The reference's detailed CSV writes binary entropy of the mean prob in
@@ -247,14 +248,14 @@ def run_mcd_analysis(
     regime), then the full metric/bootstrap/CSV pipeline.
     """
     if key is None:
-        key = jax.random.key(0)
+        key = prng.stochastic_key(0)
     predict_key, bootstrap_key = jax.random.split(key)
     with Timer(f"{label}.predict") as t:
         predictions = block(mc_dropout_predict(
             model, variables, x,
             n_passes=config.mc_passes,
             mode=config.mcd_mode,
-            batch_size=config.inference_batch_size,
+            batch_size=config.mcd_batch_size,
             key=predict_key,
         ))
     det_probs = (

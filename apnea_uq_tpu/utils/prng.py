@@ -25,6 +25,22 @@ def seed_key(seed: int) -> jax.Array:
     return jax.random.key(seed)
 
 
+def stochastic_key(seed: int, impl: str = "auto") -> jax.Array:
+    """Key for throughput-critical stochastic sampling (MCD dropout masks).
+
+    ``impl='auto'`` selects the hardware-backed ``rbg`` generator on TPU —
+    threefry mask generation costs ~40% of MC-Dropout wall-clock there
+    (measured on v5e: 5.7K -> 9.6K windows/s at T=50) — and the default
+    threefry elsewhere.  rbg is deterministic per key but its stream is
+    not guaranteed stable across JAX versions/backends, which is why it is
+    opt-in per call site rather than the global default: training-time
+    reproducibility keeps threefry.
+    """
+    if impl == "auto":
+        impl = "rbg" if jax.default_backend() == "tpu" else "threefry2x32"
+    return jax.random.key(seed, impl=impl)
+
+
 def member_key(root: jax.Array, member: int) -> jax.Array:
     """Per-ensemble-member key (reference: per-member seed 2025+i,
     train_deep_ensemble_cnns.py:126)."""
