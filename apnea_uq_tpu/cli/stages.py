@@ -212,7 +212,6 @@ def _print_run(result) -> None:
 def cmd_eval_mcd(args, config) -> int:
     from apnea_uq_tpu.training import restore_state
     from apnea_uq_tpu.uq import run_mcd_analysis, save_run
-    from apnea_uq_tpu.utils import prng
 
     registry = _registry(args)
     model, template = _baseline_template(config)
@@ -222,7 +221,7 @@ def cmd_eval_mcd(args, config) -> int:
         result = run_mcd_analysis(
             model, state.variables(), x, y, patient_ids=ids,
             config=config.uq, label=f"CNN_MCD_{label}",
-            key=prng.stochastic_key(config.train.seed),
+            seed=config.train.seed,
             detailed=ids is not None,
         )
         _print_run(result)
@@ -240,6 +239,7 @@ def cmd_eval_de(args, config) -> int:
         result = run_de_analysis(
             model, member_variables, x, y, patient_ids=ids,
             config=config.uq, label=f"CNN_DE_{label}",
+            seed=config.train.seed,
             detailed=ids is not None,
         )
         _print_run(result)

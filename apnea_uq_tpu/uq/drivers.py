@@ -237,7 +237,9 @@ def run_mcd_analysis(
     patient_ids=None,
     config: UQConfig = UQConfig(),
     label: str = "CNN_MCD",
-    key: Optional[jax.Array] = None,
+    predict_key: Optional[jax.Array] = None,
+    bootstrap_key: Optional[jax.Array] = None,
+    seed: int = 0,
     detailed: bool = True,
     sanity_check: bool = True,
 ) -> UQRunResult:
@@ -246,10 +248,16 @@ def run_mcd_analysis(
     T=``config.mc_passes`` stochastic passes under ``config.mcd_mode``
     ('clean' frozen-BN MCD or 'parity' = the reference's training=True
     regime), then the full metric/bootstrap/CSV pipeline.
+
+    ``predict_key`` (default ``prng.stochastic_key(seed)``, hardware-rbg on
+    TPU) drives only the throughput-critical dropout masks; ``bootstrap_key``
+    (default ``prng.bootstrap_key(seed)``) is always threefry so reported
+    CIs stay stable across JAX versions/backends.
     """
-    if key is None:
-        key = prng.stochastic_key(0)
-    predict_key, bootstrap_key = jax.random.split(key)
+    if predict_key is None:
+        predict_key = prng.stochastic_key(seed)
+    if bootstrap_key is None:
+        bootstrap_key = prng.bootstrap_key(seed)
     with Timer(f"{label}.predict") as t:
         predictions = block(mc_dropout_predict(
             model, variables, x,
@@ -280,15 +288,19 @@ def run_de_analysis(
     patient_ids=None,
     config: UQConfig = UQConfig(),
     label: str = "CNN_DE",
-    key: Optional[jax.Array] = None,
+    bootstrap_key: Optional[jax.Array] = None,
+    seed: int = 0,
     detailed: bool = True,
 ) -> UQRunResult:
     """Deep-Ensemble UQ analysis of one test set (C14/C16).
 
     Members are vmapped in one program (uq/predict.py) instead of the
     reference's N sequential full-set predicts (uq_techniques.py:29-30).
+    ``bootstrap_key`` defaults to ``prng.bootstrap_key(seed)`` — prediction
+    itself is deterministic, so ``seed`` only moves the CI resamples.
     """
-    bootstrap_key = jax.random.key(0) if key is None else key
+    if bootstrap_key is None:
+        bootstrap_key = prng.bootstrap_key(seed)
     with Timer(f"{label}.predict") as t:
         predictions = block(ensemble_predict(
             model, member_variables, x, batch_size=config.inference_batch_size

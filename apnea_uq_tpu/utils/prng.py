@@ -41,6 +41,14 @@ def stochastic_key(seed: int, impl: str = "auto") -> jax.Array:
     return jax.random.key(seed, impl=impl)
 
 
+def bootstrap_key(seed: int) -> jax.Array:
+    """Bootstrap-resample index key: always a threefry stream of ``seed``,
+    never the hardware rbg, so reported confidence intervals stay stable
+    across JAX versions/backends (index sampling is cheap; rbg's speed is
+    only worth its weaker stream-stability guarantee for dropout masks)."""
+    return stream(seed_key(seed), STREAM_BOOTSTRAP)
+
+
 def member_key(root: jax.Array, member: int) -> jax.Array:
     """Per-ensemble-member key (reference: per-member seed 2025+i,
     train_deep_ensemble_cnns.py:126)."""

@@ -111,10 +111,11 @@ class TestEndToEnd:
 
     def test_mcd_run(self, setup):
         model, variables, x, y, pids = setup
-        cfg = UQConfig(mc_passes=8, n_bootstrap=20, inference_batch_size=32)
+        cfg = UQConfig(mc_passes=8, n_bootstrap=20, inference_batch_size=32,
+                       mcd_batch_size=32)
         result = run_mcd_analysis(
             model, variables, x, y, patient_ids=pids, config=cfg,
-            key=jax.random.key(1),
+            predict_key=jax.random.key(1),
         )
         assert result.predictions.shape == (8, 64)
         assert ((result.predictions >= 0) & (result.predictions <= 1)).all()
