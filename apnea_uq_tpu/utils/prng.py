@@ -45,8 +45,10 @@ def bootstrap_key(seed: int) -> jax.Array:
     """Bootstrap-resample index key: always a threefry stream of ``seed``,
     never the hardware rbg, so reported confidence intervals stay stable
     across JAX versions/backends (index sampling is cheap; rbg's speed is
-    only worth its weaker stream-stability guarantee for dropout masks)."""
-    return stream(seed_key(seed), STREAM_BOOTSTRAP)
+    only worth its weaker stream-stability guarantee for dropout masks).
+    The impl is pinned explicitly so a global ``jax_default_prng_impl``
+    override cannot silently void the guarantee."""
+    return stream(jax.random.key(seed, impl="threefry2x32"), STREAM_BOOTSTRAP)
 
 
 def member_key(root: jax.Array, member: int) -> jax.Array:
