@@ -56,6 +56,7 @@ def mcd_pass_sweep(
     pass_counts: Sequence[int] = DEFAULT_PASS_COUNTS,
     config: UQConfig = UQConfig(),
     key: Optional[jax.Array] = None,
+    mesh=None,
 ) -> pd.DataFrame:
     """Overall mean predictive variance vs number of MC-Dropout passes.
 
@@ -73,6 +74,7 @@ def mcd_pass_sweep(
             mode=config.mcd_mode,
             batch_size=config.mcd_batch_size,
             key=jax.random.fold_in(key, i),
+            mesh=mesh,
         ))
     return _variance_table(preds, sorted(pass_counts))
 
@@ -84,6 +86,7 @@ def de_member_sweep(
     *,
     member_counts: Sequence[int] = DEFAULT_MEMBER_COUNTS,
     config: UQConfig = UQConfig(),
+    mesh=None,
 ) -> pd.DataFrame:
     """Overall mean predictive variance vs ensemble size.
 
@@ -93,7 +96,8 @@ def de_member_sweep(
     """
     preds = {
         name: np.asarray(ensemble_predict(
-            model, member_variables, x, batch_size=config.inference_batch_size
+            model, member_variables, x,
+            batch_size=config.inference_batch_size, mesh=mesh,
         ))
         for name, x in test_sets.items()
     }

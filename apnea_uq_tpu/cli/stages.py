@@ -38,6 +38,24 @@ def _model(config):
     return AlarconCNN1D(config.model)
 
 
+def _mesh(config, num_members: int = 1):
+    """The (ensemble, data) device mesh config.mesh describes — every
+    device-heavy stage (train, train-ensemble, eval-mcd/de, sweep) runs
+    over it; on one device it degenerates to a 1x1 mesh."""
+    from apnea_uq_tpu.parallel.mesh import make_mesh_from_config
+
+    return make_mesh_from_config(config.mesh, num_members=num_members)
+
+
+def _data_mesh():
+    """Pure data-parallel (1, D) mesh for single-model stages: the baseline
+    trainer has no member axis, so an ensemble_axis pinned in config.mesh
+    (natural for train-ensemble) must not replicate its batches."""
+    from apnea_uq_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(num_members=1)
+
+
 def _baseline_template(config):
     """Model + abstract-structure state for restoring checkpoints."""
     import jax
@@ -121,9 +139,10 @@ def cmd_train(args, config) -> int:
         model, jax.random.key(config.train.seed),
         learning_rate=config.train.learning_rate,
     )
+    mesh = _data_mesh()
     result = fit(
         model, state, prepared.x_train, prepared.y_train, config.train,
-        log_fn=print,
+        mesh=mesh, log_fn=print,
     )
     path = save_state(os.path.join(_ckpt_root(args), "baseline"), result.state)
     print(f"saved baseline checkpoint -> {path} "
@@ -132,7 +151,7 @@ def cmd_train(args, config) -> int:
     for label, (x, y, _ids) in sets.items():
         probs = predict_proba_batched(
             model, result.state.variables(), x,
-            batch_size=config.uq.inference_batch_size,
+            batch_size=config.uq.inference_batch_size, mesh=mesh,
         )
         evaluate_classification(
             probs, y, threshold=config.uq.decision_threshold,
@@ -168,6 +187,7 @@ def cmd_train_ensemble(args, config) -> int:
     # run reproduces exactly the members a fresh run would have produced.
     result = fit_ensemble(
         model, prepared.x_train, prepared.y_train, run_cfg,
+        mesh=_mesh(config, num_members=len(missing)),
         member_indices=[s - cfg.seed_base for s in missing],
         log_fn=print,
     )
@@ -222,6 +242,7 @@ def cmd_eval_mcd(args, config) -> int:
             model, state.variables(), x, y, patient_ids=ids,
             config=config.uq, label=f"CNN_MCD_{label}",
             seed=config.train.seed,
+            mesh=_mesh(config, num_members=config.uq.mc_passes),
             detailed=ids is not None,
         )
         _print_run(result)
@@ -240,6 +261,7 @@ def cmd_eval_de(args, config) -> int:
             model, member_variables, x, y, patient_ids=ids,
             config=config.uq, label=f"CNN_DE_{label}",
             seed=config.train.seed,
+            mesh=_mesh(config, num_members=args.num_members),
             detailed=ids is not None,
         )
         _print_run(result)
@@ -314,12 +336,14 @@ def cmd_sweep(args, config) -> int:
             model, state.variables(), test_sets,
             pass_counts=counts, config=config.uq,
             key=prng.stochastic_key(config.train.seed),
+            mesh=_mesh(config, num_members=max(counts)),
         )
     else:
         model, member_variables = _restore_members(args, config, max(counts))
         frame = de_member_sweep(
             model, member_variables, test_sets,
             member_counts=counts, config=config.uq,
+            mesh=_mesh(config, num_members=max(counts)),
         )
     key = f"sweep:{args.method}"
     registry.save_table(key, frame)

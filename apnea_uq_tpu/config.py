@@ -145,11 +145,18 @@ class PrepareConfig:
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """Device-mesh layout for ensemble/data parallel execution."""
+    """Device-mesh layout for ensemble/data parallel execution.
 
-    ensemble_axis: int = 0  # 0 -> auto: min(num_members, device_count)
-    data_axis: int = 1      # devices per ensemble shard for DP sub-axis
-    axis_names: tuple[str, str] = ("ensemble", "data")
+    ``ensemble_axis`` / ``data_axis`` are the two factor sizes of the
+    (ensemble, data) mesh; 0 means auto.  With both auto the layout
+    maximizes concurrent ensemble members (largest divisor of the device
+    count <= the member count) and gives the remaining devices to the DP
+    sub-axis.  Consumed by every CLI stage via
+    :func:`apnea_uq_tpu.parallel.mesh.make_mesh_from_config`.
+    """
+
+    ensemble_axis: int = 0  # 0 -> auto: largest divisor <= num_members
+    data_axis: int = 0      # 0 -> auto: device_count // ensemble_axis
 
 
 @dataclass(frozen=True)
@@ -179,8 +186,19 @@ def _to_jsonable(obj: Any) -> Any:
     return repr(obj)
 
 
+# Fields that existed in previously-saved config JSONs but were removed;
+# loading tolerates (and drops) them instead of failing the whole run.
+_LEGACY_KEYS = {
+    "MeshConfig": {"axis_names"},  # fixed ('ensemble', 'data') since r2
+}
+
+
 def _from_dict(cls: type, data: dict) -> Any:
     known = {f.name for f in dataclasses.fields(cls)}
+    data = {
+        k: v for k, v in data.items()
+        if k not in _LEGACY_KEYS.get(cls.__name__, ())
+    }
     unknown = set(data) - known
     if unknown:
         raise ValueError(

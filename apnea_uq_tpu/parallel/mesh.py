@@ -54,6 +54,35 @@ def make_mesh(
     return Mesh(mesh_devices, (AXIS_ENSEMBLE, AXIS_DATA))
 
 
+def make_mesh_from_config(
+    config,
+    num_members: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the mesh a :class:`apnea_uq_tpu.config.MeshConfig` describes.
+
+    Explicit ``ensemble_axis`` wins; else an explicit ``data_axis`` fixes
+    the DP factor (ensemble = devices / data); else fully auto (see
+    :func:`make_mesh`).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    e = config.ensemble_axis
+    if e == 0 and config.data_axis > 0:
+        if len(devs) % config.data_axis:
+            raise ValueError(
+                f"data_axis {config.data_axis} does not divide device "
+                f"count {len(devs)}"
+            )
+        e = len(devs) // config.data_axis
+    if config.ensemble_axis > 0 and config.data_axis > 0:
+        if config.ensemble_axis * config.data_axis != len(devs):
+            raise ValueError(
+                f"mesh {config.ensemble_axis}x{config.data_axis} does not "
+                f"match device count {len(devs)}"
+            )
+    return make_mesh(num_members, devs, ensemble_axis=e)
+
+
 def member_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for arrays with a leading member axis: split members over
     the ensemble axis, replicate everything else."""

@@ -151,14 +151,16 @@ def _eval_loss_jit(model, variables, x, y, batch_size, data_sharding=None):
     return total_loss / n
 
 
-@partial(jax.jit, static_argnames=("model", "batch_size"))
-def _predict_jit(model, variables, x, batch_size):
+@partial(jax.jit, static_argnames=("model", "batch_size", "data_sharding"))
+def _predict_jit(model, variables, x, batch_size, data_sharding=None):
     n = x.shape[0]
     steps = -(-n // batch_size)
     pad = steps * batch_size - n
     xp = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
 
     def body(_, xb):
+        if data_sharding is not None:
+            xb = jax.lax.with_sharding_constraint(xb, data_sharding)
         logits, _ = apply_model(model, variables, xb, mode="eval")
         return None, predict_proba(logits)
 
@@ -166,9 +168,20 @@ def _predict_jit(model, variables, x, batch_size):
     return probs.reshape(-1)[:n]
 
 
-def predict_proba_batched(model, variables, x, *, batch_size: int = 8192):
-    """Deterministic (eval-mode) probabilities, chunked over windows."""
-    return _predict_jit(model, variables, jnp.asarray(x, jnp.float32), batch_size)
+def predict_proba_batched(model, variables, x, *, batch_size: int = 8192,
+                          mesh=None):
+    """Deterministic (eval-mode) probabilities, chunked over windows;
+    with ``mesh``, each chunk shards over its ``data`` axis."""
+    data_sharding = None
+    if mesh is not None:
+        from apnea_uq_tpu.parallel import mesh as mesh_lib  # cycle-breaker
+        data_sharding = mesh_lib.data_sharding(mesh)
+        repl = mesh_lib.replicated(mesh)
+        x = jax.device_put(jnp.asarray(x, jnp.float32), repl)
+        variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
+    return _predict_jit(
+        model, variables, jnp.asarray(x, jnp.float32), batch_size, data_sharding
+    )
 
 
 def fit(
@@ -181,7 +194,6 @@ def fit(
     tx: Optional[optax.GradientTransformation] = None,
     rng: Optional[jax.Array] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
-    data_axis: str = "data",
     log_fn: Optional[Callable[[str], None]] = None,
 ) -> FitResult:
     """Train with validation-split early stopping; returns best-weight state.
@@ -198,9 +210,11 @@ def fit(
         rng = prng.stream(prng.seed_key(config.seed), prng.STREAM_SHUFFLE)
     data_sharding = None
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec
-        data_sharding = NamedSharding(mesh, PartitionSpec(data_axis))
-        replicated = NamedSharding(mesh, PartitionSpec())
+        # Import at call time: parallel.ensemble imports this module, so a
+        # top-level import of the parallel package would be circular.
+        from apnea_uq_tpu.parallel import mesh as mesh_lib
+        data_sharding = mesh_lib.data_sharding(mesh)
+        replicated = mesh_lib.replicated(mesh)
         state = jax.tree.map(lambda a: jax.device_put(a, replicated), state)
 
     x = jnp.asarray(x_train, jnp.float32)

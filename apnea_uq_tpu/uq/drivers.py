@@ -240,6 +240,7 @@ def run_mcd_analysis(
     predict_key: Optional[jax.Array] = None,
     bootstrap_key: Optional[jax.Array] = None,
     seed: int = 0,
+    mesh: Optional[jax.sharding.Mesh] = None,
     detailed: bool = True,
     sanity_check: bool = True,
 ) -> UQRunResult:
@@ -265,10 +266,12 @@ def run_mcd_analysis(
             mode=config.mcd_mode,
             batch_size=config.mcd_batch_size,
             key=predict_key,
+            mesh=mesh,
         ))
     det_probs = (
         np.asarray(predict_proba_batched(
-            model, variables, x, batch_size=config.inference_batch_size
+            model, variables, x, batch_size=config.inference_batch_size,
+            mesh=mesh,
         ))
         if sanity_check
         else None
@@ -290,6 +293,7 @@ def run_de_analysis(
     label: str = "CNN_DE",
     bootstrap_key: Optional[jax.Array] = None,
     seed: int = 0,
+    mesh: Optional[jax.sharding.Mesh] = None,
     detailed: bool = True,
 ) -> UQRunResult:
     """Deep-Ensemble UQ analysis of one test set (C14/C16).
@@ -303,7 +307,9 @@ def run_de_analysis(
         bootstrap_key = prng.bootstrap_key(seed)
     with Timer(f"{label}.predict") as t:
         predictions = block(ensemble_predict(
-            model, member_variables, x, batch_size=config.inference_batch_size
+            model, member_variables, x,
+            batch_size=config.inference_batch_size,
+            mesh=mesh,
         ))
     return _run_common(
         label, np.asarray(predictions), y_true, patient_ids, config,

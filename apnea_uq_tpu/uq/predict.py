@@ -22,33 +22,53 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
+from apnea_uq_tpu.parallel import mesh as mesh_lib
 from apnea_uq_tpu.utils import prng
 
 _MCD_MODES = {"clean": "mcd_clean", "parity": "mcd_parity"}
 
 
+def _constrain(a: jax.Array, mesh, *axes: Optional[str]) -> jax.Array:
+    """Sharding constraint helper: P(*axes) over ``mesh`` (no-op off-mesh)."""
+    if mesh is None:
+        return a
+    return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, P(*axes)))
+
+
+def _wrap_pad(a: jax.Array, multiple: int, axis: int = 0) -> jax.Array:
+    """Pad ``axis`` up to a multiple of ``multiple`` by wrapping around the
+    real rows (modular gather).  Wrapping rather than zero-filling matters
+    in 'parity' mode, where BatchNorm uses batch statistics and zero rows
+    would drag them toward zero, corrupting real windows in the same chunk;
+    padded rows are always sliced or masked off by the caller."""
+    n = a.shape[axis]
+    padded = -(-n // multiple) * multiple
+    if padded == n:
+        return a
+    return jnp.take(a, jnp.arange(padded) % n, axis=axis)
+
+
 def _chunk(x: jax.Array, batch_size: int):
-    """Pad to a multiple of batch_size and reshape to (chunks, bs, ...).
-
-    Padding wraps around the real windows (modular gather) rather than
-    zero-filling: in 'parity' mode BatchNorm uses batch statistics, and
-    zero rows in the final chunk would drag the statistics toward zero
-    and corrupt the real windows sharing that chunk.
-    """
+    """Wrap-pad to a multiple of batch_size, reshape to (chunks, bs, ...)."""
     m = x.shape[0]
-    n_chunks = -(-m // batch_size)
-    pad = n_chunks * batch_size - m
-    if pad:
-        x = jnp.take(x, jnp.arange(n_chunks * batch_size) % m, axis=0)
-    return x.reshape((n_chunks, batch_size) + x.shape[1:]), m
+    x = _wrap_pad(x, batch_size)
+    return x.reshape((-1, batch_size) + x.shape[1:]), m
 
 
-@partial(jax.jit, static_argnames=("model", "n_passes", "mode", "batch_size"))
-def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size):
+@partial(
+    jax.jit, static_argnames=("model", "n_passes", "mode", "batch_size", "mesh")
+)
+def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None):
+    """With ``mesh``, the T stochastic passes shard over the ``ensemble``
+    axis and each chunk's windows over the ``data`` axis, so all devices
+    work on every chunk; the computation per (pass, window) is unchanged —
+    same keys, same masks — so results equal the single-device path."""
     keys = jax.random.split(key, n_passes)
     chunks, m = _chunk(x, batch_size)
+    chunks = _constrain(chunks, mesh, None, mesh_lib.AXIS_DATA)
 
     def one_chunk(args):
         chunk, chunk_idx = args
@@ -59,9 +79,17 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size):
             # masks (correlated noise the reference does not have).
             k = jax.random.fold_in(k, chunk_idx)
             logits, _ = apply_model(model, variables, chunk, mode=mode, dropout_rng=k)
-            return predict_proba(logits)
+            # Constrain per pass, at the model output: with spmd_axis_name
+            # threading the pass axis, this pins the conv batch itself to
+            # the (pass-shard x window-shard) block — without it the
+            # partitioner is free to replicate windows within ensemble
+            # groups and merely reshard at the end (observed on CPU SPMD),
+            # wasting the data axis.
+            return _constrain(predict_proba(logits), mesh, mesh_lib.AXIS_DATA)
 
-        return jax.vmap(one_pass)(keys)  # (T, bs)
+        if mesh is None:
+            return jax.vmap(one_pass)(keys)  # (T, bs)
+        return jax.vmap(one_pass, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(keys)
 
     probs = jax.lax.map(
         one_chunk, (chunks, jnp.arange(chunks.shape[0]))
@@ -80,8 +108,15 @@ def mc_dropout_predict(
     batch_size: int = 512,
     key: Optional[jax.Array] = None,
     seed: int = 0,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ) -> jax.Array:
     """(T, M) positive-class probabilities from T stochastic passes.
+
+    ``mesh`` spreads the work over a device mesh — passes over its
+    ``ensemble`` axis, windows over ``data`` — replacing the reference's
+    single-device T-pass loop (uq_techniques.py:22) at pod scale.  The
+    result is identical to the single-device path (same keys -> same
+    dropout masks; the mesh only partitions the compute).
 
     ``mode='parity'`` reproduces the reference's ``training=True`` regime
     (dropout + batch-statistics BatchNorm, uq_techniques.py:22).  Note that
@@ -102,7 +137,13 @@ def mc_dropout_predict(
     if key is None:
         key = prng.stochastic_key(seed)
     x = jnp.asarray(x, jnp.float32)
-    return _mcd_jit(model, variables, x, key, n_passes, _MCD_MODES[mode], batch_size)
+    if mesh is not None:
+        repl = mesh_lib.replicated(mesh)
+        x = jax.device_put(x, repl)
+        variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
+    return _mcd_jit(
+        model, variables, x, key, n_passes, _MCD_MODES[mode], batch_size, mesh
+    )
 
 
 def stack_member_variables(member_variables: list) -> dict:
@@ -127,12 +168,51 @@ def _ensemble_jit(model, stacked_variables, x, batch_size):
     return probs[:, :m]
 
 
+@partial(jax.jit, static_argnames=("model", "batch_size", "mesh"))
+def _ensemble_shard_map_jit(model, stacked_variables, x, batch_size, mesh):
+    """Deterministic ensemble inference as an explicit ``shard_map``: each
+    device computes its (member-group x window-slice) block with purely
+    local compute — no partitioner discretion, no collectives until the
+    output is assembled.  (MCD cannot use this layout: per-pass dropout
+    masks drawn per local block would differ from the single-device
+    stream, so it keeps the GSPMD-partitioned global program instead.)
+
+    Requires the member axis divisible by the mesh's ensemble axis (the
+    caller wrap-pads) and wrap-pads windows to the data axis here."""
+    m = x.shape[0]
+    x = _wrap_pad(x, mesh.shape[mesh_lib.AXIS_DATA])
+
+    def block(member_vars, x_local):
+        def one_member(mv):
+            chunks, m_local = _chunk(
+                x_local, min(batch_size, x_local.shape[0])
+            )
+
+            def one_chunk(chunk):
+                logits, _ = apply_model(model, mv, chunk, mode="eval")
+                return predict_proba(logits)
+
+            probs = jax.lax.map(one_chunk, chunks)      # (chunks, bs_local)
+            return probs.reshape(-1)[:m_local]
+
+        return jax.vmap(one_member)(member_vars)        # (N_local, m_local)
+
+    f = jax.shard_map(
+        block,
+        mesh=mesh,
+        in_specs=(P(mesh_lib.AXIS_ENSEMBLE), P(mesh_lib.AXIS_DATA)),
+        out_specs=P(mesh_lib.AXIS_ENSEMBLE, mesh_lib.AXIS_DATA),
+    )
+    return f(stacked_variables, x)[:, :m]
+
+
 def ensemble_predict(
     model: AlarconCNN1D,
     member_variables,
     x,
     *,
     batch_size: int = 2048,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ) -> jax.Array:
     """(N, M) deterministic probabilities from N ensemble members.
     All N members' activations for one chunk are live at once, so the
@@ -142,9 +222,28 @@ def ensemble_predict(
     ``member_variables`` is either a list of per-member variable pytrees or
     an already-stacked pytree with a leading member axis.  Members are
     vmapped — one batched program instead of the reference's N sequential
-    ``model.predict`` calls (uq_techniques.py:29-30).
+    ``model.predict`` calls (uq_techniques.py:29-30).  With ``mesh``,
+    members spread over the ``ensemble`` axis and windows over ``data``,
+    so eval-de scales across a pod instead of leaving chips idle.
     """
     if isinstance(member_variables, (list, tuple)):
         member_variables = stack_member_variables(list(member_variables))
     x = jnp.asarray(x, jnp.float32)
+    n_members = jax.tree.leaves(member_variables)[0].shape[0]
+    if mesh is not None:
+        # device_put needs the member axis divisible by the ensemble axis;
+        # wrap-pad it and slice the duplicate rows back off below.
+        e_axis = mesh.shape[mesh_lib.AXIS_ENSEMBLE]
+        member_variables = jax.tree.map(
+            lambda a: _wrap_pad(a, e_axis), member_variables
+        )
+        x = jax.device_put(x, mesh_lib.replicated(mesh))
+        member_variables = jax.tree.map(
+            lambda a: jax.device_put(a, mesh_lib.member_sharding(mesh)),
+            member_variables,
+        )
+        probs = _ensemble_shard_map_jit(
+            model, member_variables, x, batch_size, mesh
+        )
+        return probs[:n_members]
     return _ensemble_jit(model, member_variables, x, batch_size)

@@ -157,3 +157,19 @@ class TestDataParallelism:
         ):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-3, atol=5e-4)
+
+
+def test_make_mesh_from_config():
+    from apnea_uq_tpu.config import MeshConfig
+    from apnea_uq_tpu.parallel.mesh import make_mesh_from_config
+
+    m = make_mesh_from_config(MeshConfig(), num_members=2)
+    assert dict(m.shape) == {"ensemble": 2, "data": 4}
+    m2 = make_mesh_from_config(MeshConfig(data_axis=2), num_members=8)
+    assert dict(m2.shape) == {"ensemble": 4, "data": 2}
+    m3 = make_mesh_from_config(MeshConfig(ensemble_axis=8), num_members=2)
+    assert dict(m3.shape) == {"ensemble": 8, "data": 1}
+    with pytest.raises(ValueError):
+        make_mesh_from_config(MeshConfig(data_axis=3))
+    with pytest.raises(ValueError):
+        make_mesh_from_config(MeshConfig(ensemble_axis=2, data_axis=2))

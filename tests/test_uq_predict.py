@@ -85,3 +85,100 @@ def test_stack_member_variables_roundtrip(rng):
     leaf0 = jax.tree.leaves(members[0]["params"])[0]
     stacked_leaf = jax.tree.leaves(stacked["params"])[0]
     assert stacked_leaf.shape == (4,) + leaf0.shape
+
+
+class TestMeshInference:
+    """UQ inference sharded over the (ensemble, data) mesh must produce
+    IDENTICAL results to the single-device path — the mesh partitions the
+    compute (passes/members x window slices), not the math or the RNG."""
+
+    def test_mcd_mesh_matches_single_device(self, rng):
+        from apnea_uq_tpu.parallel import make_mesh
+
+        model = _tiny()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(100, 60, 4)).astype(np.float32)  # forces padding
+        key = jax.random.key(3)
+        mesh = make_mesh(num_members=4)  # (ensemble=4, data=2)
+        p_mesh = np.asarray(mc_dropout_predict(
+            model, variables, x, n_passes=6, batch_size=32, key=key, mesh=mesh
+        ))
+        p_one = np.asarray(mc_dropout_predict(
+            model, variables, x, n_passes=6, batch_size=32, key=key
+        ))
+        assert p_mesh.shape == (6, 100)
+        np.testing.assert_allclose(p_mesh, p_one, rtol=1e-6, atol=1e-7)
+
+    def test_mcd_mesh_compute_is_spread(self, rng):
+        from apnea_uq_tpu.parallel import make_mesh
+        from apnea_uq_tpu.uq.predict import _MCD_MODES, _mcd_jit
+
+        model = _tiny()
+        variables = init_variables(model, jax.random.key(0))
+        x = jax.numpy.asarray(rng.normal(size=(64, 60, 4)), jax.numpy.float32)
+        # Pass-dominant (8, 1) mesh — the layout eval-mcd auto-selects
+        # (T=50 passes >> 8 devices): one pass-group per device.
+        mesh = make_mesh(num_members=8)
+        out = _mcd_jit(
+            model, variables, x, jax.random.key(0), 8, _MCD_MODES["clean"],
+            32, mesh,
+        )
+        shards = out.addressable_shards
+        assert len({s.device for s in shards}) == 8
+        assert all(s.data.shape == (1, 64) for s in shards)
+
+    def test_ensemble_mesh_matches_single_device(self, rng):
+        from apnea_uq_tpu.parallel import make_mesh
+
+        model = _tiny()
+        members = [init_variables(model, jax.random.key(s)) for s in range(4)]
+        x = rng.normal(size=(70, 60, 4)).astype(np.float32)
+        mesh = make_mesh(num_members=4)
+        p_mesh = np.asarray(ensemble_predict(
+            model, members, x, batch_size=32, mesh=mesh
+        ))
+        p_one = np.asarray(ensemble_predict(model, members, x, batch_size=32))
+        assert p_mesh.shape == (4, 70)
+        np.testing.assert_allclose(p_mesh, p_one, rtol=1e-6, atol=1e-7)
+
+    def test_ensemble_mesh_output_spread(self, rng):
+        from apnea_uq_tpu.parallel import make_mesh
+
+        model = _tiny()
+        members = [init_variables(model, jax.random.key(s)) for s in range(8)]
+        x = np.asarray(rng.normal(size=(64, 60, 4)), np.float32)
+        mesh = make_mesh(num_members=8)  # (8, 1): one member per device
+        out = ensemble_predict(model, members, x, batch_size=64, mesh=mesh)
+        assert len({s.device for s in out.addressable_shards}) == 8
+
+    def test_ensemble_mesh_member_count_not_divisible(self, rng):
+        """N=2 members on a 4-way ensemble axis (and N=5 on 4): the member
+        axis is wrap-padded for placement and sliced back — results still
+        equal the single-device path."""
+        from apnea_uq_tpu.parallel import make_mesh
+
+        model = _tiny()
+        x = rng.normal(size=(48, 60, 4)).astype(np.float32)
+        mesh = make_mesh(num_members=4)  # (4, 2)
+        for n in (2, 5):
+            members = [init_variables(model, jax.random.key(s)) for s in range(n)]
+            p_mesh = np.asarray(ensemble_predict(
+                model, members, x, batch_size=32, mesh=mesh
+            ))
+            p_one = np.asarray(ensemble_predict(model, members, x, batch_size=32))
+            assert p_mesh.shape == (n, 48)
+            np.testing.assert_allclose(p_mesh, p_one, rtol=1e-6, atol=1e-7)
+
+    def test_ensemble_mesh_single_member(self, rng):
+        """N=1 member on a 4-way ensemble axis (pad > n_members)."""
+        from apnea_uq_tpu.parallel import make_mesh
+
+        model = _tiny()
+        members = [init_variables(model, jax.random.key(0))]
+        x = rng.normal(size=(32, 60, 4)).astype(np.float32)
+        p_mesh = np.asarray(ensemble_predict(
+            model, members, x, batch_size=16, mesh=make_mesh(num_members=4)
+        ))
+        p_one = np.asarray(ensemble_predict(model, members, x, batch_size=16))
+        assert p_mesh.shape == (1, 32)
+        np.testing.assert_allclose(p_mesh, p_one, rtol=1e-6, atol=1e-7)
