@@ -211,6 +211,20 @@ def _restore_members(args, config, n_members):
     return model, [s.variables() for s in states]
 
 
+def _emit_plots(args, result) -> None:
+    if getattr(args, "plots_dir", None):
+        from apnea_uq_tpu.uq import save_run_plots
+
+        for p in save_run_plots(result, args.plots_dir):
+            print(f"wrote {p}")
+
+
+def _add_plots_arg(p) -> None:
+    p.add_argument("--plots-dir", default=None,
+                   help="Emit the per-run metric-distribution + class-bar "
+                        "PNGs here (reference uq_techniques.py:369-387).")
+
+
 def _print_run(result) -> None:
     ev = result.evaluation
     print(f"=== {result.label} ===")
@@ -247,6 +261,7 @@ def cmd_eval_mcd(args, config) -> int:
         )
         _print_run(result)
         save_run(registry, result, config=config.uq)
+        _emit_plots(args, result)
     return 0
 
 
@@ -266,6 +281,7 @@ def cmd_eval_de(args, config) -> int:
         )
         _print_run(result)
         save_run(registry, result, config=config.uq)
+        _emit_plots(args, result)
     return 0
 
 
@@ -438,11 +454,13 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p = add("eval-mcd", cmd_eval_mcd, "MC-Dropout UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
+    _add_plots_arg(p)
 
     p = add("eval-de", cmd_eval_de, "Deep-Ensemble UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--num-members", type=int, default=5)
+    _add_plots_arg(p)
 
     p = add("aggregate-patients", cmd_aggregate_patients,
             "Detailed windows -> per-patient summary.")

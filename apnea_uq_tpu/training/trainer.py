@@ -184,6 +184,91 @@ def predict_proba_batched(model, variables, x, *, batch_size: int = 8192,
     )
 
 
+@partial(jax.jit, static_argnames=("model", "tx", "data_sharding"))
+def _stream_step_jit(model, tx, state, xb, yb, mask, step_rng,
+                     data_sharding=None):
+    """One streamed optimizer step; returns (state, loss * batch weight) —
+    the same per-step quantity the scan epoch accumulates.  NOT donated:
+    fit's early-stopping snapshot aliases the state buffers, and donation
+    would invalidate the saved best weights on TPU (CPU ignores donation,
+    so tests alone would not catch it)."""
+    if data_sharding is not None:
+        xb = jax.lax.with_sharding_constraint(xb, data_sharding)
+        yb = jax.lax.with_sharding_constraint(yb, data_sharding)
+        mask = jax.lax.with_sharding_constraint(mask, data_sharding)
+    state, loss = make_train_step(model, tx)(state, xb, yb, mask, step_rng)
+    return state, loss * jnp.sum(mask)
+
+
+@partial(jax.jit, static_argnames=("model", "data_sharding"))
+def _stream_eval_batch_jit(model, variables, xb, yb, mask, data_sharding=None):
+    if data_sharding is not None:
+        xb = jax.lax.with_sharding_constraint(xb, data_sharding)
+        yb = jax.lax.with_sharding_constraint(yb, data_sharding)
+        mask = jax.lax.with_sharding_constraint(mask, data_sharding)
+    logits, _ = apply_model(model, variables, xb, mode="eval")
+    return masked_bce_with_logits(logits, yb, mask) * jnp.sum(mask)
+
+
+def _stream_epoch(model, tx, state, x, y, key, batch_size, shuffle,
+                  data_sharding, sharding, prefetch):
+    """One training epoch fed batch-by-batch from HOST arrays through the
+    double-buffered prefetch pipeline (data/feed.py) — the dataset never
+    resides in HBM whole.  Identical math to _epoch_jit: same permutation
+    (same shuffle key), same wrap-padded batches and masks, same per-step
+    dropout streams, same sequential loss accumulation."""
+    from apnea_uq_tpu.data.feed import prefetch_to_device
+
+    n = x.shape[0]
+    shuffle_key, dropout_key = jax.random.split(key)
+    idx, mask = (np.asarray(a) for a in _pad_perm(shuffle_key, n, batch_size, shuffle))
+
+    def batches():
+        for i in range(idx.shape[0]):
+            rows = idx[i]
+            yield x[rows], y[rows], mask[i]
+
+    total = jnp.zeros(())
+    for i, (xb, yb, mb) in enumerate(prefetch_to_device(
+        batches(), size=prefetch, sharding=sharding
+    )):
+        state, weighted = _stream_step_jit(
+            model, tx, state, xb, yb, mb,
+            jax.random.fold_in(dropout_key, i), data_sharding,
+        )
+        total = total + weighted
+    return state, total / n
+
+
+def _stream_eval_loss(model, variables, x, y, batch_size, data_sharding,
+                      sharding, prefetch):
+    """Streaming counterpart of _eval_loss_jit (same zero-pad + mask)."""
+    from apnea_uq_tpu.data.feed import prefetch_to_device
+
+    n = x.shape[0]
+    steps = -(-n // batch_size)
+
+    def batches():
+        for i in range(steps):
+            lo, hi = i * batch_size, min((i + 1) * batch_size, n)
+            xb = x[lo:hi]
+            yb = y[lo:hi]
+            pad = batch_size - (hi - lo)
+            if pad:
+                xb = np.concatenate([xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+                yb = np.concatenate([yb, np.zeros((pad,), yb.dtype)])
+            mb = (np.arange(batch_size) < hi - lo).astype(np.float32)
+            yield xb, yb, mb
+
+    total = jnp.zeros(())
+    for xb, yb, mb in prefetch_to_device(batches(), size=prefetch,
+                                         sharding=sharding):
+        total = total + _stream_eval_batch_jit(
+            model, variables, xb, yb, mb, data_sharding
+        )
+    return total / n
+
+
 def fit(
     model: AlarconCNN1D,
     state: TrainState,
@@ -194,6 +279,8 @@ def fit(
     tx: Optional[optax.GradientTransformation] = None,
     rng: Optional[jax.Array] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
+    streaming: bool = False,
+    prefetch: int = 2,
     log_fn: Optional[Callable[[str], None]] = None,
 ) -> FitResult:
     """Train with validation-split early stopping; returns best-weight state.
@@ -217,13 +304,20 @@ def fit(
         replicated = mesh_lib.replicated(mesh)
         state = jax.tree.map(lambda a: jax.device_put(a, replicated), state)
 
-    x = jnp.asarray(x_train, jnp.float32)
-    y = jnp.asarray(y_train, jnp.float32)
-    if mesh is not None:
-        # The dataset is replicated onto the mesh (it fits HBM at SHHS2
-        # scale; the streaming feed covers the case where it doesn't), so
-        # the per-batch gather needs no communication.
-        x, y = jax.device_put(x, replicated), jax.device_put(y, replicated)
+    if streaming:
+        # The dataset stays in HOST memory; batches flow through the
+        # double-buffered prefetch feed (data/feed.py).  Same math as the
+        # in-HBM path — same permutation, batches, masks, RNG streams.
+        x = np.asarray(x_train, np.float32)
+        y = np.asarray(y_train, np.float32)
+    else:
+        x = jnp.asarray(x_train, jnp.float32)
+        y = jnp.asarray(y_train, jnp.float32)
+        if mesh is not None:
+            # The dataset is replicated onto the mesh (it fits HBM at SHHS2
+            # scale; streaming covers the case where it doesn't), so the
+            # per-batch gather needs no communication.
+            x, y = jax.device_put(x, replicated), jax.device_put(y, replicated)
     n = x.shape[0]
     # Keras split arithmetic: train gets int(n*(1-split)), val the remainder.
     n_val = n - int(n * (1.0 - config.validation_split))
@@ -242,19 +336,35 @@ def fit(
     patience_left = config.early_stopping_patience
     stopped_early = False
 
+    batch_sharding = None
+    if streaming and mesh is not None and config.batch_size % mesh.shape["data"] == 0:
+        batch_sharding = data_sharding  # place streamed batches pre-sharded
+
     for epoch in range(config.num_epochs):
         epoch_key = jax.random.fold_in(rng, epoch)
-        state, train_loss = _epoch_jit(
-            model, tx, state, x, y, epoch_key, config.batch_size, config.shuffle,
-            data_sharding,
-        )
+        if streaming:
+            state, train_loss = _stream_epoch(
+                model, tx, state, x, y, epoch_key, config.batch_size,
+                config.shuffle, data_sharding, batch_sharding, prefetch,
+            )
+        else:
+            state, train_loss = _epoch_jit(
+                model, tx, state, x, y, epoch_key, config.batch_size,
+                config.shuffle, data_sharding,
+            )
         history["loss"].append(float(train_loss))
 
         if x_val is not None:
-            val_loss = float(
-                _eval_loss_jit(model, state.variables(), x_val, y_val,
-                               config.batch_size, data_sharding)
-            )
+            if streaming:
+                val_loss = float(_stream_eval_loss(
+                    model, state.variables(), x_val, y_val,
+                    config.batch_size, data_sharding, batch_sharding, prefetch,
+                ))
+            else:
+                val_loss = float(
+                    _eval_loss_jit(model, state.variables(), x_val, y_val,
+                                   config.batch_size, data_sharding)
+                )
             history["val_loss"].append(val_loss)
             if log_fn:
                 log_fn(f"epoch {epoch + 1}/{config.num_epochs} "

@@ -11,9 +11,14 @@ from apnea_uq_tpu.uq.drivers import (
     run_de_analysis,
     run_mcd_analysis,
     save_run,
+    save_run_plots,
 )
 from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
-from apnea_uq_tpu.uq.predict import ensemble_predict, mc_dropout_predict
+from apnea_uq_tpu.uq.predict import (
+    ensemble_predict,
+    mc_dropout_predict,
+    mc_dropout_predict_streaming,
+)
 
 __all__ = [
     "uq_evaluation_dist",
@@ -21,12 +26,14 @@ __all__ = [
     "bootstrap_metrics",
     "compute_confidence_intervals",
     "mc_dropout_predict",
+    "mc_dropout_predict_streaming",
     "ensemble_predict",
     "evaluate_uq",
     "detailed_frame",
     "run_mcd_analysis",
     "run_de_analysis",
     "save_run",
+    "save_run_plots",
     "UQEvaluation",
     "UQRunResult",
 ]

@@ -74,6 +74,7 @@ class UQRunResult:
     classification: Dict                  # stochastic-mean-prob metric suite
     deterministic_classification: Optional[Dict]  # eval-mode sanity check
     predict_seconds: float
+    y_true: Optional[np.ndarray] = None   # (M,) labels (for per-class plots)
 
 
 def evaluate_uq(
@@ -225,6 +226,7 @@ def _run_common(
         classification=classification,
         deterministic_classification=det,
         predict_seconds=predict_seconds,
+        y_true=np.asarray(y_true).reshape(-1),
     )
 
 
@@ -315,6 +317,38 @@ def run_de_analysis(
         label, np.asarray(predictions), y_true, patient_ids, config,
         None, t.elapsed_s, detailed, bootstrap_key,
     )
+
+
+def save_run_plots(result: UQRunResult, out_dir: str) -> list:
+    """The reference's per-evaluation plot set (uq_techniques.py:369-387):
+    per-true-class distribution histograms of the three uncertainty
+    metrics plus the class-mean-variance bar chart, one PNG each, named
+    by run label."""
+    import os
+
+    from apnea_uq_tpu.analysis import plots
+
+    ev = result.evaluation
+    pw = ev.per_window
+    y = result.y_true
+    if y is None:
+        raise ValueError("run result carries no labels; cannot plot per-class")
+    pre = os.path.join(out_dir, result.label)
+    return [
+        plots.plot_metric_distribution(
+            pw["pred_variance"], y, "predictive variance",
+            f"{pre}_variance_distribution.png"),
+        plots.plot_metric_distribution(
+            pw["total_pred_entropy"], y, "total predictive entropy",
+            f"{pre}_total_entropy_distribution.png"),
+        plots.plot_metric_distribution(
+            pw["mutual_info"], y, "mutual information",
+            f"{pre}_mutual_info_distribution.png"),
+        plots.plot_class_uncertainties(
+            {"class 0": ev.aggregates["mean_variance_class_0"],
+             "class 1": ev.aggregates["mean_variance_class_1"]},
+            f"{pre}_class_variance.png"),
+    ]
 
 
 def save_run(registry, result: UQRunResult, *, config=None) -> Dict[str, str]:

@@ -98,6 +98,75 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None):
     return probs[:, :m]
 
 
+@partial(jax.jit, static_argnames=("model", "n_passes", "mode"))
+def _mcd_chunk_jit(model, variables, chunk, key, chunk_idx, n_passes, mode):
+    """All T passes of ONE window chunk — the streamed unit of work.
+    Key handling matches _mcd_jit exactly (split to T, fold in the chunk
+    index), so streamed and in-HBM predictions are identical."""
+    keys = jax.random.split(key, n_passes)
+
+    def one_pass(k):
+        k = jax.random.fold_in(k, chunk_idx)
+        logits, _ = apply_model(model, variables, chunk, mode=mode, dropout_rng=k)
+        return predict_proba(logits)
+
+    return jax.vmap(one_pass)(keys)  # (T, bs)
+
+
+def mc_dropout_predict_streaming(
+    model: AlarconCNN1D,
+    variables: dict,
+    x,
+    *,
+    n_passes: int = 50,
+    mode: str = "clean",
+    batch_size: int = 512,
+    key: Optional[jax.Array] = None,
+    seed: int = 0,
+    prefetch: int = 2,
+) -> "np.ndarray":
+    """(T, M) MCD probabilities with the window set streamed from HOST
+    memory: chunks flow through the double-buffered prefetch feed
+    (data/feed.py) while the device computes the previous chunk's T
+    passes, so HBM holds O(prefetch x batch_size) windows instead of the
+    whole set — the scaling story for test sets that exceed HBM
+    (SURVEY §5.7; replaces the whole-set-as-one-batch pattern of
+    uq_techniques.py:22).  Produces bit-identical results to
+    :func:`mc_dropout_predict` for the same key.
+    """
+    import numpy as np
+
+    from apnea_uq_tpu.data.feed import prefetch_to_device
+
+    if mode not in _MCD_MODES:
+        raise ValueError(f"mode must be 'clean' or 'parity', got {mode!r}")
+    if key is None:
+        key = prng.stochastic_key(seed)
+    x = np.asarray(x, np.float32)
+    m = x.shape[0]
+    n_chunks = -(-m // batch_size)
+
+    def chunks():
+        for ci in range(n_chunks):
+            rows = np.arange(ci * batch_size, (ci + 1) * batch_size) % m
+            yield x[rows]
+
+    out = np.empty((n_passes, n_chunks * batch_size), np.float32)
+    pending = None  # one-deep result queue: fetch chunk i while i+1 computes
+    for ci, chunk in enumerate(prefetch_to_device(chunks(), size=prefetch)):
+        probs = _mcd_chunk_jit(
+            model, variables, chunk, key, ci, n_passes, _MCD_MODES[mode]
+        )
+        if pending is not None:
+            pci, p = pending
+            out[:, pci * batch_size:(pci + 1) * batch_size] = np.asarray(p)
+        pending = (ci, probs)
+    if pending is not None:
+        pci, p = pending
+        out[:, pci * batch_size:(pci + 1) * batch_size] = np.asarray(p)
+    return out[:, :m]
+
+
 def mc_dropout_predict(
     model: AlarconCNN1D,
     variables: dict,

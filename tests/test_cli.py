@@ -94,18 +94,27 @@ def test_full_pipeline(env, order, capsys):
     assert "nothing to do" in capsys.readouterr().out
 
     # -- eval-mcd / eval-de -----------------------------------------------
-    assert run("eval-mcd", "--registry", registry_dir, "--config", config) == 0
+    mcd_plots = str(env["root"] / "mcd_plots")
+    assert run("eval-mcd", "--registry", registry_dir, "--config", config,
+               "--plots-dir", mcd_plots) == 0
     out = capsys.readouterr().out
     assert "CNN_MCD_Unbalanced" in out and "overall_mean_variance" in out
     assert registry.exists(f"{reg.DETAILED_WINDOWS}:CNN_MCD_Unbalanced")
     assert registry.exists(f"{reg.RAW_PREDICTIONS}:CNN_MCD_Balanced_RUS")
+    # 4 evaluation plots (3 metric distributions + class bar) per test set
+    # (reference emits these inside evaluate_uq_methods, uq_techniques.py:369-387)
+    mcd_pngs = sorted(os.listdir(mcd_plots))
+    assert len(mcd_pngs) == 8 and all(p.endswith(".png") for p in mcd_pngs)
+    assert any("CNN_MCD_Unbalanced_mutual_info" in p for p in mcd_pngs)
 
+    de_plots = str(env["root"] / "de_plots")
     assert run("eval-de", "--registry", registry_dir, "--config", config,
-               "--num-members", "2") == 0
+               "--num-members", "2", "--plots-dir", de_plots) == 0
     capsys.readouterr()
     assert registry.exists(f"{reg.DETAILED_WINDOWS}:CNN_DE_Unbalanced")
     preds = registry.load_arrays(f"{reg.RAW_PREDICTIONS}:CNN_DE_Unbalanced")
     assert preds["predictions"].shape[0] == 2
+    assert len(os.listdir(de_plots)) == 8
 
     # -- aggregate / analyze / correlate ----------------------------------
     assert run("aggregate-patients", "--registry", registry_dir,

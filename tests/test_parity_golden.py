@@ -1,0 +1,122 @@
+"""Golden tests pinning the BN-under-dropout mode semantics (SURVEY §6).
+
+The reference's ~88% vs ~77% accuracy split comes from Keras
+``model(x, training=True)`` silently switching BatchNorm to batch
+statistics as well as enabling dropout (uq_techniques.py:22;
+analyze_mcd_patient_level.py:203-211).  These tests pin, on a trained
+model, the three facts that make the framework's explicit modes
+trustworthy:
+
+1. whole-set-batch 'parity' mode IS the ``training=True`` computation —
+   it matches an independently coded flax apply with batch-stats BN and
+   the same dropout streams (to float tolerance);
+2. 'clean' MCD (frozen BN) tracks the deterministic eval-mode model —
+   its pass-mean converges to the deterministic prediction;
+3. the modes split exactly where BN statistics matter: under covariate
+   shift, parity renormalizes per batch and diverges from the
+   deterministic model far more than clean does — the mechanism behind
+   the reference's accuracy gap, demonstrated without needing to
+   replicate its dataset-specific 11-point magnitude.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.config import ModelConfig, TrainConfig
+from apnea_uq_tpu.models import AlarconCNN1D
+from apnea_uq_tpu.models.cnn1d import predict_proba
+from apnea_uq_tpu.training import create_train_state, fit, predict_proba_batched
+from apnea_uq_tpu.uq import mc_dropout_predict
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """Tiny 2-block model trained to high accuracy on separable windows."""
+    model = AlarconCNN1D(ModelConfig(
+        features=(8, 8), kernel_sizes=(5, 3), dropout_rates=(0.4, 0.5)
+    ))
+    rng = np.random.default_rng(2025)
+
+    def data(n, sep=0.5):
+        y = rng.integers(0, 2, n)
+        x = rng.normal(size=(n, 60, 4)).astype(np.float32)
+        x[:, :, 0] += (y * 2.0 - 1.0)[:, None] * sep
+        return x, y.astype(np.float32)
+
+    x, y = data(1024)
+    x_test, y_test = data(384)
+    cfg = TrainConfig(batch_size=128, num_epochs=12, validation_split=0.1,
+                      seed=1)
+    res = fit(model, create_train_state(model, jax.random.key(0)), x, y, cfg)
+    return model, res.state.variables(), x_test, y_test
+
+
+def test_whole_set_parity_is_training_true(trained):
+    """batch_size >= len(x) parity mode == independently coded
+    ``training=True`` forward passes (batch-stats BN + dropout), per pass,
+    to float tolerance (jit fusion reorders a few fp ops)."""
+    model, variables, x_test, _ = trained
+    key = jax.random.key(9)
+    n_passes = 4
+    got = np.asarray(mc_dropout_predict(
+        model, variables, x_test, n_passes=n_passes, mode="parity",
+        batch_size=len(x_test), key=key,
+    ))
+
+    # Independent computation: raw flax apply with BN in batch-statistics
+    # mode (use_running_average=False via mode='mcd_parity'), discarding
+    # stat updates, same per-pass key derivation (split + fold_in chunk 0).
+    keys = jax.random.split(key, n_passes)
+    expected = []
+    for t in range(n_passes):
+        k = jax.random.fold_in(keys[t], 0)
+        logits, _ = model.apply(
+            variables, x_test, mode="mcd_parity",
+            rngs={"dropout": k}, mutable=["batch_stats"],
+        )
+        expected.append(np.asarray(predict_proba(logits)))
+    np.testing.assert_allclose(got, np.stack(expected), rtol=1e-5, atol=1e-6)
+
+
+def test_deterministic_and_clean_mcd_agree(trained):
+    """Clean MCD's pass-mean accuracy sits at the deterministic accuracy —
+    the reference's pre-MCD sanity probe relationship
+    (analyze_mcd_patient_level.py:203-211) holds for frozen-BN MCD."""
+    model, variables, x_test, y_test = trained
+    det = np.asarray(predict_proba_batched(model, variables, x_test))
+    det_acc = float(np.mean((det >= 0.5) == y_test))
+    assert det_acc >= 0.9, det_acc
+
+    clean = np.asarray(mc_dropout_predict(
+        model, variables, x_test, n_passes=50, mode="clean",
+        batch_size=len(x_test), key=jax.random.key(3),
+    ))
+    clean_acc = float(np.mean((clean.mean(axis=0) >= 0.5) == y_test))
+    assert abs(clean_acc - det_acc) <= 0.02, (clean_acc, det_acc)
+    # and the pass-mean converges toward the deterministic probabilities
+    assert float(np.mean(np.abs(clean.mean(axis=0) - det))) < 0.1
+
+
+def test_parity_diverges_under_covariate_shift(trained):
+    """The mode split that causes the reference's 88%->77% gap: under a
+    channel-statistics shift, parity-mode BN renormalizes per batch and
+    departs from the deterministic model, while clean MCD (frozen BN)
+    keeps tracking it."""
+    model, variables, x_test, _ = trained
+    x_shift = x_test * 1.5 + 0.75  # scale+offset covariate shift
+
+    det = np.asarray(predict_proba_batched(model, variables, x_shift))
+    key = jax.random.key(5)
+    clean = np.asarray(mc_dropout_predict(
+        model, variables, x_shift, n_passes=30, mode="clean",
+        batch_size=len(x_shift), key=key,
+    )).mean(axis=0)
+    parity = np.asarray(mc_dropout_predict(
+        model, variables, x_shift, n_passes=30, mode="parity",
+        batch_size=len(x_shift), key=key,
+    )).mean(axis=0)
+
+    clean_gap = float(np.mean(np.abs(clean - det)))
+    parity_gap = float(np.mean(np.abs(parity - det)))
+    assert parity_gap > 2 * clean_gap, (clean_gap, parity_gap)

@@ -182,3 +182,31 @@ class TestMeshInference:
         p_one = np.asarray(ensemble_predict(model, members, x, batch_size=16))
         assert p_mesh.shape == (1, 32)
         np.testing.assert_allclose(p_mesh, p_one, rtol=1e-6, atol=1e-7)
+
+
+def test_mcd_streaming_identical_to_in_hbm(rng):
+    """Streamed MCD (host chunks -> prefetch -> per-chunk T passes) is
+    bit-identical to the one-program in-HBM path for the same key."""
+    from apnea_uq_tpu.uq import mc_dropout_predict_streaming
+
+    model = _tiny()
+    variables = init_variables(model, jax.random.key(0))
+    x = rng.normal(size=(75, 60, 4)).astype(np.float32)  # 75 % 32 != 0
+    key = jax.random.key(11)
+    a = np.asarray(mc_dropout_predict(
+        model, variables, x, n_passes=5, batch_size=32, key=key
+    ))
+    b = mc_dropout_predict_streaming(
+        model, variables, x, n_passes=5, batch_size=32, key=key
+    )
+    assert b.shape == (5, 75)
+    np.testing.assert_array_equal(a, b)
+
+    # parity mode streams identically too (batch statistics per chunk)
+    ap = np.asarray(mc_dropout_predict(
+        model, variables, x, n_passes=3, mode="parity", batch_size=32, key=key
+    ))
+    bp = mc_dropout_predict_streaming(
+        model, variables, x, n_passes=3, mode="parity", batch_size=32, key=key
+    )
+    np.testing.assert_array_equal(ap, bp)
