@@ -89,6 +89,11 @@ class UQConfig:
     mc_passes: int = 50
     n_bootstrap: int = 100
     bootstrap_alpha: float = 0.05
+    # 'exact' = multinomial gather (reference semantics, backend-stable CI
+    # stream); 'poisson' = fused Pallas count-matmul kernel, ~95x faster
+    # on TPU at reference scale, backend-specific stream
+    # (ops/pallas_bootstrap.py).
+    bootstrap_engine: str = "exact"
     mcd_mode: str = "clean"
     # Windows per device chunk.  MCD's T axis multiplies the activation
     # footprint (T x mcd_batch_size rows live at once), so its chunk is

@@ -1,5 +1,5 @@
 from apnea_uq_tpu.ops.entropy import binary_entropy
 from apnea_uq_tpu.ops.losses import masked_bce_with_logits
-from apnea_uq_tpu.ops.pallas_uq import fused_uq_stats
+from apnea_uq_tpu.ops.pallas_bootstrap import poisson_bootstrap_sums
 
-__all__ = ["binary_entropy", "masked_bce_with_logits", "fused_uq_stats"]
+__all__ = ["binary_entropy", "masked_bce_with_logits", "poisson_bootstrap_sums"]

@@ -1,0 +1,148 @@
+"""Fused Poisson-bootstrap resampling as a Pallas TPU kernel.
+
+The bootstrap is the evaluation pipeline's real hot spot (SURVEY §3.3 hot
+loop #2): the reference re-runs the full UQ metric suite per resample on
+host NumPy (uq_techniques.py:137-165), and even the vectorized exact
+engine (uq/bootstrap.py) pays for a (B, M) random **gather** of every
+per-window metric vector — and gathers are what TPUs do worst.  Measured
+on a v5e chip at the reference scale (B=100, M=293K windows,
+chained-iteration timing): the exact gather engine costs **241 ms**, and
+6.1 s at M=4.2M.
+
+The reformulation: a multinomial resample enters every aggregate only
+through its per-window **counts** ``c[b, i]``, and every aggregate is a
+ratio of count-weighted sums — so bootstrap == ``C @ V`` where V packs
+the per-window metric rows.  Generating exact multinomial counts needs a
+histogram (sort or scatter — both slow on TPU; measured 347 ms scatter,
+10.5 s sort), but the **Poisson bootstrap** [Hanley & MacGibbon 2006;
+Chamandy et al. 2012, "Estimating uncertainty for massive data streams"]
+replaces them with iid ``c[b, i] ~ Poisson(1)`` and normalizes each
+resample by its realized size — the standard large-M approximation whose
+resamples differ from multinomial ones by O(1/sqrt(M)).
+
+This kernel fuses the whole thing into ONE pass over V: per window tile
+it draws the (B, tile) count block from the TPU's hardware PRNG
+(``pltpu.prng_random_bits``; the counts never touch HBM), maps bits to
+Poisson counts with 10 integer threshold compares (inverse CDF truncated
+at 9; P(c>9 | lambda=1) ~ 1.1e-7), and accumulates ``C @ V^T`` on the
+MXU.  Measured on the same v5e at B=100, M=293K: **2.5 ms** in a tight
+chained loop (vs 3.5 ms for the XLA Poisson formulation, whose (B, M)
+count matrix round-trips HBM, and 241 ms for the exact gather engine);
+``bench.py``'s harness records 232 ms -> 11.5 ms (**20x**) for the
+end-to-end engine swap at the same scale (BENCH_r*, context key
+``bootstrap_b100_m293k``).
+
+Off-TPU (CPU tests, interpret mode has no PRNG primitives) the public
+entry point falls back to the XLA Poisson formulation — same estimator,
+different (threefry) count stream.  The exact multinomial engine stays
+the default in :mod:`apnea_uq_tpu.uq.bootstrap` because its CI stream is
+backend-stable; this engine is the measured TPU fast path
+(``UQConfig.bootstrap_engine='poisson'``).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+# Number of packed metric rows (f32 sublane tile multiple; callers pad).
+N_ROWS = 16
+
+# Poisson(1) inverse CDF truncated at 9, quantized to the 24-bit uniforms
+# the kernel draws.  count = #{thresholds below the uniform draw}.
+_CDF = [
+    sum(math.exp(-1.0) / math.factorial(j) for j in range(k + 1))
+    for k in range(10)
+]
+_ICDF = [int(t * (1 << 24)) for t in _CDF]
+
+
+def _kernel(seed_ref, v_ref, out_ref, *, b_padded, tile):
+    j = pl.program_id(0)
+    # Deterministic per (key, tile) stream: the tile index is folded into
+    # the second seed word (Mosaic supports at most two seed values), so
+    # the same key + tile index always produce the same counts,
+    # independent of grid size.
+    pltpu.prng_seed(seed_ref[0], seed_ref[1] ^ (j * 0x61C88647))
+    bits = pltpu.prng_random_bits((b_padded, tile)) & 0x00FFFFFF
+    counts = jnp.zeros((b_padded, tile), jnp.int32)
+    for t in _ICDF:
+        counts = counts + (bits > t).astype(jnp.int32)
+    acc = jax.lax.dot_general(
+        counts.astype(jnp.float32), v_ref[...],
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (b_padded, N_ROWS)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = acc
+
+    @pl.when(j != 0)
+    def _accum():
+        out_ref[...] += acc
+
+
+@partial(jax.jit, static_argnames=("n_boot", "tile"))
+def _pallas_call(v, seeds, n_boot, tile):
+    b_padded = -(-n_boot // 8) * 8
+    m = v.shape[1]
+    m_padded = -(-m // tile) * tile
+    # Zero-padding is EXACT here: padded windows draw counts like any
+    # other, but multiply all-zero metric rows, contributing nothing.
+    if m_padded != m:
+        v = jnp.pad(v, ((0, 0), (0, m_padded - m)))
+    out = pl.pallas_call(
+        partial(_kernel, b_padded=b_padded, tile=tile),
+        grid=(m_padded // tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((N_ROWS, tile), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((b_padded, N_ROWS), lambda j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b_padded, N_ROWS), jnp.float32),
+    )(seeds, v)
+    return out[:n_boot]
+
+
+@partial(jax.jit, static_argnames=("n_boot",))
+def _xla_poisson_sums(v, key, n_boot):
+    """Same estimator in plain XLA (CPU/GPU fallback): materializes the
+    (B, M) count matrix, then one MXU matmul.  3.5 ms at B=100/M=293K on
+    v5e — still ~70x over the exact gather engine."""
+    cdf = jnp.asarray(_CDF, jnp.float32)
+    u = jax.random.uniform(key, (n_boot, v.shape[1]))
+    counts = jnp.sum(u[..., None] > cdf, axis=-1).astype(jnp.float32)
+    return counts @ v.T
+
+
+def poisson_bootstrap_sums(v, key, n_boot: int, *, tile: int = 2048):
+    """(B, N_ROWS) count-weighted sums of the packed per-window rows ``v``
+    ((N_ROWS, M) f32, zero-padded rows allowed) over B Poisson resamples.
+
+    Dispatches to the fused Pallas kernel on TPU, else the XLA
+    formulation.  Both are deterministic given ``key`` on their backend;
+    the two paths use different PRNG streams (hardware PRNG vs threefry),
+    so cross-backend bit-parity is not provided — use the default exact
+    engine where that matters.
+    """
+    v = jnp.asarray(v, jnp.float32)
+    if v.ndim != 2 or v.shape[0] != N_ROWS:
+        raise ValueError(f"expected ({N_ROWS}, M) packed rows, got {v.shape}")
+    if tile % 128 != 0:
+        raise ValueError(f"tile must be a multiple of 128 lanes, got {tile}")
+    if jax.default_backend() == "tpu" and pltpu is not None:
+        seeds = jnp.asarray(
+            jax.random.key_data(key), jnp.uint32
+        ).astype(jnp.int32)[:2]
+        return _pallas_call(v, seeds, n_boot, tile)
+    return _xla_poisson_sums(v, key, n_boot)

@@ -103,6 +103,7 @@ def evaluate_uq(
         base=base,
         eps=config.entropy_eps,
         metrics=metrics,
+        engine=config.bootstrap_engine,
     )
     metrics, boot = block((metrics, boot))
 

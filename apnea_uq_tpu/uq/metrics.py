@@ -72,7 +72,6 @@ def uq_evaluation_dist(
     *,
     base: str = "nats",
     eps: float = 1e-10,
-    engine: str = "jnp",
 ) -> Dict[str, jax.Array]:
     """UQ metric suite from a (K, M) (or (K, M, 1) / (M,)) prediction stack.
 
@@ -80,14 +79,13 @@ def uq_evaluation_dist(
     singleton dims are squeezed and a 1-D input is treated as a single
     pass (variance and MI collapse to zero).
 
-    ``engine`` selects the per-window reduction implementation: ``'jnp'``
-    (default, one jitted XLA fusion) or ``'pallas'`` (the fused Mosaic
-    kernel in :mod:`apnea_uq_tpu.ops.pallas_uq`; runs in interpret mode
-    off-TPU).  Both produce identical results — see the measurement note
-    in ops/pallas_uq.py for why jnp stays the default.
+    One jitted XLA fusion.  (A hand-written Pallas kernel for this
+    reduction was measured SLOWER than the XLA fusion on a v5e —
+    11.25 ms vs 15.9 ms chained at K=50, M=4.2M; the op is VPU
+    transcendental-bound, where XLA's codegen wins — and was removed in
+    r2.  The Pallas effort goes where it pays: the bootstrap resampler,
+    ops/pallas_bootstrap.py.)
     """
-    if engine not in ("jnp", "pallas"):
-        raise ValueError(f"engine must be 'jnp' or 'pallas', got {engine!r}")
     predictions = jnp.asarray(predictions)
     # Squeeze ONLY a trailing singleton output axis of a (K, M, 1) stack —
     # a blanket squeeze would misread a (K, 1) single-window stack as
@@ -104,18 +102,6 @@ def uq_evaluation_dist(
         raise ValueError(
             f"labels ({y_true.shape[0]}) do not match prediction windows "
             f"({predictions.shape[1]})"
-        )
-    if engine == "pallas":
-        from apnea_uq_tpu.ops.pallas_uq import fused_uq_stats
-
-        per_window = fused_uq_stats(predictions, base=base, eps=eps)
-        return _aggregate(
-            per_window["mean_pred"],
-            per_window["pred_variance"],
-            per_window["total_pred_entropy"],
-            per_window["expected_aleatoric_entropy"],
-            per_window["mutual_info"],
-            y_true,
         )
     return _uq_core(predictions, y_true, base, eps)
 

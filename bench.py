@@ -147,6 +147,51 @@ def bench_de_train() -> dict:
     }
 
 
+def bench_bootstrap(n_windows: int, n_boot: int = 100, n_chain: int = 10) -> dict:
+    """Bootstrap engine comparison at B=100 over ``n_windows`` windows:
+    exact multinomial gather vs the fused Pallas Poisson kernel
+    (ops/pallas_bootstrap.py).  Chained iterations inside one jit so the
+    tunnel dispatch latency doesn't pollute the per-call number."""
+    import jax.numpy as jnp
+
+    from apnea_uq_tpu.uq.bootstrap import _bootstrap_core, _pack_rows
+    from apnea_uq_tpu.ops.pallas_bootstrap import poisson_bootstrap_sums
+
+    rng = np.random.default_rng(3)
+    pv = jnp.asarray(rng.uniform(0.0, 0.25, n_windows), jnp.float32)
+    te = jnp.asarray(rng.uniform(0.0, 0.7, n_windows), jnp.float32)
+    al = jnp.asarray(rng.uniform(0.0, 0.7, n_windows), jnp.float32)
+    mi = jnp.asarray(rng.uniform(0.0, 0.1, n_windows), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, n_windows), jnp.float32)
+    key = jax.random.key(0)
+
+    @jax.jit
+    def chain_exact(pv, te, al, mi, y, key):
+        def body(i, carry):
+            out = _bootstrap_core.__wrapped__(
+                pv + carry * 0, te, al, mi, y,
+                jax.random.fold_in(key, i), n_boot)
+            return jnp.sum(out["overall_mean_variance"]).astype(jnp.float32)
+        return jax.lax.fori_loop(0, n_chain, body, jnp.zeros(()))
+
+    v = _pack_rows(pv, te, al, mi, y)
+
+    @jax.jit
+    def chain_poisson(v, key):
+        def body(i, carry):
+            s = poisson_bootstrap_sums(v + carry * 0, jax.random.fold_in(key, i), n_boot)
+            return jnp.sum(s[:, 0]).astype(jnp.float32)
+        return jax.lax.fori_loop(0, n_chain, body, jnp.zeros(()))
+
+    t_exact = _time(chain_exact, pv, te, al, mi, y, key, reps=2) / n_chain
+    t_poisson = _time(chain_poisson, v, key, reps=2) / n_chain
+    return {
+        "exact_ms": round(t_exact * 1e3, 2),
+        "poisson_ms": round(t_poisson * 1e3, 2),
+        "speedup": round(t_exact / t_poisson, 1),
+    }
+
+
 def bench_mcd() -> dict:
     from apnea_uq_tpu.config import ModelConfig
     from apnea_uq_tpu.models import AlarconCNN1D, apply_model, init_variables, predict_proba
@@ -243,6 +288,10 @@ def bench_mcd() -> dict:
             "achieved_tflops": round(achieved_tflops, 2),
             "peak_bf16_tflops": peak,
             "implied_mfu": round(achieved_tflops / peak, 4) if peak else None,
+            # Bootstrap engines at the reference test-set scale (~293K
+            # windows, SURVEY §1), where the exact engine's gather cost is
+            # representative.
+            "bootstrap_b100_m293k": bench_bootstrap(293_000),
         },
     }
 

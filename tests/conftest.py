@@ -13,16 +13,24 @@ import os
 # so a plain setdefault is not enough: override the env var AND the
 # already-loaded config, and only then is the (lazy) backend selection
 # guaranteed to build the 8-device virtual CPU platform.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+#
+# APNEA_UQ_TEST_TPU=1 opts OUT of the CPU override so TPU-gated tests
+# (e.g. the Pallas bootstrap kernel) can run against real hardware:
+#   APNEA_UQ_TEST_TPU=1 pytest tests/test_bootstrap.py -k pallas_kernel
+# Most of the suite expects the 8-device virtual mesh, so use it with -k.
+_USE_TPU = os.environ.get("APNEA_UQ_TEST_TPU") == "1"
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
