@@ -40,7 +40,11 @@ from apnea_uq_tpu.ops.entropy import binary_entropy
 from apnea_uq_tpu.training.trainer import predict_proba_batched
 from apnea_uq_tpu.uq.bootstrap import bootstrap_aggregates, compute_confidence_intervals
 from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
-from apnea_uq_tpu.uq.predict import ensemble_predict, mc_dropout_predict
+from apnea_uq_tpu.uq.predict import (
+    ensemble_predict,
+    mc_dropout_predict,
+    mc_dropout_predict_streaming,
+)
 from apnea_uq_tpu.utils import prng
 from apnea_uq_tpu.utils.timing import Timer, block
 
@@ -263,14 +267,36 @@ def run_mcd_analysis(
     if bootstrap_key is None:
         bootstrap_key = prng.bootstrap_key(seed)
     with Timer(f"{label}.predict") as t:
-        predictions = block(mc_dropout_predict(
-            model, variables, x,
-            n_passes=config.mc_passes,
-            mode=config.mcd_mode,
-            batch_size=config.mcd_batch_size,
-            key=predict_key,
-            mesh=mesh,
-        ))
+        if config.mcd_streaming:
+            # Host-streamed chunks for sets that exceed HBM; identical
+            # results to the in-HBM path.  Single-device: the mesh is not
+            # used here (streaming is the small-memory path, the mesh the
+            # many-chips path) — warn instead of silently idling a pod.
+            if mesh is not None and len(mesh.devices.flat) > 1:
+                import warnings
+
+                warnings.warn(
+                    f"mcd_streaming runs single-device; the "
+                    f"{len(mesh.devices.flat)}-device mesh is not used for "
+                    f"{label}. Unset mcd_streaming to shard over the mesh.",
+                    stacklevel=2,
+                )
+            predictions = mc_dropout_predict_streaming(
+                model, variables, x,
+                n_passes=config.mc_passes,
+                mode=config.mcd_mode,
+                batch_size=config.mcd_batch_size,
+                key=predict_key,
+            )
+        else:
+            predictions = block(mc_dropout_predict(
+                model, variables, x,
+                n_passes=config.mc_passes,
+                mode=config.mcd_mode,
+                batch_size=config.mcd_batch_size,
+                key=predict_key,
+                mesh=mesh,
+            ))
     det_probs = (
         np.asarray(predict_proba_batched(
             model, variables, x, batch_size=config.inference_batch_size,

@@ -161,3 +161,17 @@ class TestEndToEnd:
             table, result.detailed, check_dtype=False, check_exact=False,
             rtol=1e-6,
         )
+
+    def test_mcd_streaming_config(self, setup):
+        """UQConfig.mcd_streaming routes prediction through the host-
+        streamed path with identical results."""
+        model, variables, x, y, pids = setup
+        base = UQConfig(mc_passes=6, n_bootstrap=10, mcd_batch_size=32)
+        stream = UQConfig(mc_passes=6, n_bootstrap=10, mcd_batch_size=32,
+                          mcd_streaming=True)
+        a = run_mcd_analysis(model, variables, x, y, config=base, seed=4,
+                             detailed=False, sanity_check=False)
+        b = run_mcd_analysis(model, variables, x, y, config=stream, seed=4,
+                             detailed=False, sanity_check=False)
+        np.testing.assert_array_equal(a.predictions, b.predictions)
+        assert a.evaluation.confidence_intervals == b.evaluation.confidence_intervals
