@@ -74,6 +74,9 @@ class EnsembleConfig:
     learning_rate: float = 1e-3
     validation_split: float = 0.1
     early_stopping_patience: int = 5
+    # Stream per-member batch stacks from host memory instead of holding
+    # the dataset in HBM (identical results; for HBM-exceeding datasets).
+    streaming: bool = False
 
 
 @dataclass(frozen=True)

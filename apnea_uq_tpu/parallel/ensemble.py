@@ -37,10 +37,11 @@ import numpy as np
 import optax
 
 from apnea_uq_tpu.config import EnsembleConfig
-from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, init_variables
+from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, init_variables
+from apnea_uq_tpu.ops.losses import masked_bce_with_logits
 from apnea_uq_tpu.parallel import mesh as mesh_lib
 from apnea_uq_tpu.training.state import TrainState, make_optimizer
-from apnea_uq_tpu.training.trainer import _epoch_jit, _eval_loss_jit
+from apnea_uq_tpu.training.trainer import _epoch_jit, _eval_loss_jit, make_train_step
 from apnea_uq_tpu.utils import prng
 
 
@@ -129,7 +130,6 @@ def _ensemble_epoch(
     laid out P('ensemble', 'data')) and XLA inserts the per-member
     gradient all-reduce over the ``data`` axis groups.
     """
-    best_val, patience_left, active, best_params, best_stats, best_epoch, epochs_run = book
     member_keys = jax.vmap(lambda i: jax.random.fold_in(epoch_key, i))(member_ids)
 
     def member_epoch(member_state, key):
@@ -148,6 +148,18 @@ def _ensemble_epoch(
         )
 
     val_loss = jax.vmap(member_val, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(trained)
+    return _epoch_bookkeeping.__wrapped__(
+        state, trained, book, train_loss, val_loss, patience
+    )
+
+
+@partial(jax.jit, static_argnames=("patience",),
+         donate_argnames=("state", "trained", "book"))
+def _epoch_bookkeeping(state, trained, book, train_loss, val_loss, patience):
+    """Epoch-end early-stop bookkeeping, shared by the in-HBM scan epoch
+    and the streamed epoch: freeze stopped members, track per-member best
+    weights/epoch, decrement patience."""
+    best_val, patience_left, active, best_params, best_stats, best_epoch, epochs_run = book
 
     # Freeze members that already stopped.
     state = TrainState(
@@ -172,6 +184,138 @@ def _ensemble_epoch(
     return state, book, train_loss, val_loss, active
 
 
+@partial(
+    jax.jit,
+    static_argnames=("model", "tx", "data_sharding"),
+    donate_argnames=("state",),
+)
+def _stream_ensemble_step_jit(model, tx, state, xb, yb, mask, dropout_keys,
+                              step_idx, data_sharding=None):
+    """One streamed optimizer step for ALL members: per-member batches
+    (N, bs, ...) vmapped through the train step.  Same math as one scan
+    iteration of the in-HBM ensemble epoch.  The per-step dropout keys
+    fold inside the jit (``step_idx`` is a device scalar), so the host
+    loop issues exactly one dispatch per step.  ``state`` is donated —
+    the epoch works on a copy, keeping HBM at one stacked state."""
+    train_step = make_train_step(model, tx)
+
+    def member_step(member_state, xbi, ybi, dropout_key):
+        mb = mask
+        if data_sharding is not None:
+            xbi = jax.lax.with_sharding_constraint(xbi, data_sharding)
+            ybi = jax.lax.with_sharding_constraint(ybi, data_sharding)
+            mb = jax.lax.with_sharding_constraint(mb, data_sharding)
+        rng = jax.random.fold_in(dropout_key, step_idx)
+        return train_step(member_state, xbi, ybi, mb, rng)
+
+    state, loss = jax.vmap(
+        member_step, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE
+    )(state, xb, yb, dropout_keys)
+    return state, loss * jnp.sum(mask)
+
+
+@partial(jax.jit, static_argnames=("model", "data_sharding"))
+def _stream_ensemble_eval_jit(model, state, xb, yb, mask, data_sharding=None):
+    def member_eval(member_state):
+        xbi, ybi, mb = xb, yb, mask
+        if data_sharding is not None:
+            xbi = jax.lax.with_sharding_constraint(xbi, data_sharding)
+            ybi = jax.lax.with_sharding_constraint(ybi, data_sharding)
+            mb = jax.lax.with_sharding_constraint(mb, data_sharding)
+        variables = {"params": member_state.params,
+                     "batch_stats": member_state.batch_stats}
+        logits, _ = apply_model(model, variables, xbi, mode="eval")
+        return masked_bce_with_logits(logits, ybi, mb) * jnp.sum(mb)
+
+    return jax.vmap(member_eval, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(state)
+
+
+def _stream_ensemble_epoch(
+    model, tx, state, book, x, y, x_val, y_val, epoch_key, member_ids,
+    batch_size, patience, mesh, data_sharding, prefetch
+):
+    """One lockstep ensemble epoch fed batch-by-batch from HOST arrays
+    (x/y/x_val/y_val stay NumPy; data/feed.py pumps per-member batch
+    stacks, pre-sharded onto the mesh when the shapes divide).  Same
+    permutations, masks, and RNG streams as the in-HBM _ensemble_epoch,
+    so both paths train the same members."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apnea_uq_tpu.data.feed import prefetch_to_device
+    from apnea_uq_tpu.training.trainer import _pad_perm
+
+    member_keys = jax.vmap(
+        lambda i: jax.random.fold_in(epoch_key, i)
+    )(member_ids)
+    n_members = int(member_keys.shape[0])
+    n = x.shape[0]
+    # Per-member epoch key split + all permutations, identical to
+    # _epoch_jit's, in two vectorized dispatches.
+    split_keys = jax.vmap(jax.random.split)(member_keys)   # (N, 2)
+    dropout_keys = split_keys[:, 1]
+    idx = np.asarray(jax.vmap(
+        lambda k: _pad_perm(k, n, batch_size, True)[0]
+    )(split_keys[:, 0]))                                   # (N, steps, bs)
+    steps, bs = idx.shape[1], idx.shape[2]
+    # The pad mask is key-independent: real positions < n per flat slot.
+    mask = (np.arange(steps * bs) < n).astype(np.float32).reshape(steps, bs)
+
+    # Place streamed stacks directly onto the mesh when the member/batch
+    # axes divide it (member axis is padded to the ensemble axis already);
+    # otherwise land them replicated and let the step constraint shard.
+    stack_sharding = None
+    mask_sharding = None
+    if data_sharding is not None and bs % mesh.shape[mesh_lib.AXIS_DATA] == 0:
+        stack_sharding = NamedSharding(
+            mesh, P(mesh_lib.AXIS_ENSEMBLE, mesh_lib.AXIS_DATA)
+        )
+        mask_sharding = data_sharding
+
+    def batches():
+        for s in range(steps):
+            yield x[idx[:, s]], y[idx[:, s]]               # (N, bs, ...) stacks
+
+    masks_dev = [
+        jax.device_put(mask[s], mask_sharding) if mask_sharding is not None
+        else jnp.asarray(mask[s])
+        for s in range(steps)
+    ]
+    # The epoch trains a COPY so per-step donation never invalidates the
+    # pre-epoch state the bookkeeping needs (one copy per epoch instead of
+    # one per step).
+    trained = jax.tree.map(jnp.copy, state)
+    total = jnp.zeros((n_members,))
+    for s, (xb, yb) in enumerate(prefetch_to_device(
+        batches(), size=prefetch, sharding=stack_sharding
+    )):
+        trained, weighted = _stream_ensemble_step_jit(
+            model, tx, trained, xb, yb, masks_dev[s], dropout_keys,
+            jnp.asarray(s, jnp.int32), data_sharding,
+        )
+        total = total + weighted
+    train_loss = total / n
+
+    n_val = x_val.shape[0]
+    val_steps = -(-n_val // batch_size)
+    val_total = jnp.zeros((n_members,))
+    for s in range(val_steps):
+        lo, hi = s * batch_size, min((s + 1) * batch_size, n_val)
+        xb, yb = x_val[lo:hi], y_val[lo:hi]
+        pad = batch_size - (hi - lo)
+        if pad:
+            xb = np.concatenate([xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+            yb = np.concatenate([yb, np.zeros((pad,), yb.dtype)])
+        mb = (np.arange(batch_size) < hi - lo).astype(np.float32)
+        val_total = val_total + _stream_ensemble_eval_jit(
+            model, trained, jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb),
+            data_sharding,
+        )
+    val_loss = val_total / n_val
+
+    return _epoch_bookkeeping(state, trained, book, train_loss, val_loss,
+                              patience)
+
+
 @dataclasses.dataclass
 class _EnsembleRun:
     """Device-resident inputs of one ensemble-epoch program."""
@@ -192,7 +336,8 @@ class _EnsembleRun:
 
 
 def _setup_ensemble_run(
-    model, x_train, y_train, config, mesh, root_key, member_indices
+    model, x_train, y_train, config, mesh, root_key, member_indices,
+    streaming: bool = False,
 ) -> _EnsembleRun:
     n_members = config.num_members
     if member_indices is None:
@@ -208,8 +353,14 @@ def _setup_ensemble_run(
         root_key = prng.seed_key(config.seed_base)
     tx = make_optimizer(config.learning_rate)
 
-    x = jnp.asarray(x_train, jnp.float32)
-    y = jnp.asarray(y_train, jnp.float32)
+    if streaming:
+        # The dataset stays in HOST memory; the streamed epoch pumps
+        # per-member batch stacks through the prefetch feed.
+        x = np.asarray(x_train, np.float32)
+        y = np.asarray(y_train, np.float32)
+    else:
+        x = jnp.asarray(x_train, jnp.float32)
+        y = jnp.asarray(y_train, jnp.float32)
     n = x.shape[0]
     # Keras split arithmetic (see trainer.fit): val gets the tail remainder.
     n_val = n - int(n * (1.0 - config.validation_split))
@@ -238,8 +389,12 @@ def _setup_ensemble_run(
     # The dataset is replicated (every device can gather any batch row
     # locally); per-STEP batches are sharded over the 'data' axis inside
     # _ensemble_epoch, which is where the DP gradient all-reduce comes from.
-    data_repl = mesh_lib.replicated(mesh)
-    x, y, x_val, y_val = (jax.device_put(a, data_repl) for a in (x, y, x_val, y_val))
+    # In streaming mode the dataset never leaves the host.
+    if not streaming:
+        data_repl = mesh_lib.replicated(mesh)
+        x, y, x_val, y_val = (
+            jax.device_put(a, data_repl) for a in (x, y, x_val, y_val)
+        )
     data_sharding = (
         mesh_lib.data_sharding(mesh)
         if mesh.shape[mesh_lib.AXIS_DATA] > 1 else None
@@ -331,6 +486,8 @@ def fit_ensemble(
     mesh: Optional[jax.sharding.Mesh] = None,
     root_key: Optional[jax.Array] = None,
     member_indices=None,
+    streaming: Optional[bool] = None,
+    prefetch: int = 2,
     log_fn=None,
 ) -> EnsembleFitResult:
     """Train all N members concurrently over the mesh's ensemble axis,
@@ -341,9 +498,18 @@ def fit_ensemble(
     streams match the never-interrupted run (the reference's skip-if-
     checkpoint-exists resume, train_deep_ensemble_cnns.py:130-132, gets
     the same property from its seed-per-member scheme).
+
+    ``streaming`` (default: ``config.streaming``) keeps the dataset in
+    host memory and feeds per-member batch stacks through the
+    double-buffered prefetch pipeline (data/feed.py) — for training sets
+    that exceed the HBM budget.  Same permutations, masks, and RNG streams
+    as the in-HBM path, so both train the same members.
     """
+    if streaming is None:
+        streaming = config.streaming
     run = _setup_ensemble_run(
-        model, x_train, y_train, config, mesh, root_key, member_indices
+        model, x_train, y_train, config, mesh, root_key, member_indices,
+        streaming=streaming,
     )
     mesh = run.mesh
     tx, state, book = run.tx, run.state, run.book
@@ -355,11 +521,19 @@ def fit_ensemble(
     with mesh:
         for epoch in range(config.num_epochs):
             epoch_key = jax.random.fold_in(shuffle_root, epoch)
-            state, book, train_loss, val_loss, active = _ensemble_epoch(
-                model, tx, state, book, x, y, x_val, y_val, epoch_key,
-                member_ids, config.batch_size, config.early_stopping_patience,
-                data_sharding,
-            )
+            if streaming:
+                state, book, train_loss, val_loss, active = _stream_ensemble_epoch(
+                    model, tx, state, book, x, y, x_val, y_val, epoch_key,
+                    member_ids, config.batch_size,
+                    config.early_stopping_patience, mesh, data_sharding,
+                    prefetch,
+                )
+            else:
+                state, book, train_loss, val_loss, active = _ensemble_epoch(
+                    model, tx, state, book, x, y, x_val, y_val, epoch_key,
+                    member_ids, config.batch_size,
+                    config.early_stopping_patience, data_sharding,
+                )
             losses.append(np.asarray(train_loss[:n_members]))
             val_losses.append(np.asarray(val_loss[:n_members]))
             n_active = int(np.sum(np.asarray(active[:n_members])))

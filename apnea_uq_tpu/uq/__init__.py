@@ -16,6 +16,7 @@ from apnea_uq_tpu.uq.drivers import (
 from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
 from apnea_uq_tpu.uq.predict import (
     ensemble_predict,
+    ensemble_predict_streaming,
     mc_dropout_predict,
     mc_dropout_predict_streaming,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "mc_dropout_predict",
     "mc_dropout_predict_streaming",
     "ensemble_predict",
+    "ensemble_predict_streaming",
     "evaluate_uq",
     "detailed_frame",
     "run_mcd_analysis",

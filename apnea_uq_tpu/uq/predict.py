@@ -113,6 +113,38 @@ def _mcd_chunk_jit(model, variables, chunk, key, chunk_idx, n_passes, mode):
     return jax.vmap(one_pass)(keys)  # (T, bs)
 
 
+def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute):
+    """Shared host-streamed chunk loop: wrap-padded chunks flow through
+    the prefetch feed, ``compute(chunk, ci) -> (n_rows, bs)`` runs on
+    device, and a one-deep result queue overlaps each chunk's D2H fetch
+    with the next chunk's compute.  Returns the (n_rows, M) assembly."""
+    import numpy as np
+
+    from apnea_uq_tpu.data.feed import prefetch_to_device
+
+    x = np.asarray(x, np.float32)
+    m = x.shape[0]
+    n_chunks = -(-m // batch_size)
+
+    def chunks():
+        for ci in range(n_chunks):
+            rows = np.arange(ci * batch_size, (ci + 1) * batch_size) % m
+            yield x[rows]
+
+    out = np.empty((n_rows, n_chunks * batch_size), np.float32)
+    pending = None
+    for ci, chunk in enumerate(prefetch_to_device(chunks(), size=prefetch)):
+        probs = compute(chunk, ci)
+        if pending is not None:
+            pci, p = pending
+            out[:, pci * batch_size:(pci + 1) * batch_size] = np.asarray(p)
+        pending = (ci, probs)
+    if pending is not None:
+        pci, p = pending
+        out[:, pci * batch_size:(pci + 1) * batch_size] = np.asarray(p)
+    return out[:, :m]
+
+
 def mc_dropout_predict_streaming(
     model: AlarconCNN1D,
     variables: dict,
@@ -134,37 +166,16 @@ def mc_dropout_predict_streaming(
     uq_techniques.py:22).  Produces bit-identical results to
     :func:`mc_dropout_predict` for the same key.
     """
-    import numpy as np
-
-    from apnea_uq_tpu.data.feed import prefetch_to_device
-
     if mode not in _MCD_MODES:
         raise ValueError(f"mode must be 'clean' or 'parity', got {mode!r}")
     if key is None:
         key = prng.stochastic_key(seed)
-    x = np.asarray(x, np.float32)
-    m = x.shape[0]
-    n_chunks = -(-m // batch_size)
-
-    def chunks():
-        for ci in range(n_chunks):
-            rows = np.arange(ci * batch_size, (ci + 1) * batch_size) % m
-            yield x[rows]
-
-    out = np.empty((n_passes, n_chunks * batch_size), np.float32)
-    pending = None  # one-deep result queue: fetch chunk i while i+1 computes
-    for ci, chunk in enumerate(prefetch_to_device(chunks(), size=prefetch)):
-        probs = _mcd_chunk_jit(
+    return _stream_chunked(
+        x, batch_size, n_passes, prefetch,
+        lambda chunk, ci: _mcd_chunk_jit(
             model, variables, chunk, key, ci, n_passes, _MCD_MODES[mode]
-        )
-        if pending is not None:
-            pci, p = pending
-            out[:, pci * batch_size:(pci + 1) * batch_size] = np.asarray(p)
-        pending = (ci, probs)
-    if pending is not None:
-        pci, p = pending
-        out[:, pci * batch_size:(pci + 1) * batch_size] = np.asarray(p)
-    return out[:, :m]
+        ),
+    )
 
 
 def mc_dropout_predict(
@@ -225,11 +236,7 @@ def _ensemble_jit(model, stacked_variables, x, batch_size):
     chunks, m = _chunk(x, batch_size)
 
     def one_chunk(chunk):
-        def one_member(member_vars):
-            logits, _ = apply_model(model, member_vars, chunk, mode="eval")
-            return predict_proba(logits)
-
-        return jax.vmap(one_member)(stacked_variables)  # (N, bs)
+        return _ensemble_chunk_jit.__wrapped__(model, stacked_variables, chunk)
 
     probs = jax.lax.map(one_chunk, chunks)              # (chunks, N, bs)
     n_members = probs.shape[1]
@@ -273,6 +280,38 @@ def _ensemble_shard_map_jit(model, stacked_variables, x, batch_size, mesh):
         out_specs=P(mesh_lib.AXIS_ENSEMBLE, mesh_lib.AXIS_DATA),
     )
     return f(stacked_variables, x)[:, :m]
+
+
+@partial(jax.jit, static_argnames=("model",))
+def _ensemble_chunk_jit(model, stacked_variables, chunk):
+    def one_member(member_vars):
+        logits, _ = apply_model(model, member_vars, chunk, mode="eval")
+        return predict_proba(logits)
+
+    return jax.vmap(one_member)(stacked_variables)  # (N, bs)
+
+
+def ensemble_predict_streaming(
+    model: AlarconCNN1D,
+    member_variables,
+    x,
+    *,
+    batch_size: int = 2048,
+    prefetch: int = 2,
+) -> "np.ndarray":
+    """(N, M) deterministic ensemble probabilities with the window set
+    streamed from HOST memory (see :func:`mc_dropout_predict_streaming`):
+    chunks flow through the prefetch feed, a one-deep result queue
+    overlaps D2H with the next chunk's compute, and HBM holds
+    O(prefetch x batch_size) windows plus the stacked members.  Identical
+    results to :func:`ensemble_predict` (deterministic eval mode)."""
+    if isinstance(member_variables, (list, tuple)):
+        member_variables = stack_member_variables(list(member_variables))
+    n_members = jax.tree.leaves(member_variables)[0].shape[0]
+    return _stream_chunked(
+        x, batch_size, n_members, prefetch,
+        lambda chunk, ci: _ensemble_chunk_jit(model, member_variables, chunk),
+    )
 
 
 def ensemble_predict(

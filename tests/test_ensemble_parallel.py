@@ -173,3 +173,26 @@ def test_make_mesh_from_config():
         make_mesh_from_config(MeshConfig(data_axis=3))
     with pytest.raises(ValueError):
         make_mesh_from_config(MeshConfig(ensemble_axis=2, data_axis=2))
+
+
+def test_fit_ensemble_streaming_identical(rng):
+    """Streamed ensemble training (host batch stacks -> prefetch -> vmapped
+    step) reproduces the in-HBM scan path: same permutations, RNG streams,
+    losses, early-stop bookkeeping, and final members."""
+    model = _tiny()
+    x, y = _data(rng, n=320)
+    cfg = EnsembleConfig(num_members=2, num_epochs=3, batch_size=64,
+                         validation_split=0.2, early_stopping_patience=2)
+    mesh = make_mesh(2)  # (2, 4): member + data axes both exercised
+    r_mem = fit_ensemble(model, x, y, cfg, mesh=mesh)
+    r_str = fit_ensemble(model, x, y, cfg, mesh=mesh, streaming=True)
+    np.testing.assert_allclose(r_str.history["loss"], r_mem.history["loss"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(r_str.history["val_loss"],
+                               r_mem.history["val_loss"], rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(r_str.best_epoch, r_mem.best_epoch)
+    np.testing.assert_array_equal(r_str.epochs_run, r_mem.epochs_run)
+    for a, b in zip(jax.tree.leaves(r_str.state.params),
+                    jax.tree.leaves(r_mem.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)

@@ -210,3 +210,16 @@ def test_mcd_streaming_identical_to_in_hbm(rng):
         model, variables, x, n_passes=3, mode="parity", batch_size=32, key=key
     )
     np.testing.assert_array_equal(ap, bp)
+
+
+def test_ensemble_streaming_identical_to_in_hbm(rng):
+    """Streamed DE prediction == in-HBM vmapped path (deterministic)."""
+    from apnea_uq_tpu.uq import ensemble_predict_streaming
+
+    model = _tiny()
+    members = [init_variables(model, jax.random.key(s)) for s in range(3)]
+    x = rng.normal(size=(75, 60, 4)).astype(np.float32)  # 75 % 32 != 0
+    a = np.asarray(ensemble_predict(model, members, x, batch_size=32))
+    b = ensemble_predict_streaming(model, members, x, batch_size=32)
+    assert b.shape == (3, 75)
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
