@@ -189,7 +189,6 @@ def cmd_train_ensemble(args, config) -> int:
         model, prepared.x_train, prepared.y_train, run_cfg,
         mesh=_mesh(config, num_members=len(missing)),
         member_indices=[s - cfg.seed_base for s in missing],
-        streaming=cfg.streaming,
         log_fn=print,
     )
     save_ensemble(store, result.state, missing)
