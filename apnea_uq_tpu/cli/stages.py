@@ -142,7 +142,7 @@ def cmd_train(args, config) -> int:
     mesh = _data_mesh()
     result = fit(
         model, state, prepared.x_train, prepared.y_train, config.train,
-        mesh=mesh, streaming=config.train.streaming, log_fn=print,
+        mesh=mesh, log_fn=print,
     )
     path = save_state(os.path.join(_ckpt_root(args), "baseline"), result.state)
     print(f"saved baseline checkpoint -> {path} "

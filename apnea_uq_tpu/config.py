@@ -102,10 +102,12 @@ class UQConfig:
     # (ops/pallas_bootstrap.py).
     bootstrap_engine: str = "exact"
     mcd_mode: str = "clean"
-    # Stream MCD window chunks from host memory (mc_dropout_predict_streaming)
-    # instead of holding the test set in HBM; single-device (the mesh is
-    # not used on this path), bit-identical results.
+    # Stream MCD / DE window chunks from host memory
+    # (mc_dropout_predict_streaming / ensemble_predict_streaming) instead
+    # of holding the test set in HBM; single-device (the mesh is not used
+    # on these paths), identical results.
     mcd_streaming: bool = False
+    de_streaming: bool = False
     # Windows per device chunk.  MCD's T axis multiplies the activation
     # footprint (T x mcd_batch_size rows live at once), so its chunk is
     # smaller; 512 measured fastest at T=50 on a 16-GB v5e chip, where

@@ -279,7 +279,7 @@ def fit(
     tx: Optional[optax.GradientTransformation] = None,
     rng: Optional[jax.Array] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
-    streaming: bool = False,
+    streaming: Optional[bool] = None,
     prefetch: int = 2,
     log_fn: Optional[Callable[[str], None]] = None,
 ) -> FitResult:
@@ -295,6 +295,8 @@ def fit(
     tx = tx if tx is not None else make_optimizer(config.learning_rate)
     if rng is None:
         rng = prng.stream(prng.seed_key(config.seed), prng.STREAM_SHUFFLE)
+    if streaming is None:
+        streaming = config.streaming
     data_sharding = None
     if mesh is not None:
         # Import at call time: parallel.ensemble imports this module, so a

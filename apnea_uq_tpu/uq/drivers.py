@@ -42,6 +42,7 @@ from apnea_uq_tpu.uq.bootstrap import bootstrap_aggregates, compute_confidence_i
 from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
 from apnea_uq_tpu.uq.predict import (
     ensemble_predict,
+    ensemble_predict_streaming,
     mc_dropout_predict,
     mc_dropout_predict_streaming,
 )
@@ -54,6 +55,20 @@ from apnea_uq_tpu.utils.timing import Timer, block
 # explicit parameters here, defaulting to the per-surface reference values.
 DETAILED_ENTROPY_BASE = "bits"
 DETAILED_ENTROPY_EPS = 1e-9
+
+
+def _warn_streaming_ignores_mesh(flag_name: str, mesh, label: str) -> None:
+    """Streaming prediction paths are single-device; surface it instead of
+    silently idling a pod when a multi-device mesh was configured."""
+    if mesh is not None and len(mesh.devices.flat) > 1:
+        import warnings
+
+        warnings.warn(
+            f"{flag_name} runs single-device; the "
+            f"{len(mesh.devices.flat)}-device mesh is not used for {label}. "
+            f"Unset {flag_name} to shard over the mesh.",
+            stacklevel=3,
+        )
 
 
 @dataclasses.dataclass
@@ -269,18 +284,9 @@ def run_mcd_analysis(
     with Timer(f"{label}.predict") as t:
         if config.mcd_streaming:
             # Host-streamed chunks for sets that exceed HBM; identical
-            # results to the in-HBM path.  Single-device: the mesh is not
-            # used here (streaming is the small-memory path, the mesh the
-            # many-chips path) — warn instead of silently idling a pod.
-            if mesh is not None and len(mesh.devices.flat) > 1:
-                import warnings
-
-                warnings.warn(
-                    f"mcd_streaming runs single-device; the "
-                    f"{len(mesh.devices.flat)}-device mesh is not used for "
-                    f"{label}. Unset mcd_streaming to shard over the mesh.",
-                    stacklevel=2,
-                )
+            # results to the in-HBM path (streaming is the small-memory
+            # path, the mesh the many-chips path).
+            _warn_streaming_ignores_mesh("mcd_streaming", mesh, label)
             predictions = mc_dropout_predict_streaming(
                 model, variables, x,
                 n_passes=config.mc_passes,
@@ -335,11 +341,18 @@ def run_de_analysis(
     if bootstrap_key is None:
         bootstrap_key = prng.bootstrap_key(seed)
     with Timer(f"{label}.predict") as t:
-        predictions = block(ensemble_predict(
-            model, member_variables, x,
-            batch_size=config.inference_batch_size,
-            mesh=mesh,
-        ))
+        if config.de_streaming:
+            _warn_streaming_ignores_mesh("de_streaming", mesh, label)
+            predictions = ensemble_predict_streaming(
+                model, member_variables, x,
+                batch_size=config.inference_batch_size,
+            )
+        else:
+            predictions = block(ensemble_predict(
+                model, member_variables, x,
+                batch_size=config.inference_batch_size,
+                mesh=mesh,
+            ))
     return _run_common(
         label, np.asarray(predictions), y_true, patient_ids, config,
         None, t.elapsed_s, detailed, bootstrap_key,

@@ -175,3 +175,24 @@ class TestEndToEnd:
                              detailed=False, sanity_check=False)
         np.testing.assert_array_equal(a.predictions, b.predictions)
         assert a.evaluation.confidence_intervals == b.evaluation.confidence_intervals
+
+    def test_de_streaming_config(self, setup):
+        """UQConfig.de_streaming routes DE prediction through the host-
+        streamed path with identical results."""
+        model, variables, x, y, pids = setup
+        members = [init_variables(model, jax.random.key(s)) for s in range(2)]
+        base = UQConfig(n_bootstrap=10, inference_batch_size=32)
+        stream = UQConfig(n_bootstrap=10, inference_batch_size=32,
+                          de_streaming=True)
+        a = run_de_analysis(model, members, x, y, config=base, seed=4,
+                            detailed=False)
+        b = run_de_analysis(model, members, x, y, config=stream, seed=4,
+                            detailed=False)
+        np.testing.assert_allclose(a.predictions, b.predictions,
+                                   rtol=1e-6, atol=1e-7)
+        # CIs derive from the (float-tolerance-equal) predictions, so
+        # compare with the same tolerance, not exact equality.
+        ci_a, ci_b = a.evaluation.confidence_intervals, b.evaluation.confidence_intervals
+        assert set(ci_a) == set(ci_b)
+        for k in ci_a:
+            assert ci_a[k] == pytest.approx(ci_b[k], rel=1e-5, abs=1e-7), k
