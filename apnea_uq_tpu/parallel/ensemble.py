@@ -97,6 +97,22 @@ def init_ensemble_state(
     return jax.vmap(one)(member_indices)
 
 
+def _host_values(tree):
+    """Device pytree -> host NumPy pytree, multi-process safe: member-axis
+    arrays are sharded over the global 'ensemble' axis, whose shards span
+    other processes' devices in a multi-host run — allgather them in ONE
+    lockstep collective (every process executes the same epoch loop)."""
+    if all(
+        getattr(a, "is_fully_addressable", True) for a in jax.tree.leaves(tree)
+    ):
+        return jax.tree.map(np.asarray, tree)
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(
+        np.asarray, multihost_utils.process_allgather(tree, tiled=True)
+    )
+
+
 def _tree_where(cond_vec, new_tree, old_tree):
     """Per-member select: cond_vec (N,) broadcast over member-axis leaves."""
 
@@ -534,19 +550,23 @@ def fit_ensemble(
                     member_ids, config.batch_size,
                     config.early_stopping_patience, data_sharding,
                 )
-            losses.append(np.asarray(train_loss[:n_members]))
-            val_losses.append(np.asarray(val_loss[:n_members]))
-            n_active = int(np.sum(np.asarray(active[:n_members])))
+            h_train, h_val, h_active = _host_values(
+                (train_loss, val_loss, active)
+            )
+            losses.append(h_train[:n_members])
+            val_losses.append(h_val[:n_members])
+            n_active = int(np.sum(h_active[:n_members]))
             if log_fn:
                 log_fn(
                     f"epoch {epoch + 1}/{config.num_epochs} "
                     f"active={n_active}/{n_members} "
-                    f"val_loss={np.asarray(val_loss[:n_members]).round(4).tolist()}"
+                    f"val_loss={h_val[:n_members].round(4).tolist()}"
                 )
             if n_active == 0:
                 break
 
     best_val, patience_left, active, best_params, best_stats, best_epoch, epochs_run = book
+    h_best_epoch, h_epochs_run = _host_values((best_epoch, epochs_run))
     final = TrainState(
         params=best_params, batch_stats=best_stats,
         opt_state=state.opt_state, step=state.step,
@@ -557,7 +577,7 @@ def fit_ensemble(
         history={
             "loss": np.stack(losses), "val_loss": np.stack(val_losses),
         },
-        best_epoch=np.asarray(best_epoch[:n_members]),
-        epochs_run=np.asarray(epochs_run[:n_members]),
+        best_epoch=h_best_epoch[:n_members],
+        epochs_run=h_epochs_run[:n_members],
         num_members=n_members,
     )

@@ -1,0 +1,65 @@
+"""Worker process for the multi-host ensemble-training test.
+
+Run as: python _multihost_worker.py <process_id> <num_processes> <port>
+
+Each process owns 4 virtual CPU devices; jax.distributed assembles them
+into one global platform (collectives ride Gloo — the CPU stand-in for
+the ICI/DCN fabric a TPU pod uses), and fit_ensemble trains over the
+global (ensemble, data) mesh exactly as it would single-process.  Prints
+one JSON line with the training history for the parent test to compare.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    process_id, num_processes, port = (int(a) for a in sys.argv[1:4])
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from apnea_uq_tpu.config import EnsembleConfig, ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D
+    from apnea_uq_tpu.parallel import fit_ensemble, make_mesh
+
+    model = AlarconCNN1D(ModelConfig(
+        features=(8, 8), kernel_sizes=(5, 3), dropout_rates=(0.1, 0.1)
+    ))
+    # Same data on every process (the replicated-dataset DP design).
+    rng = np.random.default_rng(2025)
+    y = rng.integers(0, 2, 256)
+    x = rng.normal(size=(256, 60, 4)).astype(np.float32)
+    x[:, :, 0] += (y * 2.0 - 1.0)[:, None] * 1.5
+    y = y.astype(np.float32)
+
+    mesh = make_mesh(num_members=2)  # global (2, 4) spanning both processes
+    assert len(jax.devices()) == 4 * num_processes
+    assert len(jax.local_devices()) == 4
+    cfg = EnsembleConfig(num_members=2, num_epochs=2, batch_size=64,
+                         validation_split=0.25)
+    res = fit_ensemble(model, x, y, cfg, mesh=mesh)
+    print(json.dumps({
+        "process_id": process_id,
+        "mesh": dict(mesh.shape),
+        "loss": np.asarray(res.history["loss"]).tolist(),
+        "val_loss": np.asarray(res.history["val_loss"]).tolist(),
+        "best_epoch": np.asarray(res.best_epoch).tolist(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Multi-host (multi-process) ensemble training over a GLOBAL device mesh.
+
+The reference has no distributed backend at all (SURVEY §2.3: no NCCL/
+MPI/Horovod anywhere); the framework's comm story is JAX collectives over
+whatever fabric connects the mesh — ICI within a TPU slice, DCN across
+hosts, and Gloo on this CPU test rig.  This test launches TWO processes
+with 4 virtual devices each, assembles the 8-device global platform via
+``jax.distributed``, trains the ensemble over a global (2, 4) mesh
+spanning both processes, and asserts both processes see identical
+histories that match the single-process run on the same 8 devices —
+the multi-host path is the same program, just laid over two hosts.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.skipif(
+    os.environ.get("APNEA_UQ_SKIP_MULTIHOST") == "1",
+    reason="multi-process test disabled",
+)
+def test_two_process_training_matches_single_process():
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # A failed/timed-out worker must not orphan its peer: the survivor
+        # would sit blocked in a collective barrier holding the port.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    assert outs[0]["mesh"] == {"ensemble": 2, "data": 4}
+    # Both processes observed the same global training run.
+    np.testing.assert_allclose(outs[0]["loss"], outs[1]["loss"], rtol=1e-6)
+    np.testing.assert_allclose(outs[0]["val_loss"], outs[1]["val_loss"],
+                               rtol=1e-6)
+
+    # And the 2-host global mesh trains the SAME models as one process
+    # with all 8 devices (same data, same mesh shape, same RNG streams).
+    from apnea_uq_tpu.config import EnsembleConfig, ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D
+    from apnea_uq_tpu.parallel import fit_ensemble, make_mesh
+
+    model = AlarconCNN1D(ModelConfig(
+        features=(8, 8), kernel_sizes=(5, 3), dropout_rates=(0.1, 0.1)
+    ))
+    rng = np.random.default_rng(2025)
+    y = rng.integers(0, 2, 256)
+    x = rng.normal(size=(256, 60, 4)).astype(np.float32)
+    x[:, :, 0] += (y * 2.0 - 1.0)[:, None] * 1.5
+    res = fit_ensemble(
+        model, x, y.astype(np.float32),
+        EnsembleConfig(num_members=2, num_epochs=2, batch_size=64,
+                       validation_split=0.25),
+        mesh=make_mesh(num_members=2),
+    )
+    np.testing.assert_allclose(res.history["loss"], outs[0]["loss"],
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(res.history["val_loss"], outs[0]["val_loss"],
+                               rtol=2e-4, atol=2e-5)
