@@ -25,12 +25,15 @@ it draws the (B, tile) count block from the TPU's hardware PRNG
 (``pltpu.prng_random_bits``; the counts never touch HBM), maps bits to
 Poisson counts with 10 integer threshold compares (inverse CDF truncated
 at 9; P(c>9 | lambda=1) ~ 1.1e-7), and accumulates ``C @ V^T`` on the
-MXU.  Measured on the same v5e at B=100, M=293K: **2.5 ms** in a tight
-chained loop (vs 3.5 ms for the XLA Poisson formulation, whose (B, M)
-count matrix round-trips HBM, and 241 ms for the exact gather engine);
-``bench.py``'s harness records 232 ms -> 11.5 ms (**20x**) for the
-end-to-end engine swap at the same scale (BENCH_r*, context key
-``bootstrap_b100_m293k``).
+MXU at full f32 precision.  Measured on the same v5e at B=100, M=293K
+(post-precision-fix numbers): **2.95 ms** in a tight chained loop (vs
+3.5 ms for the XLA Poisson formulation, whose (B, M) count matrix
+round-trips HBM, and 241 ms for the exact gather engine); ``bench.py``'s
+harness records 231 ms -> 8.8 ms (**26x**) for the end-to-end engine
+swap at the same scale (BENCH_r*, context key ``bootstrap_b100_m293k``).
+The Precision.HIGHEST matmul costs ~0.45 ms of that — the kernel is
+PRNG/compare-bound, not MXU-bound, so the simpler both-operand HIGHEST
+is kept over per-operand tuning.
 
 Off-TPU (CPU tests, interpret mode has no PRNG primitives) the public
 entry point falls back to the XLA Poisson formulation — same estimator,
@@ -77,10 +80,17 @@ def _kernel(seed_ref, v_ref, out_ref, *, b_padded, tile):
     counts = jnp.zeros((b_padded, tile), jnp.int32)
     for t in _ICDF:
         counts = counts + (bits > t).astype(jnp.int32)
+    # Full-f32 matmul precision is REQUIRED: the TPU MXU's default
+    # single-pass bf16 truncates v's mantissa, which both biases the sums
+    # (~0.25% observed on near-constant entropy rows) and collapses the
+    # tiny across-resample variance the CIs are made of.  HIGHEST selects
+    # the multi-pass bf16 decomposition that recovers f32 accuracy;
+    # counts are small integers (exact in any precision).
     acc = jax.lax.dot_general(
         counts.astype(jnp.float32), v_ref[...],
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )  # (b_padded, N_ROWS)
 
     @pl.when(j == 0)
@@ -122,7 +132,8 @@ def _xla_poisson_sums(v, key, n_boot):
     cdf = jnp.asarray(_CDF, jnp.float32)
     u = jax.random.uniform(key, (n_boot, v.shape[1]))
     counts = jnp.sum(u[..., None] > cdf, axis=-1).astype(jnp.float32)
-    return counts @ v.T
+    # Same full-f32 precision requirement as the kernel's dot (see above).
+    return jnp.matmul(counts, v.T, precision=jax.lax.Precision.HIGHEST)
 
 
 def poisson_bootstrap_sums(v, key, n_boot: int, *, tile: int = 2048):

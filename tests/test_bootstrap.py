@@ -160,3 +160,27 @@ class TestPoissonEngine:
         row_sums = np.asarray(v.sum(axis=1))
         rel = np.abs(s1.mean(axis=0) / row_sums - 1)
         assert rel.max() < 0.05
+
+    def test_low_variance_regime_on_tpu(self, rng):
+        """TPU-only regression for the MXU precision bug: near-constant
+        metric rows (a trained model's entropies vary by ~1e-4) must not
+        be bf16-quantized by the kernel's matmul — the engines' aggregate
+        means must agree to f32-level accuracy, not 0.25%."""
+        import jax
+
+        if jax.default_backend() != "tpu":
+            pytest.skip("bf16 MXU truncation only manifests on TPU")
+        preds = (0.5 + rng.normal(0, 0.002, size=(20, 20000))).astype(np.float32)
+        y = rng.integers(0, 2, 20000)
+        exact = bootstrap_aggregates(preds, y, n_bootstrap=50, seed=1)
+        pois = bootstrap_aggregates(preds, y, n_bootstrap=50, seed=1,
+                                    engine="poisson")
+        for k in AGGREGATE_KEYS:
+            e = np.asarray(exact[k])
+            p = np.asarray(pois[k])
+            assert abs(e.mean() - p.mean()) < 1e-5 + 1e-3 * abs(e.mean()), \
+                (k, e.mean(), p.mean())
+            # Quantization's other failure mode: the tiny across-resample
+            # variance the CIs are made of collapsing to a constant.
+            if e.std() > 0:
+                assert p.std() > e.std() / 50, (k, e.std(), p.std())
