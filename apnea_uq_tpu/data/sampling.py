@@ -75,7 +75,12 @@ def _minority_knn(
 
     @partial(jax.jit, static_argnames=("k",))
     def block_topk(rows, row_sq, row_ids, k):
-        d = row_sq[:, None] + sq[None, :] - 2.0 * rows @ x.T
+        # Full-f32 matmul: the TPU MXU's default single-pass bf16 dot
+        # perturbs distances by ~0.4% relative, enough to flip near-tie
+        # neighbor rankings vs the reference's exact sklearn kNN.  SMOTE
+        # runs once per prepare, so the multi-pass cost is irrelevant.
+        prod = jnp.matmul(rows, x.T, precision=jax.lax.Precision.HIGHEST)
+        d = row_sq[:, None] + sq[None, :] - 2.0 * prod
         d = d.at[jnp.arange(rows.shape[0]), row_ids].set(jnp.inf)  # mask self
         _, idx = jax.lax.top_k(-d, k)
         return idx

@@ -78,7 +78,7 @@ class TestPoissonEngine:
     """The fused Poisson-bootstrap engine (ops/pallas_bootstrap.py): the
     XLA fallback path runs on the CPU CI; the Pallas kernel itself needs
     real hardware — run the gated test with
-    ``APNEA_UQ_TEST_TPU=1 pytest tests/test_bootstrap.py -k pallas_kernel``
+    ``APNEA_UQ_TEST_TPU=1 pytest tests/test_bootstrap.py -k on_tpu``
     on a TPU host (it skips on the default CPU-mesh suite)."""
 
     def test_deterministic_and_seed_sensitive(self, rng):
