@@ -32,7 +32,9 @@ class ModelConfig:
     Six Conv1D->ReLU->BatchNorm->Dropout blocks, global average pooling over
     time, and a single-logit head.  ``compute_dtype='bfloat16'`` runs conv/
     dense math on the MXU in bf16 with float32 params and float32 batch-norm
-    statistics; use ``'float32'`` for strict numerical parity work.
+    statistics; for strict numerical parity work use ``'float32'`` AND
+    ``matmul_precision='highest'`` — on TPU the MXU truncates even float32
+    matmul operands to bf16 by default (see the field comment below).
     """
 
     features: Sequence[int] = (128, 192, 224, 96, 256, 96)
@@ -43,6 +45,11 @@ class ModelConfig:
     bn_momentum: float = 0.99  # Keras BatchNormalization default
     bn_epsilon: float = 1e-3   # Keras BatchNormalization default
     compute_dtype: str = "float32"
+    # Conv/dense MXU precision ('default' | 'high' | 'highest' | None).
+    # The TPU MXU's default is single-pass bf16 even for float32 inputs,
+    # so compute_dtype='float32' alone is NOT strict f32 there — set
+    # matmul_precision='highest' for strict numerical-parity work.
+    matmul_precision: str | None = None
 
 
 @dataclass(frozen=True)

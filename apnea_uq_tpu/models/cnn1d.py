@@ -70,6 +70,7 @@ class AlarconCNN1D(nn.Module):
                 padding="SAME",
                 dtype=dtype,
                 param_dtype=jnp.float32,
+                precision=cfg.matmul_precision,
                 kernel_init=nn.initializers.glorot_uniform(),
                 name=f"conv_{i}",
             )(x)
@@ -91,6 +92,7 @@ class AlarconCNN1D(nn.Module):
             features=1,
             dtype=dtype,
             param_dtype=jnp.float32,
+            precision=cfg.matmul_precision,
             kernel_init=nn.initializers.glorot_uniform(),
             name="head",
         )(x)

@@ -123,3 +123,25 @@ def test_bfloat16_compute(tiny_model):
     assert logits.dtype == jnp.float32  # output promoted back
     probs = predict_proba(logits)
     assert np.all((np.asarray(probs) >= 0) & (np.asarray(probs) <= 1))
+
+
+def test_matmul_precision_config(rng):
+    """matmul_precision threads through conv/dense; on TPU the MXU default
+    is single-pass bf16 even for f32 inputs, so 'highest' is what makes
+    compute_dtype='float32' actually strict.  On CPU (this suite) the two
+    must coincide; on real TPU they intentionally diverge, so skip there."""
+    import pytest
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("default vs highest intentionally diverge off-CPU")
+    x = rng.normal(size=(4, 60, 4)).astype(np.float32)
+    base = AlarconCNN1D(ModelConfig(features=(8,), kernel_sizes=(3,),
+                                    dropout_rates=(0.1,)))
+    strict = AlarconCNN1D(ModelConfig(features=(8,), kernel_sizes=(3,),
+                                      dropout_rates=(0.1,),
+                                      matmul_precision="highest"))
+    v = init_variables(base, jax.random.key(0))
+    a = np.asarray(base.apply(v, x, mode="eval"))
+    b = np.asarray(strict.apply(v, x, mode="eval"))
+    # CPU computes f32 either way; the knob must not change semantics.
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
