@@ -43,6 +43,10 @@ from apnea_uq_tpu.parallel import mesh as mesh_lib
 from apnea_uq_tpu.training.state import TrainState, make_optimizer
 from apnea_uq_tpu.training.trainer import _epoch_jit, _eval_loss_jit, make_train_step
 from apnea_uq_tpu.utils import prng
+# Member-axis arrays are sharded over the global 'ensemble' axis, whose
+# shards span other processes' devices in a multi-host run; host fetches
+# go through the shared multi-process-safe helper.
+from apnea_uq_tpu.utils.multihost import host_values as _host_values
 
 
 @dataclasses.dataclass
@@ -97,20 +101,6 @@ def init_ensemble_state(
     return jax.vmap(one)(member_indices)
 
 
-def _host_values(tree):
-    """Device pytree -> host NumPy pytree, multi-process safe: member-axis
-    arrays are sharded over the global 'ensemble' axis, whose shards span
-    other processes' devices in a multi-host run — allgather them in ONE
-    lockstep collective (every process executes the same epoch loop)."""
-    if all(
-        getattr(a, "is_fully_addressable", True) for a in jax.tree.leaves(tree)
-    ):
-        return jax.tree.map(np.asarray, tree)
-    from jax.experimental import multihost_utils
-
-    return jax.tree.map(
-        np.asarray, multihost_utils.process_allgather(tree, tiled=True)
-    )
 
 
 def _tree_where(cond_vec, new_tree, old_tree):

@@ -57,6 +57,11 @@ DETAILED_ENTROPY_BASE = "bits"
 DETAILED_ENTROPY_EPS = 1e-9
 
 
+# Prediction stacks from mesh-sharded inference span processes on a
+# multi-host mesh; host fetches go through the shared helper.
+from apnea_uq_tpu.utils.multihost import host_values as _host_predictions
+
+
 def _warn_streaming_ignores_mesh(flag_name: str, mesh, label: str) -> None:
     """Streaming prediction paths are single-device; surface it instead of
     silently idling a pod when a multi-device mesh was configured."""
@@ -304,7 +309,7 @@ def run_mcd_analysis(
                 mesh=mesh,
             ))
     det_probs = (
-        np.asarray(predict_proba_batched(
+        _host_predictions(predict_proba_batched(
             model, variables, x, batch_size=config.inference_batch_size,
             mesh=mesh,
         ))
@@ -312,7 +317,7 @@ def run_mcd_analysis(
         else None
     )
     return _run_common(
-        label, np.asarray(predictions), y_true, patient_ids, config,
+        label, _host_predictions(predictions), y_true, patient_ids, config,
         det_probs, t.elapsed_s, detailed, bootstrap_key,
     )
 
@@ -354,7 +359,7 @@ def run_de_analysis(
                 mesh=mesh,
             ))
     return _run_common(
-        label, np.asarray(predictions), y_true, patient_ids, config,
+        label, _host_predictions(predictions), y_true, patient_ids, config,
         None, t.elapsed_s, detailed, bootstrap_key,
     )
 

@@ -1,0 +1,33 @@
+"""Host fetches that survive multi-process (multi-host) meshes.
+
+Arrays sharded over a mesh that spans processes are not fully addressable
+from any single process; fetching them requires a lockstep allgather.
+Every call site that pulls device results to host NumPy inside code that
+may run under ``jax.distributed`` (the ensemble trainer's per-epoch
+bookkeeping, the UQ drivers' prediction stacks) routes through here.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def host_values(tree):
+    """Device pytree -> host NumPy pytree, multi-process safe.
+
+    Fully-addressable arrays (the single-process common case) convert
+    directly; otherwise ONE ``process_allgather`` collective fetches the
+    whole pytree — callers must invoke this in lockstep on every process
+    (true for the epoch loops and drivers, which all processes execute
+    identically).
+    """
+    if all(
+        getattr(a, "is_fully_addressable", True) for a in jax.tree.leaves(tree)
+    ):
+        return jax.tree.map(np.asarray, tree)
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(
+        np.asarray, multihost_utils.process_allgather(tree, tiled=True)
+    )

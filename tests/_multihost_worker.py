@@ -52,12 +52,38 @@ def main() -> None:
     cfg = EnsembleConfig(num_members=2, num_epochs=2, batch_size=64,
                          validation_split=0.25)
     res = fit_ensemble(model, x, y, cfg, mesh=mesh)
+
+    # Mesh-sharded DE + MCD inference and the full eval drivers across
+    # processes: predictions (and the MCD deterministic sanity probe) come
+    # back through the multihost-safe allgather.
+    from apnea_uq_tpu.config import UQConfig
+    from apnea_uq_tpu.uq import run_de_analysis, run_mcd_analysis
+
+    de = run_de_analysis(
+        model, res.stacked_variables(), x[:64], y[:64],
+        config=UQConfig(n_bootstrap=10, inference_batch_size=32),
+        mesh=mesh, detailed=False,
+    )
+    assert de.predictions.shape == (2, 64)
+    mcd = run_mcd_analysis(
+        model, res.member_variables(0), x[:64], y[:64],
+        config=UQConfig(mc_passes=4, n_bootstrap=10, mcd_batch_size=32,
+                        inference_batch_size=32),
+        mesh=mesh, detailed=False, sanity_check=True, seed=3,
+    )
+    assert mcd.predictions.shape == (4, 64)
+    assert mcd.deterministic_classification is not None
+
     print(json.dumps({
         "process_id": process_id,
         "mesh": dict(mesh.shape),
         "loss": np.asarray(res.history["loss"]).tolist(),
         "val_loss": np.asarray(res.history["val_loss"]).tolist(),
         "best_epoch": np.asarray(res.best_epoch).tolist(),
+        "de_pred_sum": float(de.predictions.sum()),
+        "de_accuracy": de.classification["accuracy"],
+        "mcd_pred_sum": float(mcd.predictions.sum()),
+        "mcd_det_accuracy": mcd.deterministic_classification["accuracy"],
     }))
 
 

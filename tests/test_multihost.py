@@ -58,10 +58,17 @@ def test_two_process_training_matches_single_process():
                 p.wait()
 
     assert outs[0]["mesh"] == {"ensemble": 2, "data": 4}
-    # Both processes observed the same global training run.
+    # Both processes observed the same global training run AND the same
+    # mesh-sharded evaluation (predictions allgathered across processes).
     np.testing.assert_allclose(outs[0]["loss"], outs[1]["loss"], rtol=1e-6)
     np.testing.assert_allclose(outs[0]["val_loss"], outs[1]["val_loss"],
                                rtol=1e-6)
+    np.testing.assert_allclose(outs[0]["de_pred_sum"], outs[1]["de_pred_sum"],
+                               rtol=1e-6)
+    assert outs[0]["de_accuracy"] == outs[1]["de_accuracy"]
+    np.testing.assert_allclose(outs[0]["mcd_pred_sum"],
+                               outs[1]["mcd_pred_sum"], rtol=1e-6)
+    assert outs[0]["mcd_det_accuracy"] == outs[1]["mcd_det_accuracy"]
 
     # And the 2-host global mesh trains the SAME models as one process
     # with all 8 devices (same data, same mesh shape, same RNG streams).
