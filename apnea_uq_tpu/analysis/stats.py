@@ -56,20 +56,7 @@ def pearson_corr(x, y) -> Tuple[float, float]:
     return r, float(p)
 
 
-def _rank_with_ties(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Midranks (1-based) and the sizes of each tie group."""
-    order = np.argsort(values, kind="mergesort")
-    sorted_vals = values[order]
-    # Boundaries of runs of equal values.
-    boundary = np.concatenate(([True], sorted_vals[1:] != sorted_vals[:-1]))
-    group_ids = np.cumsum(boundary) - 1
-    counts = np.bincount(group_ids)
-    ends = np.cumsum(counts)
-    starts = ends - counts
-    midranks_per_group = (starts + 1 + ends) / 2.0
-    ranks = np.empty(values.size, np.float64)
-    ranks[order] = midranks_per_group[group_ids]
-    return ranks, counts.astype(np.float64)
+from apnea_uq_tpu.utils.ranking import rank_with_ties as _rank_with_ties
 
 
 def mann_whitney_u(

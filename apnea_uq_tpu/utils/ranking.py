@@ -1,0 +1,33 @@
+"""Shared midrank computation (ties share their mean rank).
+
+One implementation feeds both rank statistics in the framework — the
+Mann-Whitney U test (analysis/stats.py) and the rank-formulation ROC-AUC
+(evaluation/classification.py) — so tie handling cannot silently diverge
+between them.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def rank_with_ties(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Midranks (1-based) and the sizes of each tie group.
+
+    Vectorized: boundary mask over the sorted values -> tie-group ids ->
+    per-group midrank ``(start + 1 + end) / 2`` scattered back.
+    """
+    values = np.asarray(values)
+    order = np.argsort(values, kind="mergesort")
+    sorted_vals = values[order]
+    boundary = np.concatenate(([True], sorted_vals[1:] != sorted_vals[:-1]))
+    group_ids = np.cumsum(boundary) - 1
+    counts = np.bincount(group_ids)
+    ends = np.cumsum(counts)
+    starts = ends - counts
+    midranks_per_group = (starts + 1 + ends) / 2.0
+    ranks = np.empty(values.size, np.float64)
+    ranks[order] = midranks_per_group[group_ids]
+    return ranks, counts.astype(np.float64)
