@@ -336,11 +336,34 @@ def cmd_correlate(args, config) -> int:
 
 
 def cmd_sweep(args, config) -> int:
-    from apnea_uq_tpu.analysis.sweep import de_member_sweep, mcd_pass_sweep
     from apnea_uq_tpu.analysis.plots import plot_convergence
+
+    if args.from_csv:
+        # Plot an existing sweep table (the reference's C20 workflow: its
+        # convergence CSVs were hand-collected, and
+        # hyperparameter_plot_mcd_or_de_pass_convergence.py only plots
+        # them).  Schema: column ``N`` + one ``Variance_<set>`` per set.
+        # This branch stays above the sweep/training imports so a
+        # plot-only run never pays JAX initialization.
+        import pandas as pd
+
+        if not args.plot:
+            raise SystemExit("--from-csv requires --plot OUT.png")
+        frame = pd.read_csv(args.from_csv)
+        print(frame.to_string(index=False))
+        path = plot_convergence(frame, args.plot)
+        print(f"convergence plot -> {path}")
+        return 0
+
+    from apnea_uq_tpu.analysis.sweep import de_member_sweep, mcd_pass_sweep
     from apnea_uq_tpu.training import restore_state
     from apnea_uq_tpu.utils import prng
 
+    if not (args.registry and args.method and args.counts):
+        raise SystemExit(
+            "sweep needs --registry, --method and --counts (or --from-csv "
+            "with --plot to plot an existing table)"
+        )
     registry = _registry(args)
     _prepared, sets = _load_test_sets(registry)
     test_sets = {label: x for label, (x, _y, _ids) in sets.items()}
@@ -480,11 +503,16 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--labels", nargs="+", required=True)
 
     p = add("sweep", cmd_sweep, "T/N uncertainty-convergence sweep.")
-    p.add_argument("--registry", required=True)
+    p.add_argument("--registry", required=False, default=None)
     p.add_argument("--ckpt-dir", default=None)
-    p.add_argument("--method", choices=("mcd", "de"), required=True)
-    p.add_argument("--counts", nargs="+", required=True)
+    p.add_argument("--method", choices=("mcd", "de"), required=False,
+                   default=None)
+    p.add_argument("--counts", nargs="+", required=False, default=None)
     p.add_argument("--plot", default=None, help="Optional output PNG path.")
+    p.add_argument("--from-csv", default=None,
+                   help="Plot an existing sweep CSV (column N + "
+                        "Variance_<set> columns) instead of re-running "
+                        "predictions; requires --plot.")
 
     p = add("figures", cmd_figures, "Thesis overview figure set.")
     p.add_argument("--registry", required=True)

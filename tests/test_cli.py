@@ -157,6 +157,27 @@ def test_full_pipeline(env, order, capsys):
     assert len(os.listdir(fig_dir)) == 4
 
 
+def test_sweep_from_csv(tmp_path, capsys):
+    """--from-csv plots a hand-collected table (the reference's C20
+    workflow: hyperparameter_plot_mcd_or_de_pass_convergence.py only ever
+    plotted a CSV) without touching a registry or checkpoints."""
+    csv_path = str(tmp_path / "conv.csv")
+    pd.DataFrame({
+        "N": [10, 25, 50],
+        "Variance_Unbalanced": [0.04, 0.03, 0.028],
+        "Variance_Balanced": [0.05, 0.042, 0.04],
+    }).to_csv(csv_path, index=False)
+    plot_path = str(tmp_path / "conv.png")
+    assert run("sweep", "--from-csv", csv_path, "--plot", plot_path) == 0
+    capsys.readouterr()
+    assert os.path.getsize(plot_path) > 0
+
+    with pytest.raises(SystemExit):
+        run("sweep", "--from-csv", csv_path)  # --plot is required
+    with pytest.raises(SystemExit):
+        run("sweep", "--method", "mcd")      # incomplete re-run arguments
+
+
 def test_cohort_stage(env, tmp_path, capsys):
     rng = np.random.default_rng(1)
     n = 100
