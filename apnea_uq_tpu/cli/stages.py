@@ -285,6 +285,23 @@ def cmd_eval_de(args, config) -> int:
     return 0
 
 
+def cmd_demo(args, config) -> int:
+    """Zero-data smoke demo of the UQ engine (reference C12 __main__:
+    ``python uq_techniques.py`` ran a synthetic 5x1000 evaluation,
+    uq_techniques.py:395-446)."""
+    from apnea_uq_tpu.uq import run_synthetic_demo
+
+    result = run_synthetic_demo(
+        n_models=args.num_models,
+        n_windows=args.num_windows,
+        seed=args.seed,
+        config=config.uq,
+    )
+    _print_run(result)
+    _emit_plots(args, result)
+    return 0
+
+
 def cmd_aggregate_patients(args, config) -> int:
     from apnea_uq_tpu.analysis import aggregate_patients, patient_summary_report
     from apnea_uq_tpu.data import registry as reg
@@ -524,3 +541,10 @@ def register(sub, add_config_arg, load_config_fn) -> None:
             "SHHS2 cohort demographics (and optional signal quality).")
     p.add_argument("--metadata-csv", required=True)
     p.add_argument("--signal-quality", action="store_true")
+
+    p = add("demo", cmd_demo,
+            "Zero-data synthetic smoke demo of the UQ engine.")
+    p.add_argument("--num-models", type=int, default=5)
+    p.add_argument("--num-windows", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=2025)
+    _add_plots_arg(p)

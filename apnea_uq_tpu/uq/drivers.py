@@ -364,6 +364,48 @@ def run_de_analysis(
     )
 
 
+def run_synthetic_demo(
+    *,
+    n_models: int = 5,
+    n_windows: int = 1000,
+    positive_rate: float = 0.3,
+    seed: int = 2025,
+    config: UQConfig = UQConfig(n_bootstrap=50),
+    label: str = "SYNTHETIC_DEMO",
+) -> UQRunResult:
+    """Self-contained smoke demo of the full UQ pipeline — no data, no
+    trained model (reference C12 ``__main__``: uq_techniques.py:395-446
+    fabricates a 5x1000 prediction matrix and runs evaluate_uq_methods
+    on it).
+
+    Windows get a class-dependent latent logit plus per-window difficulty
+    noise; each "model" observes it through its own disagreement noise, so
+    the stack has genuine aleatoric (overlapping classes) and epistemic
+    (inter-model) components and every downstream quantity — decomposition,
+    bootstrap CIs, classification suite, detailed frame, plots — is
+    exercised with plausible values.  Synthetic patient ids let the
+    patient-level analyses consume the result too.
+    """
+    if not 0.0 < positive_rate < 1.0:
+        raise ValueError(f"positive_rate must be in (0, 1), got {positive_rate}")
+    rng = np.random.default_rng(seed)
+    y = (rng.uniform(size=n_windows) < positive_rate).astype(np.float32)
+    # Latent per-window logit: separated class means, overlapping tails.
+    latent = np.where(y == 1, 1.4, -1.4) + rng.normal(0.0, 0.9, n_windows)
+    # Per-model observation: a small systematic offset per model plus
+    # per-(model, window) noise -> non-degenerate mutual information.
+    model_bias = rng.normal(0.0, 0.25, (n_models, 1))
+    noise = rng.normal(0.0, 0.45, (n_models, n_windows))
+    predictions = 1.0 / (1.0 + np.exp(-(latent[None, :] + model_bias + noise)))
+    patient_ids = np.asarray(
+        [f"DEMO{int(i):04d}" for i in rng.integers(0, 20, n_windows)]
+    )
+    return _run_common(
+        label, predictions.astype(np.float32), y, patient_ids, config,
+        None, 0.0, True, prng.bootstrap_key(seed),
+    )
+
+
 def save_run_plots(result: UQRunResult, out_dir: str) -> list:
     """The reference's per-evaluation plot set (uq_techniques.py:369-387):
     per-true-class distribution histograms of the three uncertainty
