@@ -22,6 +22,7 @@ from apnea_uq_tpu.analysis import (
     uncertainty_correctness_test,
     window_level_analysis,
 )
+from apnea_uq_tpu.utils.ranking import rank_with_ties
 
 
 def _detailed_frame(rng, n=400, n_patients=20):
@@ -163,3 +164,24 @@ class TestDrivers:
         assert out["significant"]
         assert out["median_incorrect"] > out["median_correct"]
         assert out["n_incorrect"] + out["n_correct"] == 2000
+
+
+class TestRankWithTies:
+    """Direct unit tests for the shared midrank helper (utils/ranking.py)
+    that feeds both Mann-Whitney and the rank-formulation ROC-AUC."""
+
+    def test_matches_scipy_rankdata(self, rng):
+        values = rng.integers(0, 50, 500).astype(np.float64)  # many ties
+        ranks, counts = rank_with_ties(values)
+        np.testing.assert_allclose(
+            ranks, scipy.stats.rankdata(values, method="average")
+        )
+        assert counts.sum() == values.size
+
+    def test_all_distinct_and_all_equal(self):
+        ranks, counts = rank_with_ties(np.asarray([3.0, 1.0, 2.0]))
+        np.testing.assert_allclose(ranks, [3.0, 1.0, 2.0])
+        assert counts.tolist() == [1.0, 1.0, 1.0]
+        ranks, counts = rank_with_ties(np.full(5, 7.0))
+        np.testing.assert_allclose(ranks, np.full(5, 3.0))
+        assert counts.tolist() == [5.0]
