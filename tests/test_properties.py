@@ -1,0 +1,80 @@
+"""Property-based tests (Hypothesis) for the UQ metric engine and entropy
+ops — the SURVEY §4 property list (MI >= 0, total = aleatoric + MI,
+epistemic -> 0 under agreement, base conversion, CI ordering) checked over
+generated inputs instead of one seed.
+
+Shapes are FIXED per test so every Hypothesis example reuses the same
+compiled program (value-only search keeps the suite fast on the CPU CI).
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+from hypothesis.extra.numpy import arrays
+
+from apnea_uq_tpu.ops.entropy import binary_entropy
+from apnea_uq_tpu.uq import (
+    bootstrap_aggregates,
+    compute_confidence_intervals,
+    uq_evaluation_dist,
+)
+
+K, M = 6, 64
+
+unit_floats = st.floats(
+    min_value=0.0, max_value=1.0, allow_nan=False, width=32
+)
+prob_stacks = arrays(np.float32, (K, M), elements=unit_floats)
+labels = arrays(np.float32, (M,), elements=st.sampled_from([0.0, 1.0]))
+
+
+@settings(max_examples=25, deadline=None)
+@given(preds=prob_stacks, y=labels)
+def test_decomposition_properties(preds, y):
+    m = uq_evaluation_dist(preds, y, base="nats")
+    mi = np.asarray(m["mutual_info"])
+    total = np.asarray(m["total_pred_entropy"])
+    aleatoric = np.asarray(m["expected_aleatoric_entropy"])
+    # MI clamped >= 0; decomposition holds wherever no clamp fired.
+    assert (mi >= 0).all()
+    unclamped = mi > 0
+    np.testing.assert_allclose(
+        total[unclamped], (aleatoric + mi)[unclamped], atol=1e-5
+    )
+    # Entropies of a binary variable are bounded by ln 2.
+    assert (total <= np.log(2) + 1e-6).all()
+    assert (np.asarray(m["pred_variance"]) <= 0.25 + 1e-6).all()
+
+
+@settings(max_examples=25, deadline=None)
+@given(preds=prob_stacks, y=labels)
+def test_agreement_kills_epistemic(preds, y):
+    # All passes identical -> zero variance and zero mutual information.
+    same = np.broadcast_to(preds[:1], preds.shape).copy()
+    m = uq_evaluation_dist(same, y, base="nats")
+    np.testing.assert_allclose(np.asarray(m["pred_variance"]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m["mutual_info"]), 0.0, atol=1e-5)
+
+
+@settings(max_examples=25, deadline=None)
+@given(probs=arrays(np.float32, (M,), elements=unit_floats))
+def test_entropy_symmetry_and_bases(probs):
+    h = np.asarray(binary_entropy(probs, base="nats"))
+    h_flip = np.asarray(binary_entropy(1.0 - probs, base="nats"))
+    np.testing.assert_allclose(h, h_flip, atol=1e-5)
+    assert (h >= -1e-7).all() and (h <= np.log(2) + 1e-6).all()
+    h_bits = np.asarray(binary_entropy(probs, base="bits"))
+    np.testing.assert_allclose(h, h_bits * np.log(2), atol=1e-5)
+
+
+@settings(max_examples=10, deadline=None)
+@given(preds=prob_stacks, y=labels, seed=st.integers(0, 2**31 - 1))
+def test_bootstrap_cis_ordered(preds, y, seed):
+    boot = bootstrap_aggregates(preds, y, n_bootstrap=25, seed=seed)
+    cis = compute_confidence_intervals(boot)
+    names = {k.rsplit("_ci_", 1)[0] for k in cis if "_ci_" in k}
+    assert names
+    for name in names:
+        lo, hi = cis[f"{name}_ci_lower"], cis[f"{name}_ci_upper"]
+        mean = cis[f"{name}_mean"]
+        assert lo <= hi
+        assert lo - 1e-9 <= mean <= hi + 1e-9
