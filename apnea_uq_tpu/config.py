@@ -68,6 +68,10 @@ class TrainConfig:
     # holding the dataset in HBM (identical results; for datasets that
     # exceed the HBM budget).
     streaming: bool = False
+    # Track per-epoch accuracy + streaming-histogram ROC-AUC on device
+    # (the reference's Keras compile metrics, cnn_baseline_train.py:100-102);
+    # adds history keys accuracy/auc/val_accuracy/val_auc.
+    track_metrics: bool = False
 
 
 @dataclass(frozen=True)
@@ -84,6 +88,11 @@ class EnsembleConfig:
     # Stream per-member batch stacks from host memory instead of holding
     # the dataset in HBM (identical results; for HBM-exceeding datasets).
     streaming: bool = False
+    # Per-member per-epoch accuracy + streaming-histogram ROC-AUC on device
+    # (the reference's ensemble trainer compiles the same Keras metrics as
+    # the baseline); adds (epochs, N) history arrays accuracy/auc/
+    # val_accuracy/val_auc.
+    track_metrics: bool = False
 
 
 @dataclass(frozen=True)

@@ -37,7 +37,10 @@ import numpy as np
 import optax
 
 from apnea_uq_tpu.config import EnsembleConfig
-from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, init_variables
+from apnea_uq_tpu.models.cnn1d import (
+    AlarconCNN1D, apply_model, init_variables, predict_proba,
+)
+from apnea_uq_tpu.ops import streaming_auc
 from apnea_uq_tpu.ops.losses import masked_bce_with_logits
 from apnea_uq_tpu.parallel import mesh as mesh_lib
 from apnea_uq_tpu.training.state import TrainState, make_optimizer
@@ -115,12 +118,15 @@ def _tree_where(cond_vec, new_tree, old_tree):
 
 @partial(
     jax.jit,
-    static_argnames=("model", "tx", "batch_size", "patience", "data_sharding"),
+    static_argnames=(
+        "model", "tx", "batch_size", "patience", "data_sharding",
+        "track_metrics",
+    ),
     donate_argnames=("state", "book"),
 )
 def _ensemble_epoch(
     model, tx, state, book, x, y, x_val, y_val, epoch_key, member_ids,
-    batch_size, patience, data_sharding=None
+    batch_size, patience, data_sharding=None, track_metrics=False
 ):
     """One lockstep epoch for all members + early-stop bookkeeping.
 
@@ -135,28 +141,45 @@ def _ensemble_epoch(
     (``spmd_axis_name`` prepends the member axis, so the stacked batch is
     laid out P('ensemble', 'data')) and XLA inserts the per-member
     gradient all-reduce over the ``data`` axis groups.
+
+    ``track_metrics`` appends per-member (train_acc, train_auc, val_acc,
+    val_auc) vectors to the returns — the reference ensemble trainer's
+    Keras compile metrics, per member.  Like the existing val_loss
+    history, a stopped member's entries describe the lockstep-epoch state
+    that bookkeeping computes and then discards (the member itself stays
+    frozen); read its history only up to ``epochs_run``.
     """
     member_keys = jax.vmap(lambda i: jax.random.fold_in(epoch_key, i))(member_ids)
 
     def member_epoch(member_state, key):
         return _epoch_jit.__wrapped__(
-            model, tx, member_state, x, y, key, batch_size, True, data_sharding
+            model, tx, member_state, x, y, key, batch_size, True,
+            data_sharding, track_metrics
         )
 
-    trained, train_loss = jax.vmap(
+    epoch_out = jax.vmap(
         member_epoch, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE
     )(state, member_keys)
+    if track_metrics:
+        trained, train_loss, train_acc, train_auc = epoch_out
+    else:
+        trained, train_loss = epoch_out
 
     def member_val(member_state):
         variables = {"params": member_state.params, "batch_stats": member_state.batch_stats}
         return _eval_loss_jit.__wrapped__(
-            model, variables, x_val, y_val, batch_size, data_sharding
+            model, variables, x_val, y_val, batch_size, data_sharding,
+            track_metrics
         )
 
-    val_loss = jax.vmap(member_val, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(trained)
-    return _epoch_bookkeeping.__wrapped__(
+    val_out = jax.vmap(member_val, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(trained)
+    val_loss = val_out[0] if track_metrics else val_out
+    booked = _epoch_bookkeeping.__wrapped__(
         state, trained, book, train_loss, val_loss, patience
     )
+    if track_metrics:
+        return booked + ((train_acc, train_auc, val_out[1], val_out[2]),)
+    return booked
 
 
 @partial(jax.jit, static_argnames=("patience",),
@@ -190,27 +213,53 @@ def _epoch_bookkeeping(state, trained, book, train_loss, val_loss, patience):
     return state, book, train_loss, val_loss, active
 
 
+def _member_metric_state(n_members: int):
+    """Per-member streaming-metric carry: leading member axis on both
+    leaves of ops/streaming_auc.empty_metric_state()."""
+    return jax.tree.map(
+        lambda a: jnp.zeros((n_members,) + a.shape, a.dtype),
+        streaming_auc.empty_metric_state(),
+    )
+
+
 @partial(
     jax.jit,
-    static_argnames=("model", "tx", "data_sharding"),
+    static_argnames=("model", "tx", "data_sharding", "track_metrics"),
     donate_argnames=("state",),
 )
 def _stream_ensemble_step_jit(model, tx, state, xb, yb, mask, dropout_keys,
-                              step_idx, data_sharding=None):
+                              step_idx, data_sharding=None,
+                              metric_state=None, track_metrics=False):
     """One streamed optimizer step for ALL members: per-member batches
     (N, bs, ...) vmapped through the train step.  Same math as one scan
     iteration of the in-HBM ensemble epoch.  The per-step dropout keys
     fold inside the jit (``step_idx`` is a device scalar), so the host
     loop issues exactly one dispatch per step.  ``state`` is donated —
     the epoch works on a copy, keeping HBM at one stacked state."""
-    train_step = make_train_step(model, tx)
+    train_step = make_train_step(model, tx, with_probs=track_metrics)
 
-    def member_step(member_state, xbi, ybi, dropout_key):
+    def constrained(xbi, ybi):
         mb = mask
         if data_sharding is not None:
             xbi = jax.lax.with_sharding_constraint(xbi, data_sharding)
             ybi = jax.lax.with_sharding_constraint(ybi, data_sharding)
             mb = jax.lax.with_sharding_constraint(mb, data_sharding)
+        return xbi, ybi, mb
+
+    if track_metrics:
+        def member_step(member_state, xbi, ybi, dropout_key, mstate_i):
+            xbi, ybi, mb = constrained(xbi, ybi)
+            rng = jax.random.fold_in(dropout_key, step_idx)
+            ms, loss, probs = train_step(member_state, xbi, ybi, mb, rng)
+            return ms, loss, streaming_auc.metric_update(mstate_i, probs, ybi, mb)
+
+        state, loss, metric_state = jax.vmap(
+            member_step, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE
+        )(state, xb, yb, dropout_keys, metric_state)
+        return state, loss * jnp.sum(mask), metric_state
+
+    def member_step(member_state, xbi, ybi, dropout_key):
+        xbi, ybi, mb = constrained(xbi, ybi)
         rng = jax.random.fold_in(dropout_key, step_idx)
         return train_step(member_state, xbi, ybi, mb, rng)
 
@@ -220,9 +269,10 @@ def _stream_ensemble_step_jit(model, tx, state, xb, yb, mask, dropout_keys,
     return state, loss * jnp.sum(mask)
 
 
-@partial(jax.jit, static_argnames=("model", "data_sharding"))
-def _stream_ensemble_eval_jit(model, state, xb, yb, mask, data_sharding=None):
-    def member_eval(member_state):
+@partial(jax.jit, static_argnames=("model", "data_sharding", "track_metrics"))
+def _stream_ensemble_eval_jit(model, state, xb, yb, mask, data_sharding=None,
+                              metric_state=None, track_metrics=False):
+    def eval_one(member_state):
         xbi, ybi, mb = xb, yb, mask
         if data_sharding is not None:
             xbi = jax.lax.with_sharding_constraint(xbi, data_sharding)
@@ -231,14 +281,29 @@ def _stream_ensemble_eval_jit(model, state, xb, yb, mask, data_sharding=None):
         variables = {"params": member_state.params,
                      "batch_stats": member_state.batch_stats}
         logits, _ = apply_model(model, variables, xbi, mode="eval")
-        return masked_bce_with_logits(logits, ybi, mb) * jnp.sum(mb)
+        return masked_bce_with_logits(logits, ybi, mb) * jnp.sum(mb), logits, ybi, mb
 
-    return jax.vmap(member_eval, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(state)
+    if track_metrics:
+        def member_eval(member_state, mstate_i):
+            weighted, logits, ybi, mb = eval_one(member_state)
+            mstate_i = streaming_auc.metric_update(
+                mstate_i, predict_proba(logits), ybi, mb
+            )
+            return weighted, mstate_i
+
+        return jax.vmap(
+            member_eval, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE
+        )(state, metric_state)
+
+    return jax.vmap(
+        lambda ms: eval_one(ms)[0], spmd_axis_name=mesh_lib.AXIS_ENSEMBLE
+    )(state)
 
 
 def _stream_ensemble_epoch(
     model, tx, state, book, x, y, x_val, y_val, epoch_key, member_ids,
-    batch_size, patience, mesh, data_sharding, prefetch
+    batch_size, patience, mesh, data_sharding, prefetch,
+    track_metrics=False,
 ):
     """One lockstep ensemble epoch fed batch-by-batch from HOST arrays
     (x/y/x_val/y_val stay NumPy; data/feed.py pumps per-member batch
@@ -291,19 +356,28 @@ def _stream_ensemble_epoch(
     # one per step).
     trained = jax.tree.map(jnp.copy, state)
     total = jnp.zeros((n_members,))
+    mstate = _member_metric_state(n_members) if track_metrics else None
     for s, (xb, yb) in enumerate(prefetch_to_device(
         batches(), size=prefetch, sharding=stack_sharding
     )):
-        trained, weighted = _stream_ensemble_step_jit(
-            model, tx, trained, xb, yb, masks_dev[s], dropout_keys,
-            jnp.asarray(s, jnp.int32), data_sharding,
-        )
+        if track_metrics:
+            trained, weighted, mstate = _stream_ensemble_step_jit(
+                model, tx, trained, xb, yb, masks_dev[s], dropout_keys,
+                jnp.asarray(s, jnp.int32), data_sharding,
+                mstate, track_metrics=True,
+            )
+        else:
+            trained, weighted = _stream_ensemble_step_jit(
+                model, tx, trained, xb, yb, masks_dev[s], dropout_keys,
+                jnp.asarray(s, jnp.int32), data_sharding,
+            )
         total = total + weighted
     train_loss = total / n
 
     n_val = x_val.shape[0]
     val_steps = -(-n_val // batch_size)
     val_total = jnp.zeros((n_members,))
+    val_mstate = _member_metric_state(n_members) if track_metrics else None
     for s in range(val_steps):
         lo, hi = s * batch_size, min((s + 1) * batch_size, n_val)
         xb, yb = x_val[lo:hi], y_val[lo:hi]
@@ -312,14 +386,27 @@ def _stream_ensemble_epoch(
             xb = np.concatenate([xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
             yb = np.concatenate([yb, np.zeros((pad,), yb.dtype)])
         mb = (np.arange(batch_size) < hi - lo).astype(np.float32)
-        val_total = val_total + _stream_ensemble_eval_jit(
-            model, trained, jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb),
-            data_sharding,
-        )
+        if track_metrics:
+            weighted, val_mstate = _stream_ensemble_eval_jit(
+                model, trained, jnp.asarray(xb), jnp.asarray(yb),
+                jnp.asarray(mb), data_sharding,
+                val_mstate, track_metrics=True,
+            )
+        else:
+            weighted = _stream_ensemble_eval_jit(
+                model, trained, jnp.asarray(xb), jnp.asarray(yb),
+                jnp.asarray(mb), data_sharding,
+            )
+        val_total = val_total + weighted
     val_loss = val_total / n_val
 
-    return _epoch_bookkeeping(state, trained, book, train_loss, val_loss,
-                              patience)
+    booked = _epoch_bookkeeping(state, trained, book, train_loss, val_loss,
+                                patience)
+    if track_metrics:
+        t_acc, t_auc = jax.vmap(streaming_auc.metric_results)(mstate)
+        v_acc, v_auc = jax.vmap(streaming_auc.metric_results)(val_mstate)
+        return booked + ((t_acc, t_auc, v_acc, v_auc),)
+    return booked
 
 
 @dataclasses.dataclass
@@ -510,6 +597,11 @@ def fit_ensemble(
     double-buffered prefetch pipeline (data/feed.py) — for training sets
     that exceed the HBM budget.  Same permutations, masks, and RNG streams
     as the in-HBM path, so both train the same members.
+
+    ``config.track_metrics`` adds per-member on-device streaming metrics
+    (ops/streaming_auc.py) to the history: (epochs, N) arrays
+    ``accuracy``/``auc``/``val_accuracy``/``val_auc`` — the reference
+    ensemble trainer's Keras compile metrics.
     """
     if streaming is None:
         streaming = config.streaming
@@ -522,24 +614,36 @@ def fit_ensemble(
     x, y, x_val, y_val = run.x, run.y, run.x_val, run.y_val
     member_ids, data_sharding = run.member_ids, run.data_sharding
     shuffle_root, n_members = run.shuffle_root, run.n_members
+    track = config.track_metrics
     losses: List[np.ndarray] = []
     val_losses: List[np.ndarray] = []
+    metric_history: Dict[str, List[np.ndarray]] = {
+        k: [] for k in ("accuracy", "auc", "val_accuracy", "val_auc")
+    } if track else {}
     with mesh:
         for epoch in range(config.num_epochs):
             epoch_key = jax.random.fold_in(shuffle_root, epoch)
             if streaming:
-                state, book, train_loss, val_loss, active = _stream_ensemble_epoch(
+                out = _stream_ensemble_epoch(
                     model, tx, state, book, x, y, x_val, y_val, epoch_key,
                     member_ids, config.batch_size,
                     config.early_stopping_patience, mesh, data_sharding,
-                    prefetch,
+                    prefetch, track_metrics=track,
                 )
             else:
-                state, book, train_loss, val_loss, active = _ensemble_epoch(
+                out = _ensemble_epoch(
                     model, tx, state, book, x, y, x_val, y_val, epoch_key,
                     member_ids, config.batch_size,
                     config.early_stopping_patience, data_sharding,
+                    track_metrics=track,
                 )
+            state, book, train_loss, val_loss, active = out[:5]
+            if track:
+                h_metrics = _host_values(out[5])
+                for k, v in zip(
+                    ("accuracy", "auc", "val_accuracy", "val_auc"), h_metrics
+                ):
+                    metric_history[k].append(v[:n_members])
             h_train, h_val, h_active = _host_values(
                 (train_loss, val_loss, active)
             )
@@ -562,11 +666,12 @@ def fit_ensemble(
         opt_state=state.opt_state, step=state.step,
     )
     take = lambda a: jax.tree.map(lambda leaf: leaf[:n_members], a)
+    history = {"loss": np.stack(losses), "val_loss": np.stack(val_losses)}
+    for k, v in metric_history.items():
+        history[k] = np.stack(v)
     return EnsembleFitResult(
         state=take(final),
-        history={
-            "loss": np.stack(losses), "val_loss": np.stack(val_losses),
-        },
+        history=history,
         best_epoch=h_best_epoch[:n_members],
         epochs_run=h_epochs_run[:n_members],
         num_members=n_members,

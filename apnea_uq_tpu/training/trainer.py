@@ -26,6 +26,7 @@ import optax
 
 from apnea_uq_tpu.config import TrainConfig
 from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
+from apnea_uq_tpu.ops import streaming_auc
 from apnea_uq_tpu.ops.losses import masked_bce_with_logits
 from apnea_uq_tpu.training.state import TrainState, make_optimizer
 from apnea_uq_tpu.utils import prng
@@ -39,8 +40,13 @@ class FitResult:
     stopped_early: bool
 
 
-def make_train_step(model: AlarconCNN1D, tx: optax.GradientTransformation):
-    """One optimizer step on one masked batch. Pure; jit/vmap/shard-safe."""
+def make_train_step(model: AlarconCNN1D, tx: optax.GradientTransformation,
+                    with_probs: bool = False):
+    """One optimizer step on one masked batch. Pure; jit/vmap/shard-safe.
+
+    ``with_probs=True`` additionally returns the training-mode
+    probabilities of the batch (free — the loss already produced the
+    logits), for streaming epoch metrics (ops/streaming_auc.py)."""
 
     def train_step(state: TrainState, xb, yb, mask, dropout_rng):
         def loss_fn(params):
@@ -50,19 +56,21 @@ def make_train_step(model: AlarconCNN1D, tx: optax.GradientTransformation):
                 rngs={"dropout": dropout_rng}, mutable=["batch_stats"],
             )
             loss = masked_bce_with_logits(logits, yb, mask)
-            return loss, mutated["batch_stats"]
+            return loss, (mutated["batch_stats"], logits)
 
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        (loss, (new_stats, logits)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        return (
-            TrainState(
-                params=optax.apply_updates(state.params, updates),
-                batch_stats=new_stats,
-                opt_state=new_opt,
-                step=state.step + 1,
-            ),
-            loss,
+        new_state = TrainState(
+            params=optax.apply_updates(state.params, updates),
+            batch_stats=new_stats,
+            opt_state=new_opt,
+            step=state.step + 1,
         )
+        if with_probs:
+            return new_state, loss, predict_proba(logits)
+        return new_state, loss
 
     return train_step
 
@@ -86,11 +94,15 @@ def _pad_perm(key, n: int, batch_size: int, shuffle: bool):
 
 @partial(
     jax.jit,
-    static_argnames=("model", "tx", "batch_size", "shuffle", "data_sharding"),
+    static_argnames=(
+        "model", "tx", "batch_size", "shuffle", "data_sharding",
+        "track_metrics",
+    ),
 )
 def _epoch_jit(model, tx, state, x, y, key, batch_size, shuffle,
-               data_sharding=None):
-    """One full training epoch as a scan over batches. Returns (state, mean_loss).
+               data_sharding=None, track_metrics=False):
+    """One full training epoch as a scan over batches. Returns (state,
+    mean_loss), plus (accuracy, auc) scalars when ``track_metrics``.
 
     ``data_sharding`` (a NamedSharding with spec P('data')) turns on data
     parallelism: each step's gathered batch is constrained to shard over
@@ -100,13 +112,20 @@ def _epoch_jit(model, tx, state, x, y, key, batch_size, shuffle,
     itself stays replicated — the gather from a local replica needs no
     communication, and semantics are bit-identical to the single-device
     run (same global batches in the same order).
+
+    ``track_metrics`` threads the fixed-size streaming-metric carry
+    (ops/streaming_auc.py) through the scan — the TPU-native analogue of
+    the reference's Keras compile metrics (cnn_baseline_train.py:100-102),
+    computed on training-mode batch outputs like Keras, aggregated over
+    the epoch instead of as a running mean.
     """
-    train_step = make_train_step(model, tx)
+    train_step = make_train_step(model, tx, with_probs=track_metrics)
     n = x.shape[0]
     shuffle_key, dropout_key = jax.random.split(key)
     idx, mask = _pad_perm(shuffle_key, n, batch_size, shuffle)
 
-    def body(state, inputs):
+    def body(carry, inputs):
+        state, mstate = carry
         batch_idx, batch_mask, step_i = inputs
         xb = jnp.take(x, batch_idx, axis=0)
         yb = jnp.take(y, batch_idx, axis=0)
@@ -115,17 +134,32 @@ def _epoch_jit(model, tx, state, x, y, key, batch_size, shuffle,
             yb = jax.lax.with_sharding_constraint(yb, data_sharding)
             batch_mask = jax.lax.with_sharding_constraint(batch_mask, data_sharding)
         step_rng = jax.random.fold_in(dropout_key, step_i)
-        state, loss = train_step(state, xb, yb, batch_mask, step_rng)
-        return state, loss * jnp.sum(batch_mask)
+        if track_metrics:
+            state, loss, probs = train_step(state, xb, yb, batch_mask, step_rng)
+            mstate = streaming_auc.metric_update(mstate, probs, yb, batch_mask)
+        else:
+            state, loss = train_step(state, xb, yb, batch_mask, step_rng)
+        return (state, mstate), loss * jnp.sum(batch_mask)
 
     steps = idx.shape[0]
-    state, losses = jax.lax.scan(body, state, (idx, mask, jnp.arange(steps)))
-    return state, jnp.sum(losses) / n
+    # None (an empty pytree) when untracked: no dead carry in the scan.
+    mstate0 = streaming_auc.empty_metric_state() if track_metrics else None
+    (state, mstate), losses = jax.lax.scan(
+        body, (state, mstate0), (idx, mask, jnp.arange(steps)),
+    )
+    mean_loss = jnp.sum(losses) / n
+    if track_metrics:
+        acc, auc = streaming_auc.metric_results(mstate)
+        return state, mean_loss, acc, auc
+    return state, mean_loss
 
 
-@partial(jax.jit, static_argnames=("model", "batch_size", "data_sharding"))
-def _eval_loss_jit(model, variables, x, y, batch_size, data_sharding=None):
-    """Mean inference-mode BCE over a dataset (validation loss)."""
+@partial(jax.jit, static_argnames=("model", "batch_size", "data_sharding",
+                                   "track_metrics"))
+def _eval_loss_jit(model, variables, x, y, batch_size, data_sharding=None,
+                   track_metrics=False):
+    """Mean inference-mode BCE over a dataset (validation loss), plus
+    (accuracy, auc) when ``track_metrics``."""
     n = x.shape[0]
     steps = -(-n // batch_size)
     total = steps * batch_size
@@ -135,6 +169,7 @@ def _eval_loss_jit(model, variables, x, y, batch_size, data_sharding=None):
     mask = (jnp.arange(total) < n).astype(jnp.float32)
 
     def body(carry, inputs):
+        total_loss, mstate = carry
         xb, yb, mb = inputs
         if data_sharding is not None:
             xb = jax.lax.with_sharding_constraint(xb, data_sharding)
@@ -142,12 +177,21 @@ def _eval_loss_jit(model, variables, x, y, batch_size, data_sharding=None):
             mb = jax.lax.with_sharding_constraint(mb, data_sharding)
         logits, _ = apply_model(model, variables, xb, mode="eval")
         loss = masked_bce_with_logits(logits, yb, mb)
-        return carry + loss * jnp.sum(mb), None
+        if track_metrics:
+            mstate = streaming_auc.metric_update(
+                mstate, predict_proba(logits), yb, mb
+            )
+        return (total_loss + loss * jnp.sum(mb), mstate), None
 
     shape = lambda a: a.reshape((steps, batch_size) + a.shape[1:])
-    total_loss, _ = jax.lax.scan(
-        body, jnp.zeros(()), (shape(xp), shape(yp), shape(mask))
+    mstate0 = streaming_auc.empty_metric_state() if track_metrics else None
+    (total_loss, mstate), _ = jax.lax.scan(
+        body, (jnp.zeros(()), mstate0),
+        (shape(xp), shape(yp), shape(mask)),
     )
+    if track_metrics:
+        acc, auc = streaming_auc.metric_results(mstate)
+        return total_loss / n, acc, auc
     return total_loss / n
 
 
@@ -184,11 +228,14 @@ def predict_proba_batched(model, variables, x, *, batch_size: int = 8192,
     )
 
 
-@partial(jax.jit, static_argnames=("model", "tx", "data_sharding"))
+@partial(jax.jit, static_argnames=("model", "tx", "data_sharding",
+                                   "track_metrics"))
 def _stream_step_jit(model, tx, state, xb, yb, mask, step_rng,
-                     data_sharding=None):
+                     data_sharding=None, metric_state=None,
+                     track_metrics=False):
     """One streamed optimizer step; returns (state, loss * batch weight) —
-    the same per-step quantity the scan epoch accumulates.  NOT donated:
+    the same per-step quantity the scan epoch accumulates — plus the
+    updated metric carry when ``track_metrics``.  NOT donated:
     fit's early-stopping snapshot aliases the state buffers, and donation
     would invalidate the saved best weights on TPU (CPU ignores donation,
     so tests alone would not catch it)."""
@@ -196,27 +243,40 @@ def _stream_step_jit(model, tx, state, xb, yb, mask, step_rng,
         xb = jax.lax.with_sharding_constraint(xb, data_sharding)
         yb = jax.lax.with_sharding_constraint(yb, data_sharding)
         mask = jax.lax.with_sharding_constraint(mask, data_sharding)
-    state, loss = make_train_step(model, tx)(state, xb, yb, mask, step_rng)
+    step = make_train_step(model, tx, with_probs=track_metrics)
+    if track_metrics:
+        state, loss, probs = step(state, xb, yb, mask, step_rng)
+        metric_state = streaming_auc.metric_update(metric_state, probs, yb, mask)
+        return state, loss * jnp.sum(mask), metric_state
+    state, loss = step(state, xb, yb, mask, step_rng)
     return state, loss * jnp.sum(mask)
 
 
-@partial(jax.jit, static_argnames=("model", "data_sharding"))
-def _stream_eval_batch_jit(model, variables, xb, yb, mask, data_sharding=None):
+@partial(jax.jit, static_argnames=("model", "data_sharding", "track_metrics"))
+def _stream_eval_batch_jit(model, variables, xb, yb, mask, data_sharding=None,
+                           metric_state=None, track_metrics=False):
     if data_sharding is not None:
         xb = jax.lax.with_sharding_constraint(xb, data_sharding)
         yb = jax.lax.with_sharding_constraint(yb, data_sharding)
         mask = jax.lax.with_sharding_constraint(mask, data_sharding)
     logits, _ = apply_model(model, variables, xb, mode="eval")
-    return masked_bce_with_logits(logits, yb, mask) * jnp.sum(mask)
+    weighted = masked_bce_with_logits(logits, yb, mask) * jnp.sum(mask)
+    if track_metrics:
+        metric_state = streaming_auc.metric_update(
+            metric_state, predict_proba(logits), yb, mask
+        )
+        return weighted, metric_state
+    return weighted
 
 
 def _stream_epoch(model, tx, state, x, y, key, batch_size, shuffle,
-                  data_sharding, sharding, prefetch):
+                  data_sharding, sharding, prefetch, track_metrics=False):
     """One training epoch fed batch-by-batch from HOST arrays through the
     double-buffered prefetch pipeline (data/feed.py) — the dataset never
     resides in HBM whole.  Identical math to _epoch_jit: same permutation
     (same shuffle key), same wrap-padded batches and masks, same per-step
-    dropout streams, same sequential loss accumulation."""
+    dropout streams, same sequential loss accumulation (and the same
+    streaming-metric carry when ``track_metrics``)."""
     from apnea_uq_tpu.data.feed import prefetch_to_device
 
     n = x.shape[0]
@@ -229,19 +289,30 @@ def _stream_epoch(model, tx, state, x, y, key, batch_size, shuffle,
             yield x[rows], y[rows], mask[i]
 
     total = jnp.zeros(())
+    mstate = streaming_auc.empty_metric_state() if track_metrics else None
     for i, (xb, yb, mb) in enumerate(prefetch_to_device(
         batches(), size=prefetch, sharding=sharding
     )):
-        state, weighted = _stream_step_jit(
-            model, tx, state, xb, yb, mb,
-            jax.random.fold_in(dropout_key, i), data_sharding,
-        )
+        if track_metrics:
+            state, weighted, mstate = _stream_step_jit(
+                model, tx, state, xb, yb, mb,
+                jax.random.fold_in(dropout_key, i), data_sharding,
+                mstate, track_metrics=True,
+            )
+        else:
+            state, weighted = _stream_step_jit(
+                model, tx, state, xb, yb, mb,
+                jax.random.fold_in(dropout_key, i), data_sharding,
+            )
         total = total + weighted
+    if track_metrics:
+        acc, auc = streaming_auc.metric_results(mstate)
+        return state, total / n, acc, auc
     return state, total / n
 
 
 def _stream_eval_loss(model, variables, x, y, batch_size, data_sharding,
-                      sharding, prefetch):
+                      sharding, prefetch, track_metrics=False):
     """Streaming counterpart of _eval_loss_jit (same zero-pad + mask)."""
     from apnea_uq_tpu.data.feed import prefetch_to_device
 
@@ -261,11 +332,22 @@ def _stream_eval_loss(model, variables, x, y, batch_size, data_sharding,
             yield xb, yb, mb
 
     total = jnp.zeros(())
+    mstate = streaming_auc.empty_metric_state() if track_metrics else None
     for xb, yb, mb in prefetch_to_device(batches(), size=prefetch,
                                          sharding=sharding):
-        total = total + _stream_eval_batch_jit(
-            model, variables, xb, yb, mb, data_sharding
-        )
+        if track_metrics:
+            weighted, mstate = _stream_eval_batch_jit(
+                model, variables, xb, yb, mb, data_sharding,
+                mstate, track_metrics=True,
+            )
+        else:
+            weighted = _stream_eval_batch_jit(
+                model, variables, xb, yb, mb, data_sharding
+            )
+        total = total + weighted
+    if track_metrics:
+        acc, auc = streaming_auc.metric_results(mstate)
+        return total / n, acc, auc
     return total / n
 
 
@@ -330,7 +412,11 @@ def fit(
     else:
         x_val = y_val = None
 
+    track = config.track_metrics
     history: Dict[str, List[float]] = {"loss": [], "val_loss": []}
+    if track:
+        history.update({"accuracy": [], "auc": [],
+                        "val_accuracy": [], "val_auc": []})
     best_val = np.inf
     best_epoch = -1
     best_params = state.params
@@ -345,32 +431,54 @@ def fit(
     for epoch in range(config.num_epochs):
         epoch_key = jax.random.fold_in(rng, epoch)
         if streaming:
-            state, train_loss = _stream_epoch(
+            out = _stream_epoch(
                 model, tx, state, x, y, epoch_key, config.batch_size,
                 config.shuffle, data_sharding, batch_sharding, prefetch,
+                track_metrics=track,
             )
         else:
-            state, train_loss = _epoch_jit(
+            out = _epoch_jit(
                 model, tx, state, x, y, epoch_key, config.batch_size,
-                config.shuffle, data_sharding,
+                config.shuffle, data_sharding, track_metrics=track,
             )
+        if track:
+            state, train_loss, train_acc, train_auc = out
+            history["accuracy"].append(float(train_acc))
+            history["auc"].append(float(train_auc))
+        else:
+            state, train_loss = out
         history["loss"].append(float(train_loss))
+        metric_note = (
+            f" acc={history['accuracy'][-1]:.4f} auc={history['auc'][-1]:.4f}"
+            if track else ""
+        )
 
         if x_val is not None:
             if streaming:
-                val_loss = float(_stream_eval_loss(
+                val_out = _stream_eval_loss(
                     model, state.variables(), x_val, y_val,
                     config.batch_size, data_sharding, batch_sharding, prefetch,
-                ))
-            else:
-                val_loss = float(
-                    _eval_loss_jit(model, state.variables(), x_val, y_val,
-                                   config.batch_size, data_sharding)
+                    track_metrics=track,
                 )
+            else:
+                val_out = _eval_loss_jit(
+                    model, state.variables(), x_val, y_val,
+                    config.batch_size, data_sharding, track_metrics=track,
+                )
+            if track:
+                val_loss, val_acc, val_auc = val_out
+                val_loss = float(val_loss)
+                history["val_accuracy"].append(float(val_acc))
+                history["val_auc"].append(float(val_auc))
+                metric_note += (f" val_acc={float(val_acc):.4f} "
+                                f"val_auc={float(val_auc):.4f}")
+            else:
+                val_loss = float(val_out)
             history["val_loss"].append(val_loss)
             if log_fn:
                 log_fn(f"epoch {epoch + 1}/{config.num_epochs} "
-                       f"loss={float(train_loss):.4f} val_loss={val_loss:.4f}")
+                       f"loss={float(train_loss):.4f} val_loss={val_loss:.4f}"
+                       f"{metric_note}")
             if val_loss < best_val:
                 best_val = val_loss
                 best_epoch = epoch
@@ -384,7 +492,8 @@ def fit(
                     break
         else:
             if log_fn:
-                log_fn(f"epoch {epoch + 1}/{config.num_epochs} loss={float(train_loss):.4f}")
+                log_fn(f"epoch {epoch + 1}/{config.num_epochs} "
+                       f"loss={float(train_loss):.4f}{metric_note}")
             best_epoch = epoch
 
     if x_val is not None and config.restore_best_weights and best_epoch >= 0:

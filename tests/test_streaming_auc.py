@@ -1,0 +1,189 @@
+"""On-device streaming (histogram) AUC + accuracy (ops/streaming_auc.py)
+and their integration into fit(track_metrics=True) — the TPU-native
+equivalent of the reference's Keras compile metrics
+(cnn_baseline_train.py:100-102)."""
+
+import jax
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.config import ModelConfig, TrainConfig
+from apnea_uq_tpu.evaluation.classification import roc_auc
+from apnea_uq_tpu.models import AlarconCNN1D
+from apnea_uq_tpu.ops.streaming_auc import (
+    accuracy_from_counts,
+    auc_from_histograms,
+    empty_metric_state,
+    metric_results,
+    metric_update,
+)
+from apnea_uq_tpu.training import create_train_state, fit
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+TINY = ModelConfig(features=(8, 12), kernel_sizes=(5, 3),
+                   dropout_rates=(0.1, 0.1))
+
+
+def _stream(probs, labels, mask=None, batches=4):
+    """Feed (probs, labels) through the metric carry in several batches."""
+    state = empty_metric_state()
+    if mask is None:
+        mask = np.ones_like(probs, np.float32)
+    for p, l, m in zip(np.array_split(probs, batches),
+                       np.array_split(labels, batches),
+                       np.array_split(mask, batches)):
+        state = metric_update(state, p, l, m)
+    return metric_results(state)
+
+
+class TestStreamingOps:
+    def test_matches_exact_auc(self, rng):
+        probs = rng.uniform(0, 1, 4000).astype(np.float32)
+        labels = (rng.uniform(size=4000) < 0.35).astype(np.float32)
+        acc, auc = _stream(probs, labels)
+        exact = roc_auc(labels, probs)
+        # 512-bin quantization: error bounded well below 1e-2 here.
+        assert float(auc) == pytest.approx(exact, abs=5e-3)
+        assert float(acc) == pytest.approx(
+            np.mean((probs >= 0.5) == labels), abs=1e-6
+        )
+
+    def test_batching_invariance(self, rng):
+        probs = rng.uniform(0, 1, 1000).astype(np.float32)
+        labels = (rng.uniform(size=1000) < 0.5).astype(np.float32)
+        a = _stream(probs, labels, batches=1)
+        b = _stream(probs, labels, batches=7)
+        assert float(a[1]) == pytest.approx(float(b[1]), abs=1e-6)
+        assert float(a[0]) == pytest.approx(float(b[0]), abs=1e-6)
+
+    def test_perfect_and_inverted_separation(self):
+        probs = np.concatenate([np.full(50, 0.9), np.full(50, 0.1)]).astype(np.float32)
+        labels = np.concatenate([np.ones(50), np.zeros(50)]).astype(np.float32)
+        _, auc = _stream(probs, labels, batches=2)
+        assert float(auc) == pytest.approx(1.0)
+        _, auc_inv = _stream(probs, 1.0 - labels, batches=2)
+        assert float(auc_inv) == pytest.approx(0.0)
+
+    def test_single_class_nan(self):
+        probs = np.asarray([0.2, 0.8], np.float32)
+        _, auc = _stream(probs, np.ones(2, np.float32), batches=1)
+        assert np.isnan(float(auc))
+
+    def test_mask_excludes_rows(self, rng):
+        probs = rng.uniform(0, 1, 200).astype(np.float32)
+        labels = (rng.uniform(size=200) < 0.5).astype(np.float32)
+        mask = np.zeros(200, np.float32)
+        mask[:120] = 1.0
+        masked = _stream(probs, labels, mask=mask, batches=3)
+        trimmed = _stream(probs[:120], labels[:120], batches=3)
+        assert float(masked[1]) == pytest.approx(float(trimmed[1]), abs=1e-6)
+        assert float(masked[0]) == pytest.approx(float(trimmed[0]), abs=1e-6)
+
+    def test_ties_in_one_bin_give_half(self):
+        # All scores identical -> every pos/neg pair ties -> AUC 0.5.
+        probs = np.full(100, 0.42, np.float32)
+        labels = np.concatenate([np.ones(40), np.zeros(60)]).astype(np.float32)
+        _, auc = _stream(probs, labels, batches=2)
+        assert float(auc) == pytest.approx(0.5)
+
+    def test_empty_state_results_nan(self):
+        acc, auc = metric_results(empty_metric_state())
+        assert np.isnan(float(acc)) and np.isnan(float(auc))
+        assert np.isnan(float(accuracy_from_counts(np.zeros(2))))
+        assert np.isnan(float(auc_from_histograms(np.zeros((2, 8)))))
+
+
+def _fit_data(rng, n=256):
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    x = rng.normal(size=(n, 60, 4)).astype(np.float32)
+    x[:, :, 0] += (2 * y[:, None] - 1) * 1.5  # separable via channel 0
+    return x, y
+
+
+class TestFitIntegration:
+    def test_history_keys_and_values(self, rng):
+        # Same scale as test_training.test_learns_separable_problem — a
+        # shorter run can sit in an inverted early transient where AUC
+        # legitimately reads ~0.
+        x, y = _fit_data(rng, n=1024)
+        model = AlarconCNN1D(TINY)
+        state = create_train_state(model, jax.random.key(0))
+        cfg = TrainConfig(num_epochs=12, batch_size=128,
+                          validation_split=0.1,
+                          early_stopping_patience=20, track_metrics=True)
+        res = fit(model, state, x, y, cfg)
+        for k in ("accuracy", "auc", "val_accuracy", "val_auc"):
+            assert len(res.history[k]) == len(res.history["loss"])
+        # Separable data: final-epoch val AUC must beat chance clearly.
+        assert res.history["val_auc"][-1] > 0.8
+        assert res.history["accuracy"][-1] > 0.7
+
+    def test_tracking_does_not_change_training(self, rng):
+        x, y = _fit_data(rng)
+        model = AlarconCNN1D(TINY)
+        state = create_train_state(model, jax.random.key(0))
+        cfg_off = TrainConfig(num_epochs=2, batch_size=64,
+                              validation_split=0.25,
+                              early_stopping_patience=10)
+        cfg_on = TrainConfig(num_epochs=2, batch_size=64,
+                             validation_split=0.25,
+                             early_stopping_patience=10, track_metrics=True)
+        a = fit(model, state, x, y, cfg_off)
+        b = fit(model, state, x, y, cfg_on)
+        np.testing.assert_allclose(a.history["loss"], b.history["loss"],
+                                   rtol=1e-6)
+        for la, lb in zip(jax.tree.leaves(a.state.params),
+                          jax.tree.leaves(b.state.params)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_streaming_path_matches_in_hbm(self, rng):
+        x, y = _fit_data(rng)
+        model = AlarconCNN1D(TINY)
+        state = create_train_state(model, jax.random.key(0))
+        cfg = TrainConfig(num_epochs=2, batch_size=64, validation_split=0.25,
+                          early_stopping_patience=10, track_metrics=True)
+        a = fit(model, state, x, y, cfg)
+        b = fit(model, state, x, y, cfg, streaming=True)
+        for k in ("accuracy", "auc", "val_accuracy", "val_auc"):
+            np.testing.assert_allclose(a.history[k], b.history[k],
+                                       rtol=1e-5, atol=1e-6)
+
+
+class TestEnsembleIntegration:
+    def test_history_shapes_and_streaming_parity(self, rng):
+        from apnea_uq_tpu.config import EnsembleConfig
+        from apnea_uq_tpu.parallel import fit_ensemble
+
+        x, y = _fit_data(rng, n=256)
+        model = AlarconCNN1D(TINY)
+        cfg = EnsembleConfig(num_members=2, num_epochs=2, batch_size=64,
+                             validation_split=0.25,
+                             early_stopping_patience=10, track_metrics=True)
+        res = fit_ensemble(model, x, y, cfg)
+        for k in ("accuracy", "auc", "val_accuracy", "val_auc"):
+            assert res.history[k].shape == res.history["loss"].shape
+            assert np.isfinite(res.history[k]).all()
+            assert (res.history[k] >= 0).all() and (res.history[k] <= 1).all()
+        # Streamed path must report identical metrics (same members, same
+        # batches, same streams).
+        stream = fit_ensemble(model, x, y, cfg, streaming=True)
+        for k in ("accuracy", "auc", "val_accuracy", "val_auc"):
+            np.testing.assert_allclose(res.history[k], stream.history[k],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_off_by_default_history_unchanged(self, rng):
+        from apnea_uq_tpu.config import EnsembleConfig
+        from apnea_uq_tpu.parallel import fit_ensemble
+
+        x, y = _fit_data(rng, n=128)
+        model = AlarconCNN1D(TINY)
+        cfg = EnsembleConfig(num_members=2, num_epochs=1, batch_size=64,
+                             validation_split=0.25,
+                             early_stopping_patience=10)
+        res = fit_ensemble(model, x, y, cfg)
+        assert set(res.history) == {"loss", "val_loss"}
