@@ -538,7 +538,7 @@ def compile_ensemble_epoch(
         lowered = _ensemble_epoch.lower(
             model, run.tx, *args,
             config.batch_size, config.early_stopping_patience,
-            run.data_sharding,
+            run.data_sharding, config.track_metrics,
         )
         return lowered.compile(), args
 
