@@ -159,8 +159,10 @@ class TestEnsembleIntegration:
         from apnea_uq_tpu.config import EnsembleConfig
         from apnea_uq_tpu.parallel import fit_ensemble
 
-        x, y = _fit_data(rng, n=256)
+        x, y = _fit_data(rng, n=128)
         model = AlarconCNN1D(TINY)
+        # 2 epochs on purpose: epoch-2 parity catches a streaming metric
+        # carry that fails to reset between epochs.
         cfg = EnsembleConfig(num_members=2, num_epochs=2, batch_size=64,
                              validation_split=0.25,
                              early_stopping_patience=10, track_metrics=True)
