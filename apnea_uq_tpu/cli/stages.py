@@ -225,6 +225,13 @@ def _add_plots_arg(p) -> None:
                         "PNGs here (reference uq_techniques.py:369-387).")
 
 
+def _add_profile_arg(p) -> None:
+    p.add_argument("--profile-dir", default=None,
+                   help="Wrap the evaluation in a jax.profiler trace and "
+                        "write it here (viewable in TensorBoard/XProf); "
+                        "the SURVEY §5.1 tracing hook.")
+
+
 def _print_run(result) -> None:
     ev = result.evaluation
     print(f"=== {result.label} ===")
@@ -246,19 +253,23 @@ def _print_run(result) -> None:
 def cmd_eval_mcd(args, config) -> int:
     from apnea_uq_tpu.training import restore_state
     from apnea_uq_tpu.uq import run_mcd_analysis, save_run
+    from apnea_uq_tpu.utils.timing import profile_trace
 
     registry = _registry(args)
     model, template = _baseline_template(config)
     state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
     _prepared, sets = _load_test_sets(registry)
     for label, (x, y, ids) in sets.items():
-        result = run_mcd_analysis(
-            model, state.variables(), x, y, patient_ids=ids,
-            config=config.uq, label=f"CNN_MCD_{label}",
-            seed=config.train.seed,
-            mesh=_mesh(config, num_members=config.uq.mc_passes),
-            detailed=ids is not None,
-        )
+        # Trace only the device-heavy evaluation; plots/registry writes
+        # would otherwise dominate the XProf host timeline.
+        with profile_trace(getattr(args, "profile_dir", None)):
+            result = run_mcd_analysis(
+                model, state.variables(), x, y, patient_ids=ids,
+                config=config.uq, label=f"CNN_MCD_{label}",
+                seed=config.train.seed,
+                mesh=_mesh(config, num_members=config.uq.mc_passes),
+                detailed=ids is not None,
+            )
         _print_run(result)
         save_run(registry, result, config=config.uq)
         _emit_plots(args, result)
@@ -267,18 +278,20 @@ def cmd_eval_mcd(args, config) -> int:
 
 def cmd_eval_de(args, config) -> int:
     from apnea_uq_tpu.uq import run_de_analysis, save_run
+    from apnea_uq_tpu.utils.timing import profile_trace
 
     registry = _registry(args)
     model, member_variables = _restore_members(args, config, args.num_members)
     _prepared, sets = _load_test_sets(registry)
     for label, (x, y, ids) in sets.items():
-        result = run_de_analysis(
-            model, member_variables, x, y, patient_ids=ids,
-            config=config.uq, label=f"CNN_DE_{label}",
-            seed=config.train.seed,
-            mesh=_mesh(config, num_members=args.num_members),
-            detailed=ids is not None,
-        )
+        with profile_trace(getattr(args, "profile_dir", None)):
+            result = run_de_analysis(
+                model, member_variables, x, y, patient_ids=ids,
+                config=config.uq, label=f"CNN_DE_{label}",
+                seed=config.train.seed,
+                mesh=_mesh(config, num_members=args.num_members),
+                detailed=ids is not None,
+            )
         _print_run(result)
         save_run(registry, result, config=config.uq)
         _emit_plots(args, result)
@@ -495,12 +508,14 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
     _add_plots_arg(p)
+    _add_profile_arg(p)
 
     p = add("eval-de", cmd_eval_de, "Deep-Ensemble UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--num-members", type=int, default=5)
     _add_plots_arg(p)
+    _add_profile_arg(p)
 
     p = add("aggregate-patients", cmd_aggregate_patients,
             "Detailed windows -> per-patient summary.")

@@ -7,6 +7,7 @@ the whole chain prepare -> train -> train-ensemble -> eval-mcd/eval-de ->
 aggregate/analyze/correlate/sweep/figures runs in-process.
 """
 
+import glob
 import json
 import os
 
@@ -95,8 +96,12 @@ def test_full_pipeline(env, order, capsys):
 
     # -- eval-mcd / eval-de -----------------------------------------------
     mcd_plots = str(env["root"] / "mcd_plots")
+    profile_dir = str(env["root"] / "trace")
     assert run("eval-mcd", "--registry", registry_dir, "--config", config,
-               "--plots-dir", mcd_plots) == 0
+               "--plots-dir", mcd_plots, "--profile-dir", profile_dir) == 0
+    # --profile-dir wraps the evaluation in a jax.profiler trace
+    # (SURVEY §5.1 tracing hook).
+    assert glob.glob(os.path.join(profile_dir, "**", "*"), recursive=True)
     out = capsys.readouterr().out
     assert "CNN_MCD_Unbalanced" in out and "overall_mean_variance" in out
     assert registry.exists(f"{reg.DETAILED_WINDOWS}:CNN_MCD_Unbalanced")
