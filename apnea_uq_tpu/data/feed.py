@@ -54,33 +54,8 @@ def prefetch_to_device(
         enqueue(1)
 
 
-def batch_iterator(
-    arrays,
-    batch_size: int,
-    *,
-    shuffle: bool = False,
-    seed: int = 0,
-    drop_remainder: bool = False,
-) -> Iterator:
-    """Mini-batches over a pytree of equal-length host arrays.
-
-    The host-side half of the feed: pair with `prefetch_to_device` for
-    the full pipeline.  Shuffling permutes indices once per call
-    (epoch-level reshuffle = one call per epoch with a folded seed).
-    """
-    import numpy as np
-
-    leaves = jax.tree.leaves(arrays)
-    if not leaves:
-        return
-    n = len(leaves[0])
-    for leaf in leaves:
-        if len(leaf) != n:
-            raise ValueError("all arrays must share the leading dimension")
-    order = np.arange(n)
-    if shuffle:
-        np.random.default_rng(seed).shuffle(order)
-    stop = n - (n % batch_size) if drop_remainder else n
-    for start in range(0, stop, batch_size):
-        idx = order[start : start + batch_size]
-        yield jax.tree.map(lambda a: a[idx], arrays)
+# (The host-side batch construction deliberately lives with each consumer
+# — the trainers and predictors build their own index streams so that the
+# streamed paths share exact permutations/masks/RNG with the in-HBM jitted
+# programs.  A generic batch iterator here would duplicate that without
+# being usable by them.)

@@ -1,40 +1,19 @@
-"""Host->device feed: batching and prefetch semantics."""
+"""Host->device feed: prefetch semantics."""
 
 import numpy as np
+import pytest
 
-from apnea_uq_tpu.data.feed import batch_iterator, prefetch_to_device
-
-
-def test_batch_iterator_covers_all_rows(rng):
-    x = rng.normal(size=(25, 4)).astype(np.float32)
-    y = np.arange(25)
-    batches = list(batch_iterator({"x": x, "y": y}, batch_size=8))
-    assert [len(b["y"]) for b in batches] == [8, 8, 8, 1]
-    np.testing.assert_array_equal(np.concatenate([b["y"] for b in batches]), y)
+from apnea_uq_tpu.data.feed import prefetch_to_device
 
 
-def test_drop_remainder(rng):
-    x = rng.normal(size=(25, 4)).astype(np.float32)
-    batches = list(batch_iterator({"x": x}, batch_size=8, drop_remainder=True))
-    assert [len(b["x"]) for b in batches] == [8, 8, 8]
-
-
-def test_shuffle_deterministic_and_complete(rng):
-    y = np.arange(100)
-    a = list(batch_iterator({"y": y}, 16, shuffle=True, seed=5))
-    b = list(batch_iterator({"y": y}, 16, shuffle=True, seed=5))
-    c = list(batch_iterator({"y": y}, 16, shuffle=True, seed=6))
-    flat_a = np.concatenate([m["y"] for m in a])
-    flat_b = np.concatenate([m["y"] for m in b])
-    flat_c = np.concatenate([m["y"] for m in c])
-    np.testing.assert_array_equal(flat_a, flat_b)
-    assert not np.array_equal(flat_a, flat_c)
-    np.testing.assert_array_equal(np.sort(flat_a), y)  # a permutation
+def _batches(x, batch_size):
+    for start in range(0, x.shape[0], batch_size):
+        yield {"x": x[start:start + batch_size]}
 
 
 def test_prefetch_preserves_stream(rng):
     x = rng.normal(size=(40, 3)).astype(np.float32)
-    batches = list(batch_iterator({"x": x}, 8))
+    batches = list(_batches(x, 8))
     out = list(prefetch_to_device(batches, size=2))
     assert len(out) == len(batches)
     for got, want in zip(out, batches):
@@ -43,6 +22,11 @@ def test_prefetch_preserves_stream(rng):
 
 def test_prefetch_empty_stream():
     assert list(prefetch_to_device([], size=2)) == []
+
+
+def test_prefetch_size_validation():
+    with pytest.raises(ValueError):
+        list(prefetch_to_device([{"x": np.ones(2)}], size=0))
 
 
 def test_prefetch_lazy_consumption(rng):
