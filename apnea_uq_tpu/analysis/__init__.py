@@ -24,7 +24,11 @@ from apnea_uq_tpu.analysis.stats import (
 # (aggregate-patients, analyze-windows, correlate, figures) must stay
 # importable and fast without a device runtime.  Import it directly:
 # ``from apnea_uq_tpu.analysis.sweep import mcd_pass_sweep``.
-from apnea_uq_tpu.analysis.windows import WindowAnalysis, window_level_analysis
+from apnea_uq_tpu.analysis.windows import (
+    WindowAnalysis,
+    retention_curve,
+    window_level_analysis,
+)
 
 __all__ = [
     "COL_PATIENT",
@@ -39,6 +43,7 @@ __all__ = [
     "aggregate_patients",
     "patient_summary_report",
     "window_level_analysis",
+    "retention_curve",
     "WindowAnalysis",
     "pearson_corr",
     "mann_whitney_u",

@@ -232,3 +232,30 @@ def plot_convergence(
     ax.set_title("Uncertainty convergence")
     ax.legend()
     return _save(fig, out_path)
+
+
+# ---------------------------------------------------- retention curve ----
+
+def plot_retention_curve(curves: Mapping[str, "pd.DataFrame"], out_path: str) -> str:
+    """Accuracy vs retained fraction, one line per label.
+
+    ``curves`` maps a run label to a retention frame
+    (analysis/windows.retention_curve schema: fraction/accuracy columns).
+    Visualizes the reference's headline ">99% on the most-confident
+    subset" claim (reference README.md:14) as a curve instead of a single
+    annotated bin.
+    """
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for label, frame in curves.items():
+        if not {"fraction", "accuracy"}.issubset(frame.columns):
+            raise ValueError(
+                f"retention frame for {label!r} needs fraction/accuracy "
+                f"columns; got {list(frame.columns)}"
+            )
+        ax.plot(frame["fraction"], frame["accuracy"], marker="o", label=label)
+    ax.set_xlabel("fraction of windows retained (lowest uncertainty first)")
+    ax.set_ylabel("accuracy on retained windows")
+    ax.set_title("Selective prediction: accuracy vs retention")
+    ax.set_ylim(None, 1.005)
+    ax.legend()
+    return _save(fig, out_path)

@@ -328,12 +328,26 @@ def cmd_aggregate_patients(args, config) -> int:
 
 
 def cmd_analyze_windows(args, config) -> int:
-    from apnea_uq_tpu.analysis import window_level_analysis
+    from apnea_uq_tpu.analysis import retention_curve, window_level_analysis
     from apnea_uq_tpu.data import registry as reg
 
     registry = _registry(args)
     detailed = registry.load_table(f"{reg.DETAILED_WINDOWS}:{args.label}")
     print(window_level_analysis(detailed, num_bins=args.num_bins).report())
+    if args.retention or args.retention_plot:
+        # The thesis headline ("over 99% on the most-confident subset",
+        # reference README.md:14) as a reproducible table.
+        # --retention-plot implies --retention.
+        curve = retention_curve(detailed)
+        print("\nSelective prediction (windows retained by lowest "
+              "uncertainty first):")
+        print(curve.to_string(index=False, float_format="%.4f"))
+        if args.retention_plot:
+            from apnea_uq_tpu.analysis.plots import plot_retention_curve
+
+            path = plot_retention_curve({args.label: curve},
+                                        args.retention_plot)
+            print(f"retention plot -> {path}")
     return 0
 
 
@@ -528,6 +542,13 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--registry", required=True)
     p.add_argument("--label", required=True)
     p.add_argument("--num-bins", type=int, default=10)
+    p.add_argument("--retention", action="store_true",
+                   help="Also print the selective-prediction retention "
+                        "table (accuracy on the lowest-uncertainty "
+                        "fraction; reference README.md:14's >99%% claim).")
+    p.add_argument("--retention-plot", default=None,
+                   help="With --retention: write the accuracy-vs-retained"
+                        "-fraction curve PNG here.")
 
     p = add("correlate", cmd_correlate,
             "Patient Pearson correlation + window Mann-Whitney tests.")

@@ -19,6 +19,7 @@ from apnea_uq_tpu.analysis import (
     patient_accuracy_entropy_correlation,
     patient_summary_report,
     pearson_corr,
+    retention_curve,
     uncertainty_correctness_test,
     window_level_analysis,
 )
@@ -185,3 +186,52 @@ class TestRankWithTies:
         ranks, counts = rank_with_ties(np.full(5, 7.0))
         np.testing.assert_allclose(ranks, np.full(5, 3.0))
         assert counts.tolist() == [5.0]
+
+
+class TestRetentionCurve:
+    """Selective-prediction retention curve (analysis/windows.py) — the
+    reference headline's '>99% on the most-confident subset'
+    (reference README.md:14) as a computable table."""
+
+    def _frame(self, rng, n=500):
+        # Low-entropy windows are mostly correct, high-entropy mostly not.
+        entropy = np.sort(rng.uniform(0, 1, n))
+        p_correct = 1.0 - 0.8 * entropy
+        correct = rng.uniform(size=n) < p_correct
+        true = rng.integers(0, 2, n)
+        pred = np.where(correct, true, 1 - true)
+        return pd.DataFrame({
+            COL_TRUE_LABEL: true,
+            COL_PRED_LABEL: pred,
+            COL_ENTROPY: entropy,
+        })
+
+    def test_full_fraction_equals_overall_accuracy(self, rng):
+        frame = self._frame(rng)
+        curve = retention_curve(frame)
+        overall = float((frame[COL_TRUE_LABEL] == frame[COL_PRED_LABEL]).mean())
+        last = curve.iloc[-1]
+        assert last["fraction"] == 1.0 and last["n_windows"] == len(frame)
+        assert last["accuracy"] == pytest.approx(overall)
+
+    def test_confident_subset_beats_overall(self, rng):
+        curve = retention_curve(self._frame(rng))
+        assert curve.iloc[0]["accuracy"] > curve.iloc[-1]["accuracy"] + 0.05
+        # thresholds are nondecreasing with the retained fraction
+        assert (np.diff(curve["threshold"]) >= -1e-12).all()
+        assert (np.diff(curve["n_windows"]) > 0).all()
+
+    def test_custom_fractions_and_validation(self, rng):
+        frame = self._frame(rng, n=100)
+        curve = retention_curve(frame, fractions=[0.1, 0.5, 1.0])
+        assert curve["n_windows"].tolist() == [10, 50, 100]
+        with pytest.raises(ValueError):
+            retention_curve(frame, fractions=[0.0, 0.5])
+        with pytest.raises(ValueError):
+            retention_curve(frame.drop(columns=[COL_ENTROPY]))
+
+    def test_empty_frame_raises(self):
+        empty = pd.DataFrame({COL_TRUE_LABEL: [], COL_PRED_LABEL: [],
+                              COL_ENTROPY: []})
+        with pytest.raises(ValueError, match="no windows"):
+            retention_curve(empty)

@@ -129,9 +129,14 @@ def test_full_pipeline(env, order, capsys):
     detailed = registry.load_table(f"{reg.DETAILED_WINDOWS}:CNN_MCD_Unbalanced")
     assert summary["num_windows"].sum() == len(detailed)
 
+    retention_png = str(env["root"] / "retention.png")
     assert run("analyze-windows", "--registry", registry_dir,
-               "--config", config, "--label", "CNN_MCD_Unbalanced") == 0
-    assert "Binned accuracy" in capsys.readouterr().out
+               "--config", config, "--label", "CNN_MCD_Unbalanced",
+               "--retention", "--retention-plot", retention_png) == 0
+    out = capsys.readouterr().out
+    assert "Binned accuracy" in out
+    assert "Selective prediction" in out
+    assert os.path.getsize(retention_png) > 0
 
     assert run("correlate", "--registry", registry_dir, "--config", config,
                "--labels", "CNN_MCD_Unbalanced") == 0
