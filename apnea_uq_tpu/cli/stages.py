@@ -438,7 +438,11 @@ def cmd_sweep(args, config) -> int:
 
 
 def cmd_figures(args, config) -> int:
-    from apnea_uq_tpu.analysis import aggregate_patients, window_level_analysis
+    from apnea_uq_tpu.analysis import (
+        aggregate_patients,
+        retention_curve,
+        window_level_analysis,
+    )
     from apnea_uq_tpu.analysis import plots
     from apnea_uq_tpu.data import registry as reg
 
@@ -452,6 +456,7 @@ def cmd_figures(args, config) -> int:
         k: window_level_analysis(v, num_bins=args.num_bins).binned
         for k, v in frames.items()
     }
+    retention = {k: retention_curve(v) for k, v in frames.items()}
     out = args.out_dir
     paths = [
         plots.plot_patient_entropy_histograms(
@@ -462,6 +467,11 @@ def cmd_figures(args, config) -> int:
             frames, os.path.join(out, "correct_incorrect_box.png")),
         plots.plot_binned_accuracy(
             binned, os.path.join(out, "binned_accuracy.png")),
+        # MCD-vs-DE selective prediction in one frame — the comparison
+        # behind the reference's ">99% on the most-confident subset"
+        # headline (README.md:14).
+        plots.plot_retention_curve(
+            retention, os.path.join(out, "retention_curves.png")),
     ]
     for p in paths:
         print(f"wrote {p}")

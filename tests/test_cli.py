@@ -164,7 +164,8 @@ def test_full_pipeline(env, order, capsys):
                "--labels", "CNN_MCD_Unbalanced", "CNN_DE_Unbalanced",
                "--out-dir", fig_dir) == 0
     capsys.readouterr()
-    assert len(os.listdir(fig_dir)) == 4
+    figs = sorted(os.listdir(fig_dir))
+    assert len(figs) == 5 and "retention_curves.png" in figs
 
 
 def test_sweep_from_csv(tmp_path, capsys):
