@@ -24,6 +24,11 @@ from apnea_uq_tpu.analysis.stats import (
 # (aggregate-patients, analyze-windows, correlate, figures) must stay
 # importable and fast without a device runtime.  Import it directly:
 # ``from apnea_uq_tpu.analysis.sweep import mcd_pass_sweep``.
+from apnea_uq_tpu.analysis.calibration import (
+    CalibrationSummary,
+    calibration_summary,
+    reliability_bins,
+)
 from apnea_uq_tpu.analysis.windows import (
     WindowAnalysis,
     retention_curve,
@@ -44,6 +49,9 @@ __all__ = [
     "patient_summary_report",
     "window_level_analysis",
     "retention_curve",
+    "calibration_summary",
+    "reliability_bins",
+    "CalibrationSummary",
     "WindowAnalysis",
     "pearson_corr",
     "mann_whitney_u",

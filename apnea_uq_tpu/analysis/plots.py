@@ -259,3 +259,39 @@ def plot_retention_curve(curves: Mapping[str, "pd.DataFrame"], out_path: str) ->
     ax.set_ylim(None, 1.005)
     ax.legend()
     return _save(fig, out_path)
+
+
+# ------------------------------------------------ reliability diagram ----
+
+def plot_reliability_diagram(
+    summaries: Mapping[str, "pd.DataFrame"], out_path: str
+) -> str:
+    """Reliability diagram: empirical positive rate vs mean predicted
+    probability per confidence bin, one line per label, with the y = x
+    perfect-calibration diagonal.
+
+    ``summaries`` maps a run label to a reliability table
+    (analysis/calibration.reliability_bins schema).
+    """
+    fig, ax = plt.subplots(figsize=(5.5, 5))
+    ax.plot([0, 1], [0, 1], linestyle="--", color="grey",
+            label="perfect calibration")
+    for label, frame in summaries.items():
+        if not {"mean_confidence", "positive_rate", "count"}.issubset(
+            frame.columns
+        ):
+            raise ValueError(
+                f"reliability frame for {label!r} needs mean_confidence/"
+                f"positive_rate/count columns; got {list(frame.columns)}"
+            )
+        occupied = frame["count"] > 0
+        ax.plot(frame.loc[occupied, "mean_confidence"],
+                frame.loc[occupied, "positive_rate"],
+                marker="o", label=label)
+    ax.set_xlabel("mean predicted probability (confidence)")
+    ax.set_ylabel("empirical positive rate")
+    ax.set_title("Reliability diagram")
+    ax.set_xlim(0, 1)
+    ax.set_ylim(0, 1)
+    ax.legend()
+    return _save(fig, out_path)

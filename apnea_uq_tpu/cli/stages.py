@@ -328,12 +328,29 @@ def cmd_aggregate_patients(args, config) -> int:
 
 
 def cmd_analyze_windows(args, config) -> int:
-    from apnea_uq_tpu.analysis import retention_curve, window_level_analysis
+    from apnea_uq_tpu.analysis import (
+        calibration_summary,
+        retention_curve,
+        window_level_analysis,
+    )
     from apnea_uq_tpu.data import registry as reg
 
     registry = _registry(args)
     detailed = registry.load_table(f"{reg.DETAILED_WINDOWS}:{args.label}")
     print(window_level_analysis(detailed, num_bins=args.num_bins).report())
+    if args.calibration or args.calibration_plot:
+        # --calibration-plot implies --calibration.  Confidence bins are
+        # a separate axis from the entropy bins, hence their own flag.
+        summary = calibration_summary(detailed,
+                                      num_bins=args.calibration_bins)
+        print("\nCalibration (mean-probability reliability):")
+        print(summary.report())
+        if args.calibration_plot:
+            from apnea_uq_tpu.analysis.plots import plot_reliability_diagram
+
+            path = plot_reliability_diagram({args.label: summary.bins},
+                                            args.calibration_plot)
+            print(f"reliability diagram -> {path}")
     if args.retention or args.retention_plot:
         # The thesis headline ("over 99% on the most-confident subset",
         # reference README.md:14) as a reproducible table.
@@ -559,6 +576,15 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--retention-plot", default=None,
                    help="With --retention: write the accuracy-vs-retained"
                         "-fraction curve PNG here.")
+    p.add_argument("--calibration", action="store_true",
+                   help="Also print the reliability table + ECE/MCE/Brier "
+                        "of the mean predicted probabilities.")
+    p.add_argument("--calibration-plot", default=None,
+                   help="With --calibration: write the reliability-diagram "
+                        "PNG here.")
+    p.add_argument("--calibration-bins", type=int, default=15,
+                   help="Confidence bins for the reliability table/ECE "
+                        "(independent of --num-bins, which bins entropy).")
 
     p = add("correlate", cmd_correlate,
             "Patient Pearson correlation + window Mann-Whitney tests.")

@@ -1,0 +1,94 @@
+"""Calibration analysis (analysis/calibration.py): reliability bins,
+ECE/MCE, Brier score — probability-calibration tooling the reference
+lacks, on the detailed-frame contract."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from apnea_uq_tpu.analysis import (
+    COL_PROB,
+    COL_TRUE_LABEL,
+    calibration_summary,
+    reliability_bins,
+)
+
+
+def _frame(probs, y):
+    return pd.DataFrame({COL_PROB: probs, COL_TRUE_LABEL: y})
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_perfectly_calibrated_has_near_zero_ece(rng):
+    # Labels drawn FROM the predicted probabilities -> calibrated by
+    # construction; ECE is sampling noise only.
+    n = 200_000
+    probs = rng.uniform(0, 1, n)
+    y = (rng.uniform(size=n) < probs).astype(np.float64)
+    s = calibration_summary(_frame(probs, y))
+    assert s.ece < 0.01
+    assert s.mce < 0.03
+    # Brier of a calibrated continuous-prob predictor: E[p(1-p)] = 1/6.
+    assert s.brier == pytest.approx(1.0 / 6.0, abs=0.01)
+
+
+def test_miscalibrated_overconfident_detected(rng):
+    n = 50_000
+    true_p = rng.uniform(0.2, 0.8, n)
+    y = (rng.uniform(size=n) < true_p).astype(np.float64)
+    overconfident = np.clip(true_p + np.where(true_p > 0.5, 0.19, -0.19), 0, 1)
+    s = calibration_summary(_frame(overconfident, y))
+    assert s.ece > 0.1
+
+
+def test_brier_matches_formula(rng):
+    probs = rng.uniform(0, 1, 100)
+    y = rng.integers(0, 2, 100).astype(np.float64)
+    s = calibration_summary(_frame(probs, y))
+    assert s.brier == pytest.approx(float(np.mean((probs - y) ** 2)))
+
+
+def test_bins_complete_and_counts_sum(rng):
+    probs = rng.uniform(0, 1, 1000)
+    y = rng.integers(0, 2, 1000)
+    bins = reliability_bins(_frame(probs, y), num_bins=15)
+    assert len(bins) == 15
+    assert bins["count"].sum() == 1000
+    occupied = bins["count"] > 0
+    assert np.isfinite(bins.loc[occupied, "mean_confidence"]).all()
+    assert bins.loc[~occupied, "mean_confidence"].isna().all()
+
+
+def test_p_equal_one_joins_last_bin():
+    bins = reliability_bins(_frame([1.0, 0.999, 0.0], [1, 1, 0]), num_bins=10)
+    assert bins["count"].iloc[-1] == 2
+    assert bins["count"].iloc[0] == 1
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="no windows"):
+        calibration_summary(_frame([], []))
+    with pytest.raises(ValueError, match="missing column"):
+        calibration_summary(pd.DataFrame({COL_PROB: [0.5]}))
+    with pytest.raises(ValueError, match="lie in"):
+        calibration_summary(_frame([1.5], [1]))
+    with pytest.raises(ValueError, match="num_bins"):
+        reliability_bins(_frame([0.5], [1]), num_bins=0)
+
+
+def test_report_and_plot(tmp_path, rng):
+    from apnea_uq_tpu.analysis.plots import plot_reliability_diagram
+
+    probs = rng.uniform(0, 1, 500)
+    y = (rng.uniform(size=500) < probs).astype(np.float64)
+    s = calibration_summary(_frame(probs, y))
+    assert "Expected calibration error" in s.report()
+    out = str(tmp_path / "rel.png")
+    assert plot_reliability_diagram({"DEMO": s.bins}, out) == out
+    import os
+
+    assert os.path.getsize(out) > 0

@@ -130,13 +130,17 @@ def test_full_pipeline(env, order, capsys):
     assert summary["num_windows"].sum() == len(detailed)
 
     retention_png = str(env["root"] / "retention.png")
+    reliability_png = str(env["root"] / "reliability.png")
     assert run("analyze-windows", "--registry", registry_dir,
                "--config", config, "--label", "CNN_MCD_Unbalanced",
-               "--retention", "--retention-plot", retention_png) == 0
+               "--retention", "--retention-plot", retention_png,
+               "--calibration-plot", reliability_png) == 0
     out = capsys.readouterr().out
     assert "Binned accuracy" in out
     assert "Selective prediction" in out
+    assert "Expected calibration error" in out
     assert os.path.getsize(retention_png) > 0
+    assert os.path.getsize(reliability_png) > 0
 
     assert run("correlate", "--registry", registry_dir, "--config", config,
                "--labels", "CNN_MCD_Unbalanced") == 0
