@@ -1,0 +1,69 @@
+"""Docs-vs-CLI consistency: every `apnea-uq <subcommand>` and every
+`--flag` named in the user-facing docs must actually exist, so the
+migration guide and README cannot silently rot as the CLI evolves."""
+
+import re
+from pathlib import Path
+
+from apnea_uq_tpu.cli.main import build_parser
+
+REPO = Path(__file__).resolve().parent.parent
+DOCS = [REPO / "README.md", REPO / "docs" / "MIGRATION.md"]
+
+
+def _subparsers(parser):
+    for action in parser._actions:
+        if hasattr(action, "choices") and isinstance(action.choices, dict):
+            return action.choices
+    raise AssertionError("no subparsers found on the CLI parser")
+
+
+def _code_text(doc: Path) -> str:
+    """Only backticked spans and fenced code blocks — commands in the docs
+    always live in code context, and prose mentioning `apnea-uq` as a word
+    must not produce phantom subcommands."""
+    text = doc.read_text().replace("\\\n", " ")  # join shell continuations
+    fenced = re.findall(r"```[a-z]*\n(.*?)```", text, re.S)
+    inline = re.findall(r"`([^`]*)`", text)
+    return "\n".join(fenced + inline)
+
+
+def test_documented_subcommands_exist():
+    commands = set(_subparsers(build_parser()))
+    documented = set()
+    for doc in DOCS:
+        documented.update(
+            re.findall(r"apnea-uq ([a-z][a-z0-9-]*)", _code_text(doc))
+        )
+    missing = documented - commands
+    assert not missing, f"docs reference unknown subcommands: {sorted(missing)}"
+    # And the docs should cover the pipeline's core stages.
+    for core in ("ingest", "prepare", "train", "train-ensemble", "eval-mcd",
+                 "eval-de", "demo"):
+        assert core in documented, f"core stage {core!r} undocumented"
+
+
+def test_documented_flags_exist_per_subcommand():
+    """Within a documented command line, every --flag after
+    `apnea-uq <sub>` must be a real option of that subcommand."""
+    subs = _subparsers(build_parser())
+    checked = 0
+    for doc in DOCS:
+        for m in re.finditer(
+            r"apnea-uq ([a-z][a-z0-9-]*)((?:[ \t]+[^\s`|]+)*)",
+            _code_text(doc),
+        ):
+            name, rest = m.group(1), m.group(2)
+            if name not in subs:
+                continue  # covered by the other test
+            known = {
+                opt for action in subs[name]._actions
+                for opt in action.option_strings
+            }
+            for flag in re.findall(r"--[a-z][a-z0-9-]*", rest):
+                assert flag in known, (
+                    f"docs show `apnea-uq {name} ... {flag}` but that "
+                    f"subcommand has no such flag (has {sorted(known)})"
+                )
+                checked += 1
+    assert checked >= 10, "flag extraction matched suspiciously few flags"
