@@ -3,10 +3,10 @@
 In-tree implementations of the two tests the reference takes from
 ``scipy.stats`` (patient_accuracy_entropy_correlation.py:36-41,
 window_uncertainty_vs_correctness_mannwhitney.py:18) — the core math is
-NumPy here (rank transform, tie correction, t / normal conversion), with
-only the CDF special functions delegated to ``scipy.special`` (the same
-C layer scipy.stats itself sits on).  Both are verified against
-scipy.stats in the test suite.
+NumPy here (rank transform, tie correction, t / normal conversion), and
+the CDF special functions are in-tree scalar float64 implementations
+(utils/special.py).  Both tests are verified against scipy.stats in the
+test suite.
 """
 
 from __future__ import annotations
@@ -14,7 +14,8 @@ from __future__ import annotations
 from typing import Dict, Tuple
 
 import numpy as np
-from scipy.special import ndtr, stdtr
+
+from apnea_uq_tpu.utils.special import ndtr, stdtr
 
 from apnea_uq_tpu.analysis.columns import (
     COL_CORRECT,

@@ -225,6 +225,14 @@ def _add_plots_arg(p) -> None:
                         "PNGs here (reference uq_techniques.py:369-387).")
 
 
+def _add_no_detailed_arg(p) -> None:
+    p.add_argument("--no-detailed", action="store_true",
+                   help="Skip the per-window detailed CSV — the reference's "
+                        "global evaluation variants (evaluate_mcd_global.py:"
+                        "96-124, evaluate_de_global.py:117-141), which "
+                        "compute aggregates + CIs only.")
+
+
 def _add_profile_arg(p) -> None:
     p.add_argument("--profile-dir", default=None,
                    help="Wrap the evaluation in a jax.profiler trace and "
@@ -259,7 +267,7 @@ def cmd_eval_mcd(args, config) -> int:
     model, template = _baseline_template(config)
     state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
     _prepared, sets = _load_test_sets(registry)
-    for label, (x, y, ids) in sets.items():
+    for i, (label, (x, y, ids)) in enumerate(sets.items()):
         # Trace only the device-heavy evaluation; plots/registry writes
         # would otherwise dominate the XProf host timeline.
         with profile_trace(getattr(args, "profile_dir", None)):
@@ -268,7 +276,11 @@ def cmd_eval_mcd(args, config) -> int:
                 config=config.uq, label=f"CNN_MCD_{label}",
                 seed=config.train.seed,
                 mesh=_mesh(config, num_members=config.uq.mc_passes),
-                detailed=ids is not None,
+                detailed=ids is not None and not args.no_detailed,
+                # The reference probes deterministic accuracy once, before
+                # the per-set loop (analyze_mcd_patient_level.py:203-211) —
+                # not once per test set.
+                sanity_check=i == 0,
             )
         _print_run(result)
         save_run(registry, result, config=config.uq)
@@ -290,7 +302,7 @@ def cmd_eval_de(args, config) -> int:
                 config=config.uq, label=f"CNN_DE_{label}",
                 seed=config.train.seed,
                 mesh=_mesh(config, num_members=args.num_members),
-                detailed=ids is not None,
+                detailed=ids is not None and not args.no_detailed,
             )
         _print_run(result)
         save_run(registry, result, config=config.uq)
@@ -548,6 +560,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p = add("eval-mcd", cmd_eval_mcd, "MC-Dropout UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
+    _add_no_detailed_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
 
@@ -555,6 +568,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--num-members", type=int, default=5)
+    _add_no_detailed_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
 

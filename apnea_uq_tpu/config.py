@@ -15,6 +15,8 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
+import numpy as np
+
 # Canonical seed of the reference pipeline (cnn_baseline_train.py:18,
 # prepare_numpy_datasets.py:50, train_deep_ensemble_cnns.py:13).
 DEFAULT_SEED = 2025
@@ -217,6 +219,10 @@ def _to_jsonable(obj: Any) -> Any:
         return [_to_jsonable(v) for v in obj]
     if isinstance(obj, dict):
         return {str(k): _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
     return repr(obj)
 
 

@@ -141,11 +141,27 @@ def missing_fraction_ok(
 
 
 def fft_resample(signal: np.ndarray, target_length: int) -> np.ndarray:
-    """FFT-domain resampling, the semantics of scipy.signal.resample as
-    used at preprocess_shhs_raw.py:163."""
-    from scipy.signal import resample
-
-    return resample(signal, target_length)
+    """FFT-domain resampling: the exact real-input semantics of
+    scipy.signal.resample as used at preprocess_shhs_raw.py:163, in-tree
+    (truncate/zero-pad the rfft spectrum, with the doubled/halved unpaired
+    Nyquist bin when min(n, num) is even), verified against scipy to
+    1e-12 in tests/test_data_ingest.py.  ``num == n`` returns a copy
+    without the FFT round-trip (scipy's round-trip differs by ~1 ulp)."""
+    signal = np.asarray(signal, dtype=np.float64)
+    n = signal.shape[0]
+    num = int(target_length)
+    if num == n:
+        return signal.copy()
+    if n == 0 or num <= 0:
+        raise ValueError(f"cannot resample length {n} to {num}")
+    spectrum = np.fft.rfft(signal)
+    m = min(num, n)
+    spectrum = spectrum[: m // 2 + 1]
+    if m % 2 == 0:
+        # The unpaired bin at m//2: its conjugate partner is folded in on
+        # down-sampling (x2) or split back out on up-sampling (x0.5).
+        spectrum[m // 2] *= 2.0 if num < n else 0.5
+    return np.fft.irfft(spectrum * (num / n), n=num)
 
 
 def label_windows(

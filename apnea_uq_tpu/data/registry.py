@@ -28,6 +28,7 @@ TEST_STD_UNBALANCED = "test_std_unbalanced"    # L2 -> L3/L5: full test set
 TEST_STD_RUS = "test_std_rus"                  # L2 -> L3/L5: RUS-balanced test set
 RAW_PREDICTIONS = "raw_predictions"            # L5 side: (K, M) probability stack
 DETAILED_WINDOWS = "detailed_windows"          # L5 -> L6: per-window CSV
+METRICS = "metrics"                            # L5 side: aggregates/CIs/classification JSON
 PATIENT_SUMMARY = "patient_summary"            # L6 -> L7: per-patient CSV
 CHECKPOINT = "checkpoint"                      # L3 -> L5: model checkpoints (dir)
 
@@ -136,6 +137,33 @@ class ArtifactRegistry:
         if entry is None:
             raise KeyError(f"artifact {key!r} not in registry at {self.root}")
         return pd.read_csv(os.path.join(self.root, entry["file"]))
+
+    # -- json documents ---------------------------------------------------
+
+    def save_json(self, key: str, document: Dict[str, Any], *, config: Any = None) -> str:
+        """Save a JSON-able dict (numpy values are converted)."""
+        path = self.path_for(key, ".json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_to_jsonable(document), f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        self._record(
+            key,
+            {
+                "file": os.path.basename(path),
+                "kind": "json",
+                "keys": sorted(map(str, document)),
+                "config": _to_jsonable(config),
+            },
+        )
+        return path
+
+    def load_json(self, key: str) -> Dict[str, Any]:
+        entry = self.describe(key)
+        if entry is None:
+            raise KeyError(f"artifact {key!r} not in registry at {self.root}")
+        with open(os.path.join(self.root, entry["file"])) as f:
+            return json.load(f)
 
     # -- directories (checkpoints) ---------------------------------------
 

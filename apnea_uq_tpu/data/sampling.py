@@ -1,9 +1,10 @@
 """Patient-grouped splitting and class rebalancing (SMOTE / RUS).
 
 The reference delegates these to scikit-learn / imbalanced-learn
-(prepare_numpy_datasets.py:3-5,140,185,207).  imbalanced-learn is not
-available in this environment, so SMOTE and random undersampling are
-implemented in-tree.  SMOTE's O(n^2) minority k-NN search — the one
+(prepare_numpy_datasets.py:3-5,140,185,207).  All three are in-tree here
+— the grouped split as a bit-identical GroupShuffleSplit replica, SMOTE
+and random undersampling from the algorithm definitions — keeping
+sklearn/imblearn out of the runtime dependency set.  SMOTE's O(n^2) minority k-NN search — the one
 compute-heavy step — runs on device as chunked matmul distance blocks +
 ``lax.top_k`` (MXU-shaped), with the synthesis step staying in host
 NumPy where the rest of the data pipeline lives.
@@ -25,16 +26,34 @@ def grouped_train_test_split(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """(train_idx, test_idx) with no group straddling the boundary.
 
-    Same semantics as sklearn's GroupShuffleSplit as used at
-    prepare_numpy_datasets.py:140-142 (and identical output for a given
-    seed, since it delegates to it): test_size is a fraction of *unique
-    groups*, not of rows.
+    In-tree replica of sklearn's GroupShuffleSplit as used at
+    prepare_numpy_datasets.py:140-142, bit-identical for any given seed
+    (verified against sklearn in tests/test_data_sampling.py): test_size
+    is a fraction of *unique groups* (ceil for test, floor for train),
+    drawn by a ``RandomState(seed)`` permutation of the sorted unique
+    groups — so a seed-2025 split here selects exactly the patients the
+    reference's split did.
     """
-    from sklearn.model_selection import GroupShuffleSplit
-
-    splitter = GroupShuffleSplit(n_splits=1, test_size=test_size, random_state=seed)
-    placeholder = np.zeros(len(groups))
-    train_idx, test_idx = next(splitter.split(placeholder, groups=groups))
+    if not 0.0 < test_size < 1.0:
+        raise ValueError(f"test_size must be in (0, 1), got {test_size}")
+    classes, group_indices = np.unique(np.asarray(groups), return_inverse=True)
+    n_groups = classes.shape[0]
+    n_test = int(np.ceil(test_size * n_groups))
+    # sklearn sizes train as the complement (not floor((1-t)*n), which can
+    # land one short under float rounding and silently drop a group).
+    n_train = n_groups - n_test
+    if n_train <= 0:
+        # sklearn raises here too; a silent empty train set would NaN the
+        # downstream standardization instead of failing loudly.
+        raise ValueError(
+            f"test_size={test_size} leaves no training groups "
+            f"({n_groups} unique groups, {n_test} assigned to test)"
+        )
+    permutation = np.random.RandomState(seed).permutation(n_groups)
+    test_groups = permutation[:n_test]
+    train_groups = permutation[n_test : n_test + n_train]
+    train_idx = np.flatnonzero(np.isin(group_indices, train_groups))
+    test_idx = np.flatnonzero(np.isin(group_indices, test_groups))
     return train_idx, test_idx
 
 

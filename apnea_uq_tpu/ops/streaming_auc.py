@@ -55,11 +55,17 @@ def histogram_update(
     weights are not supported (counts are integer; fractions would
     silently truncate to zero)."""
     num_bins = hists.shape[1]
+    finite = jnp.isfinite(probs)
+    # Sanitize before the int cast (NaN->int is backend-defined and warns
+    # in eager mode); the finite mask below zeroes these rows' counts.
     bins = jnp.clip(
-        (probs * num_bins).astype(jnp.int32), 0, num_bins - 1
+        (jnp.where(finite, probs, 0.0) * num_bins).astype(jnp.int32),
+        0, num_bins - 1,
     )
     labels = labels.astype(jnp.float32)
-    mask = mask.astype(jnp.float32)
+    # Exclude non-finite probabilities (a diverged model) from the counts
+    # rather than clipping NaN into a valid bin via backend-defined casts.
+    mask = mask.astype(jnp.float32) * finite.astype(jnp.float32)
     neg = hists[0].at[bins].add((mask * (1.0 - labels)).astype(jnp.int32))
     pos = hists[1].at[bins].add((mask * labels).astype(jnp.int32))
     return jnp.stack([neg, pos])
@@ -91,8 +97,9 @@ def accuracy_update(
 ) -> jax.Array:
     """Accumulate (correct, total) over one masked batch; counts is (2,)
     int32 (batch-local sums are exact in f32, totals must not be).
-    ``mask`` is a {0, 1} inclusion mask, not fractional weights."""
-    mask = mask.astype(jnp.float32)
+    ``mask`` is a {0, 1} inclusion mask, not fractional weights.
+    Non-finite probabilities are excluded, matching histogram_update."""
+    mask = mask.astype(jnp.float32) * jnp.isfinite(probs).astype(jnp.float32)
     pred = (probs >= threshold).astype(jnp.float32)
     correct = jnp.sum(mask * (pred == labels.astype(jnp.float32)))
     return counts + jnp.stack([correct, jnp.sum(mask)]).astype(jnp.int32)

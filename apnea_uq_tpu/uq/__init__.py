@@ -10,6 +10,7 @@ from apnea_uq_tpu.uq.drivers import (
     evaluate_uq,
     run_de_analysis,
     run_mcd_analysis,
+    run_metrics_document,
     run_synthetic_demo,
     save_run,
     save_run_plots,
@@ -35,6 +36,7 @@ __all__ = [
     "detailed_frame",
     "run_mcd_analysis",
     "run_de_analysis",
+    "run_metrics_document",
     "run_synthetic_demo",
     "save_run",
     "save_run_plots",

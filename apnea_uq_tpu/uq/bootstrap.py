@@ -35,19 +35,20 @@ AGGREGATE_KEYS = (
 )
 
 
-@partial(jax.jit, static_argnames=("n_bootstrap",))
-def _bootstrap_core(
+@jax.jit
+def gather_aggregates(
     pred_variance: jax.Array,
     total_entropy: jax.Array,
     aleatoric: jax.Array,
     mutual_info: jax.Array,
     y_true: jax.Array,
-    key: jax.Array,
-    n_bootstrap: int,
+    idx: jax.Array,
 ) -> Dict[str, jax.Array]:
-    m = pred_variance.shape[0]
-    idx = jax.random.randint(key, (n_bootstrap, m), 0, m)  # resample with replacement
-
+    """The six scalar aggregates for an explicit (B, M) resample-index
+    matrix.  Exposed separately from :func:`_bootstrap_core` so parity
+    tests can drive the gather engine with the reference's own
+    ``np.random.choice`` index stream (uq_techniques.py:142) and compare
+    per-resample values exactly."""
     var_b = pred_variance[idx]          # (B, M)
     tot_b = total_entropy[idx]
     ale_b = aleatoric[idx]
@@ -69,6 +70,23 @@ def _bootstrap_core(
         "mean_expected_aleatoric_entropy": jnp.mean(ale_b, axis=1),
         "mean_mutual_info": jnp.mean(mi_b, axis=1),
     }
+
+
+@partial(jax.jit, static_argnames=("n_bootstrap",))
+def _bootstrap_core(
+    pred_variance: jax.Array,
+    total_entropy: jax.Array,
+    aleatoric: jax.Array,
+    mutual_info: jax.Array,
+    y_true: jax.Array,
+    key: jax.Array,
+    n_bootstrap: int,
+) -> Dict[str, jax.Array]:
+    m = pred_variance.shape[0]
+    idx = jax.random.randint(key, (n_bootstrap, m), 0, m)  # resample with replacement
+    return gather_aggregates(
+        pred_variance, total_entropy, aleatoric, mutual_info, y_true, idx
+    )
 
 
 @partial(jax.jit, static_argnames=())
@@ -192,11 +210,19 @@ def compute_confidence_intervals(
     """
     if not bootstrap_results:
         return {}
+    # float64 throughout: np.percentile interpolates in float64 regardless of
+    # input dtype, so a float32 mean of a near-constant bootstrap vector can
+    # land ~1 ulp outside its own CI.  mean ∈ [lo, hi] must hold exactly.
     if isinstance(bootstrap_results, dict):
-        columns = {k: np.asarray(v) for k, v in bootstrap_results.items()}
+        columns = {
+            k: np.asarray(v, dtype=np.float64) for k, v in bootstrap_results.items()
+        }
     else:
         keys = bootstrap_results[0].keys()
-        columns = {k: np.asarray([r[k] for r in bootstrap_results]) for k in keys}
+        columns = {
+            k: np.asarray([r[k] for r in bootstrap_results], dtype=np.float64)
+            for k in keys
+        }
 
     out: Dict[str, float] = {}
     for name, values in columns.items():

@@ -438,12 +438,37 @@ def save_run_plots(result: UQRunResult, out_dir: str) -> list:
     ]
 
 
+def run_metrics_document(result: UQRunResult) -> Dict:
+    """The run's scalar results as one JSON-able document: aggregates,
+    bootstrap CIs, the classification suite(s), and run provenance.  The
+    reference merely *returned* its merged dict (uq_techniques.py:343-365)
+    and lost it once the terminal scrolled; persisting it is the
+    observability bar the registry sets for every other stage."""
+    ev = result.evaluation
+    doc = {
+        "label": result.label,
+        "n_passes": ev.n_passes,
+        "n_windows": ev.n_windows,
+        "predict_seconds": result.predict_seconds,
+        "aggregates": dict(ev.aggregates),
+        "confidence_intervals": dict(ev.confidence_intervals),
+        "classification": dict(result.classification),
+    }
+    if result.deterministic_classification is not None:
+        doc["deterministic_classification"] = dict(
+            result.deterministic_classification
+        )
+    return doc
+
+
 def save_run(registry, result: UQRunResult, *, config=None) -> Dict[str, str]:
     """Persist a run's artifacts under canonical registry keys.
 
     raw predictions -> ``raw_predictions:<label>`` (the reference's
-    mc_raw_pred*.npy dump, analyze_mcd_patient_level.py:100) and the
-    detailed frame -> ``detailed_windows:<label>`` (the L5->L6 CSV).
+    mc_raw_pred*.npy dump, analyze_mcd_patient_level.py:100), the
+    detailed frame -> ``detailed_windows:<label>`` (the L5->L6 CSV), and
+    the scalar results -> ``metrics:<label>`` (JSON: aggregates, CIs,
+    classification suite).
     """
     from apnea_uq_tpu.data import registry as reg
 
@@ -457,4 +482,7 @@ def save_run(registry, result: UQRunResult, *, config=None) -> Dict[str, str]:
         paths["detailed_windows"] = registry.save_table(
             f"{reg.DETAILED_WINDOWS}:{result.label}", result.detailed, config=config
         )
+    paths["metrics"] = registry.save_json(
+        f"{reg.METRICS}:{result.label}", run_metrics_document(result), config=config
+    )
     return paths

@@ -1,0 +1,93 @@
+"""Scalar special functions for the in-tree statistical tests.
+
+The two CDFs ``analysis/stats.py`` needs — standard normal (Mann-Whitney
+asymptotic p) and Student t (Pearson p) — previously came from
+``scipy.special``; they are implemented here so the framework's runtime
+dependency claims hold (README "Environment").  Both are float64 scalar
+functions (the tests produce scalar p-values), verified against
+scipy.special across sign, tail, and degrees-of-freedom ranges in
+tests/test_analysis.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+_SQRT2 = math.sqrt(2.0)
+
+
+def ndtr(x: float) -> float:
+    """Standard normal CDF via the complementary error function."""
+    return 0.5 * math.erfc(-float(x) / _SQRT2)
+
+
+def _betacf(a: float, b: float, x: float) -> float:
+    """Continued fraction for the incomplete beta (modified Lentz)."""
+    tiny = 1e-300
+    qab, qap, qam = a + b, a + 1.0, a - 1.0
+    c = 1.0
+    d = 1.0 - qab * x / qap
+    if abs(d) < tiny:
+        d = tiny
+    d = 1.0 / d
+    h = d
+    for m in range(1, 300):
+        m2 = 2 * m
+        # even step
+        aa = m * (b - m) * x / ((qam + m2) * (a + m2))
+        d = 1.0 + aa * d
+        if abs(d) < tiny:
+            d = tiny
+        c = 1.0 + aa / c
+        if abs(c) < tiny:
+            c = tiny
+        d = 1.0 / d
+        h *= d * c
+        # odd step
+        aa = -(a + m) * (qab + m) * x / ((a + m2) * (qap + m2))
+        d = 1.0 + aa * d
+        if abs(d) < tiny:
+            d = tiny
+        c = 1.0 + aa / c
+        if abs(c) < tiny:
+            c = tiny
+        d = 1.0 / d
+        delta = d * c
+        h *= delta
+        if abs(delta - 1.0) < 1e-15:
+            break
+    return h
+
+
+def betainc(a: float, b: float, x: float) -> float:
+    """Regularized incomplete beta I_x(a, b), scalar float64."""
+    if not (a > 0.0 and b > 0.0):
+        raise ValueError(f"betainc requires a, b > 0, got {a}, {b}")
+    if x <= 0.0:
+        return 0.0
+    if x >= 1.0:
+        return 1.0
+    ln_front = (
+        math.lgamma(a + b)
+        - math.lgamma(a)
+        - math.lgamma(b)
+        + a * math.log(x)
+        + b * math.log1p(-x)
+    )
+    front = math.exp(ln_front)
+    # Use the continued fraction on whichever side converges fast.
+    if x < (a + 1.0) / (a + b + 2.0):
+        return front * _betacf(a, b, x) / a
+    return 1.0 - front * _betacf(b, a, 1.0 - x) / b
+
+
+def stdtr(df: float, t: float) -> float:
+    """Student t CDF with ``df`` degrees of freedom at ``t``."""
+    df = float(df)
+    t = float(t)
+    if df <= 0.0:
+        raise ValueError(f"stdtr requires df > 0, got {df}")
+    if t == 0.0:
+        return 0.5
+    tail = 0.5 * betainc(0.5 * df, 0.5, df / (df + t * t))
+    return tail if t < 0.0 else 1.0 - tail

@@ -106,6 +106,49 @@ class TestWindowAnalysis:
         )
 
 
+class TestSpecialFunctions:
+    """The in-tree CDF special functions (utils/special.py) vs
+    scipy.special, across signs, tails, and df ranges."""
+
+    def test_ndtr_matches_scipy(self):
+        import scipy.special
+
+        from apnea_uq_tpu.utils.special import ndtr
+
+        for x in (-8.0, -3.5, -1.0, -1e-9, 0.0, 0.7, 2.0, 8.0):
+            assert ndtr(x) == pytest.approx(
+                float(scipy.special.ndtr(x)), rel=1e-13, abs=1e-300
+            ), x
+
+    @pytest.mark.parametrize("df", [1, 2, 3, 10, 29, 100, 2500])
+    def test_stdtr_matches_scipy(self, df):
+        import scipy.special
+
+        from apnea_uq_tpu.utils.special import stdtr
+
+        for t in (-30.0, -4.2, -1.0, -0.01, 0.0, 0.3, 2.5, 12.0):
+            assert stdtr(df, t) == pytest.approx(
+                float(scipy.special.stdtr(df, t)), rel=1e-10, abs=1e-300
+            ), (df, t)
+
+    def test_betainc_matches_scipy(self, rng):
+        import scipy.special
+
+        from apnea_uq_tpu.utils.special import betainc
+
+        for _ in range(50):
+            a = float(rng.uniform(0.1, 50.0))
+            b = float(rng.uniform(0.1, 50.0))
+            x = float(rng.uniform(0.0, 1.0))
+            assert betainc(a, b, x) == pytest.approx(
+                float(scipy.special.betainc(a, b, x)), rel=1e-10, abs=1e-14
+            ), (a, b, x)
+        assert betainc(2.0, 3.0, 0.0) == 0.0
+        assert betainc(2.0, 3.0, 1.0) == 1.0
+        with pytest.raises(ValueError):
+            betainc(-1.0, 1.0, 0.5)
+
+
 class TestPearson:
     @pytest.mark.parametrize("n", [5, 30, 200])
     def test_matches_scipy(self, rng, n):

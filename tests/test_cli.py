@@ -104,8 +104,18 @@ def test_full_pipeline(env, order, capsys):
     assert glob.glob(os.path.join(profile_dir, "**", "*"), recursive=True)
     out = capsys.readouterr().out
     assert "CNN_MCD_Unbalanced" in out and "overall_mean_variance" in out
+    # The deterministic sanity probe runs once, on the first (Unbalanced)
+    # set — reference behavior (analyze_mcd_patient_level.py:203-211).
+    assert out.count("deterministic accuracy") == 1
     assert registry.exists(f"{reg.DETAILED_WINDOWS}:CNN_MCD_Unbalanced")
     assert registry.exists(f"{reg.RAW_PREDICTIONS}:CNN_MCD_Balanced_RUS")
+    # The printed scalar results are persisted too (metrics JSON artifact).
+    metrics_doc = registry.load_json(f"{reg.METRICS}:CNN_MCD_Unbalanced")
+    assert set(metrics_doc) >= {"aggregates", "confidence_intervals",
+                                "classification"}
+    assert "overall_mean_variance" in metrics_doc["aggregates"]
+    assert "overall_mean_variance_ci_lower" in metrics_doc["confidence_intervals"]
+    assert 0.0 <= metrics_doc["classification"]["accuracy"] <= 1.0
     # 4 evaluation plots (3 metric distributions + class bar) per test set
     # (reference emits these inside evaluate_uq_methods, uq_techniques.py:369-387)
     mcd_pngs = sorted(os.listdir(mcd_plots))
@@ -117,9 +127,30 @@ def test_full_pipeline(env, order, capsys):
                "--num-members", "2", "--plots-dir", de_plots) == 0
     capsys.readouterr()
     assert registry.exists(f"{reg.DETAILED_WINDOWS}:CNN_DE_Unbalanced")
+    assert registry.exists(f"{reg.METRICS}:CNN_DE_Unbalanced")
     preds = registry.load_arrays(f"{reg.RAW_PREDICTIONS}:CNN_DE_Unbalanced")
     assert preds["predictions"].shape[0] == 2
     assert len(os.listdir(de_plots)) == 8
+
+    # -- global (no-CSV) evaluation variants (C15/C16) ---------------------
+    # --no-detailed reproduces evaluate_{mcd,de}_global.py: aggregates +
+    # CIs only, no per-window detailed CSV.  Overwrite-safety: run into a
+    # fresh registry so the detailed artifacts above survive.
+    global_registry = str(env["root"] / "registry_global")
+    import shutil
+    shutil.copytree(registry_dir, global_registry)
+    greg = ArtifactRegistry(global_registry)
+    detailed_csv = os.path.join(
+        global_registry, greg.describe(f"{reg.DETAILED_WINDOWS}:CNN_DE_Unbalanced")["file"]
+    )
+    before = os.path.getmtime(detailed_csv)
+    assert run("eval-de", "--registry", global_registry, "--config", config,
+               "--num-members", "2", "--no-detailed") == 0
+    capsys.readouterr()
+    doc = greg.load_json(f"{reg.METRICS}:CNN_DE_Unbalanced")
+    assert "overall_mean_variance" in doc["aggregates"]
+    # The global variant did not rewrite the per-window CSV.
+    assert os.path.getmtime(detailed_csv) == before
 
     # -- aggregate / analyze / correlate ----------------------------------
     assert run("aggregate-patients", "--registry", registry_dir,

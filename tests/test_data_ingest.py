@@ -8,6 +8,7 @@ from apnea_uq_tpu.config import IngestConfig
 from apnea_uq_tpu.data.annotations import RespiratoryEvents
 from apnea_uq_tpu.data.edf import EdfSignal, write_edf
 from apnea_uq_tpu.data.ingest import (
+    fft_resample,
     ingest_directory,
     ingest_recording,
     interpolate_out_of_range,
@@ -15,6 +16,33 @@ from apnea_uq_tpu.data.ingest import (
     windows_from_reference_csv,
     windows_to_reference_csv,
 )
+
+
+class TestFftResample:
+    @pytest.mark.parametrize("n,num", [
+        (600, 60),    # the SHHS 10 Hz -> 1 Hz downsample (even min)
+        (601, 60),
+        (250, 125),
+        (120, 121),   # near-identity upsample
+        (60, 600),    # upsample (even min)
+        (61, 600),
+        (64, 63),
+    ])
+    def test_matches_scipy(self, rng, n, num):
+        scipy_signal = pytest.importorskip("scipy.signal")
+        x = rng.normal(size=n)
+        np.testing.assert_allclose(
+            fft_resample(x, num), scipy_signal.resample(x, num),
+            rtol=1e-12, atol=1e-12,
+        )
+
+    def test_identity_and_errors(self, rng):
+        x = rng.normal(size=50)
+        np.testing.assert_array_equal(fft_resample(x, 50), x)
+        with pytest.raises(ValueError):
+            fft_resample(x, 0)
+        with pytest.raises(ValueError):
+            fft_resample(np.empty(0), 10)
 
 APNEA = "Obstructive apnea|Obstructive Apnea"
 HYPO = "Hypopnea|Hypopnea"

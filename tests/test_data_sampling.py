@@ -44,6 +44,53 @@ class TestGroupedSplit:
         with pytest.raises(ValueError, match="both train and test"):
             verify_no_group_overlap(groups, np.array([0]), np.array([1, 2]))
 
+    @pytest.mark.parametrize("seed", [0, 7, 2025])
+    @pytest.mark.parametrize("test_size", [0.2, 0.3])
+    def test_bit_identical_to_sklearn(self, rng, seed, test_size):
+        """The in-tree split replicates sklearn's GroupShuffleSplit
+        exactly (including seed 2025, the reference's split seed at
+        prepare_numpy_datasets.py:140-142), so datasets prepared here
+        contain exactly the patients the reference's pipeline selected."""
+        sklearn = pytest.importorskip("sklearn.model_selection")
+
+        # Unsorted, uneven group sizes — the shapes np.unique must handle.
+        groups = rng.choice([f"p{i:03d}" for i in range(23)], size=400)
+        tr, te = grouped_train_test_split(groups, test_size=test_size, seed=seed)
+        splitter = sklearn.GroupShuffleSplit(
+            n_splits=1, test_size=test_size, random_state=seed
+        )
+        tr_ref, te_ref = next(splitter.split(np.zeros(len(groups)), groups=groups))
+        np.testing.assert_array_equal(tr, tr_ref)
+        np.testing.assert_array_equal(te, te_ref)
+
+    def test_every_group_lands_somewhere(self, rng):
+        """Regression: floor((1-t)*n) sizing dropped a group entirely for
+        (test_size, n_groups) pairs where float rounding lands just below
+        an integer — train must be the exact complement of test."""
+        sklearn = pytest.importorskip("sklearn.model_selection")
+        for n_groups, test_size in [(5, 0.8), (90, 0.3), (170, 0.3), (10, 0.33)]:
+            groups = np.repeat([f"g{i}" for i in range(n_groups)], 2)
+            tr, te = grouped_train_test_split(groups, test_size=test_size, seed=0)
+            assert len(tr) + len(te) == len(groups)
+            splitter = sklearn.GroupShuffleSplit(
+                n_splits=1, test_size=test_size, random_state=0
+            )
+            tr_ref, te_ref = next(
+                splitter.split(np.zeros(len(groups)), groups=groups)
+            )
+            np.testing.assert_array_equal(tr, tr_ref)
+            np.testing.assert_array_equal(te, te_ref)
+
+    def test_bad_test_size_raises(self):
+        with pytest.raises(ValueError, match="test_size"):
+            grouped_train_test_split(np.array(["a", "b"]), test_size=1.0)
+
+    def test_empty_train_raises(self):
+        # sklearn raises here too; a silent empty train set would NaN
+        # downstream standardization.
+        with pytest.raises(ValueError, match="no training groups"):
+            grouped_train_test_split(np.array(["a", "a"]), test_size=0.5)
+
 
 class TestMinorityKnn:
     def test_matches_brute_force(self, rng):

@@ -1,7 +1,9 @@
-"""Docs-vs-CLI consistency: every `apnea-uq <subcommand>` and every
-`--flag` named in the user-facing docs must actually exist, so the
-migration guide and README cannot silently rot as the CLI evolves."""
+"""Docs-vs-code consistency: every `apnea-uq <subcommand>` and every
+`--flag` named in the user-facing docs must actually exist, and the
+README's dependency claims must match the package's actual imports, so
+the migration guide and README cannot silently rot as the code evolves."""
 
+import ast
 import re
 from pathlib import Path
 
@@ -9,6 +11,11 @@ from apnea_uq_tpu.cli.main import build_parser
 
 REPO = Path(__file__).resolve().parent.parent
 DOCS = [REPO / "README.md", REPO / "docs" / "MIGRATION.md"]
+
+# README "Environment": packages claimed absent at runtime.  The claim
+# rotted once (r2 verdict: sklearn/scipy imports on the prepare and
+# analysis paths), so it is now enforced against the package's AST.
+CLAIMED_ABSENT = ("tensorflow", "sklearn", "imblearn", "pyedflib", "scipy")
 
 
 def _subparsers(parser):
@@ -41,6 +48,41 @@ def test_documented_subcommands_exist():
     for core in ("ingest", "prepare", "train", "train-ensemble", "eval-mcd",
                  "eval-de", "demo"):
         assert core in documented, f"core stage {core!r} undocumented"
+
+
+def _imported_modules(path: Path) -> set:
+    """Top-level module names imported anywhere in a source file (both
+    module-level and function-local imports — a lazy import is still a
+    runtime dependency)."""
+    tree = ast.parse(path.read_text())
+    mods = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            mods.update(alias.name.split(".")[0] for alias in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            mods.add(node.module.split(".")[0])
+    return mods
+
+
+def test_readme_dependency_claims_match_imports():
+    """README claims these packages are not runtime dependencies; no file
+    in the package may import them.  (`jax.scipy` is jax, not scipy —
+    the AST walk sees only the top-level name, so it does not trip.)"""
+    readme = (REPO / "README.md").read_text().lower()
+    for name in CLAIMED_ABSENT:
+        assert name.replace("sklearn", "scikit-learn") in readme or name in readme, (
+            f"README no longer mentions {name!r}; update CLAIMED_ABSENT "
+            f"to track the current dependency claims"
+        )
+    offenders = {}
+    for path in sorted((REPO / "apnea_uq_tpu").rglob("*.py")):
+        bad = _imported_modules(path) & set(CLAIMED_ABSENT)
+        if bad:
+            offenders[str(path.relative_to(REPO))] = sorted(bad)
+    assert not offenders, (
+        f"README claims no runtime dependency on {CLAIMED_ABSENT}, but the "
+        f"package imports them: {offenders}"
+    )
 
 
 def test_documented_flags_exist_per_subcommand():

@@ -84,6 +84,20 @@ class TestStreamingOps:
         assert float(masked[1]) == pytest.approx(float(trimmed[1]), abs=1e-6)
         assert float(masked[0]) == pytest.approx(float(trimmed[0]), abs=1e-6)
 
+    def test_nonfinite_probs_excluded(self, rng):
+        # A diverged model's NaN/inf scores must not be binned as if they
+        # were real probabilities — they drop out of both AUC and accuracy.
+        probs = rng.uniform(0, 1, 200).astype(np.float32)
+        labels = (rng.uniform(size=200) < 0.5).astype(np.float32)
+        dirty = probs.copy()
+        dirty[::5] = np.nan
+        dirty[1::7] = np.inf
+        bad = np.isnan(dirty) | np.isinf(dirty)
+        polluted = _stream(dirty, labels, batches=3)
+        clean = _stream(probs[~bad], labels[~bad], batches=3)
+        assert float(polluted[1]) == pytest.approx(float(clean[1]), abs=1e-6)
+        assert float(polluted[0]) == pytest.approx(float(clean[0]), abs=1e-6)
+
     def test_ties_in_one_bin_give_half(self):
         # All scores identical -> every pos/neg pair ties -> AUC 0.5.
         probs = np.full(100, 0.42, np.float32)

@@ -154,7 +154,7 @@ class TestEndToEnd:
 
         registry = ArtifactRegistry(str(tmp_path))
         paths = save_run(registry, result)
-        assert set(paths) == {"raw_predictions", "detailed_windows"}
+        assert set(paths) == {"raw_predictions", "detailed_windows", "metrics"}
         loaded = registry.load_arrays("raw_predictions:DE_test")
         np.testing.assert_allclose(loaded["predictions"], result.predictions)
         table = registry.load_table("detailed_windows:DE_test")
@@ -163,6 +163,21 @@ class TestEndToEnd:
             table, result.detailed, check_dtype=False, check_exact=False,
             rtol=1e-6,
         )
+        # The scalar results survive the terminal: aggregates + CIs +
+        # classification round-trip through the metrics JSON artifact.
+        doc = registry.load_json("metrics:DE_test")
+        assert doc["label"] == "DE_test"
+        assert doc["n_passes"] == 3 and doc["n_windows"] == 64
+        assert doc["aggregates"] == pytest.approx(result.evaluation.aggregates)
+        assert doc["confidence_intervals"] == pytest.approx(
+            result.evaluation.confidence_intervals
+        )
+        assert doc["classification"]["accuracy"] == pytest.approx(
+            result.classification["accuracy"]
+        )
+        assert doc["classification"]["confusion_matrix"] == np.asarray(
+            result.classification["confusion_matrix"]
+        ).tolist()
 
     def test_mcd_streaming_config(self, setup):
         """UQConfig.mcd_streaming routes prediction through the host-
