@@ -62,20 +62,6 @@ DETAILED_ENTROPY_EPS = 1e-9
 from apnea_uq_tpu.utils.multihost import host_values as _host_predictions
 
 
-def _warn_streaming_ignores_mesh(flag_name: str, mesh, label: str) -> None:
-    """Streaming prediction paths are single-device; surface it instead of
-    silently idling a pod when a multi-device mesh was configured."""
-    if mesh is not None and len(mesh.devices.flat) > 1:
-        import warnings
-
-        warnings.warn(
-            f"{flag_name} runs single-device; the "
-            f"{len(mesh.devices.flat)}-device mesh is not used for {label}. "
-            f"Unset {flag_name} to shard over the mesh.",
-            stacklevel=3,
-        )
-
-
 @dataclasses.dataclass
 class UQEvaluation:
     """Aggregates + bootstrap CIs over one prediction stack (C12 parity)."""
@@ -289,15 +275,16 @@ def run_mcd_analysis(
     with Timer(f"{label}.predict") as t:
         if config.mcd_streaming:
             # Host-streamed chunks for sets that exceed HBM; identical
-            # results to the in-HBM path (streaming is the small-memory
-            # path, the mesh the many-chips path).
-            _warn_streaming_ignores_mesh("mcd_streaming", mesh, label)
+            # results to the in-HBM path.  Streaming (small-memory) and
+            # the mesh (many-chips) compose: each chunk shards over
+            # (ensemble, data).
             predictions = mc_dropout_predict_streaming(
                 model, variables, x,
                 n_passes=config.mc_passes,
                 mode=config.mcd_mode,
                 batch_size=config.mcd_batch_size,
                 key=predict_key,
+                mesh=mesh,
             )
         else:
             predictions = block(mc_dropout_predict(
@@ -347,10 +334,10 @@ def run_de_analysis(
         bootstrap_key = prng.bootstrap_key(seed)
     with Timer(f"{label}.predict") as t:
         if config.de_streaming:
-            _warn_streaming_ignores_mesh("de_streaming", mesh, label)
             predictions = ensemble_predict_streaming(
                 model, member_variables, x,
                 batch_size=config.inference_batch_size,
+                mesh=mesh,
             )
         else:
             predictions = block(ensemble_predict(

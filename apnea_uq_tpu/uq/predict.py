@@ -58,6 +58,33 @@ def _chunk(x: jax.Array, batch_size: int):
     return x.reshape((-1, batch_size) + x.shape[1:]), m
 
 
+def _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh):
+    """The T stochastic passes of ONE window chunk — the single body both
+    the in-HBM ``lax.map`` path and the streamed per-chunk jit run, so
+    streamed == in-HBM parity holds by construction rather than by keeping
+    two copies in sync.  With ``mesh``, the passes shard over ``ensemble``
+    and the chunk's windows over ``data``."""
+    chunk = _constrain(chunk, mesh, mesh_lib.AXIS_DATA)
+
+    def one_pass(k):
+        # Fresh noise per (pass, chunk): reusing the per-pass key across
+        # chunks would give windows in different chunks identical dropout
+        # masks (correlated noise the reference does not have).
+        k = jax.random.fold_in(k, chunk_idx)
+        logits, _ = apply_model(model, variables, chunk, mode=mode, dropout_rng=k)
+        # Constrain per pass, at the model output: with spmd_axis_name
+        # threading the pass axis, this pins the conv batch itself to
+        # the (pass-shard x window-shard) block — without it the
+        # partitioner is free to replicate windows within ensemble
+        # groups and merely reshard at the end (observed on CPU SPMD),
+        # wasting the data axis.
+        return _constrain(predict_proba(logits), mesh, mesh_lib.AXIS_DATA)
+
+    if mesh is None:
+        return jax.vmap(one_pass)(keys)  # (T, bs)
+    return jax.vmap(one_pass, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(keys)
+
+
 @partial(
     jax.jit, static_argnames=("model", "n_passes", "mode", "batch_size", "mesh")
 )
@@ -72,24 +99,7 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None):
 
     def one_chunk(args):
         chunk, chunk_idx = args
-
-        def one_pass(k):
-            # Fresh noise per (pass, chunk): reusing the per-pass key across
-            # chunks would give windows in different chunks identical dropout
-            # masks (correlated noise the reference does not have).
-            k = jax.random.fold_in(k, chunk_idx)
-            logits, _ = apply_model(model, variables, chunk, mode=mode, dropout_rng=k)
-            # Constrain per pass, at the model output: with spmd_axis_name
-            # threading the pass axis, this pins the conv batch itself to
-            # the (pass-shard x window-shard) block — without it the
-            # partitioner is free to replicate windows within ensemble
-            # groups and merely reshard at the end (observed on CPU SPMD),
-            # wasting the data axis.
-            return _constrain(predict_proba(logits), mesh, mesh_lib.AXIS_DATA)
-
-        if mesh is None:
-            return jax.vmap(one_pass)(keys)  # (T, bs)
-        return jax.vmap(one_pass, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(keys)
+        return _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh)
 
     probs = jax.lax.map(
         one_chunk, (chunks, jnp.arange(chunks.shape[0]))
@@ -98,29 +108,30 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None):
     return probs[:, :m]
 
 
-@partial(jax.jit, static_argnames=("model", "n_passes", "mode"))
-def _mcd_chunk_jit(model, variables, chunk, key, chunk_idx, n_passes, mode):
+@partial(jax.jit, static_argnames=("model", "n_passes", "mode", "mesh"))
+def _mcd_chunk_jit(model, variables, chunk, key, chunk_idx, n_passes, mode,
+                   mesh=None):
     """All T passes of ONE window chunk — the streamed unit of work.
-    Key handling matches _mcd_jit exactly (split to T, fold in the chunk
-    index), so streamed and in-HBM predictions are identical."""
+    Same body as the in-HBM path (:func:`_mcd_passes`): split to T keys,
+    fold in the chunk index, identical sharding, so streamed and in-HBM
+    predictions are identical and a pod's chips all work on every chunk."""
     keys = jax.random.split(key, n_passes)
-
-    def one_pass(k):
-        k = jax.random.fold_in(k, chunk_idx)
-        logits, _ = apply_model(model, variables, chunk, mode=mode, dropout_rng=k)
-        return predict_proba(logits)
-
-    return jax.vmap(one_pass)(keys)  # (T, bs)
+    return _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh)
 
 
-def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute):
+def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute,
+                    sharding=None):
     """Shared host-streamed chunk loop: wrap-padded chunks flow through
     the prefetch feed, ``compute(chunk, ci) -> (n_rows, bs)`` runs on
     device, and a one-deep result queue overlaps each chunk's D2H fetch
-    with the next chunk's compute.  Returns the (n_rows, M) assembly."""
+    with the next chunk's compute.  Returns the (n_rows, M) assembly.
+    ``sharding`` places each chunk directly onto a mesh (window axis over
+    ``data``), so the H2D transfer lands shard-wise instead of bouncing
+    through one device."""
     import numpy as np
 
     from apnea_uq_tpu.data.feed import prefetch_to_device
+    from apnea_uq_tpu.utils.multihost import host_values
 
     x = np.asarray(x, np.float32)
     m = x.shape[0]
@@ -133,16 +144,32 @@ def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute):
 
     out = np.empty((n_rows, n_chunks * batch_size), np.float32)
     pending = None
-    for ci, chunk in enumerate(prefetch_to_device(chunks(), size=prefetch)):
+    # Chunk results come back through the multi-process-safe fetch: on a
+    # process-spanning mesh each per-chunk output is not fully addressable
+    # and a bare np.asarray would raise.  All processes run this loop in
+    # lockstep (same chunks, same order), which host_values requires.
+    for ci, chunk in enumerate(
+        prefetch_to_device(chunks(), size=prefetch, sharding=sharding)
+    ):
         probs = compute(chunk, ci)
         if pending is not None:
             pci, p = pending
-            out[:, pci * batch_size:(pci + 1) * batch_size] = np.asarray(p)
+            out[:, pci * batch_size:(pci + 1) * batch_size] = host_values(p)
         pending = (ci, probs)
     if pending is not None:
         pci, p = pending
-        out[:, pci * batch_size:(pci + 1) * batch_size] = np.asarray(p)
+        out[:, pci * batch_size:(pci + 1) * batch_size] = host_values(p)
     return out[:, :m]
+
+
+def _chunk_sharding(mesh, batch_size: int):
+    """Window-axis sharding for streamed chunks, or None when the chunk
+    does not divide the data axis (the in-jit constraint then reshards)."""
+    if mesh is None:
+        return None
+    if batch_size % mesh.shape[mesh_lib.AXIS_DATA] != 0:
+        return None
+    return NamedSharding(mesh, P(mesh_lib.AXIS_DATA))
 
 
 def mc_dropout_predict_streaming(
@@ -156,6 +183,7 @@ def mc_dropout_predict_streaming(
     key: Optional[jax.Array] = None,
     seed: int = 0,
     prefetch: int = 2,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ) -> "np.ndarray":
     """(T, M) MCD probabilities with the window set streamed from HOST
     memory: chunks flow through the double-buffered prefetch feed
@@ -165,16 +193,26 @@ def mc_dropout_predict_streaming(
     (SURVEY §5.7; replaces the whole-set-as-one-batch pattern of
     uq_techniques.py:22).  Produces bit-identical results to
     :func:`mc_dropout_predict` for the same key.
+
+    ``mesh`` composes both scaling axes: each streamed chunk's T passes
+    shard over ``ensemble`` and its windows over ``data`` (the same
+    layout and key discipline as the in-HBM mesh path), so a test set
+    that exceeds HBM on a pod streams through ALL chips.
     """
     if mode not in _MCD_MODES:
         raise ValueError(f"mode must be 'clean' or 'parity', got {mode!r}")
     if key is None:
         key = prng.stochastic_key(seed)
+    if mesh is not None:
+        repl = mesh_lib.replicated(mesh)
+        variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
     return _stream_chunked(
         x, batch_size, n_passes, prefetch,
         lambda chunk, ci: _mcd_chunk_jit(
-            model, variables, chunk, key, ci, n_passes, _MCD_MODES[mode]
+            model, variables, chunk, key, ci, n_passes, _MCD_MODES[mode],
+            mesh,
         ),
+        sharding=_chunk_sharding(mesh, batch_size),
     )
 
 
@@ -291,6 +329,21 @@ def _ensemble_chunk_jit(model, stacked_variables, chunk):
     return jax.vmap(one_member)(stacked_variables)  # (N, bs)
 
 
+@partial(jax.jit, static_argnames=("model", "mesh"))
+def _ensemble_chunk_mesh_jit(model, stacked_variables, chunk, mesh):
+    """One streamed chunk through the whole ensemble on the mesh: the
+    same explicit shard_map layout as :func:`_ensemble_shard_map_jit` —
+    each device computes its (member-group x window-slice) block of the
+    chunk with purely local math."""
+    f = jax.shard_map(
+        lambda mv, xl: _ensemble_chunk_jit.__wrapped__(model, mv, xl),
+        mesh=mesh,
+        in_specs=(P(mesh_lib.AXIS_ENSEMBLE), P(mesh_lib.AXIS_DATA)),
+        out_specs=P(mesh_lib.AXIS_ENSEMBLE, mesh_lib.AXIS_DATA),
+    )
+    return f(stacked_variables, chunk)
+
+
 def ensemble_predict_streaming(
     model: AlarconCNN1D,
     member_variables,
@@ -298,20 +351,47 @@ def ensemble_predict_streaming(
     *,
     batch_size: int = 2048,
     prefetch: int = 2,
+    mesh: Optional[jax.sharding.Mesh] = None,
 ) -> "np.ndarray":
     """(N, M) deterministic ensemble probabilities with the window set
     streamed from HOST memory (see :func:`mc_dropout_predict_streaming`):
     chunks flow through the prefetch feed, a one-deep result queue
     overlaps D2H with the next chunk's compute, and HBM holds
     O(prefetch x batch_size) windows plus the stacked members.  Identical
-    results to :func:`ensemble_predict` (deterministic eval mode)."""
+    results to :func:`ensemble_predict` (deterministic eval mode).
+
+    ``mesh`` shards each streamed chunk's members over ``ensemble`` and
+    windows over ``data`` (the shard_map layout of the in-HBM mesh path),
+    composing the small-memory and many-chips axes.  The chunk size is
+    rounded up to the data-axis multiple shard_map requires.
+    """
     if isinstance(member_variables, (list, tuple)):
         member_variables = stack_member_variables(list(member_variables))
     n_members = jax.tree.leaves(member_variables)[0].shape[0]
-    return _stream_chunked(
-        x, batch_size, n_members, prefetch,
-        lambda chunk, ci: _ensemble_chunk_jit(model, member_variables, chunk),
+    if mesh is None:
+        return _stream_chunked(
+            x, batch_size, n_members, prefetch,
+            lambda chunk, ci: _ensemble_chunk_jit(model, member_variables, chunk),
+        )
+    d_axis = mesh.shape[mesh_lib.AXIS_DATA]
+    e_axis = mesh.shape[mesh_lib.AXIS_ENSEMBLE]
+    batch_size = -(-batch_size // d_axis) * d_axis
+    member_variables = jax.tree.map(
+        lambda a: _wrap_pad(a, e_axis), member_variables
     )
+    member_variables = jax.tree.map(
+        lambda a: jax.device_put(a, mesh_lib.member_sharding(mesh)),
+        member_variables,
+    )
+    n_padded = jax.tree.leaves(member_variables)[0].shape[0]
+    probs = _stream_chunked(
+        x, batch_size, n_padded, prefetch,
+        lambda chunk, ci: _ensemble_chunk_mesh_jit(
+            model, member_variables, chunk, mesh
+        ),
+        sharding=_chunk_sharding(mesh, batch_size),
+    )
+    return probs[:n_members]
 
 
 def ensemble_predict(

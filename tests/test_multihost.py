@@ -27,6 +27,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow  # two full interpreter+backend boots; minutes of wall
 @pytest.mark.skipif(
     os.environ.get("APNEA_UQ_SKIP_MULTIHOST") == "1",
     reason="multi-process test disabled",

@@ -169,6 +169,8 @@ class TestFitIntegration:
 
 
 class TestEnsembleIntegration:
+    @pytest.mark.slow  # 4 ensemble-epoch compiles; the baseline trainer's
+    # metric integration + streamed parity runs by default (TestFitIntegration)
     def test_history_shapes_and_streaming_parity(self, rng):
         from apnea_uq_tpu.config import EnsembleConfig
         from apnea_uq_tpu.parallel import fit_ensemble
