@@ -78,3 +78,61 @@ def test_bootstrap_cis_ordered(preds, y, seed):
         mean = cis[f"{name}_mean"]
         assert lo <= hi
         assert lo - 1e-9 <= mean <= hi + 1e-9
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    n_groups=st.integers(2, 60),
+    rows_per_group=st.integers(1, 4),
+    test_size=st.floats(0.05, 0.95, allow_nan=False),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_group_split_matches_sklearn_everywhere(n_groups, rows_per_group,
+                                                test_size, seed):
+    """The in-tree GroupShuffleSplit replica vs sklearn over generated
+    (n_groups, test_size, seed) — the r3 review found a rounding
+    divergence a fixed grid missed, so the parity claim is property-
+    checked, including sklearn's raise on an empty train split."""
+    import pytest
+    sklearn_ms = pytest.importorskip("sklearn.model_selection")
+
+    from apnea_uq_tpu.data.sampling import grouped_train_test_split
+
+    groups = np.repeat([f"g{i:03d}" for i in range(n_groups)], rows_per_group)
+    splitter = sklearn_ms.GroupShuffleSplit(
+        n_splits=1, test_size=test_size, random_state=seed
+    )
+    try:
+        tr_ref, te_ref = next(
+            splitter.split(np.zeros(len(groups)), groups=groups)
+        )
+    except ValueError:
+        with pytest.raises(ValueError):
+            grouped_train_test_split(groups, test_size=test_size, seed=seed)
+        return
+    tr, te = grouped_train_test_split(groups, test_size=test_size, seed=seed)
+    np.testing.assert_array_equal(tr, tr_ref)
+    np.testing.assert_array_equal(te, te_ref)
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    n=st.integers(2, 400),
+    num=st.integers(1, 400),
+    data=st.data(),
+)
+def test_fft_resample_matches_scipy_everywhere(n, num, data):
+    """In-tree FFT resample vs scipy.signal.resample over generated
+    (n, num) pairs — both parities of the unpaired-Nyquist special case
+    and the identity path."""
+    import pytest
+    scipy_signal = pytest.importorskip("scipy.signal")
+
+    from apnea_uq_tpu.data.ingest import fft_resample
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31 - 1)))
+    x = rng.normal(size=n)
+    ours = fft_resample(x, num)
+    theirs = scipy_signal.resample(x, num)
+    assert ours.shape == theirs.shape == (num,)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-9, atol=1e-9)

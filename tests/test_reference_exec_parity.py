@@ -32,6 +32,7 @@ from apnea_uq_tpu.uq.bootstrap import (
 from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
 
 REF_PATH = "/root/reference/uncertainty_quantification/uq_techniques.py"
+REF_EVAL_PATH = "/root/reference/evaluation/evaluate_classification.py"
 
 pytestmark = pytest.mark.skipif(
     not os.path.exists(REF_PATH), reason="reference checkout not mounted"
@@ -85,6 +86,10 @@ def _stack(rng, k=7, m=500, kind="uniform"):
         p = rng.uniform(0.0, 1.0, size=(k, m))
     elif kind == "edgy":  # mass near the clip boundaries
         p = np.clip(rng.beta(0.05, 0.05, size=(k, m)), 0.0, 1.0)
+    elif kind == "saturated":  # EXACT 0.0/1.0 entries exercise the eps clip
+        p = rng.uniform(0.0, 1.0, size=(k, m))
+        p[rng.uniform(size=(k, m)) < 0.3] = 0.0
+        p[rng.uniform(size=(k, m)) < 0.3] = 1.0
     elif kind == "constant":
         p = np.full((k, m), 0.37)
     else:
@@ -108,7 +113,7 @@ SCALAR_KEYS = (
 
 
 class TestUqEvaluationDist:
-    @pytest.mark.parametrize("kind", ["uniform", "edgy", "constant"])
+    @pytest.mark.parametrize("kind", ["uniform", "edgy", "saturated", "constant"])
     def test_matches_reference(self, ref, rng, kind):
         preds, y = _stack(rng, kind=kind)
         theirs = ref.uq_evaluation_dist(preds.astype(np.float64), y)
@@ -204,6 +209,88 @@ class TestBootstrapParity:
             for key in theirs:
                 assert ours[key] == pytest.approx(theirs[key], rel=1e-12), (alpha, key)
 
+class TestClassificationEvaluatorParity:
+    """C6: exec the reference's sklearn-based evaluator
+    (evaluate_classification.py:7-153) and compare the framework's
+    in-tree suite value-for-value on the same probabilities."""
+
+    @pytest.fixture(scope="class")
+    def ref_eval(self):
+        pytest.importorskip("sklearn")
+        if not os.path.exists(REF_EVAL_PATH):
+            pytest.skip("reference evaluation module not mounted")
+        spec = importlib.util.spec_from_file_location(
+            "ref_evaluate_classification", REF_EVAL_PATH
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
+    def test_matches_reference_evaluator(self, ref_eval, rng, capsys):
+        from apnea_uq_tpu.evaluation.classification import evaluate_classification
+
+        n = 400
+        probs = rng.uniform(0.0, 1.0, n)
+        probs = probs[np.abs(probs - 0.5) > 1e-6]  # reference thresholds
+        # with strict > 0.5, the framework with >= — identical off 0.5.
+        y = (rng.uniform(size=len(probs)) < 0.35).astype(np.int64)
+
+        class StubModel:
+            def predict(self, x):
+                return probs.reshape(-1, 1)
+
+        theirs = ref_eval.evaluate_classification_model(
+            StubModel(), np.zeros((len(probs), 1)), y
+        )
+        capsys.readouterr()  # swallow the reference's prints
+        assert theirs is not None
+        ours = evaluate_classification(probs, y)
+
+        assert ours["accuracy"] == pytest.approx(theirs["accuracy"], abs=1e-12)
+        assert ours["roc_auc"] == pytest.approx(theirs["roc_auc"], rel=1e-10)
+        assert ours["cohen_kappa"] == pytest.approx(theirs["cohen_kappa"], rel=1e-10)
+        assert ours["mcc"] == pytest.approx(theirs["mcc"], rel=1e-10)
+        assert ours["sensitivity"] == pytest.approx(
+            theirs["overall_sensitivity"], rel=1e-12)
+        assert ours["specificity"] == pytest.approx(
+            theirs["overall_specificity"], rel=1e-12)
+        np.testing.assert_array_equal(
+            np.asarray(ours["confusion_matrix"]), theirs["confusion_matrix"]
+        )
+        # PR-AUC definitions differ by design: the reference trapezoid-
+        # integrates the PR curve (auc(recall, precision)), the framework
+        # uses sklearn-style step-interpolated average precision.  They
+        # agree closely but not exactly.
+        assert ours["pr_auc"] == pytest.approx(theirs["auc_pr"], rel=0.02)
+        # Per-class report values are the same sklearn definitions (the
+        # reference's returned dict uses bare "0"/"1" keys — target_names
+        # only shapes its printed report).
+        for cls in ("0", "1"):
+            for k in ("precision", "recall", "f1-score", "support"):
+                assert ours["report"][cls][k] == pytest.approx(
+                    theirs["classification_report_dict"][cls][k], rel=1e-12
+                ), (cls, k)
+
+    def test_single_class_guard_matches(self, ref_eval, rng, capsys):
+        from apnea_uq_tpu.evaluation.classification import evaluate_classification
+
+        probs = rng.uniform(0.0, 1.0, 50)
+
+        class StubModel:
+            def predict(self, x):
+                return probs
+
+        theirs = ref_eval.evaluate_classification_model(
+            StubModel(), np.zeros((50, 1)), np.ones(50, np.int64)
+        )
+        capsys.readouterr()
+        ours = evaluate_classification(probs, np.ones(50, np.int64))
+        # Both report the undefined AUCs as None and keep going.
+        assert theirs["roc_auc"] is None and ours["roc_auc"] is None
+        assert ours["accuracy"] == pytest.approx(theirs["accuracy"], abs=1e-12)
+
+
+class TestBootstrapOwnStream:
     def test_own_stream_agrees_statistically(self, ref, rng):
         """Our jax-PRNG bootstrap and the reference's np-PRNG bootstrap
         estimate the same sampling distribution: B=400 means must agree
