@@ -240,22 +240,30 @@ def _add_profile_arg(p) -> None:
                         "the SURVEY §5.1 tracing hook.")
 
 
-def _print_run(result) -> None:
-    ev = result.evaluation
-    print(f"=== {result.label} ===")
-    print(f"predict: {result.predict_seconds:.2f}s for "
-          f"{ev.n_passes}x{ev.n_windows} windows")
-    if result.deterministic_classification is not None:
-        print(f"deterministic accuracy: "
-              f"{result.deterministic_classification['accuracy']:.4f}")
-    print(f"stochastic-mean accuracy: {result.classification['accuracy']:.4f}")
-    for k, v in ev.aggregates.items():
-        ci_lo = ev.confidence_intervals.get(f"{k}_ci_lower")
-        ci_hi = ev.confidence_intervals.get(f"{k}_ci_upper")
+def _print_metrics_doc(doc) -> None:
+    """One printer for a run's scalar results — used for live eval output
+    AND the `metrics` read-back, so the two can't drift apart."""
+    print(f"=== {doc['label']} ===")
+    print(f"predict: {doc['predict_seconds']:.2f}s for "
+          f"{doc['n_passes']}x{doc['n_windows']} windows")
+    det = doc.get("deterministic_classification")
+    if det is not None:
+        print(f"deterministic accuracy: {det['accuracy']:.4f}")
+    print(f"stochastic-mean accuracy: {doc['classification']['accuracy']:.4f}")
+    cis = doc["confidence_intervals"]
+    for k, v in doc["aggregates"].items():
+        ci_lo = cis.get(f"{k}_ci_lower")
+        ci_hi = cis.get(f"{k}_ci_upper")
         if ci_lo is not None:
             print(f"  {k}: {v:.6f}  [{ci_lo:.6f}, {ci_hi:.6f}]")
         else:
             print(f"  {k}: {v:.6f}")
+
+
+def _print_run(result) -> None:
+    from apnea_uq_tpu.uq import run_metrics_document
+
+    _print_metrics_doc(run_metrics_document(result))
 
 
 def cmd_eval_mcd(args, config) -> int:
@@ -324,6 +332,37 @@ def cmd_demo(args, config) -> int:
     )
     _print_run(result)
     _emit_plots(args, result)
+    return 0
+
+
+def cmd_metrics(args, config) -> int:
+    """Read back a persisted evaluation's scalar results (the
+    ``metrics:<label>`` JSON artifact written by eval-mcd/eval-de) — the
+    numbers the reference only ever printed to a scrolled-away terminal."""
+    import json
+
+    from apnea_uq_tpu.data import registry as reg
+
+    registry = _registry(args)
+    key = f"{reg.METRICS}:{args.label}"
+    if not registry.exists(key):
+        # exists() also checks the file on disk, so filter the suggestion
+        # list the same way — a manifest entry whose file was deleted must
+        # not be offered as available.
+        have = sorted(
+            k.split(":", 1)[1]
+            for k in registry.manifest()["artifacts"]
+            if k.startswith(f"{reg.METRICS}:") and registry.exists(k)
+        )
+        raise SystemExit(
+            f"no metrics stored for label {args.label!r} "
+            f"(have: {have or 'none'}) — run eval-mcd/eval-de first"
+        )
+    doc = registry.load_json(key)
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    _print_metrics_doc(doc)
     return 0
 
 
@@ -571,6 +610,14 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     _add_no_detailed_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
+
+    p = add("metrics", cmd_metrics,
+            "Print a stored evaluation's aggregates/CIs/accuracy.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--label", required=True,
+                   help="Run label, e.g. CNN_MCD_Unbalanced.")
+    p.add_argument("--json", action="store_true",
+                   help="Dump the raw metrics JSON document.")
 
     p = add("aggregate-patients", cmd_aggregate_patients,
             "Detailed windows -> per-patient summary.")

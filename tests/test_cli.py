@@ -152,6 +152,20 @@ def test_full_pipeline(env, order, capsys):
     # The global variant did not rewrite the per-window CSV.
     assert os.path.getmtime(detailed_csv) == before
 
+    # -- metrics read-back -------------------------------------------------
+    assert run("metrics", "--registry", registry_dir, "--config", config,
+               "--label", "CNN_MCD_Unbalanced") == 0
+    out = capsys.readouterr().out
+    assert "stochastic-mean accuracy" in out and "overall_mean_variance" in out
+    assert run("metrics", "--registry", registry_dir, "--config", config,
+               "--label", "CNN_DE_Unbalanced", "--json") == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["label"] == "CNN_DE_Unbalanced"
+    with pytest.raises(SystemExit, match="no metrics stored"):
+        run("metrics", "--registry", registry_dir, "--config", config,
+            "--label", "NOPE")
+    capsys.readouterr()
+
     # -- aggregate / analyze / correlate ----------------------------------
     assert run("aggregate-patients", "--registry", registry_dir,
                "--config", config, "--label", "CNN_MCD_Unbalanced") == 0
