@@ -27,8 +27,9 @@ bytes so the tunnel's bandwidth doesn't pollute a compute measurement.
 Prints ONE json line with the primary metric in the driver's schema
 ({"metric", "value", "unit", "vs_baseline"}) plus the extra fields above.
 Env knobs: BENCH_WINDOWS/PASSES/CHUNK (MCD), BENCH_MEMBERS/TRAIN_WINDOWS/
-EPOCHS/BATCH (DE), BENCH_METRIC=de_train for the DE metric alone,
-BENCH_SKIP_DE=1 to skip the DE secondary.
+EPOCHS/BATCH/DE_REPS (DE), BENCH_METRIC=de_train for the DE metric alone,
+BENCH_SKIP_DE=1 to skip the DE secondary, BENCH_WATCHDOG_SECS to change
+or disable (0) the hang watchdog (default 45 min).
 """
 
 from __future__ import annotations
@@ -331,13 +332,48 @@ def bench_mcd() -> dict:
     }
 
 
+def _start_watchdog():
+    """Fail loudly instead of hanging the driver's whole budget: the
+    tunneled TPU backend can stall indefinitely at device init (observed:
+    ``jax.devices()`` blocking >5 min during a tunnel outage), and a bench
+    that never prints looks identical to one still working.  After
+    BENCH_WATCHDOG_SECS (default 45 min, 0 disables) emit a
+    machine-readable error line and exit non-zero.  Returns the timer;
+    ``main`` cancels it once results are in hand so a run finishing near
+    the deadline cannot emit both a result line and the error line."""
+    import threading
+
+    secs = float(os.environ.get("BENCH_WATCHDOG_SECS", 2700))
+    if secs <= 0:
+        return None
+
+    def fire():
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0,
+            "unit": "error",
+            "vs_baseline": 0,
+            "error": f"bench did not complete within {secs:.0f}s "
+                     f"(device/tunnel hang?)",
+        }), flush=True)
+        os._exit(3)
+
+    timer = threading.Timer(secs, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def main() -> None:
+    watchdog = _start_watchdog()
     if os.environ.get("BENCH_METRIC") == "de_train":
-        print(json.dumps(bench_de_train()))
-        return
-    result = bench_mcd()
-    if not os.environ.get("BENCH_SKIP_DE"):
-        result["secondary"] = bench_de_train()
+        result = bench_de_train()
+    else:
+        result = bench_mcd()
+        if not os.environ.get("BENCH_SKIP_DE"):
+            result["secondary"] = bench_de_train()
+    if watchdog is not None:
+        watchdog.cancel()
     print(json.dumps(result))
 
 
