@@ -346,13 +346,15 @@ def cmd_metrics(args, config) -> int:
     registry = _registry(args)
     key = f"{reg.METRICS}:{args.label}"
     if not registry.exists(key):
-        # exists() also checks the file on disk, so filter the suggestion
-        # list the same way — a manifest entry whose file was deleted must
-        # not be offered as available.
+        # Like exists(), require the file on disk — a manifest entry whose
+        # file was deleted must not be offered as available.  One manifest
+        # read; per-key checks are plain stat calls.
+        artifacts = registry.manifest()["artifacts"]
         have = sorted(
             k.split(":", 1)[1]
-            for k in registry.manifest()["artifacts"]
-            if k.startswith(f"{reg.METRICS}:") and registry.exists(k)
+            for k, entry in artifacts.items()
+            if k.startswith(f"{reg.METRICS}:")
+            and os.path.exists(os.path.join(registry.root, entry["file"]))
         )
         raise SystemExit(
             f"no metrics stored for label {args.label!r} "

@@ -379,10 +379,7 @@ def ensemble_predict_streaming(
     member_variables = jax.tree.map(
         lambda a: _wrap_pad(a, e_axis), member_variables
     )
-    member_variables = jax.tree.map(
-        lambda a: jax.device_put(a, mesh_lib.member_sharding(mesh)),
-        member_variables,
-    )
+    member_variables = mesh_lib.shard_member_tree(member_variables, mesh)
     n_padded = jax.tree.leaves(member_variables)[0].shape[0]
     probs = _stream_chunked(
         x, batch_size, n_padded, prefetch,
@@ -426,10 +423,7 @@ def ensemble_predict(
             lambda a: _wrap_pad(a, e_axis), member_variables
         )
         x = jax.device_put(x, mesh_lib.replicated(mesh))
-        member_variables = jax.tree.map(
-            lambda a: jax.device_put(a, mesh_lib.member_sharding(mesh)),
-            member_variables,
-        )
+        member_variables = mesh_lib.shard_member_tree(member_variables, mesh)
         probs = _ensemble_shard_map_jit(
             model, member_variables, x, batch_size, mesh
         )

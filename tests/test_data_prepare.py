@@ -142,3 +142,39 @@ class TestRegistry:
         assert not registry.exists("windows")
         registry.save_arrays("windows", {"x": np.zeros(3)})
         assert registry.exists("windows")
+
+    def test_json_roundtrip_and_numpy_conversion(self, tmp_path):
+        registry = ArtifactRegistry(str(tmp_path))
+        doc = {
+            "label": "TEST",
+            "scalar": np.float32(0.5),          # numpy scalar -> float
+            "matrix": np.arange(4).reshape(2, 2),  # ndarray -> nested list
+            "nested": {"values": [1.0, None, "s"]},
+        }
+        registry.save_json("metrics:TEST", doc)
+        back = registry.load_json("metrics:TEST")
+        assert back["scalar"] == 0.5
+        assert back["matrix"] == [[0, 1], [2, 3]]
+        assert back["nested"] == {"values": [1.0, None, "s"]}
+        entry = registry.describe("metrics:TEST")
+        assert entry["kind"] == "json"
+        assert entry["keys"] == ["label", "matrix", "nested", "scalar"]
+        # Overwrite replaces the document (atomic tmp+rename write).
+        registry.save_json("metrics:TEST", {"label": "TEST", "v": 2})
+        assert registry.load_json("metrics:TEST") == {"label": "TEST", "v": 2}
+
+    def test_json_missing_key_raises(self, tmp_path):
+        registry = ArtifactRegistry(str(tmp_path))
+        with pytest.raises(KeyError, match="not in registry"):
+            registry.load_json("metrics:NOPE")
+
+    def test_exists_requires_file_on_disk(self, tmp_path):
+        import os
+
+        registry = ArtifactRegistry(str(tmp_path))
+        path = registry.save_json("metrics:GONE", {"a": 1})
+        assert registry.exists("metrics:GONE")
+        os.remove(path)
+        # manifest entry remains, but the artifact is gone -> not exists
+        assert registry.describe("metrics:GONE") is not None
+        assert not registry.exists("metrics:GONE")

@@ -275,6 +275,37 @@ class TestStreamingMeshComposition:
         assert len({s.device for s in out.addressable_shards}) == 8
         assert all(s.data.shape == (2, 16) for s in out.addressable_shards)
 
+    def test_chunk_sharding_divisibility(self):
+        from apnea_uq_tpu.parallel import make_mesh
+        from apnea_uq_tpu.uq.predict import _chunk_sharding
+
+        mesh = make_mesh(num_members=4)  # (ensemble=4, data=2)
+        assert _chunk_sharding(None, 32) is None
+        s = _chunk_sharding(mesh, 32)  # 32 % 2 == 0 -> shard-wise H2D
+        assert s is not None and s.mesh.shape == mesh.shape
+        # Non-divisible chunk: fall back to unsharded placement (the
+        # in-jit constraint reshards); documented in README/DESIGN.
+        assert _chunk_sharding(mesh, 33) is None
+
+    def test_mcd_streamed_mesh_nondivisible_chunk_still_matches(self, rng):
+        """batch_size not divisible by the data axis takes the fallback
+        H2D path but must still produce the same predictions."""
+        from apnea_uq_tpu.parallel import make_mesh
+        from apnea_uq_tpu.uq import mc_dropout_predict_streaming
+
+        model = _tiny()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(50, 60, 4)).astype(np.float32)
+        key = jax.random.key(2)
+        mesh = make_mesh(num_members=4)  # data axis 2; 25 % 2 != 0
+        streamed = mc_dropout_predict_streaming(
+            model, variables, x, n_passes=4, batch_size=25, key=key, mesh=mesh
+        )
+        single = mc_dropout_predict_streaming(
+            model, variables, x, n_passes=4, batch_size=25, key=key
+        )
+        np.testing.assert_allclose(streamed, single, rtol=1e-6, atol=1e-7)
+
     def test_de_streamed_mesh_matches_in_hbm_mesh(self, rng):
         from apnea_uq_tpu.parallel import make_mesh
         from apnea_uq_tpu.uq import ensemble_predict_streaming
