@@ -346,16 +346,10 @@ def cmd_metrics(args, config) -> int:
     registry = _registry(args)
     key = f"{reg.METRICS}:{args.label}"
     if not registry.exists(key):
-        # Like exists(), require the file on disk — a manifest entry whose
-        # file was deleted must not be offered as available.  One manifest
-        # read; per-key checks are plain stat calls.
-        artifacts = registry.manifest()["artifacts"]
-        have = sorted(
+        have = [
             k.split(":", 1)[1]
-            for k, entry in artifacts.items()
-            if k.startswith(f"{reg.METRICS}:")
-            and os.path.exists(os.path.join(registry.root, entry["file"]))
-        )
+            for k in registry.available(f"{reg.METRICS}:")
+        ]
         raise SystemExit(
             f"no metrics stored for label {args.label!r} "
             f"(have: {have or 'none'}) — run eval-mcd/eval-de first"

@@ -74,6 +74,17 @@ class ArtifactRegistry:
             os.path.join(self.root, entry["file"])
         )
 
+    def available(self, prefix: str = "") -> list:
+        """Keys starting with ``prefix`` whose artifact files exist on
+        disk — the same on-disk requirement as :meth:`exists`, with ONE
+        manifest read for the whole listing."""
+        return sorted(
+            key
+            for key, entry in self.manifest()["artifacts"].items()
+            if key.startswith(prefix)
+            and os.path.exists(os.path.join(self.root, entry["file"]))
+        )
+
     # -- arrays -----------------------------------------------------------
 
     def path_for(self, key: str, suffix: str) -> str:

@@ -476,9 +476,7 @@ def _setup_ensemble_run(
     state = init_ensemble_state(model, n_padded, root_key,
                                 learning_rate=config.learning_rate,
                                 member_indices=member_ids)
-    state = jax.tree.map(
-        lambda a: jax.device_put(a, mesh_lib.member_sharding(mesh)), state
-    )
+    state = mesh_lib.shard_member_tree(state, mesh)
     # The dataset is replicated (every device can gather any batch row
     # locally); per-STEP batches are sharded over the 'data' axis inside
     # _ensemble_epoch, which is where the DP gradient all-reduce comes from.
@@ -504,10 +502,7 @@ def _setup_ensemble_run(
         jnp.full((n_padded,), -1, jnp.int32),                # best_epoch
         jnp.zeros((n_padded,), jnp.int32),                   # epochs_run
     )
-    book = tuple(
-        jax.tree.map(lambda a: jax.device_put(a, mesh_lib.member_sharding(mesh)), b)
-        for b in book
-    )
+    book = tuple(mesh_lib.shard_member_tree(b, mesh) for b in book)
     return _EnsembleRun(
         mesh=mesh, tx=tx, state=state, book=book, x=x, y=y,
         x_val=x_val, y_val=y_val, member_ids=member_ids,

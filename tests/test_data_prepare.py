@@ -173,8 +173,14 @@ class TestRegistry:
 
         registry = ArtifactRegistry(str(tmp_path))
         path = registry.save_json("metrics:GONE", {"a": 1})
+        registry.save_json("metrics:KEPT", {"a": 2})
+        registry.save_arrays("windows", {"x": np.zeros(2)})
         assert registry.exists("metrics:GONE")
+        assert registry.available("metrics:") == ["metrics:GONE", "metrics:KEPT"]
         os.remove(path)
-        # manifest entry remains, but the artifact is gone -> not exists
+        # manifest entry remains, but the artifact is gone -> not exists,
+        # and the availability listing filters it the same way.
         assert registry.describe("metrics:GONE") is not None
         assert not registry.exists("metrics:GONE")
+        assert registry.available("metrics:") == ["metrics:KEPT"]
+        assert registry.available() == ["metrics:KEPT", "windows"]
