@@ -61,24 +61,34 @@ def _stub_tensorflow():
     }
 
 
+def _exec_reference_module(name: str, path: str, stubs: dict):
+    """Exec a reference source file as a module with the given stub
+    modules temporarily installed in sys.modules (restored afterwards,
+    also if the import raises) — shared by every exec-parity fixture."""
+    if not os.path.exists(path):
+        pytest.skip(f"reference module not mounted: {path}")
+    saved = {n: sys.modules.get(n) for n in stubs}
+    sys.modules.update(stubs)
+    try:
+        spec = importlib.util.spec_from_file_location(name, path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+    finally:
+        for n, mod in saved.items():
+            if mod is None:
+                sys.modules.pop(n, None)
+            else:
+                sys.modules[n] = mod
+    return module
+
+
 @pytest.fixture(scope="module")
 def ref():
     """The reference uq_techniques module, exec'd with tf stubbed."""
     os.environ.setdefault("MPLBACKEND", "Agg")
-    stubs = _stub_tensorflow()
-    saved = {name: sys.modules.get(name) for name in stubs}
-    sys.modules.update(stubs)
-    try:
-        spec = importlib.util.spec_from_file_location("ref_uq_techniques", REF_PATH)
-        module = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(module)
-    finally:
-        for name, mod in saved.items():
-            if mod is None:
-                sys.modules.pop(name, None)
-            else:
-                sys.modules[name] = mod
-    return module
+    return _exec_reference_module(
+        "ref_uq_techniques", REF_PATH, _stub_tensorflow()
+    )
 
 
 def _stack(rng, k=7, m=500, kind="uniform"):
@@ -217,14 +227,9 @@ class TestClassificationEvaluatorParity:
     @pytest.fixture(scope="class")
     def ref_eval(self):
         pytest.importorskip("sklearn")
-        if not os.path.exists(REF_EVAL_PATH):
-            pytest.skip("reference evaluation module not mounted")
-        spec = importlib.util.spec_from_file_location(
-            "ref_evaluate_classification", REF_EVAL_PATH
+        return _exec_reference_module(
+            "ref_evaluate_classification", REF_EVAL_PATH, {}
         )
-        module = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(module)
-        return module
 
     def test_matches_reference_evaluator(self, ref_eval, rng, capsys):
         from apnea_uq_tpu.evaluation.classification import evaluate_classification
@@ -308,28 +313,15 @@ class TestPreprocessingParity:
     @pytest.fixture(scope="class")
     def ref_prep(self):
         pytest.importorskip("scipy")
-        if not os.path.exists(REF_PREP_PATH):
-            pytest.skip("reference preprocessing module not mounted")
         stub = types.ModuleType("pyedflib")
 
         class EdfReader:  # import-time placeholder only
             pass
 
         stub.EdfReader = EdfReader
-        saved = sys.modules.get("pyedflib")
-        sys.modules["pyedflib"] = stub
-        try:
-            spec = importlib.util.spec_from_file_location(
-                "ref_preprocess_shhs_raw", REF_PREP_PATH
-            )
-            module = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(module)
-        finally:
-            if saved is None:
-                sys.modules.pop("pyedflib", None)
-            else:
-                sys.modules["pyedflib"] = saved
-        return module
+        return _exec_reference_module(
+            "ref_preprocess_shhs_raw", REF_PREP_PATH, {"pyedflib": stub}
+        )
 
     def test_segment_and_label_matches(self, ref_prep, rng, tmp_path):
         import pandas as pd
@@ -441,6 +433,86 @@ class TestPreprocessingParity:
             rtol=1e-6, atol=1e-5,
         )
         np.testing.assert_array_equal(theirs["THOR RES"], thor)
+
+
+REF_PREPARE_PATH = (
+    "/root/reference/data_prepocessing/prepare_numpy_datasets.py"
+)
+
+
+class TestPrepareParity:
+    """C2: exec the reference's dataset-finalization module (imblearn
+    stubbed — its SMOTE/RUS classes are only touched inside the main
+    driver, not the functions under test) and pin the reshape + per-window
+    standardization math (prepare_numpy_datasets.py:66-95)."""
+
+    @pytest.fixture(scope="class")
+    def ref_prepare(self):
+        pytest.importorskip("sklearn")
+        over = types.ModuleType("imblearn.over_sampling")
+        under = types.ModuleType("imblearn.under_sampling")
+        imblearn = types.ModuleType("imblearn")
+
+        class SMOTE:  # import-time placeholders only
+            pass
+
+        class RandomUnderSampler:
+            pass
+
+        over.SMOTE = SMOTE
+        under.RandomUnderSampler = RandomUnderSampler
+        imblearn.over_sampling = over
+        imblearn.under_sampling = under
+        return _exec_reference_module(
+            "ref_prepare_numpy_datasets", REF_PREPARE_PATH,
+            {"imblearn": imblearn, "imblearn.over_sampling": over,
+             "imblearn.under_sampling": under},
+        )
+
+    def test_standardize_per_window_matches(self, ref_prepare, rng, capsys):
+        from apnea_uq_tpu.data.prepare import standardize_per_window
+
+        x = rng.normal(2.0, 3.0, size=(50, 60, 4))
+        x[7, :, 2] = 5.0  # constant channel-in-window: eps guard path
+        theirs = ref_prepare.standardize_per_window(x.copy())
+        capsys.readouterr()
+        np.testing.assert_allclose(
+            standardize_per_window(x.astype(np.float32)), theirs,
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_reshape_matches_csv_interop(self, ref_prepare, rng, capsys,
+                                         tmp_path):
+        """The reference reshapes the flattened CSV features with a plain
+        C-order reshape (steps, features); windows_from_reference_csv must
+        land every value in the same (window, t, ch) cell."""
+        import pandas as pd
+
+        from apnea_uq_tpu.data import WindowSet
+        from apnea_uq_tpu.data.ingest import (
+            windows_from_reference_csv, windows_to_reference_csv,
+        )
+
+        channels = ("SaO2", "PR", "THOR RES", "ABDO RES")
+        n = 12
+        x = rng.normal(size=(n, 60, 4)).astype(np.float32)
+        ws = WindowSet(
+            x=x, y=rng.integers(0, 2, n).astype(np.int8),
+            patient_ids=np.asarray([f"P{i}" for i in range(n)]),
+            start_time_s=(np.arange(n) * 60).astype(np.int32),
+            channels=channels,
+        )
+        path = str(tmp_path / "flat.csv")
+        windows_to_reference_csv(ws, path)
+        frame = pd.read_csv(path)
+        flat = frame[ref_prepare.FEATURE_COLS].to_numpy()
+        theirs = ref_prepare.reshape_flat_to_3d(flat, 60, 4)
+        capsys.readouterr()
+        back = windows_from_reference_csv(path)
+        np.testing.assert_allclose(theirs, x, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(back.x, theirs, rtol=1e-6, atol=1e-7)
+        with pytest.raises(ValueError):
+            ref_prepare.reshape_flat_to_3d(flat[:, :-1], 60, 4)
 
 
 class TestBootstrapOwnStream:
