@@ -104,8 +104,14 @@ class UQConfig:
     ``mcd_mode`` selects the stochastic-pass semantics:
 
     - ``'parity'``: dropout on AND batch-norm in batch-statistics mode —
-      byte-for-byte the reference's ``model(x, training=True)``
-      (uq_techniques.py:22), the regime behind its ~77% MCD accuracy.
+      the reference's ``model(x, training=True)`` regime
+      (uq_techniques.py:22), behind its ~77% MCD accuracy.  BN batch
+      statistics are computed per ``mcd_batch_size`` chunk; the reference
+      used the whole test set as ONE batch, so exact reproduction of that
+      detail needs ``mcd_batch_size`` equal to the window count (a
+      non-multiple chunk wrap-pads some windows more than others; the
+      drivers warn whenever the chunk is not an exact multiple of the
+      set).
     - ``'clean'``: dropout on, batch-norm frozen at running statistics —
       the methodologically standard MC Dropout.  Accuracy stays near the
       deterministic ~88%.
@@ -122,8 +128,10 @@ class UQConfig:
     mcd_mode: str = "clean"
     # Stream MCD / DE window chunks from host memory
     # (mc_dropout_predict_streaming / ensemble_predict_streaming) instead
-    # of holding the test set in HBM; single-device (the mesh is not used
-    # on these paths), identical results.
+    # of holding the test set in HBM; identical results to the in-HBM
+    # paths.  Streaming composes with the mesh: each chunk's passes /
+    # members shard over the 'ensemble' axis and its windows over 'data',
+    # so HBM-exceeding sets stream through ALL chips.
     mcd_streaming: bool = False
     de_streaming: bool = False
     # Windows per device chunk.  MCD's T axis multiplies the activation

@@ -146,12 +146,24 @@ def fft_resample(signal: np.ndarray, target_length: int) -> np.ndarray:
     (truncate/zero-pad the rfft spectrum, with the doubled/halved unpaired
     Nyquist bin when min(n, num) is even), verified against scipy to
     1e-12 in tests/test_data_ingest.py.  ``num == n`` returns a copy
-    without the FFT round-trip (scipy's round-trip differs by ~1 ulp)."""
-    signal = np.asarray(signal, dtype=np.float64)
+    without the FFT round-trip (scipy's round-trip differs by ~1 ulp).
+
+    The output dtype follows scipy: float32 in -> float32 out, float16
+    promotes to float32, integer and other inputs promote to float64.
+    The FFT itself runs in float64 regardless — numpy has no
+    single-precision FFT — so a float32 input matches scipy's float32
+    path to float32 roundoff (scipy computes the transform in single
+    precision), while float64 matches to 1e-12."""
+    signal = np.asarray(signal)
+    out_dtype = (
+        np.result_type(signal.dtype, np.float32)
+        if np.issubdtype(signal.dtype, np.floating) else np.float64
+    )
+    signal = signal.astype(np.float64, copy=False)
     n = signal.shape[0]
     num = int(target_length)
     if num == n:
-        return signal.copy()
+        return signal.astype(out_dtype, copy=True)
     if n == 0 or num <= 0:
         raise ValueError(f"cannot resample length {n} to {num}")
     spectrum = np.fft.rfft(signal)
@@ -161,7 +173,7 @@ def fft_resample(signal: np.ndarray, target_length: int) -> np.ndarray:
         # The unpaired bin at m//2: its conjugate partner is folded in on
         # down-sampling (x2) or split back out on up-sampling (x0.5).
         spectrum[m // 2] *= 2.0 if num < n else 0.5
-    return np.fft.irfft(spectrum * (num / n), n=num)
+    return np.fft.irfft(spectrum * (num / n), n=num).astype(out_dtype, copy=False)
 
 
 def label_windows(

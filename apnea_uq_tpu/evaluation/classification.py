@@ -152,7 +152,10 @@ def evaluate_classification(
     """
     probs = _as1d(probs).astype(np.float64)
     y_true = _as1d(y_true).astype(np.int64)
-    y_pred = (probs >= threshold).astype(np.int64)
+    # Strictly greater, matching the reference's tie-break exactly
+    # (evaluate_classification.py:49, analyze_mcd_patient_level.py:117):
+    # a probability of exactly `threshold` predicts class 0.
+    y_pred = (probs > threshold).astype(np.int64)
 
     cm = confusion_matrix_2x2(y_true, y_pred)
     tn, fp, fn, tp = int(cm[0, 0]), int(cm[0, 1]), int(cm[1, 0]), int(cm[1, 1])

@@ -100,7 +100,9 @@ def accuracy_update(
     ``mask`` is a {0, 1} inclusion mask, not fractional weights.
     Non-finite probabilities are excluded, matching histogram_update."""
     mask = mask.astype(jnp.float32) * jnp.isfinite(probs).astype(jnp.float32)
-    pred = (probs >= threshold).astype(jnp.float32)
+    # Strictly greater: Keras BinaryAccuracy and the reference evaluator
+    # (evaluate_classification.py:49) both send exactly-threshold to 0.
+    pred = (probs > threshold).astype(jnp.float32)
     correct = jnp.sum(mask * (pred == labels.astype(jnp.float32)))
     return counts + jnp.stack([correct, jnp.sum(mask)]).astype(jnp.int32)
 

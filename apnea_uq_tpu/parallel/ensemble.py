@@ -597,6 +597,14 @@ def fit_ensemble(
     (ops/streaming_auc.py) to the history: (epochs, N) arrays
     ``accuracy``/``auc``/``val_accuracy``/``val_auc`` — the reference
     ensemble trainer's Keras compile metrics.
+
+    Cost note (vmap packing): members train in lockstep over the mesh's
+    ensemble axis, so the member count is padded up to a multiple of that
+    axis and the padded slots train real epochs whose weights are then
+    discarded — e.g. N=10 on an 8-wide axis runs 16 member-slots, a 60%
+    compute overhead.  The overhead is logged at startup via ``log_fn``;
+    to avoid it, pick N a multiple of (or dividing) the ensemble axis, or
+    shrink the axis via ``MeshConfig.ensemble_axis``.
     """
     if streaming is None:
         streaming = config.streaming
@@ -604,6 +612,15 @@ def fit_ensemble(
         model, x_train, y_train, config, mesh, root_key, member_indices,
         streaming=streaming,
     )
+    if log_fn and run.n_padded > run.n_members:
+        waste = run.n_padded - run.n_members
+        log_fn(
+            f"ensemble axis {run.mesh.shape[mesh_lib.AXIS_ENSEMBLE]} pads "
+            f"{run.n_members} members to {run.n_padded} lockstep slots: "
+            f"{waste} discarded slot(s) = "
+            f"{100.0 * waste / run.n_members:.0f}% extra compute over the "
+            f"requested members"
+        )
     mesh = run.mesh
     tx, state, book = run.tx, run.state, run.book
     x, y, x_val, y_val = run.x, run.y, run.x_val, run.y_val

@@ -188,7 +188,7 @@ def detailed_frame(
         COL_PATIENT: patient_ids,
         COL_WINDOW: np.arange(m),
         COL_TRUE_LABEL: y_true.astype(np.int64),
-        COL_PRED_LABEL: (mean_prob >= threshold).astype(np.int64),
+        COL_PRED_LABEL: (mean_prob > threshold).astype(np.int64),
         COL_PROB: mean_prob.astype(np.float64),
         COL_VARIANCE: variance.astype(np.float64),
         COL_ENTROPY: entropy.astype(np.float64),
@@ -272,6 +272,24 @@ def run_mcd_analysis(
         predict_key = prng.stochastic_key(seed)
     if bootstrap_key is None:
         bootstrap_key = prng.bootstrap_key(seed)
+    if config.mcd_mode == "parity" and config.mcd_batch_size % len(x) != 0:
+        # The reference ran the WHOLE test set as one batch, so its BN
+        # batch statistics are whole-set.  Chunk statistics match that
+        # only when every window appears equally often in one chunk —
+        # i.e. mcd_batch_size is an exact multiple of the window count
+        # (smaller chunks see subsets; a larger non-multiple chunk
+        # wrap-pads some windows more than others, skewing the batch
+        # mean/variance).  Surface this so parity numbers are never
+        # silently chunk-stat numbers.
+        import warnings
+        warnings.warn(
+            f"mcd_mode='parity' with mcd_batch_size={config.mcd_batch_size}"
+            f" and {len(x)} windows: BatchNorm statistics are computed per"
+            " (wrap-padded) chunk, not over the whole set as in the"
+            " reference's model(x, training=True).  Set mcd_batch_size"
+            " equal to the window count for exact parity.",
+            stacklevel=2,
+        )
     with Timer(f"{label}.predict") as t:
         if config.mcd_streaming:
             # Host-streamed chunks for sets that exceed HBM; identical

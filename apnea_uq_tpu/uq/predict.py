@@ -197,13 +197,24 @@ def mc_dropout_predict_streaming(
     ``mesh`` composes both scaling axes: each streamed chunk's T passes
     shard over ``ensemble`` and its windows over ``data`` (the same
     layout and key discipline as the in-HBM mesh path), so a test set
-    that exceeds HBM on a pod streams through ALL chips.
+    that exceeds HBM on a pod streams through ALL chips.  The chunk size
+    is rounded up to the data-axis multiple (as the DE streamed path
+    does) so chunks place shard-wise; when that rounding changes the
+    chunk size, results equal :func:`mc_dropout_predict` called with the
+    ROUNDED ``batch_size`` (chunk boundaries feed the per-chunk RNG
+    fold).
     """
     if mode not in _MCD_MODES:
         raise ValueError(f"mode must be 'clean' or 'parity', got {mode!r}")
     if key is None:
         key = prng.stochastic_key(seed)
     if mesh is not None:
+        # Round the chunk up to the data-axis multiple (as the DE path
+        # does) so chunks get placed shard-wise; otherwise they land on
+        # one local device, which fails outright on a process-spanning
+        # mesh where the global-mesh jit needs every shard addressable.
+        d_axis = mesh.shape[mesh_lib.AXIS_DATA]
+        batch_size = -(-batch_size // d_axis) * d_axis
         repl = mesh_lib.replicated(mesh)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
     return _stream_chunked(
@@ -238,9 +249,11 @@ def mc_dropout_predict(
 
     ``mode='parity'`` reproduces the reference's ``training=True`` regime
     (dropout + batch-statistics BatchNorm, uq_techniques.py:22).  Note that
-    in parity mode batch statistics are computed per ``batch_size`` chunk;
-    the reference used the entire test set as one batch, so pass
-    ``batch_size >= len(x)`` for exact parity of that detail.
+    in parity mode batch statistics are computed per (wrap-padded)
+    ``batch_size`` chunk; the reference used the entire test set as one
+    batch, so pass ``batch_size`` equal to ``len(x)`` (or an exact
+    multiple — wrap-padding then repeats every window equally) for exact
+    parity of that detail.
     ``mode='clean'`` freezes BatchNorm at running statistics (standard MC
     Dropout; SURVEY §6).
 

@@ -28,8 +28,10 @@ Prints ONE json line with the primary metric in the driver's schema
 ({"metric", "value", "unit", "vs_baseline"}) plus the extra fields above.
 Env knobs: BENCH_WINDOWS/PASSES/CHUNK (MCD), BENCH_MEMBERS/TRAIN_WINDOWS/
 EPOCHS/BATCH/DE_REPS (DE), BENCH_METRIC=de_train for the DE metric alone,
-BENCH_SKIP_DE=1 to skip the DE secondary, BENCH_WATCHDOG_SECS to change
-or disable (0) the hang watchdog (default 45 min).
+BENCH_SKIP_DE=1 to skip the DE secondary, BENCH_SKIP_STREAMED=1 to skip
+the streamed-overhead context, BENCH_DE_CHUNK for its DE chunk size,
+BENCH_WATCHDOG_SECS to change or disable (0) the hang watchdog
+(default 45 min).
 """
 
 from __future__ import annotations
@@ -212,6 +214,67 @@ def bench_bootstrap(n_windows: int, n_boot: int = 100, n_chain: int = 10) -> dic
     }
 
 
+def bench_streamed(model, variables, x_host, n_passes, chunk) -> dict:
+    """Streamed-vs-in-HBM overhead at identical shapes (r3 verdict item 5):
+    streaming is the framework's scaling story for HBM-exceeding test sets
+    (replacing the whole-set-as-one-batch pattern of uq_techniques.py:22),
+    and "identical results" was proven in tests while its single-chip cost
+    was unmeasured.  Both paths are timed end-to-end INCLUDING host
+    assembly of the full (T, M)/(N, M) result — that is what a user of
+    either path gets — so the ratio is the true cost of keeping the
+    window set in host memory.  MCD streams T stochastic passes; DE
+    streams a 10-member deterministic ensemble."""
+    from apnea_uq_tpu.models import init_variables
+    from apnea_uq_tpu.uq import (
+        ensemble_predict,
+        ensemble_predict_streaming,
+        mc_dropout_predict,
+        mc_dropout_predict_streaming,
+    )
+    from apnea_uq_tpu.uq.predict import stack_member_variables
+    from apnea_uq_tpu.utils import prng
+
+    def t_end_to_end(fn, reps=2):
+        fn()  # warmup/compile
+        best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    key = prng.stochastic_key(1)
+    t_mcd_hbm = t_end_to_end(lambda: np.asarray(mc_dropout_predict(
+        model, variables, x_host, n_passes=n_passes, mode="clean",
+        batch_size=chunk, key=key,
+    )))
+    t_mcd_str = t_end_to_end(lambda: mc_dropout_predict_streaming(
+        model, variables, x_host, n_passes=n_passes, mode="clean",
+        batch_size=chunk, key=key,
+    ))
+
+    n_members = 10
+    members = stack_member_variables([
+        init_variables(model, jax.random.key(s)) for s in range(n_members)
+    ])
+    de_chunk = int(os.environ.get("BENCH_DE_CHUNK", 2048))
+    t_de_hbm = t_end_to_end(lambda: np.asarray(ensemble_predict(
+        model, members, x_host, batch_size=de_chunk,
+    )))
+    t_de_str = t_end_to_end(lambda: ensemble_predict_streaming(
+        model, members, x_host, batch_size=de_chunk,
+    ))
+    return {
+        "mcd_inhbm_s": round(t_mcd_hbm, 3),
+        "mcd_streamed_s": round(t_mcd_str, 3),
+        "mcd_streamed_vs_inhbm": round(t_mcd_str / t_mcd_hbm, 3),
+        "de10_inhbm_s": round(t_de_hbm, 3),
+        "de10_streamed_s": round(t_de_str, 3),
+        "de10_streamed_vs_inhbm": round(t_de_str / t_de_hbm, 3),
+        "de_chunk": de_chunk,
+    }
+
+
 def bench_mcd() -> dict:
     from apnea_uq_tpu.config import ModelConfig
     from apnea_uq_tpu.models import AlarconCNN1D, apply_model, init_variables, predict_proba
@@ -328,6 +391,14 @@ def bench_mcd() -> dict:
             # windows, SURVEY §1), where the exact engine's gather cost is
             # representative.
             "bootstrap_b100_m293k": bench_bootstrap(293_000),
+            # Host-streamed vs in-HBM inference at the same shapes — the
+            # measured cost of the HBM-exceeding-set scaling path.
+            "streamed_overhead": (
+                None if os.environ.get("BENCH_SKIP_STREAMED")
+                else bench_streamed(
+                    model, variables, np.asarray(x), n_passes, chunk
+                )
+            ),
         },
     }
 

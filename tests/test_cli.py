@@ -302,3 +302,113 @@ def test_init_config(tmp_path, capsys):
     with open(out_path) as f:
         data = json.load(f)
     assert set(data) >= {"model", "train", "ensemble", "uq"}
+
+
+def test_ingest_to_figures_single_registry(tmp_path, capsys):
+    """The whole pipeline in ONE continuous run from raw signals: synthetic
+    EDF+XML -> ingest -> prepare -> train -> train-ensemble -> eval-mcd ->
+    eval-de -> aggregate -> analyze -> correlate -> figures, every stage
+    consuming the registry the previous stage wrote.  This crosses the
+    L1->L2 seam (SURVEY §1: `SHHS2_ID_all_60.csv` ->
+    prepare_numpy_datasets.py:61) inside a single registry — the seam the
+    reference's drifted filename contracts broke — where
+    test_full_pipeline starts from a pre-seeded windows artifact."""
+    from apnea_uq_tpu.data.edf import EdfSignal, write_edf
+
+    rng = np.random.default_rng(5)
+    edf_dir = tmp_path / "edf"
+    xml_dir = tmp_path / "xml"
+    edf_dir.mkdir()
+    xml_dir.mkdir()
+    n_seconds = 1800  # 30 windows per recording
+    for i in range(6):
+        patient = f"20010{i}"
+        # An apnea run in the first half of each recording gives every
+        # patient positive AND negative windows, so any patient split
+        # leaves both classes on both sides (RUS/metrics need that).
+        signals = [
+            EdfSignal("SaO2", 1.0,
+                      (95 + rng.normal(0, 1, n_seconds)).astype(np.float32)),
+            EdfSignal("PR", 1.0,
+                      (70 + rng.normal(0, 5, n_seconds)).astype(np.float32)),
+            EdfSignal("THOR RES", 10.0,
+                      rng.normal(0, 0.5, 10 * n_seconds).astype(np.float32)),
+            EdfSignal("ABDO RES", 10.0,
+                      rng.normal(0, 0.5, 10 * n_seconds).astype(np.float32)),
+        ]
+        write_edf(str(edf_dir / f"shhs2-{patient}.edf"), signals)
+        (xml_dir / f"shhs2-{patient}-nsrr.xml").write_text(
+            """<?xml version="1.0"?>
+<PSGAnnotation><ScoredEvents>
+<ScoredEvent><EventType>Recording Start Time</EventType>
+<EventConcept>Recording Start Time</EventConcept>
+<Start>0</Start><Duration>25200</Duration></ScoredEvent>
+<ScoredEvent><EventType>Respiratory|Respiratory</EventType>
+<EventConcept>Obstructive apnea|Obstructive Apnea</EventConcept>
+<Start>70</Start><Duration>50</Duration></ScoredEvent>
+<ScoredEvent><EventType>Respiratory|Respiratory</EventType>
+<EventConcept>Hypopnea|Hypopnea</EventConcept>
+<Start>400</Start><Duration>40</Duration></ScoredEvent>
+</ScoredEvents></PSGAnnotation>
+"""
+        )
+
+    registry_dir = str(tmp_path / "registry")
+    config = ExperimentConfig(
+        model=ModelConfig(features=(4, 6), kernel_sizes=(3, 3),
+                          dropout_rates=(0.2, 0.3)),
+        train=TrainConfig(batch_size=32, num_epochs=1, validation_split=0.1,
+                          seed=1),
+        ensemble=EnsembleConfig(num_members=2, num_epochs=1, batch_size=32,
+                                seed_base=2025),
+        uq=UQConfig(mc_passes=4, n_bootstrap=10, inference_batch_size=64,
+                    mcd_batch_size=64),
+        prepare=PrepareConfig(smote=False),
+    )
+    config_path = str(tmp_path / "config.json")
+    with open(config_path, "w") as f:
+        json.dump(_to_jsonable(config), f)
+
+    # L1: raw EDF/XML -> windows artifact.
+    assert run("ingest", "--edf-dir", str(edf_dir), "--xml-dir", str(xml_dir),
+               "--registry", registry_dir) == 0
+    assert "processed 6 recordings" in capsys.readouterr().out
+    registry = ArtifactRegistry(registry_dir)
+    arrays = registry.load_arrays(reg.WINDOWS)
+    assert arrays["x"].shape == (180, 60, 4)
+    assert 0 < arrays["y"].sum() < 180  # both classes ingested
+
+    # L2 consumes L1's output in place — the seam under test.
+    assert run("prepare", "--registry", registry_dir, "--config",
+               config_path) == 0
+    capsys.readouterr()
+    assert registry.exists(reg.TEST_STD_UNBALANCED)
+
+    # L3 -> L5 -> L6 -> L7 on the same registry.
+    assert run("train", "--registry", registry_dir, "--config",
+               config_path) == 0
+    assert run("train-ensemble", "--registry", registry_dir, "--config",
+               config_path) == 0
+    assert run("eval-mcd", "--registry", registry_dir, "--config",
+               config_path) == 0
+    assert run("eval-de", "--registry", registry_dir, "--config",
+               config_path, "--num-members", "2") == 0
+    assert run("aggregate-patients", "--registry", registry_dir, "--config",
+               config_path, "--label", "CNN_MCD_Unbalanced") == 0
+    assert run("analyze-windows", "--registry", registry_dir, "--config",
+               config_path, "--label", "CNN_MCD_Unbalanced") == 0
+    assert run("correlate", "--registry", registry_dir, "--config",
+               config_path, "--labels", "CNN_MCD_Unbalanced") == 0
+    capsys.readouterr()
+    fig_dir = str(tmp_path / "figs")
+    assert run("figures", "--registry", registry_dir, "--config", config_path,
+               "--labels", "CNN_MCD_Unbalanced", "CNN_DE_Unbalanced",
+               "--out-dir", fig_dir) == 0
+    capsys.readouterr()
+    assert len(os.listdir(fig_dir)) == 5
+    # Patient-level artifacts trace back to the ingested recordings
+    # (numeric-string IDs come back as ints from the CSV round-trip).
+    summary = registry.load_table(f"{reg.PATIENT_SUMMARY}:CNN_MCD_Unbalanced")
+    assert set(summary["Patient_ID"].astype(str)).issubset(
+        {f"20010{i}" for i in range(6)}
+    )

@@ -44,6 +44,24 @@ class TestFftResample:
         with pytest.raises(ValueError):
             fft_resample(np.empty(0), 10)
 
+    def test_preserves_floating_dtype(self, rng):
+        """scipy.signal.resample preserves a float32 input's dtype and
+        promotes integers to float64; match both (values to float32
+        roundoff — the in-tree FFT runs in double precision)."""
+        scipy_signal = pytest.importorskip("scipy.signal")
+        x32 = rng.normal(size=60).astype(np.float32)
+        ours = fft_resample(x32, 40)
+        theirs = scipy_signal.resample(x32, 40)
+        assert ours.dtype == theirs.dtype == np.float32
+        np.testing.assert_allclose(ours, theirs, rtol=2e-5, atol=2e-5)
+        assert fft_resample(x32, 60).dtype == np.float32  # identity path
+        xi = rng.integers(0, 100, size=60)
+        assert fft_resample(xi, 40).dtype == np.float64
+        # float16 promotes to float32, as scipy does.
+        x16 = x32.astype(np.float16)
+        assert fft_resample(x16, 40).dtype == np.float32
+        assert scipy_signal.resample(x16, 40).dtype == np.float32
+
 APNEA = "Obstructive apnea|Obstructive Apnea"
 HYPO = "Hypopnea|Hypopnea"
 

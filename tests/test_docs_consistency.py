@@ -109,3 +109,61 @@ def test_documented_flags_exist_per_subcommand():
                 )
                 checked += 1
     assert checked >= 10, "flag extraction matched suspiciously few flags"
+
+
+def test_config_streaming_comments_track_mesh_support():
+    """The UQConfig streaming comment rotted in r3: it said "the mesh is
+    not used on these paths" in the same round the streamed predictors
+    gained mesh composition.  Dataclass comments are user-facing docs
+    too, so pin the claim to the code: the streamed predictors DO take a
+    mesh, and no config comment may deny it."""
+    import inspect
+
+    from apnea_uq_tpu import config as config_mod
+    from apnea_uq_tpu.uq import predict
+
+    for fn in (predict.mc_dropout_predict_streaming,
+               predict.ensemble_predict_streaming):
+        assert "mesh" in inspect.signature(fn).parameters, (
+            f"{fn.__name__} lost its mesh parameter; update the UQConfig "
+            "streaming comment (and this test) to match"
+        )
+    src = inspect.getsource(config_mod)
+    for stale in ("mesh is not used", "single-device (the mesh"):
+        assert stale not in src, (
+            f"config.py claims {stale!r} but the streamed predictors "
+            "compose with the mesh"
+        )
+    # The comment block above mcd_streaming must acknowledge the mesh
+    # composition positively, not just avoid denying it.
+    uq_src = inspect.getsource(config_mod.UQConfig)
+    comment = uq_src.split("mcd_streaming: bool")[0].rsplit("# Stream", 1)[-1]
+    assert "mesh" in comment, (
+        "the UQConfig streaming comment no longer mentions how streaming "
+        "composes with the mesh"
+    )
+
+
+def test_parity_mode_docstrings_agree_on_chunk_stats():
+    """r3 shipped contradictory docs: UQConfig called 'parity' mode
+    "byte-for-byte the reference" while mc_dropout_predict documented
+    that exact parity needs batch_size >= len(x) (BN statistics are
+    per-chunk).  Both docstrings must state the whole-set-batch caveat,
+    and neither may overclaim byte-for-byte."""
+    from apnea_uq_tpu.config import UQConfig
+    from apnea_uq_tpu.uq.predict import mc_dropout_predict
+
+    for name, doc in (("UQConfig", UQConfig.__doc__),
+                      ("mc_dropout_predict", mc_dropout_predict.__doc__)):
+        assert "byte-for-byte" not in doc, f"{name} overclaims exact parity"
+        # '>=' was itself an overclaim (a larger non-multiple chunk
+        # wrap-pads windows unevenly into the BN batch statistics); the
+        # docs must advise equality, not >=.
+        assert ">= len(x)" not in doc and ">= the window count" not in doc, (
+            f"{name} advises batch_size >= the set, but wrap-padding "
+            "makes only exact multiples match whole-set BN statistics"
+        )
+        assert "equal to the window count" in doc or "equal to ``len(x)``" in doc, (
+            f"{name} no longer documents that exact parity-mode BN "
+            "statistics need the whole set in one batch"
+        )

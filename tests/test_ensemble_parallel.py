@@ -75,6 +75,30 @@ def test_member_count_not_multiple_of_mesh(rng):
     assert probs.shape == (5, 16)
 
 
+def test_padded_member_cost_is_logged(rng):
+    """Lockstep vmap packing pads N up to the ensemble-axis multiple and
+    trains throwaway slots (SURVEY §2.3's 8+2 case); fit_ensemble must
+    name that cost up front instead of silently charging it — and stay
+    quiet when nothing is padded."""
+    model = _tiny()
+    x, y = _data(rng, n=128)
+    cfg = EnsembleConfig(num_members=3, num_epochs=1, batch_size=64,
+                         validation_split=0.25)
+    lines = []
+    fit_ensemble(model, x, y, cfg, mesh=make_mesh(8), log_fn=lines.append)
+    pad_lines = [l for l in lines if "discarded slot" in l]
+    # 3 members on the auto (ensemble=8 -> padded to 8)... the mesh
+    # factorization decides; assert the message matches the actual pad.
+    assert len(pad_lines) == 1, lines
+    assert "3 members" in pad_lines[0]
+
+    cfg4 = EnsembleConfig(num_members=4, num_epochs=1, batch_size=64,
+                          validation_split=0.25)
+    lines4 = []
+    fit_ensemble(model, x, y, cfg4, mesh=make_mesh(4), log_fn=lines4.append)
+    assert not any("discarded slot" in l for l in lines4), lines4
+
+
 def test_per_member_early_stopping_bookkeeping(rng):
     model = _tiny()
     x, y = _data(rng, n=384)

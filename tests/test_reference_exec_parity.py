@@ -34,6 +34,21 @@ from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
 REF_PATH = "/root/reference/uncertainty_quantification/uq_techniques.py"
 REF_EVAL_PATH = "/root/reference/evaluation/evaluate_classification.py"
 
+# Exec'ing the mounted reference grants it in-process code execution, so
+# each file is pinned to the sha256 of the snapshot that was reviewed
+# (2025-05-23 checkout); a drifted file is skipped, never executed.
+# Re-review and re-pin when the mounted snapshot legitimately updates.
+_REVIEWED_SHA256 = {
+    REF_PATH:
+        "1b7b8f98b9cfc3b765b2f0d9c46a6db1d2ecaf4b5ccd055a7eb6c79e8978f723",
+    REF_EVAL_PATH:
+        "9b0f21f04ab54437d36414feea3754052902e28379035b193bc0038d5663db14",
+    "/root/reference/data_prepocessing/preprocess_shhs_raw.py":
+        "e7dc5a2cde88c1c05fa6597cb07accb4b9cfb52b966494a0e072d54de0163ee8",
+    "/root/reference/data_prepocessing/prepare_numpy_datasets.py":
+        "8e985cd220ab08d822f42c601883a95d8363575d174b99f173489390412f0282",
+}
+
 pytestmark = pytest.mark.skipif(
     not os.path.exists(REF_PATH), reason="reference checkout not mounted"
 )
@@ -64,9 +79,25 @@ def _stub_tensorflow():
 def _exec_reference_module(name: str, path: str, stubs: dict):
     """Exec a reference source file as a module with the given stub
     modules temporarily installed in sys.modules (restored afterwards,
-    also if the import raises) — shared by every exec-parity fixture."""
+    also if the import raises) — shared by every exec-parity fixture.
+    The file must hash to its reviewed checksum (_REVIEWED_SHA256) or it
+    is skipped without executing, so untrusted drift in the mount cannot
+    run in-process."""
+    import hashlib
+
     if not os.path.exists(path):
         pytest.skip(f"reference module not mounted: {path}")
+    pinned = _REVIEWED_SHA256.get(path)
+    if pinned is None:
+        pytest.skip(f"no reviewed checksum pinned for {path}; refusing exec")
+    with open(path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    if digest != pinned:
+        pytest.skip(
+            f"mounted reference {path} does not match its reviewed "
+            f"checksum ({digest[:12]}... != {pinned[:12]}...); refusing "
+            "to exec unreviewed content — re-review and re-pin"
+        )
     saved = {n: sys.modules.get(n) for n in stubs}
     sys.modules.update(stubs)
     try:
@@ -236,8 +267,9 @@ class TestClassificationEvaluatorParity:
 
         n = 400
         probs = rng.uniform(0.0, 1.0, n)
-        probs = probs[np.abs(probs - 0.5) > 1e-6]  # reference thresholds
-        # with strict > 0.5, the framework with >= — identical off 0.5.
+        # Exactly-0.5 rows included deliberately: both sides threshold
+        # strictly (> 0.5 -> positive), so ties predict class 0 on both.
+        probs[:8] = 0.5
         y = (rng.uniform(size=len(probs)) < 0.35).astype(np.int64)
 
         class StubModel:

@@ -139,6 +139,38 @@ class TestEndToEnd:
         assert result.detailed is None
         assert result.deterministic_classification is None
 
+    def test_parity_mode_chunked_bn_warns(self, setup):
+        """parity mode with mcd_batch_size < the window count computes
+        per-chunk BN statistics (the reference's batch was the whole
+        set), so the driver must warn rather than silently produce
+        non-reference parity numbers; whole-set and clean-mode runs must
+        stay silent."""
+        import dataclasses
+        import warnings
+
+        model, variables, x, y, _ = setup
+        chunked = UQConfig(mc_passes=2, n_bootstrap=5, mcd_mode="parity",
+                           mcd_batch_size=32, inference_batch_size=64)
+        for warned in (
+            chunked,  # smaller chunk: per-chunk subsets
+            # larger but NOT a multiple of the 64 windows: wrap-padding
+            # repeats some windows more than others in the BN batch.
+            dataclasses.replace(chunked, mcd_batch_size=96),
+        ):
+            with pytest.warns(UserWarning, match="wrap-padded"):
+                run_mcd_analysis(model, variables, x, y, config=warned,
+                                 detailed=False, sanity_check=False)
+        for quiet in (
+            dataclasses.replace(chunked, mcd_batch_size=len(x)),
+            # exact multiple: every window appears equally in the chunk.
+            dataclasses.replace(chunked, mcd_batch_size=2 * len(x)),
+            dataclasses.replace(chunked, mcd_mode="clean"),
+        ):
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")
+                run_mcd_analysis(model, variables, x, y, config=quiet,
+                                 detailed=False, sanity_check=False)
+
     def test_de_run_and_registry(self, setup, tmp_path):
         model, variables, x, y, pids = setup
         members = [init_variables(model, jax.random.key(s)) for s in range(3)]
