@@ -45,6 +45,7 @@ from apnea_uq_tpu.uq.predict import (
     ensemble_predict_streaming,
     mc_dropout_predict,
     mc_dropout_predict_streaming,
+    mcd_effective_batch_size,
 )
 from apnea_uq_tpu.utils import prng
 from apnea_uq_tpu.utils.timing import Timer, block
@@ -272,22 +273,26 @@ def run_mcd_analysis(
         predict_key = prng.stochastic_key(seed)
     if bootstrap_key is None:
         bootstrap_key = prng.bootstrap_key(seed)
-    if config.mcd_mode == "parity" and config.mcd_batch_size % len(x) != 0:
-        # The reference ran the WHOLE test set as one batch, so its BN
-        # batch statistics are whole-set.  Chunk statistics match that
-        # only when every window appears equally often in one chunk —
-        # i.e. mcd_batch_size is an exact multiple of the window count
-        # (smaller chunks see subsets; a larger non-multiple chunk
-        # wrap-pads some windows more than others, skewing the batch
-        # mean/variance).  Surface this so parity numbers are never
-        # silently chunk-stat numbers.
+    # The reference ran the WHOLE test set as one batch, so its BN batch
+    # statistics are whole-set.  Chunk statistics match that only when
+    # every window appears equally often in one chunk — i.e. the chunk
+    # the predictor ACTUALLY runs at (mcd_batch_size rounded up to the
+    # mesh data-axis multiple; mcd_effective_batch_size) is an exact
+    # multiple of the window count.  Smaller chunks see subsets; a larger
+    # non-multiple chunk wrap-pads some windows more than others, skewing
+    # the batch mean/variance.  Surface this so parity numbers are never
+    # silently chunk-stat numbers.
+    effective_bs = mcd_effective_batch_size(config.mcd_batch_size, mesh)
+    if config.mcd_mode == "parity" and effective_bs % len(x) != 0:
         import warnings
         warnings.warn(
-            f"mcd_mode='parity' with mcd_batch_size={config.mcd_batch_size}"
-            f" and {len(x)} windows: BatchNorm statistics are computed per"
-            " (wrap-padded) chunk, not over the whole set as in the"
-            " reference's model(x, training=True).  Set mcd_batch_size"
-            " equal to the window count for exact parity.",
+            f"mcd_mode='parity' with effective chunk {effective_bs}"
+            f" (mcd_batch_size={config.mcd_batch_size}, rounded to the"
+            f" mesh data-axis multiple) and {len(x)} windows: BatchNorm"
+            " statistics are computed per (wrap-padded) chunk, not over"
+            " the whole set as in the reference's model(x, training=True)."
+            "  Set mcd_batch_size to a multiple of the window count that"
+            " the mesh's data axis divides for exact parity.",
             stacklevel=2,
         )
     with Timer(f"{label}.predict") as t:

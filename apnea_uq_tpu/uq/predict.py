@@ -162,6 +162,21 @@ def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute,
     return out[:, :m]
 
 
+def mcd_effective_batch_size(batch_size: int, mesh=None) -> int:
+    """The chunk size the MCD predictors actually run at: with a mesh,
+    ``batch_size`` rounds up to the data-axis multiple so chunks place
+    shard-wise (required on process-spanning meshes).  Both the in-HBM
+    and streamed paths apply the same rounding — chunk boundaries feed
+    the per-chunk RNG fold and (in parity mode) the BN batch statistics,
+    so the two paths must agree on them to stay bit-comparable.  Exposed
+    so callers (e.g. the parity-mode chunk warning in uq/drivers.py) can
+    reason about the real chunk."""
+    if mesh is None:
+        return batch_size
+    d_axis = mesh.shape[mesh_lib.AXIS_DATA]
+    return -(-batch_size // d_axis) * d_axis
+
+
 def _chunk_sharding(mesh, batch_size: int):
     """Window-axis sharding for streamed chunks, or None when the chunk
     does not divide the data axis (the in-jit constraint then reshards)."""
@@ -192,29 +207,24 @@ def mc_dropout_predict_streaming(
     whole set — the scaling story for test sets that exceed HBM
     (SURVEY §5.7; replaces the whole-set-as-one-batch pattern of
     uq_techniques.py:22).  Produces bit-identical results to
-    :func:`mc_dropout_predict` for the same key.
+    :func:`mc_dropout_predict` for the same key and ``mesh`` — both
+    paths chunk at :func:`mcd_effective_batch_size`, so toggling
+    streaming never changes predictions.
 
     ``mesh`` composes both scaling axes: each streamed chunk's T passes
     shard over ``ensemble`` and its windows over ``data`` (the same
     layout and key discipline as the in-HBM mesh path), so a test set
-    that exceeds HBM on a pod streams through ALL chips.  The chunk size
-    is rounded up to the data-axis multiple (as the DE streamed path
-    does) so chunks place shard-wise; when that rounding changes the
-    chunk size, results equal :func:`mc_dropout_predict` called with the
-    ROUNDED ``batch_size`` (chunk boundaries feed the per-chunk RNG
-    fold).
+    that exceeds HBM on a pod streams through ALL chips.
     """
     if mode not in _MCD_MODES:
         raise ValueError(f"mode must be 'clean' or 'parity', got {mode!r}")
     if key is None:
         key = prng.stochastic_key(seed)
     if mesh is not None:
-        # Round the chunk up to the data-axis multiple (as the DE path
-        # does) so chunks get placed shard-wise; otherwise they land on
-        # one local device, which fails outright on a process-spanning
-        # mesh where the global-mesh jit needs every shard addressable.
-        d_axis = mesh.shape[mesh_lib.AXIS_DATA]
-        batch_size = -(-batch_size // d_axis) * d_axis
+        # Chunks must place shard-wise (an unsharded device_put fails on
+        # a process-spanning mesh); the rounding is shared with the
+        # in-HBM mesh path so both run at the same effective chunk.
+        batch_size = mcd_effective_batch_size(batch_size, mesh)
         repl = mesh_lib.replicated(mesh)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
     return _stream_chunked(
@@ -244,8 +254,11 @@ def mc_dropout_predict(
     ``mesh`` spreads the work over a device mesh — passes over its
     ``ensemble`` axis, windows over ``data`` — replacing the reference's
     single-device T-pass loop (uq_techniques.py:22) at pod scale.  The
-    result is identical to the single-device path (same keys -> same
-    dropout masks; the mesh only partitions the compute).
+    chunk runs at :func:`mcd_effective_batch_size` (``batch_size``
+    rounded up to the data-axis multiple, shared with the streamed
+    path); results are identical to the single-device path at that
+    effective batch size — same keys -> same dropout masks; the mesh
+    only partitions the compute.
 
     ``mode='parity'`` reproduces the reference's ``training=True`` regime
     (dropout + batch-statistics BatchNorm, uq_techniques.py:22).  Note that
@@ -269,6 +282,10 @@ def mc_dropout_predict(
         key = prng.stochastic_key(seed)
     x = jnp.asarray(x, jnp.float32)
     if mesh is not None:
+        # Same rounding as the streamed path (mcd_effective_batch_size),
+        # so streamed and in-HBM runs on the same mesh chunk identically
+        # and their results stay bit-comparable.
+        batch_size = mcd_effective_batch_size(batch_size, mesh)
         repl = mesh_lib.replicated(mesh)
         x = jax.device_put(x, repl)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)

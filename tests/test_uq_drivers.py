@@ -171,6 +171,32 @@ class TestEndToEnd:
                 run_mcd_analysis(model, variables, x, y, config=quiet,
                                  detailed=False, sanity_check=False)
 
+    def test_parity_warning_uses_mesh_effective_chunk(self, setup):
+        """On a mesh the predictor rounds the chunk up to the data-axis
+        multiple, so a nominally-exact mcd_batch_size can still wrap-pad:
+        the warning must judge the EFFECTIVE chunk (review r4)."""
+        from apnea_uq_tpu.parallel import make_mesh
+
+        model, variables, x, y, _ = setup
+        x60, y60 = x[:60], y[:60]
+        cfg = UQConfig(mc_passes=2, n_bootstrap=5, mcd_mode="parity",
+                       mcd_batch_size=60, inference_batch_size=64)
+        # data axis 8: effective chunk ceil(60/8)*8 = 64 != k*60 -> warn
+        # even though mcd_batch_size == len(x).
+        mesh8 = make_mesh(num_members=1, ensemble_axis=1)
+        assert mesh8.shape["data"] == 8
+        with pytest.warns(UserWarning, match="effective chunk 64"):
+            run_mcd_analysis(model, variables, x60, y60, config=cfg,
+                             detailed=False, sanity_check=False, mesh=mesh8)
+        # data axis 4: effective chunk stays 60 -> quiet.
+        import warnings
+        mesh4 = make_mesh(num_members=2, ensemble_axis=2)
+        assert mesh4.shape["data"] == 4
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            run_mcd_analysis(model, variables, x60, y60, config=cfg,
+                             detailed=False, sanity_check=False, mesh=mesh4)
+
     def test_de_run_and_registry(self, setup, tmp_path):
         model, variables, x, y, pids = setup
         members = [init_variables(model, jax.random.key(s)) for s in range(3)]

@@ -289,25 +289,33 @@ class TestStreamingMeshComposition:
 
     def test_mcd_streamed_mesh_nondivisible_chunk_rounds_up(self, rng):
         """batch_size not divisible by the data axis is rounded up to its
-        multiple (as the DE streamed path does), so chunks always place
-        shard-wise — required on process-spanning meshes, where the
-        single-local-device fallback would fail.  Results equal the
-        single-device stream at the ROUNDED batch size (chunk boundaries
-        feed the per-chunk RNG fold)."""
+        multiple (mcd_effective_batch_size) in BOTH the streamed and the
+        in-HBM mesh paths, so chunks always place shard-wise — required
+        on process-spanning meshes — and toggling streaming on a mesh
+        never changes predictions.  Both equal the single-device stream
+        at the ROUNDED batch size (chunk boundaries feed the per-chunk
+        RNG fold)."""
         from apnea_uq_tpu.parallel import make_mesh
         from apnea_uq_tpu.uq import mc_dropout_predict_streaming
+        from apnea_uq_tpu.uq.predict import mcd_effective_batch_size
 
         model = _tiny()
         variables = init_variables(model, jax.random.key(0))
         x = rng.normal(size=(50, 60, 4)).astype(np.float32)
         key = jax.random.key(2)
         mesh = make_mesh(num_members=4)  # data axis 2; 25 % 2 != 0 -> 26
+        assert mcd_effective_batch_size(25, mesh) == 26
+        assert mcd_effective_batch_size(25, None) == 25
         streamed = mc_dropout_predict_streaming(
             model, variables, x, n_passes=4, batch_size=25, key=key, mesh=mesh
         )
+        hbm = np.asarray(mc_dropout_predict(
+            model, variables, x, n_passes=4, batch_size=25, key=key, mesh=mesh
+        ))
         single = mc_dropout_predict_streaming(
             model, variables, x, n_passes=4, batch_size=26, key=key
         )
+        np.testing.assert_allclose(streamed, hbm, rtol=1e-6, atol=1e-7)
         np.testing.assert_allclose(streamed, single, rtol=1e-6, atol=1e-7)
 
     def test_de_streamed_mesh_matches_in_hbm_mesh(self, rng):
