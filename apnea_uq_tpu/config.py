@@ -108,10 +108,12 @@ class UQConfig:
       (uq_techniques.py:22), behind its ~77% MCD accuracy.  BN batch
       statistics are computed per ``mcd_batch_size`` chunk; the reference
       used the whole test set as ONE batch, so exact reproduction of that
-      detail needs ``mcd_batch_size`` equal to the window count (a
-      non-multiple chunk wrap-pads some windows more than others; the
-      drivers warn whenever the chunk is not an exact multiple of the
-      set).
+      detail needs the EFFECTIVE chunk — ``mcd_batch_size``, rounded up
+      to the mesh data-axis multiple when a mesh is used — to be an
+      exact multiple of the window count (a non-multiple chunk wrap-pads
+      some windows more than others into the batch statistics; the
+      drivers warn whenever that happens).  Off-mesh, set it equal to
+      the window count.
     - ``'clean'``: dropout on, batch-norm frozen at running statistics —
       the methodologically standard MC Dropout.  Accuracy stays near the
       deterministic ~88%.

@@ -45,7 +45,7 @@ from apnea_uq_tpu.uq.predict import (
     ensemble_predict_streaming,
     mc_dropout_predict,
     mc_dropout_predict_streaming,
-    mcd_effective_batch_size,
+    effective_batch_size,
 )
 from apnea_uq_tpu.utils import prng
 from apnea_uq_tpu.utils.timing import Timer, block
@@ -277,12 +277,12 @@ def run_mcd_analysis(
     # statistics are whole-set.  Chunk statistics match that only when
     # every window appears equally often in one chunk — i.e. the chunk
     # the predictor ACTUALLY runs at (mcd_batch_size rounded up to the
-    # mesh data-axis multiple; mcd_effective_batch_size) is an exact
+    # mesh data-axis multiple; effective_batch_size) is an exact
     # multiple of the window count.  Smaller chunks see subsets; a larger
     # non-multiple chunk wrap-pads some windows more than others, skewing
     # the batch mean/variance.  Surface this so parity numbers are never
     # silently chunk-stat numbers.
-    effective_bs = mcd_effective_batch_size(config.mcd_batch_size, mesh)
+    effective_bs = effective_batch_size(config.mcd_batch_size, mesh)
     if config.mcd_mode == "parity" and effective_bs % len(x) != 0:
         import warnings
         warnings.warn(

@@ -162,7 +162,7 @@ def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute,
     return out[:, :m]
 
 
-def mcd_effective_batch_size(batch_size: int, mesh=None) -> int:
+def effective_batch_size(batch_size: int, mesh=None) -> int:
     """The chunk size the MCD predictors actually run at: with a mesh,
     ``batch_size`` rounds up to the data-axis multiple so chunks place
     shard-wise (required on process-spanning meshes).  Both the in-HBM
@@ -208,7 +208,7 @@ def mc_dropout_predict_streaming(
     (SURVEY §5.7; replaces the whole-set-as-one-batch pattern of
     uq_techniques.py:22).  Produces bit-identical results to
     :func:`mc_dropout_predict` for the same key and ``mesh`` — both
-    paths chunk at :func:`mcd_effective_batch_size`, so toggling
+    paths chunk at :func:`effective_batch_size`, so toggling
     streaming never changes predictions.
 
     ``mesh`` composes both scaling axes: each streamed chunk's T passes
@@ -224,7 +224,7 @@ def mc_dropout_predict_streaming(
         # Chunks must place shard-wise (an unsharded device_put fails on
         # a process-spanning mesh); the rounding is shared with the
         # in-HBM mesh path so both run at the same effective chunk.
-        batch_size = mcd_effective_batch_size(batch_size, mesh)
+        batch_size = effective_batch_size(batch_size, mesh)
         repl = mesh_lib.replicated(mesh)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
     return _stream_chunked(
@@ -254,7 +254,7 @@ def mc_dropout_predict(
     ``mesh`` spreads the work over a device mesh — passes over its
     ``ensemble`` axis, windows over ``data`` — replacing the reference's
     single-device T-pass loop (uq_techniques.py:22) at pod scale.  The
-    chunk runs at :func:`mcd_effective_batch_size` (``batch_size``
+    chunk runs at :func:`effective_batch_size` (``batch_size``
     rounded up to the data-axis multiple, shared with the streamed
     path); results are identical to the single-device path at that
     effective batch size — same keys -> same dropout masks; the mesh
@@ -264,9 +264,11 @@ def mc_dropout_predict(
     (dropout + batch-statistics BatchNorm, uq_techniques.py:22).  Note that
     in parity mode batch statistics are computed per (wrap-padded)
     ``batch_size`` chunk; the reference used the entire test set as one
-    batch, so pass ``batch_size`` equal to ``len(x)`` (or an exact
-    multiple — wrap-padding then repeats every window equally) for exact
-    parity of that detail.
+    batch, so exact parity of that detail needs the EFFECTIVE chunk
+    (:func:`effective_batch_size` — on a mesh, ``batch_size`` rounds up
+    to the data-axis multiple) to be an exact multiple of ``len(x)``:
+    off-mesh, pass ``batch_size = len(x)``; on a mesh, a multiple of the
+    window count that the data axis divides.
     ``mode='clean'`` freezes BatchNorm at running statistics (standard MC
     Dropout; SURVEY §6).
 
@@ -282,10 +284,10 @@ def mc_dropout_predict(
         key = prng.stochastic_key(seed)
     x = jnp.asarray(x, jnp.float32)
     if mesh is not None:
-        # Same rounding as the streamed path (mcd_effective_batch_size),
+        # Same rounding as the streamed path (effective_batch_size),
         # so streamed and in-HBM runs on the same mesh chunk identically
         # and their results stay bit-comparable.
-        batch_size = mcd_effective_batch_size(batch_size, mesh)
+        batch_size = effective_batch_size(batch_size, mesh)
         repl = mesh_lib.replicated(mesh)
         x = jax.device_put(x, repl)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
@@ -403,9 +405,8 @@ def ensemble_predict_streaming(
             x, batch_size, n_members, prefetch,
             lambda chunk, ci: _ensemble_chunk_jit(model, member_variables, chunk),
         )
-    d_axis = mesh.shape[mesh_lib.AXIS_DATA]
     e_axis = mesh.shape[mesh_lib.AXIS_ENSEMBLE]
-    batch_size = -(-batch_size // d_axis) * d_axis
+    batch_size = effective_batch_size(batch_size, mesh)
     member_variables = jax.tree.map(
         lambda a: _wrap_pad(a, e_axis), member_variables
     )

@@ -74,6 +74,28 @@ def main() -> None:
     assert mcd.predictions.shape == (4, 64)
     assert mcd.deterministic_classification is not None
 
+    # Host-streamed MCD over the PROCESS-SPANNING mesh — the scenario the
+    # streamed chunk-placement/rounding exists for: no process addresses
+    # every device, so chunks MUST device_put shard-wise and results come
+    # back through the multihost-safe fetch.  batch_size=22 does not
+    # divide the 4-wide data axis and rounds to 24; the streamed run must
+    # equal the in-HBM mesh run at the same nominal batch size.
+    from apnea_uq_tpu.uq import mc_dropout_predict, mc_dropout_predict_streaming
+    from apnea_uq_tpu.utils import prng
+    from apnea_uq_tpu.utils.multihost import host_values
+
+    skey = prng.stochastic_key(7)
+    streamed = mc_dropout_predict_streaming(
+        model, res.member_variables(0), x[:64], n_passes=3, batch_size=22,
+        key=skey, mesh=mesh,
+    )
+    hbm = host_values(mc_dropout_predict(
+        model, res.member_variables(0), x[:64], n_passes=3, batch_size=22,
+        key=skey, mesh=mesh,
+    ))
+    assert streamed.shape == (3, 64)
+    np.testing.assert_allclose(streamed, hbm, rtol=1e-6, atol=1e-7)
+
     print(json.dumps({
         "process_id": process_id,
         "mesh": dict(mesh.shape),
@@ -84,6 +106,7 @@ def main() -> None:
         "de_accuracy": de.classification["accuracy"],
         "mcd_pred_sum": float(mcd.predictions.sum()),
         "mcd_det_accuracy": mcd.deterministic_classification["accuracy"],
+        "mcd_streamed_sum": float(streamed.sum()),
     }))
 
 

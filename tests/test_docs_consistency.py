@@ -163,7 +163,13 @@ def test_parity_mode_docstrings_agree_on_chunk_stats():
             f"{name} advises batch_size >= the set, but wrap-padding "
             "makes only exact multiples match whole-set BN statistics"
         )
-        assert "equal to the window count" in doc or "equal to ``len(x)``" in doc, (
+        assert "multiple of the window count" in doc or "multiple of ``len(x)``" in doc, (
             f"{name} no longer documents that exact parity-mode BN "
-            "statistics need the whole set in one batch"
+            "statistics need the (effective) chunk to be an exact "
+            "multiple of the window count"
+        )
+        # And both must acknowledge the mesh rounding that feeds the
+        # effective chunk (the r4 review's silent-non-parity trap).
+        assert "EFFECTIVE chunk" in doc, (
+            f"{name} no longer mentions the mesh-rounded effective chunk"
         )

@@ -47,6 +47,18 @@ _REVIEWED_SHA256 = {
         "e7dc5a2cde88c1c05fa6597cb07accb4b9cfb52b966494a0e072d54de0163ee8",
     "/root/reference/data_prepocessing/prepare_numpy_datasets.py":
         "8e985cd220ab08d822f42c601883a95d8363575d174b99f173489390412f0282",
+    "/root/reference/uncertainty_quantification/aggregate_patient_uq_metrics.py":
+        "ba2c79c55fabde48557e53f28d916b2aa2927525af200b13a1862edd84cf7f56",
+    "/root/reference/uncertainty_quantification/analyze_window_level_uncertainty.py":
+        "cf9941ab587c62aa6328113fa00e5d5f5d4be5135d5f31e584395daca728da88",
+    "/root/reference/uq_analysis/patient_accuracy_entropy_correlation.py":
+        "f769a431bb75b4fc35c359e4876dd2778c0217a7cdbd7ab8f5033eb537da42f7",
+    "/root/reference/uq_analysis/window_uncertainty_vs_correctness_mannwhitney.py":
+        "2e0f21fb9b409549be4700edaf0070aeea8ea12a287b62137adbb38df3692022",
+    "/root/reference/datasets/SHHS_cohort_analysis.py":
+        "e979f7000ee246560cce3b7d46736198900e97530d4fb5ab3b5bc648d70d328d",
+    "/root/reference/datasets/SHHS_signal_quality.py":
+        "7800cd52aece6569d544c0747b2f4822e9e45054b557d90e95a5176e8fc9399a",
 }
 
 pytestmark = pytest.mark.skipif(
@@ -566,3 +578,262 @@ class TestBootstrapOwnStream:
             assert abs(ours_ci[f"{key}_mean"] - theirs_ci[f"{key}_mean"]) < max(
                 4 * se, 1e-7
             ), key
+
+
+class TestAnalysisScriptsExecParity:
+    """C17/C18/C21/C22: the four analysis scripts are module-level
+    programs that read a CSV from a hard-coded relative path at import.
+    Synthesizing that CSV in a tmp cwd makes them exec'able after all
+    (r3 PARITY.md assumed they were not), extending the strongest parity
+    tier to patient aggregation, window binning, and both statistical
+    tests: after exec, the scripts' module globals hold their computed
+    frames/statistics, compared value-for-value against the framework."""
+
+    REF_AGG = ("/root/reference/uncertainty_quantification/"
+               "aggregate_patient_uq_metrics.py")
+    REF_WINDOW = ("/root/reference/uncertainty_quantification/"
+                  "analyze_window_level_uncertainty.py")
+    REF_CORR = ("/root/reference/uq_analysis/"
+                "patient_accuracy_entropy_correlation.py")
+    REF_MWU = ("/root/reference/uq_analysis/"
+               "window_uncertainty_vs_correctness_mannwhitney.py")
+
+    @pytest.fixture()
+    def detailed(self, rng):
+        """A detailed per-window frame in the reference CSV schema, with
+        both correct and incorrect windows, continuous uncertainty
+        values, and single-window patients (the std-zeroing edge)."""
+        import pandas as pd
+
+        n = 240
+        # object dtype: a fixed-width <U3 array would silently truncate
+        # the SOLO ids and void the single-window std-zeroing assertions.
+        pids = np.array([f"P{i % 12:02d}" for i in range(n)], dtype=object)
+        pids[:2] = ["SOLO_A", "SOLO_B"]  # single-window patients
+        y = (rng.uniform(size=n) < 0.3).astype(np.int64)
+        flip = rng.uniform(size=n) < 0.2
+        pred = np.where(flip, 1 - y, y)
+        probs = np.clip(rng.beta(2, 2, n), 1e-6, 1 - 1e-6)
+        return pd.DataFrame({
+            "Patient_ID": pids,
+            "Window_Index": np.arange(n),
+            "True_Label": y,
+            "Predicted_Label": pred,
+            "Predicted_Probability": probs,
+            "Predictive_Variance": rng.uniform(0.0, 0.25, n),
+            "Predictive_Entropy": rng.uniform(0.0, 1.0, n),
+        })
+
+    def test_patient_aggregation_matches(self, detailed, tmp_path,
+                                         monkeypatch, capsys):
+        from apnea_uq_tpu.analysis.patient import (
+            SUMMARY_METRIC_COLUMNS, aggregate_patients,
+        )
+
+        monkeypatch.chdir(tmp_path)
+        detailed.to_csv(tmp_path / "detail_patient_MCD.csv", index=False)
+        ref = _exec_reference_module("ref_aggregate", self.REF_AGG, {})
+        capsys.readouterr()
+        theirs = ref.patient_summary.sort_values("Patient_ID").reset_index(
+            drop=True)
+        ours = aggregate_patients(detailed).sort_values(
+            "Patient_ID").reset_index(drop=True)
+        assert list(theirs["Patient_ID"]) == list(ours["Patient_ID"])
+        for col in SUMMARY_METRIC_COLUMNS:
+            np.testing.assert_allclose(
+                ours[col].to_numpy(np.float64),
+                theirs[col].to_numpy(np.float64),
+                rtol=1e-12, atol=1e-12, err_msg=col,
+            )
+        # Both zero the std for single-window patients (:45-46).
+        solo = theirs[theirs["Patient_ID"].str.startswith("SOLO")]
+        assert (solo["std_variance"] == 0).all()
+        assert (solo["std_entropy"] == 0).all()
+
+    def test_window_binning_matches(self, detailed, tmp_path, monkeypatch,
+                                    capsys):
+        from apnea_uq_tpu.analysis.windows import window_level_analysis
+
+        monkeypatch.chdir(tmp_path)
+        detailed.to_csv(tmp_path / "detail_patient_DE.csv", index=False)
+        ref = _exec_reference_module("ref_window_level", self.REF_WINDOW, {})
+        capsys.readouterr()
+        ours = window_level_analysis(detailed)
+        theirs = ref.binned_results.reset_index()
+        assert ours.num_windows == len(ref.uq_results_df)
+        assert ours.overall_accuracy == pytest.approx(
+            float(ref.uq_results_df["Correct"].mean()), abs=1e-15)
+        assert [str(b) for b in theirs[theirs.columns[0]]] == [
+            str(b) for b in ours.binned["Predictive_Entropy_Bin"]]
+        np.testing.assert_array_equal(
+            ours.binned["window_count"], theirs["window_count"])
+        np.testing.assert_allclose(
+            ours.binned["accuracy"].to_numpy(np.float64),
+            theirs["accuracy"].to_numpy(np.float64), rtol=1e-12)
+        np.testing.assert_allclose(
+            ours.binned["error_rate"].to_numpy(np.float64),
+            theirs["error_rate"].to_numpy(np.float64), rtol=1e-12)
+
+    def test_pearson_correlation_matches(self, detailed, tmp_path,
+                                         monkeypatch, capsys):
+        pytest.importorskip("scipy")
+        from apnea_uq_tpu.analysis.patient import aggregate_patients
+        from apnea_uq_tpu.analysis.stats import pearson_corr
+
+        monkeypatch.chdir(tmp_path)
+        summary = aggregate_patients(detailed)
+        summary.to_csv(tmp_path / "patient_summary.csv", index=False)
+        # __main__-gated module: exec has no side effects; call its
+        # function (the script's whole computation, :15-46) directly.
+        ref = _exec_reference_module("ref_patient_corr", self.REF_CORR, {})
+        r_ref, p_ref = ref.calculate_and_print_correlation(
+            str(tmp_path / "patient_summary.csv"), "MCD",
+            "mean_entropy", "patient_accuracy",
+        )
+        capsys.readouterr()
+        assert r_ref is not None
+        r, p = pearson_corr(summary["mean_entropy"],
+                            summary["patient_accuracy"])
+        assert r == pytest.approx(r_ref, rel=1e-12)
+        assert p == pytest.approx(p_ref, rel=1e-9)  # in-tree t CDF
+
+    def test_mann_whitney_matches(self, detailed, tmp_path, monkeypatch,
+                                  capsys):
+        pytest.importorskip("scipy")
+        from apnea_uq_tpu.analysis.stats import mann_whitney_u
+
+        monkeypatch.chdir(tmp_path)
+        detailed.to_csv(tmp_path / "detail_patient_DE.csv", index=False)
+        ref = _exec_reference_module("ref_mannwhitney", self.REF_MWU, {})
+        capsys.readouterr()
+        # The script's whole body is one try/except that would swallow a
+        # missing-file error; the globals only exist on the happy path.
+        assert hasattr(ref, "stat") and hasattr(ref, "p_value"), (
+            "reference script did not reach the test computation")
+        correct = detailed["True_Label"] == detailed["Predicted_Label"]
+        u, p = mann_whitney_u(
+            detailed.loc[~correct, "Predictive_Entropy"],
+            detailed.loc[correct, "Predictive_Entropy"],
+            alternative="greater",
+        )
+        assert u == pytest.approx(float(ref.stat), rel=1e-12)
+        assert p == pytest.approx(float(ref.p_value), rel=1e-9)
+
+
+class TestCohortScriptsExecParity:
+    """C23/C24: the two datasets/ scripts are function-based (argparse
+    __main__-gated), so exec is side-effect free and their analysis
+    functions can be driven directly on a synthetic NSRR metadata CSV.
+    They print rather than return, so parity is pinned on the printed
+    numbers (formatted identically from the framework's structured
+    output).  Bonus finding preserved here: the reference's AHI severity
+    table is UNREACHABLE — its np.select call passes value-subsets of
+    mismatched lengths as the condition list and raises 'shape mismatch',
+    swallowed by the script's blanket except — so the framework's
+    severity distribution implements the labeled intent
+    (SHHS_cohort_analysis.py:139-152), which the reference code itself
+    never manages to print."""
+
+    REF_COHORT = "/root/reference/datasets/SHHS_cohort_analysis.py"
+    REF_QUALITY = "/root/reference/datasets/SHHS_signal_quality.py"
+
+    @pytest.fixture()
+    def metadata(self, rng, tmp_path):
+        import pandas as pd
+
+        n = 300
+        df = pd.DataFrame({
+            "ahi_a0h3a": np.where(rng.uniform(size=n) < 0.12, np.nan,
+                                  rng.exponential(12.0, n)),
+            "age_s2": np.where(rng.uniform(size=n) < 0.05, np.nan,
+                               rng.normal(63.0, 10.0, n).round(1)),
+            "gender": rng.choice([1.0, 2.0], n),
+            "race": rng.choice([1.0, 2.0, 3.0], n, p=[0.7, 0.2, 0.1]),
+            "quoxim": rng.choice([1.0, 2.0, 3.0, 4.0, 5.0, np.nan], n),
+            "quhr": rng.choice([3.0, 4.0, 5.0], n),
+            "quchest": rng.choice([2.0, 4.0, 5.0], n),
+            "quabdo": rng.choice([4.0, 5.0], n),
+        })
+        path = tmp_path / "shhs2-dataset.csv"
+        df.to_csv(path, index=False)
+        return df, str(path)
+
+    def test_cohort_demographics_match(self, metadata, capsys):
+        import re
+
+        from apnea_uq_tpu.analysis.cohort import analyze_cohort
+
+        df, path = metadata
+        ref = _exec_reference_module("ref_cohort_analysis", self.REF_COHORT, {})
+        ref.analyze_cohort(path)
+        out = capsys.readouterr().out
+        ours = analyze_cohort(df)
+
+        assert f"N = {ours['n_cohort']}" in out
+        age, ahi = ours["age"], ours["ahi"]
+        assert (f"Mean Age: {age['mean']:.1f} ± {age['std']:.1f} years"
+                in out)
+        assert f"Median Age: {age['median']:.1f} years" in out
+        assert (f"Age Range: {age['min']:.1f} - {age['max']:.1f} years"
+                in out)
+        assert (f"Mean AHI: {ahi['mean']:.1f} ± {ahi['std']:.1f} events/hour"
+                in out)
+        assert f"Median AHI: {ahi['median']:.1f} events/hour" in out
+        for label, key in (("Male (1.0)", "Male"), ("Female (2.0)", "Female")):
+            cat = ours["gender"]["categories"][key]
+            m = re.search(rf"{re.escape(label)}:\s+(\d+)\s+\(([\d.]+)%\)", out)
+            assert m, label
+            assert int(m.group(1)) == cat["count"]
+            assert float(m.group(2)) == pytest.approx(cat["percent"], abs=0.05)
+        for label, key in (("White (1.0)", "White"),
+                           ("Black or African American (2.0)",
+                            "Black or African American"),
+                           ("Other (3.0)", "Other")):
+            cat = ours["race"]["categories"][key]
+            m = re.search(rf"{re.escape(label)}:\s+(\d+)\s+\(([\d.]+)%\)", out)
+            assert m, label
+            assert int(m.group(1)) == cat["count"]
+
+        # The reference defect, pinned: its severity table never prints
+        # (np.select over mismatched-length value subsets raises, caught
+        # by the blanket except) — while the framework's distribution
+        # totals the full cohort under the same labeled thresholds.
+        assert "AHI Severity Distribution in Cohort:" not in out
+        assert "shape mismatch" in out
+        sev = ours["ahi_severity"]
+        assert int(sev["count"].sum()) == ours["n_cohort"]
+        assert list(sev["category"]) == [
+            "Normal (AHI < 5.0)", "Mild OSA (AHI 5.0-14.9)",
+            "Moderate OSA (AHI 15.0-29.9)", "Severe OSA (AHI >= 30.0)",
+        ]
+
+    def test_signal_quality_matches(self, metadata, capsys):
+        import re
+
+        import pandas as pd
+
+        from apnea_uq_tpu.analysis.cohort import (
+            QUALITY_VARS, analyze_signal_quality,
+        )
+
+        df, path = metadata
+        ref = _exec_reference_module("ref_signal_quality", self.REF_QUALITY, {})
+        ref.analyze_signal_quality(path)
+        out = capsys.readouterr().out
+        ours = analyze_signal_quality(df)
+
+        assert f"N = {ours['n_cohort']}" in out
+        for var in QUALITY_VARS:
+            info = ours["channels"][var]
+            # Per-variable section: mean score + every category count.
+            sec = out.split(f"({var})")[1].split("--- Statistics")[0]
+            values = pd.to_numeric(
+                df.loc[pd.to_numeric(df["ahi_a0h3a"], errors="coerce")
+                       .notna(), var], errors="coerce").dropna()
+            assert f"N (non-missing values): {info['n']}" in sec
+            assert f"Mean score: {values.mean():.2f}" in sec
+            for label, cat in info["categories"].items():
+                m = re.search(
+                    rf"Category \d+ \({re.escape(label)}\): {cat['count']}\b",
+                    sec)
+                assert m, (var, label, cat, sec[:500])

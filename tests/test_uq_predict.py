@@ -289,7 +289,7 @@ class TestStreamingMeshComposition:
 
     def test_mcd_streamed_mesh_nondivisible_chunk_rounds_up(self, rng):
         """batch_size not divisible by the data axis is rounded up to its
-        multiple (mcd_effective_batch_size) in BOTH the streamed and the
+        multiple (effective_batch_size) in BOTH the streamed and the
         in-HBM mesh paths, so chunks always place shard-wise — required
         on process-spanning meshes — and toggling streaming on a mesh
         never changes predictions.  Both equal the single-device stream
@@ -297,15 +297,15 @@ class TestStreamingMeshComposition:
         RNG fold)."""
         from apnea_uq_tpu.parallel import make_mesh
         from apnea_uq_tpu.uq import mc_dropout_predict_streaming
-        from apnea_uq_tpu.uq.predict import mcd_effective_batch_size
+        from apnea_uq_tpu.uq.predict import effective_batch_size
 
         model = _tiny()
         variables = init_variables(model, jax.random.key(0))
         x = rng.normal(size=(50, 60, 4)).astype(np.float32)
         key = jax.random.key(2)
         mesh = make_mesh(num_members=4)  # data axis 2; 25 % 2 != 0 -> 26
-        assert mcd_effective_batch_size(25, mesh) == 26
-        assert mcd_effective_batch_size(25, None) == 25
+        assert effective_batch_size(25, mesh) == 26
+        assert effective_batch_size(25, None) == 25
         streamed = mc_dropout_predict_streaming(
             model, variables, x, n_passes=4, batch_size=25, key=key, mesh=mesh
         )
