@@ -163,14 +163,15 @@ def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute,
 
 
 def effective_batch_size(batch_size: int, mesh=None) -> int:
-    """The chunk size the MCD predictors actually run at: with a mesh,
+    """The chunk size the predictors actually run at: with a mesh,
     ``batch_size`` rounds up to the data-axis multiple so chunks place
-    shard-wise (required on process-spanning meshes).  Both the in-HBM
-    and streamed paths apply the same rounding — chunk boundaries feed
-    the per-chunk RNG fold and (in parity mode) the BN batch statistics,
-    so the two paths must agree on them to stay bit-comparable.  Exposed
-    so callers (e.g. the parity-mode chunk warning in uq/drivers.py) can
-    reason about the real chunk."""
+    shard-wise (required on process-spanning meshes).  Every mesh path
+    applies the same rounding — both MCD paths (where chunk boundaries
+    feed the per-chunk RNG fold and, in parity mode, the BN batch
+    statistics, so in-HBM and streamed must agree to stay
+    bit-comparable) and the streamed DE path.  Exposed so callers (e.g.
+    the parity-mode chunk warning in uq/drivers.py) can reason about
+    the real chunk."""
     if mesh is None:
         return batch_size
     d_axis = mesh.shape[mesh_lib.AXIS_DATA]

@@ -59,6 +59,10 @@ _REVIEWED_SHA256 = {
         "e979f7000ee246560cce3b7d46736198900e97530d4fb5ab3b5bc648d70d328d",
     "/root/reference/datasets/SHHS_signal_quality.py":
         "7800cd52aece6569d544c0747b2f4822e9e45054b557d90e95a5176e8fc9399a",
+    "/root/reference/uq_analysis/final_plot_uq_overview_figures.py":
+        "92c7d9a97f19157ae3ecc485ba5ef548eb8c75b1d31bef2f4cd2f25600eac2e8",
+    "/root/reference/uq_analysis/hyperparameter_plot_mcd_or_de_pass_convergence.py":
+        "413018ef1c861bcfa96d7d0427f6d0884abb0b750e3de27e235f224e796a5116",
 }
 
 pytestmark = pytest.mark.skipif(
@@ -837,3 +841,91 @@ class TestCohortScriptsExecParity:
                     rf"Category \d+ \({re.escape(label)}\): {cat['count']}\b",
                     sec)
                 assert m, (var, label, cat, sec[:500])
+
+
+class TestPlotScriptsConsumeFrameworkArtifacts:
+    """C19/C20 interop: plots cannot be value-compared, but the reference
+    plot scripts CAN be fed the framework's own artifacts — proving the
+    detailed-frame, patient-summary, and sweep-table schemas this
+    framework writes are consumable by the reference's thesis-figure
+    code unchanged (the artifact-contract guarantee PARITY.md claims)."""
+
+    REF_FIGURES = "/root/reference/uq_analysis/final_plot_uq_overview_figures.py"
+    REF_CONV = ("/root/reference/uq_analysis/"
+                "hyperparameter_plot_mcd_or_de_pass_convergence.py")
+
+    def test_thesis_figures_script_runs_on_framework_csvs(
+            self, rng, tmp_path, monkeypatch, capsys):
+        pytest.importorskip("scipy")
+        pytest.importorskip("seaborn")
+        import matplotlib
+        matplotlib.use("Agg")
+
+        from apnea_uq_tpu.analysis.patient import aggregate_patients
+        from apnea_uq_tpu.uq.drivers import detailed_frame
+
+        monkeypatch.chdir(tmp_path)
+        # Framework artifacts for both methods: detailed per-window frame
+        # (from a synthetic prediction stack) and its patient aggregation.
+        for tag in ("MCD", "DE"):
+            k = 6 if tag == "MCD" else 4
+            m = 180
+            preds = np.clip(
+                rng.beta(2.0, 2.0, (k, m))
+                + rng.normal(0, 0.05, (k, m)), 1e-6, 1 - 1e-6)
+            y = (rng.uniform(size=m) < 0.3).astype(np.int64)
+            pids = np.array([f"p{i % 15:02d}" for i in range(m)],
+                            dtype=object)
+            frame = detailed_frame(preds, y, pids)
+            frame.to_csv(tmp_path / f"detail_patient_{tag}.csv", index=False)
+            summary_dir = tmp_path / f"patient_level_uq_analysis_{tag}"
+            summary_dir.mkdir()
+            aggregate_patients(frame).to_csv(
+                summary_dir / f"patient_summary_metrics_{tag}.csv",
+                index=False)
+
+        _exec_reference_module("ref_thesis_figures", self.REF_FIGURES, {})
+        out = capsys.readouterr().out
+        pngs = sorted(p.name for p in (tmp_path / "final_thesis_plots").glob("*.png"))
+        assert pngs == [
+            "binned_accuracy_plot_final_annotated.png",
+            "patient_accuracy_vs_entropy_final.png",
+            "patient_entropy_histograms_final.png",
+            "window_correctness_boxplots_final.png",
+        ], (pngs, out[-2000:])
+        for p in (tmp_path / "final_thesis_plots").glob("*.png"):
+            assert p.stat().st_size > 0
+
+    def test_convergence_plot_consumes_framework_sweep_table(
+            self, rng, tmp_path, monkeypatch, capsys):
+        import jax
+        import matplotlib
+        matplotlib.use("Agg")
+
+        from apnea_uq_tpu.analysis.sweep import mcd_pass_sweep
+        from apnea_uq_tpu.config import ModelConfig, UQConfig
+        from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+
+        monkeypatch.chdir(tmp_path)
+        model = AlarconCNN1D(ModelConfig(
+            features=(6, 6), kernel_sizes=(3, 3), dropout_rates=(0.3, 0.3)))
+        variables = init_variables(model, jax.random.key(0))
+        sets = {
+            "Unbalanced": rng.normal(size=(40, 60, 4)).astype(np.float32),
+            "Balanced": rng.normal(size=(32, 60, 4)).astype(np.float32),
+        }
+        table = mcd_pass_sweep(
+            model, variables, sets, pass_counts=(2, 4, 8),
+            config=UQConfig(mcd_batch_size=40), key=jax.random.key(1))
+        assert list(table.columns) == ["N", "Variance_Unbalanced",
+                                       "Variance_Balanced"]
+        table.to_csv(tmp_path / "conv.csv", index=False)
+
+        ref = _exec_reference_module("ref_convergence_plot", self.REF_CONV, {})
+        ref.plot_variance_convergence(
+            str(tmp_path / "conv.csv"),
+            output_plot_filename=str(tmp_path / "conv.png"),
+            method="mcd",
+        )
+        capsys.readouterr()
+        assert (tmp_path / "conv.png").stat().st_size > 0
