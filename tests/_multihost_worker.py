@@ -95,6 +95,17 @@ def main() -> None:
     ))
     assert streamed.shape == (3, 64)
     np.testing.assert_allclose(streamed, hbm, rtol=1e-6, atol=1e-7)
+    # ... and the streamed DE path over the same process-spanning mesh.
+    from apnea_uq_tpu.uq import ensemble_predict, ensemble_predict_streaming
+
+    de_streamed = ensemble_predict_streaming(
+        model, res.stacked_variables(), x[:64], batch_size=22, mesh=mesh,
+    )
+    de_hbm = host_values(ensemble_predict(
+        model, res.stacked_variables(), x[:64], batch_size=22, mesh=mesh,
+    ))
+    assert de_streamed.shape == (2, 64)
+    np.testing.assert_allclose(de_streamed, de_hbm, rtol=1e-6, atol=1e-7)
 
     print(json.dumps({
         "process_id": process_id,
@@ -107,6 +118,7 @@ def main() -> None:
         "mcd_pred_sum": float(mcd.predictions.sum()),
         "mcd_det_accuracy": mcd.deterministic_classification["accuracy"],
         "mcd_streamed_sum": float(streamed.sum()),
+        "de_streamed_sum": float(de_streamed.sum()),
     }))
 
 

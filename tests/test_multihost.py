@@ -70,11 +70,13 @@ def test_two_process_training_matches_single_process():
     np.testing.assert_allclose(outs[0]["mcd_pred_sum"],
                                outs[1]["mcd_pred_sum"], rtol=1e-6)
     assert outs[0]["mcd_det_accuracy"] == outs[1]["mcd_det_accuracy"]
-    # Host-streamed MCD over the process-spanning mesh: both processes
-    # assembled identical streamed predictions (worker also asserts
-    # streamed == in-HBM in-process).
+    # Host-streamed MCD and DE over the process-spanning mesh: both
+    # processes assembled identical streamed predictions (worker also
+    # asserts streamed == in-HBM in-process).
     np.testing.assert_allclose(outs[0]["mcd_streamed_sum"],
                                outs[1]["mcd_streamed_sum"], rtol=1e-6)
+    np.testing.assert_allclose(outs[0]["de_streamed_sum"],
+                               outs[1]["de_streamed_sum"], rtol=1e-6)
 
     # And the 2-host global mesh trains the SAME models as one process
     # with all 8 devices (same data, same mesh shape, same RNG streams).
