@@ -173,3 +173,29 @@ def test_parity_mode_docstrings_agree_on_chunk_stats():
         assert "EFFECTIVE chunk" in doc, (
             f"{name} no longer mentions the mesh-rounded effective chunk"
         )
+
+
+def test_design_doc_tracks_chunk_rounding():
+    """DESIGN.md's streaming-x-mesh section rotted in r4 (it still said
+    'MCD never rounds the chunk up' after both paths gained the shared
+    rounding).  Pin the claim to the code: as long as the predictors
+    share effective_batch_size, DESIGN.md must describe that and must
+    not deny rounding."""
+    import inspect
+
+    from apnea_uq_tpu.uq import predict
+
+    design = (REPO / "docs" / "DESIGN.md").read_text()
+    if hasattr(predict, "effective_batch_size"):
+        assert "never rounds the chunk" not in design, (
+            "DESIGN.md denies chunk rounding, but the MCD paths and "
+            "streamed DE round via effective_batch_size"
+        )
+        src = inspect.getsource(predict)
+        assert src.count("effective_batch_size(batch_size, mesh)") >= 3, (
+            "the shared rounding call sites moved; update this test and "
+            "DESIGN.md together"
+        )
+        assert "effective_batch_size" in design, (
+            "DESIGN.md no longer documents the shared chunk rounding"
+        )
