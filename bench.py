@@ -214,6 +214,18 @@ def bench_bootstrap(n_windows: int, n_boot: int = 100, n_chain: int = 10) -> dic
     }
 
 
+def _guarded(fn, *, skip: bool = False):
+    """Run a secondary context block, degrading failure to a recorded
+    error so the primary metric still prints (the main() watchdog covers
+    hangs; this covers raises)."""
+    if skip:
+        return None
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 — context must not kill the bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def bench_streamed(model, variables, x_host, n_passes, chunk) -> dict:
     """Streamed-vs-in-HBM overhead at identical shapes (r3 verdict item 5):
     streaming is the framework's scaling story for HBM-exceeding test sets
@@ -390,14 +402,17 @@ def bench_mcd() -> dict:
             # Bootstrap engines at the reference test-set scale (~293K
             # windows, SURVEY §1), where the exact engine's gather cost is
             # representative.
-            "bootstrap_b100_m293k": bench_bootstrap(293_000),
+            "bootstrap_b100_m293k": _guarded(lambda: bench_bootstrap(293_000)),
             # Host-streamed vs in-HBM inference at the same shapes — the
-            # measured cost of the HBM-exceeding-set scaling path.
-            "streamed_overhead": (
-                None if os.environ.get("BENCH_SKIP_STREAMED")
-                else bench_streamed(
+            # measured cost of the HBM-exceeding-set scaling path.  A
+            # context block must never sink the primary metric (the r3
+            # bench shipped nothing because one failure took down the
+            # whole run), so failures degrade to an error field.
+            "streamed_overhead": _guarded(
+                lambda: bench_streamed(
                     model, variables, np.asarray(x), n_passes, chunk
-                )
+                ),
+                skip=bool(os.environ.get("BENCH_SKIP_STREAMED")),
             ),
         },
     }

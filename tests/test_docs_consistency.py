@@ -199,3 +199,9 @@ def test_design_doc_tracks_chunk_rounding():
         assert "effective_batch_size" in design, (
             "DESIGN.md no longer documents the shared chunk rounding"
         )
+    else:
+        # Renamed/removed helper: DESIGN.md must not keep citing it.
+        assert "effective_batch_size" not in design, (
+            "predict.effective_batch_size is gone but DESIGN.md still "
+            "cites it; update the doc and this test together"
+        )
