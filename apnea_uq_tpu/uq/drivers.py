@@ -269,6 +269,9 @@ def run_mcd_analysis(
     (default ``prng.bootstrap_key(seed)``) is always threefry so reported
     CIs stay stable across JAX versions/backends.
     """
+    if len(x) == 0:
+        raise ValueError("run_mcd_analysis needs at least one window; "
+                         "got an empty window set")
     if predict_key is None:
         predict_key = prng.stochastic_key(seed)
     if bootstrap_key is None:
@@ -353,6 +356,9 @@ def run_de_analysis(
     ``bootstrap_key`` defaults to ``prng.bootstrap_key(seed)`` — prediction
     itself is deterministic, so ``seed`` only moves the CI resamples.
     """
+    if len(x) == 0:
+        raise ValueError("run_de_analysis needs at least one window; "
+                         "got an empty window set")
     if bootstrap_key is None:
         bootstrap_key = prng.bootstrap_key(seed)
     with Timer(f"{label}.predict") as t:

@@ -179,8 +179,13 @@ def effective_batch_size(batch_size: int, mesh=None) -> int:
 
 
 def _chunk_sharding(mesh, batch_size: int):
-    """Window-axis sharding for streamed chunks, or None when the chunk
-    does not divide the data axis (the in-jit constraint then reshards)."""
+    """Window-axis sharding for streamed chunks.
+
+    The None branch is a defensive guard only: every streamed call site
+    rounds ``batch_size`` via :func:`effective_batch_size` first, so the
+    chunk always divides the data axis.  Were a non-multiple ever passed,
+    returning None keeps the transfer correct (unsharded device_put; the
+    in-jit constraint then reshards) on single-process meshes."""
     if mesh is None:
         return None
     if batch_size % mesh.shape[mesh_lib.AXIS_DATA] != 0:

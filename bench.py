@@ -30,17 +30,33 @@ Env knobs: BENCH_WINDOWS/PASSES/CHUNK (MCD), BENCH_MEMBERS/TRAIN_WINDOWS/
 EPOCHS/BATCH/DE_REPS (DE), BENCH_METRIC=de_train for the DE metric alone,
 BENCH_SKIP_DE=1 to skip the DE secondary, BENCH_SKIP_STREAMED=1 to skip
 the streamed-overhead context, BENCH_DE_CHUNK for its DE chunk size,
+BENCH_BOOT_WINDOWS for the bootstrap context scale,
 BENCH_WATCHDOG_SECS to change or disable (0) the hang watchdog
-(default 45 min).
+(default 45 min), BENCH_INIT_WAIT_SECS to change or disable (0) the
+backend-init retry budget (default 25 min; BENCH_INIT_PROBE_SECS caps
+each individual probe, default 2 min), and two smoke-run knobs:
+BENCH_PLATFORM=cpu runs the whole bench off-TPU (the CPU smoke test's
+path; sitecustomize pins JAX_PLATFORMS at interpreter start, so this is
+a config update, not an env passthrough) and BENCH_DTYPE=float32 swaps
+the bf16 compute dtype (CPU emulates bf16 convs too slowly to smoke).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
+
+# Must precede any device use: the environment's sitecustomize forces
+# JAX_PLATFORMS=axon at interpreter start, so an env var alone cannot
+# retarget the bench — only this config update can (the same dance
+# tests/conftest.py does for the CPU test rig).
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -57,6 +73,79 @@ _CHIP_SPECS = {
     "TPU v6 lite": (918.0, 32e9),
     "TPU v6e": (918.0, 32e9),
 }
+
+
+def _bench_dtype() -> str:
+    """Compute dtype for both timed model paths (default the TPU operating
+    point, bf16 on the MXU).  BENCH_DTYPE=float32 exists for the CPU smoke
+    run — CPU backends emulate bf16 convolutions orders of magnitude too
+    slowly to execute the bench logic at any size."""
+    return os.environ.get("BENCH_DTYPE", "bfloat16")
+
+
+def _emit_bench_error(msg: str) -> None:
+    """The driver-schema error line; shared by every give-up path (init
+    retry exhaustion, hang watchdog) so the parsers downstream see one
+    shape."""
+    print(json.dumps({
+        "metric": "bench_error",
+        "value": 0,
+        "unit": "error",
+        "vs_baseline": 0,
+        "error": msg,
+    }), flush=True)
+
+
+def _wait_for_backend() -> None:
+    """Retry backend init until it works or a budget expires (r4 verdict:
+    the round-4 capture died in seconds on a fast ``UNAVAILABLE`` from a
+    flapping tunnel, and the watchdog only covers the *hang* failure mode).
+
+    Probes ``jax.devices()`` in a budgeted subprocess — the call can hang
+    indefinitely during a tunnel outage, so it must not run in this
+    process — and retries with backoff for up to BENCH_INIT_WAIT_SECS
+    (default 25 min, 0 disables) before emitting the standard error JSON
+    line and exiting non-zero.  Skipped entirely under BENCH_PLATFORM
+    (an explicitly retargeted backend, e.g. the CPU smoke run, has no
+    tunnel to wait for)."""
+    import subprocess
+
+    if os.environ.get("BENCH_PLATFORM"):
+        return
+    budget = float(os.environ.get("BENCH_INIT_WAIT_SECS", 1500))
+    if budget <= 0:
+        return
+    probe_timeout = float(os.environ.get("BENCH_INIT_PROBE_SECS", 120))
+    deadline = time.monotonic() + budget
+    delay = 20.0
+    attempts, last = 0, "no probe ran"
+    while True:
+        attempts += 1
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; assert jax.devices()"],
+                capture_output=True, text=True, timeout=probe_timeout,
+            )
+            if r.returncode == 0:
+                return
+            tail = (r.stderr or r.stdout).strip().splitlines()
+            last = tail[-1] if tail else f"probe exited rc={r.returncode}"
+        except subprocess.TimeoutExpired:
+            last = (f"probe hung >{probe_timeout:.0f}s in jax.devices() "
+                    f"(tunnel-outage pattern)")
+        # Clamp the final sleep to the remaining budget rather than giving
+        # up when the next full delay would cross the deadline — a tunnel
+        # recovering inside that last window still gets its probe.
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        time.sleep(min(delay, remaining))
+        delay = min(delay * 1.6, 300.0)
+    _emit_bench_error(
+        f"TPU backend unavailable after {attempts} init probes "
+        f"over {budget:.0f}s; last: {last}"
+    )
+    sys.exit(2)
 
 
 def _time(fn, *args, warmup: int = 1, reps: int = 3) -> float:
@@ -116,7 +205,7 @@ def bench_de_train() -> dict:
     x = rng.normal(size=(n_windows, 60, 4)).astype(np.float32)
     y = rng.integers(0, 2, n_windows).astype(np.float32)
 
-    model = AlarconCNN1D(ModelConfig(compute_dtype="bfloat16"))
+    model = AlarconCNN1D(ModelConfig(compute_dtype=_bench_dtype()))
     no_stop = n_epochs + 1  # patience > epochs -> fixed-length run
 
     # Setup (config construction, param init) stays OUTSIDE the timed
@@ -304,7 +393,7 @@ def bench_mcd() -> dict:
     x = jnp.asarray(rng.normal(size=(n_windows, 60, 4)), jnp.float32)
 
     # Framework path: bf16 MXU compute, vmap over dropout keys, chunked.
-    model_cfg = ModelConfig(compute_dtype="bfloat16")
+    model_cfg = ModelConfig(compute_dtype=_bench_dtype())
     model = AlarconCNN1D(model_cfg)
     variables = init_variables(model, jax.random.key(0))
 
@@ -401,8 +490,9 @@ def bench_mcd() -> dict:
             "implied_mfu": round(achieved_tflops / peak, 4) if peak else None,
             # Bootstrap engines at the reference test-set scale (~293K
             # windows, SURVEY §1), where the exact engine's gather cost is
-            # representative.
-            "bootstrap_b100_m293k": _guarded(lambda: bench_bootstrap(293_000)),
+            # representative (BENCH_BOOT_WINDOWS shrinks it for smoke runs).
+            "bootstrap_b100_m293k": _guarded(lambda: bench_bootstrap(
+                int(os.environ.get("BENCH_BOOT_WINDOWS", 293_000)))),
             # Host-streamed vs in-HBM inference at the same shapes — the
             # measured cost of the HBM-exceeding-set scaling path.  A
             # context block must never sink the primary metric (the r3
@@ -434,14 +524,10 @@ def _start_watchdog():
         return None
 
     def fire():
-        print(json.dumps({
-            "metric": "bench_error",
-            "value": 0,
-            "unit": "error",
-            "vs_baseline": 0,
-            "error": f"bench did not complete within {secs:.0f}s "
-                     f"(device/tunnel hang?)",
-        }), flush=True)
+        _emit_bench_error(
+            f"bench did not complete within {secs:.0f}s "
+            f"(device/tunnel hang?)"
+        )
         os._exit(3)
 
     timer = threading.Timer(secs, fire)
@@ -451,6 +537,7 @@ def _start_watchdog():
 
 
 def main() -> None:
+    _wait_for_backend()
     watchdog = _start_watchdog()
     if os.environ.get("BENCH_METRIC") == "de_train":
         result = bench_de_train()

@@ -10,7 +10,7 @@
 // in-tree (preprocess_shhs_raw.py:3,129-137).
 //
 // Build: make -C native   (or apnea_uq_tpu/data/_native.py compiles it on
-// first use with g++ -O3 -march=native -shared -fPIC).
+// first use with g++ -O3 -fPIC -shared -std=c++17).
 
 #include <cstdint>
 

@@ -1,0 +1,194 @@
+"""bench.py execution coverage (r4 verdict item 2).
+
+Two rounds of bench rework shipped without ever executing — the TPU
+tunnel was down and the script had no off-TPU path — so a bench-script
+bug could silently waste the next hardware capture.  These tests make
+that impossible:
+
+* the CPU smoke test runs the REAL ``python bench.py`` end-to-end at
+  tiny shapes (``BENCH_PLATFORM=cpu`` + size knobs) and asserts the one
+  JSON line carries the full schema — primary metric, DE secondary, and
+  the streamed-overhead + bootstrap context blocks with no degraded
+  ``error`` fields;
+* the ``_wait_for_backend`` unit tests cover the init retry loop added
+  for the *fast-fail* outage mode (r4's capture died in seconds on
+  ``UNAVAILABLE``): transient failures retry with backoff, an exhausted
+  budget emits the standard ``bench_error`` JSON line and exits 2, and
+  explicit platform overrides skip the probe entirely.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+SMOKE_ENV = {
+    # Retarget the backend from inside bench.py (sitecustomize pins
+    # JAX_PLATFORMS=axon at boot, so the subprocess is the production
+    # smoke path, not a test shortcut).
+    "BENCH_PLATFORM": "cpu",
+    "BENCH_DTYPE": "float32",  # CPU emulates bf16 convs too slowly
+    "BENCH_WINDOWS": "256",
+    "BENCH_PASSES": "4",
+    "BENCH_CHUNK": "64",
+    # XLA:CPU backward convolutions run far off peak, so the DE-train
+    # block dominates the smoke wall-clock — keep its shapes minimal.
+    "BENCH_MEMBERS": "2",
+    "BENCH_TRAIN_WINDOWS": "64",
+    "BENCH_EPOCHS": "1",
+    "BENCH_BATCH": "32",
+    "BENCH_DE_REPS": "1",
+    "BENCH_DE_CHUNK": "64",
+    "BENCH_BOOT_WINDOWS": "2048",
+    "BENCH_WATCHDOG_SECS": "900",
+}
+
+
+@pytest.mark.slow  # fresh interpreter + full-model CPU convs (~3-5 min)
+def test_bench_cpu_smoke_end_to_end():
+    # Strip ambient BENCH_* knobs too: an exported BENCH_SKIP_DE/
+    # BENCH_METRIC in a developer shell must not reshape the asserted
+    # schema (SMOKE_ENV is the complete knob set for this run).
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+           and not k.startswith("BENCH_")}
+    env.update(SMOKE_ENV)
+    # Share the suite's persistent compile cache so repeat runs are warm.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(__file__), ".jax_cache"))
+    proc = subprocess.run(
+        [sys.executable, BENCH], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, f"bench.py failed:\n{proc.stderr[-3000:]}"
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE json line, got: {proc.stdout!r}"
+    result = json.loads(lines[0])
+
+    # Driver schema on the primary metric.
+    assert result["metric"] == "mcd_t50_inference_throughput"
+    assert result["unit"] == "windows/sec/chip"
+    assert result["value"] > 0
+    assert result["vs_baseline"] > 0
+    assert result["effective"]["windows"] == 256
+
+    # DE secondary in the same schema (metric name tracks BENCH_MEMBERS).
+    sec = result["secondary"]
+    assert sec["metric"] == "de2_train_wallclock"
+    assert sec["unit"] == "seconds"
+    assert sec["value"] > 0
+    assert sec["vs_baseline"] > 0
+    assert len(sec["effective"]["per_rep_ratios"]) == 1
+
+    # Context blocks executed for real — no degraded error fields.
+    ctx = result["context"]
+    boot = ctx["bootstrap_b100_m293k"]
+    assert "error" not in boot, boot
+    assert boot["exact_ms"] > 0 and boot["poisson_ms"] > 0
+    streamed = ctx["streamed_overhead"]
+    assert "error" not in streamed, streamed
+    for key in ("mcd_streamed_vs_inhbm", "de10_streamed_vs_inhbm"):
+        assert streamed[key] > 0, (key, streamed)
+
+
+@pytest.fixture(scope="module")
+def bench_mod():
+    # exec_module runs bench.py's top level IN THIS PROCESS; an ambient
+    # BENCH_PLATFORM would make it jax.config.update the suite's global
+    # platform mid-run, so shield it for the import (module-scope fixture,
+    # so no monkeypatch — restore by hand).
+    saved = os.environ.pop("BENCH_PLATFORM", None)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_bench_under_test", BENCH)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    finally:
+        if saved is not None:
+            os.environ["BENCH_PLATFORM"] = saved
+    return mod
+
+
+def _proc(rc: int, stderr: str = "") -> types.SimpleNamespace:
+    return types.SimpleNamespace(returncode=rc, stderr=stderr, stdout="")
+
+
+class TestWaitForBackend:
+    def test_transient_unavailable_retries_then_succeeds(
+        self, bench_mod, monkeypatch
+    ):
+        calls, sleeps = [], []
+        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+        monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "600")
+
+        def fake_run(cmd, **kw):
+            calls.append(cmd)
+            if len(calls) < 3:
+                return _proc(1, "jaxlib.xla_extension.XlaRuntimeError: "
+                                "UNAVAILABLE: TPU backend setup error")
+            return _proc(0)
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        monkeypatch.setattr(time, "sleep", sleeps.append)
+        bench_mod._wait_for_backend()  # returns without raising
+        assert len(calls) == 3
+        assert sleeps == [20.0, 32.0]  # backoff between failed probes
+
+    def test_exhausted_budget_emits_error_json_and_exits(
+        self, bench_mod, monkeypatch, capsys
+    ):
+        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+        monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "1")
+        monkeypatch.setattr(
+            subprocess, "run",
+            lambda cmd, **kw: _proc(1, "UNAVAILABLE: flapping tunnel"),
+        )
+        # With sleep a no-op the loop spins probes until the 1s budget's
+        # monotonic deadline passes, then gives up with the error line.
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        with pytest.raises(SystemExit) as exc:
+            bench_mod._wait_for_backend()
+        assert exc.value.code == 2
+        err = json.loads(capsys.readouterr().out.strip())
+        assert err["metric"] == "bench_error"
+        assert err["unit"] == "error"
+        assert "UNAVAILABLE: flapping tunnel" in err["error"]
+
+    def test_hang_mode_reported(self, bench_mod, monkeypatch, capsys):
+        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+        monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "1")
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+
+        def hang(cmd, **kw):
+            raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 120))
+
+        monkeypatch.setattr(subprocess, "run", hang)
+        with pytest.raises(SystemExit):
+            bench_mod._wait_for_backend()
+        err = json.loads(capsys.readouterr().out.strip())
+        assert "hung" in err["error"]
+
+    def test_platform_override_skips_probe(self, bench_mod, monkeypatch):
+        def boom(cmd, **kw):  # pragma: no cover - must not run
+            raise AssertionError("probe must not run under BENCH_PLATFORM")
+
+        monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+        monkeypatch.setattr(subprocess, "run", boom)
+        bench_mod._wait_for_backend()
+
+    def test_zero_budget_disables(self, bench_mod, monkeypatch):
+        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+        monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "0")
+        monkeypatch.setattr(
+            subprocess, "run",
+            lambda cmd, **kw: (_ for _ in ()).throw(AssertionError),
+        )
+        bench_mod._wait_for_backend()

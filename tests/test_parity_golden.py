@@ -133,17 +133,26 @@ def test_parity_mode_depresses_accuracy_end_to_end(trained):
     statistics) differs from training (SURVEY §6;
     analyze_mcd_patient_level.py:121,203-211).  Clean MCD stays at the
     deterministic level — the reference's pre-MCD sanity-probe
-    relationship."""
+    relationship.
+
+    The set carries 6% label noise (labels flipped AFTER the windows are
+    generated) so the deterministic accuracy sits measurably below 1.0:
+    on a fully separable set both halves of the claim were trivially
+    satisfied at det == clean == 1.000 (r4 verdict) — here "clean tracks
+    deterministic" and "parity drops below clean" are each load-bearing
+    at a realistic operating point."""
     model, variables, _, _ = trained
     rng = np.random.default_rng(7)
     n = 768
-    y = (rng.uniform(size=n) < 0.07).astype(np.float32)  # ~7% positive
+    y_struct = (rng.uniform(size=n) < 0.07).astype(np.float32)  # ~7% pos
     x = rng.normal(size=(n, 60, 4)).astype(np.float32)
-    x[:, :, 0] += (y * 2.0 - 1.0)[:, None] * 0.5
+    x[:, :, 0] += (y_struct * 2.0 - 1.0)[:, None] * 0.5
+    flip = rng.uniform(size=n) < 0.06  # irreducible-error windows
+    y = np.where(flip, 1.0 - y_struct, y_struct).astype(np.float32)
 
     det = np.asarray(predict_proba_batched(model, variables, x))
     det_acc = float(np.mean((det > 0.5) == y))
-    assert det_acc >= 0.85, det_acc
+    assert 0.85 <= det_acc < 1.0, det_acc
 
     key = jax.random.key(11)
     clean = np.asarray(mc_dropout_predict(

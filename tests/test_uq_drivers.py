@@ -20,6 +20,7 @@ from apnea_uq_tpu.uq import (
     run_mcd_analysis,
     save_run,
 )
+from apnea_uq_tpu.uq.predict import stack_member_variables
 
 
 def _tiny():
@@ -170,6 +171,25 @@ class TestEndToEnd:
                 warnings.simplefilter("error")
                 run_mcd_analysis(model, variables, x, y, config=quiet,
                                  detailed=False, sanity_check=False)
+
+    def test_empty_window_set_raises_value_error(self, setup):
+        """An empty window set must fail with a clear ValueError up front —
+        not a ZeroDivisionError from the parity chunk warning (advisor r4)
+        or a silent (T, 0) result with NaN aggregates."""
+        model, variables, _, _, _ = setup
+        x0 = np.zeros((0, 60, 4), np.float32)
+        y0 = np.zeros((0,), np.int64)
+        cfg = UQConfig(mc_passes=2, n_bootstrap=5, mcd_mode="parity",
+                       mcd_batch_size=32)
+        with pytest.raises(ValueError, match="at least one window"):
+            run_mcd_analysis(model, variables, x0, y0, config=cfg,
+                             detailed=False, sanity_check=False)
+        members = stack_member_variables(
+            [init_variables(model, jax.random.key(s)) for s in range(2)]
+        )
+        with pytest.raises(ValueError, match="at least one window"):
+            run_de_analysis(model, members, x0, y0, config=cfg,
+                            detailed=False)
 
     def test_parity_warning_uses_mesh_effective_chunk(self, setup):
         """On a mesh the predictor rounds the chunk up to the data-axis
