@@ -121,17 +121,21 @@ def _wait_for_backend() -> None:
     attempts, last = 0, "no probe ran"
     while True:
         attempts += 1
+        # A hang-mode probe must not overshoot the budget either: cap
+        # the last probe at the remaining time.
+        probe_budget = min(probe_timeout,
+                           max(deadline - time.monotonic(), 1.0))
         try:
             r = subprocess.run(
                 [sys.executable, "-c", "import jax; assert jax.devices()"],
-                capture_output=True, text=True, timeout=probe_timeout,
+                capture_output=True, text=True, timeout=probe_budget,
             )
             if r.returncode == 0:
                 return
             tail = (r.stderr or r.stdout).strip().splitlines()
             last = tail[-1] if tail else f"probe exited rc={r.returncode}"
         except subprocess.TimeoutExpired:
-            last = (f"probe hung >{probe_timeout:.0f}s in jax.devices() "
+            last = (f"probe hung >{probe_budget:.0f}s in jax.devices() "
                     f"(tunnel-outage pattern)")
         # Clamp the final sleep to the remaining budget rather than giving
         # up when the next full delay would cross the deadline — a tunnel

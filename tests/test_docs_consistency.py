@@ -205,3 +205,48 @@ def test_design_doc_tracks_chunk_rounding():
             "predict.effective_batch_size is gone but DESIGN.md still "
             "cites it; update the doc and this test together"
         )
+
+
+def test_bench_env_knobs_are_documented():
+    """bench.py's module docstring is the operator's knob reference for
+    the one hardware capture per round; an undocumented knob is
+    undiscoverable mid-outage (r5 review caught BENCH_INIT_PROBE_SECS
+    missing).  Enforce both directions against the source: every
+    BENCH_* env var the script reads appears in the docstring, and the
+    docstring names no phantom knobs."""
+    source = (REPO / "bench.py").read_text()
+    tree = ast.parse(source)
+    read = set()
+    for node in ast.walk(tree):
+        # os.environ.get("BENCH_X", ...), os.getenv("BENCH_X"), and
+        # os.environ["BENCH_X"]
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if (node.func.attr in ("get", "getenv") and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and str(node.args[0].value).startswith("BENCH_")):
+                read.add(node.args[0].value)
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)  # reads, not env writes
+                and isinstance(node.slice, ast.Constant)
+                and str(node.slice.value).startswith("BENCH_")):
+            read.add(node.slice.value)
+    assert read, "bench.py reads no BENCH_* knobs? scan is broken"
+
+    docstring = ast.get_docstring(tree) or ""
+    documented = set(re.findall(r"BENCH_[A-Z_]+", docstring))
+    # The docstring compresses families as BENCH_WINDOWS/PASSES/CHUNK —
+    # expand slash-joined suffixes after a BENCH_ prefix (the list may
+    # wrap across a line break after a slash).
+    for m in re.finditer(r"BENCH_([A-Z_]+(?:/\s*[A-Z_]+)+)", docstring):
+        for suffix in re.split(r"/\s*", m.group(1)):
+            documented.add(f"BENCH_{suffix}")
+    undocumented = read - documented
+    assert not undocumented, (
+        f"bench.py reads {sorted(undocumented)} but its module docstring "
+        "(the operator knob reference) does not mention them"
+    )
+    phantom = documented - read
+    assert not phantom, (
+        f"bench.py's docstring documents {sorted(phantom)} but the script "
+        "never reads them (knob rot)"
+    )
