@@ -192,3 +192,56 @@ class TestWaitForBackend:
             lambda cmd, **kw: (_ for _ in ()).throw(AssertionError),
         )
         bench_mod._wait_for_backend()
+
+
+class TestMainDispatch:
+    """main()'s metric routing and watchdog lifecycle, with the heavy
+    bench functions stubbed out — the only bench.py lines the CPU smoke
+    does not execute are the BENCH_METRIC=de_train and BENCH_SKIP_DE
+    branches."""
+
+    @pytest.fixture(autouse=True)
+    def stub(self, bench_mod, monkeypatch):
+        monkeypatch.setenv("BENCH_PLATFORM", "cpu")  # skip the init probe
+        # Every test starts from a clean knob state — ambient exported
+        # BENCH_METRIC/BENCH_SKIP_DE must not reroute the branch under
+        # test (the same sanitization the subprocess smoke test does).
+        monkeypatch.delenv("BENCH_METRIC", raising=False)
+        monkeypatch.delenv("BENCH_SKIP_DE", raising=False)
+        monkeypatch.setattr(bench_mod, "bench_mcd", lambda: {"metric": "mcd"})
+        monkeypatch.setattr(
+            bench_mod, "bench_de_train", lambda: {"metric": "de"})
+        self.bench_mod = bench_mod
+
+    def _run(self, capsys):
+        self.bench_mod.main()
+        return json.loads(capsys.readouterr().out.strip())
+
+    def test_default_is_mcd_plus_de_secondary(self, capsys):
+        out = self._run(capsys)
+        assert out["metric"] == "mcd"
+        assert out["secondary"]["metric"] == "de"
+
+    def test_skip_de_drops_secondary(self, monkeypatch, capsys):
+        monkeypatch.setenv("BENCH_SKIP_DE", "1")
+        out = self._run(capsys)
+        assert out["metric"] == "mcd"
+        assert "secondary" not in out
+
+    def test_de_train_metric_runs_alone(self, monkeypatch, capsys):
+        monkeypatch.setenv("BENCH_METRIC", "de_train")
+        out = self._run(capsys)
+        assert out == {"metric": "de"}
+
+    def test_watchdog_cancelled_after_results(self, monkeypatch, capsys):
+        cancelled = []
+
+        class Timer:
+            def cancel(self):
+                cancelled.append(True)
+
+        monkeypatch.setattr(
+            self.bench_mod, "_start_watchdog", lambda: Timer())
+        self._run(capsys)
+        assert cancelled == [True]
+
