@@ -162,7 +162,9 @@ def cmd_train(args, config) -> int:
 
 def cmd_train_ensemble(args, config) -> int:
     from apnea_uq_tpu.parallel import fit_ensemble
-    from apnea_uq_tpu.training import EnsembleCheckpointStore, save_ensemble
+    from apnea_uq_tpu.training import (
+        EnsembleCheckpointStore, save_ensemble_result,
+    )
 
     registry = _registry(args)
     prepared, _ = _load_test_sets(registry, include_train=True)
@@ -191,8 +193,17 @@ def cmd_train_ensemble(args, config) -> int:
         member_indices=[s - cfg.seed_base for s in missing],
         log_fn=print,
     )
-    save_ensemble(store, result.state, missing)
-    print(f"saved {len(missing)} members -> {store.root}")
+    # The result may carry MORE members than requested: with
+    # keep_padded_members the padded lockstep slots come back as real
+    # members, each checkpointed under its global-index seed (bit-identical
+    # to what a fresh larger run would save, so growing N later re-trains
+    # nothing).  skip_existing covers the resume corner where a promoted
+    # slot's seed is already on disk from an earlier run.
+    save_ensemble_result(store, result, seed_base=cfg.seed_base,
+                         skip_existing=True)
+    promoted = result.promoted_members
+    extra = f" (incl. {promoted} promoted padded slots)" if promoted else ""
+    print(f"saved {result.num_members} members{extra} -> {store.root}")
     return 0
 
 
@@ -202,9 +213,14 @@ def _restore_members(args, config, n_members):
     model, template = _baseline_template(config)
     store = EnsembleCheckpointStore(os.path.join(_ckpt_root(args), "ensemble"))
     seeds = store.existing_seeds()
-    if len(seeds) < n_members:
+    if n_members <= 0:
+        # "All checkpointed members" — the natural companion of padded-slot
+        # promotion, where the store holds more members than the configured
+        # N and every one of them is free uncertainty capacity.
+        n_members = len(seeds)
+    if not seeds or len(seeds) < n_members:
         raise SystemExit(
-            f"need {n_members} ensemble members, found {len(seeds)} "
+            f"need {max(n_members, 1)} ensemble members, found {len(seeds)} "
             f"in {store.root} — run train-ensemble first"
         )
     states = store.restore_members(seeds[:n_members], template)
@@ -302,6 +318,7 @@ def cmd_eval_de(args, config) -> int:
 
     registry = _registry(args)
     model, member_variables = _restore_members(args, config, args.num_members)
+    n_members = len(member_variables)  # resolved count (0 -> all existing)
     _prepared, sets = _load_test_sets(registry)
     for label, (x, y, ids) in sets.items():
         with profile_trace(getattr(args, "profile_dir", None)):
@@ -309,7 +326,7 @@ def cmd_eval_de(args, config) -> int:
                 model, member_variables, x, y, patient_ids=ids,
                 config=config.uq, label=f"CNN_DE_{label}",
                 seed=config.train.seed,
-                mesh=_mesh(config, num_members=args.num_members),
+                mesh=_mesh(config, num_members=n_members),
                 detailed=ids is not None and not args.no_detailed,
             )
         _print_run(result)
@@ -602,7 +619,11 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p = add("eval-de", cmd_eval_de, "Deep-Ensemble UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
-    p.add_argument("--num-members", type=int, default=5)
+    p.add_argument("--num-members", type=int, default=5,
+                   help="Ensemble members to evaluate (default 5); 0 (or "
+                        "negative) evaluates every checkpointed member — "
+                        "incl. padded slots promoted by "
+                        "EnsembleConfig.keep_padded_members.")
     _add_no_detailed_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)

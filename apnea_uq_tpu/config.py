@@ -90,6 +90,14 @@ class EnsembleConfig:
     # Stream per-member batch stacks from host memory instead of holding
     # the dataset in HBM (identical results; for HBM-exceeding datasets).
     streaming: bool = False
+    # Lockstep vmap packing pads num_members up to a multiple of the mesh
+    # ensemble axis; the padded slots train real epochs either way.  False
+    # (default) discards their weights — the historical behavior.  True
+    # promotes them to REAL returned members: N=10 on an 8-wide axis
+    # yields 16 members from the same jitted epoch work, bit-identical to
+    # an explicit N=16 run with the same root key (padded slots already
+    # receive globally-consistent per-member RNG streams).
+    keep_padded_members: bool = False
     # Per-member per-epoch accuracy + streaming-histogram ROC-AUC on device
     # (the reference's ensemble trainer compiles the same Keras metrics as
     # the baseline); adds (epochs, N) history arrays accuracy/auc/

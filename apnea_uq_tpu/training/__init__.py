@@ -2,7 +2,9 @@ from apnea_uq_tpu.training.checkpoint import (
     EnsembleCheckpointStore,
     member_state,
     restore_state,
+    result_member_seeds,
     save_ensemble,
+    save_ensemble_result,
     save_state,
 )
 from apnea_uq_tpu.training.state import TrainState, create_train_state
@@ -19,4 +21,6 @@ __all__ = [
     "restore_state",
     "member_state",
     "save_ensemble",
+    "save_ensemble_result",
+    "result_member_seeds",
 ]

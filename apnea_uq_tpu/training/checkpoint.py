@@ -125,3 +125,32 @@ def save_ensemble(
             continue
         paths.append(store.save_member(seed, member_state(stacked, i)))
     return paths
+
+
+def result_member_seeds(result, seed_base: int) -> List[int]:
+    """The checkpoint seeds of every member a ``fit_ensemble`` result
+    returned: ``seed_base + global_member_index``, the same arithmetic the
+    reference's seed-per-member scheme uses (train_deep_ensemble_cnns.py:
+    126).  Derived from ``result.member_ids`` rather than a 0..N-1 range
+    so promoted padded slots (``EnsembleConfig.keep_padded_members``) and
+    resumed partial runs both land under the seed a fresh full run of
+    that size would have used — growing N later re-trains nothing."""
+    if result.member_ids is None:  # legacy result: positional members
+        return [seed_base + i for i in range(result.num_members)]
+    return [seed_base + int(g) for g in result.member_ids]
+
+
+def save_ensemble_result(
+    store: EnsembleCheckpointStore,
+    result,
+    *,
+    seed_base: int,
+    skip_existing: bool = False,
+) -> List[str]:
+    """Checkpoint every member of an :class:`EnsembleFitResult` — the
+    requested members AND any promoted padded slots — under its
+    global-index seed."""
+    return save_ensemble(
+        store, result.state, result_member_seeds(result, seed_base),
+        skip_existing=skip_existing,
+    )

@@ -353,8 +353,13 @@ def run_de_analysis(
 
     Members are vmapped in one program (uq/predict.py) instead of the
     reference's N sequential full-set predicts (uq_techniques.py:29-30).
-    ``bootstrap_key`` defaults to ``prng.bootstrap_key(seed)`` — prediction
-    itself is deterministic, so ``seed`` only moves the CI resamples.
+    ``member_variables`` takes any carrier ``as_stacked_members`` accepts —
+    including a ``fit_ensemble`` result, whose EFFECTIVE member count
+    (promoted padded slots included, ``EnsembleConfig.keep_padded_members``)
+    then feeds the uncertainty decomposition: the formulas are unchanged,
+    they simply see N_eff passes.  ``bootstrap_key`` defaults to
+    ``prng.bootstrap_key(seed)`` — prediction itself is deterministic, so
+    ``seed`` only moves the CI resamples.
     """
     if len(x) == 0:
         raise ValueError("run_de_analysis needs at least one window; "

@@ -28,6 +28,13 @@ from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
 from apnea_uq_tpu.parallel import mesh as mesh_lib
 from apnea_uq_tpu.utils import prng
 
+# jax exports shard_map at top level from 0.5; on 0.4.x it lives under
+# jax.experimental with the same (f, mesh, in_specs, out_specs) signature.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 _MCD_MODES = {"clean": "mcd_clean", "parity": "mcd_parity"}
 
 
@@ -307,6 +314,21 @@ def stack_member_variables(member_variables: list) -> dict:
     return jax.tree.map(lambda *leaves: jnp.stack(leaves, axis=0), *member_variables)
 
 
+def as_stacked_members(member_variables) -> dict:
+    """Normalize every accepted DE-member carrier to one stacked pytree:
+    a list/tuple of per-member variable dicts, an already-stacked pytree,
+    or an ``EnsembleFitResult`` (duck-typed via ``stacked_variables`` to
+    avoid importing the trainer here).  Accepting the fit result directly
+    means the EFFECTIVE member count — including padded lockstep slots
+    promoted by ``EnsembleConfig.keep_padded_members`` — flows into
+    inference whole; callers can't accidentally re-slice it away."""
+    if hasattr(member_variables, "stacked_variables"):
+        member_variables = member_variables.stacked_variables()
+    if isinstance(member_variables, (list, tuple)):
+        member_variables = stack_member_variables(list(member_variables))
+    return member_variables
+
+
 @partial(jax.jit, static_argnames=("model", "batch_size"))
 def _ensemble_jit(model, stacked_variables, x, batch_size):
     chunks, m = _chunk(x, batch_size)
@@ -349,7 +371,7 @@ def _ensemble_shard_map_jit(model, stacked_variables, x, batch_size, mesh):
 
         return jax.vmap(one_member)(member_vars)        # (N_local, m_local)
 
-    f = jax.shard_map(
+    f = _shard_map(
         block,
         mesh=mesh,
         in_specs=(P(mesh_lib.AXIS_ENSEMBLE), P(mesh_lib.AXIS_DATA)),
@@ -373,7 +395,7 @@ def _ensemble_chunk_mesh_jit(model, stacked_variables, chunk, mesh):
     same explicit shard_map layout as :func:`_ensemble_shard_map_jit` —
     each device computes its (member-group x window-slice) block of the
     chunk with purely local math."""
-    f = jax.shard_map(
+    f = _shard_map(
         lambda mv, xl: _ensemble_chunk_jit.__wrapped__(model, mv, xl),
         mesh=mesh,
         in_specs=(P(mesh_lib.AXIS_ENSEMBLE), P(mesh_lib.AXIS_DATA)),
@@ -403,8 +425,7 @@ def ensemble_predict_streaming(
     composing the small-memory and many-chips axes.  The chunk size is
     rounded up to the data-axis multiple shard_map requires.
     """
-    if isinstance(member_variables, (list, tuple)):
-        member_variables = stack_member_variables(list(member_variables))
+    member_variables = as_stacked_members(member_variables)
     n_members = jax.tree.leaves(member_variables)[0].shape[0]
     if mesh is None:
         return _stream_chunked(
@@ -441,15 +462,16 @@ def ensemble_predict(
     footprint scales with ``n_members * batch_size`` rows (see the HBM
     note on :func:`mc_dropout_predict`).
 
-    ``member_variables`` is either a list of per-member variable pytrees or
-    an already-stacked pytree with a leading member axis.  Members are
+    ``member_variables`` is a list of per-member variable pytrees, an
+    already-stacked pytree with a leading member axis, or a
+    ``fit_ensemble`` result (whose effective member count — promoted
+    padded slots included — then flows into inference).  Members are
     vmapped — one batched program instead of the reference's N sequential
     ``model.predict`` calls (uq_techniques.py:29-30).  With ``mesh``,
     members spread over the ``ensemble`` axis and windows over ``data``,
     so eval-de scales across a pod instead of leaving chips idle.
     """
-    if isinstance(member_variables, (list, tuple)):
-        member_variables = stack_member_variables(list(member_variables))
+    member_variables = as_stacked_members(member_variables)
     x = jnp.asarray(x, jnp.float32)
     n_members = jax.tree.leaves(member_variables)[0].shape[0]
     if mesh is not None:

@@ -11,6 +11,14 @@ from __future__ import annotations
 
 import jax
 
+# Partitionable threefry is a correctness requirement here, not a perf
+# knob: the mesh predictors vmap dropout keys with spmd_axis_name and
+# assert sharded == single-device results bit-for-bit, which only holds
+# when random-bit generation is sharding-invariant.  Newer JAX defaults
+# this on; older 0.4.x rigs default it off and produce mesh-dependent
+# dropout masks, so pin it at import (before any key is made).
+jax.config.update("jax_threefry_partitionable", True)
+
 # Stream ids folded into derived keys.  Arbitrary but fixed constants.
 STREAM_INIT = 0x1A17
 STREAM_SHUFFLE = 0x5487
