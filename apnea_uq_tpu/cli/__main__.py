@@ -1,0 +1,8 @@
+"""``python -m apnea_uq_tpu.cli`` — the same entry point as ``apnea-uq``."""
+
+import sys
+
+from apnea_uq_tpu.cli.main import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,7 @@ import sys
 
 from apnea_uq_tpu import __version__
 from apnea_uq_tpu.config import ExperimentConfig, load_config, save_config
+from apnea_uq_tpu.telemetry import log
 
 
 def _add_config_arg(p: argparse.ArgumentParser) -> None:
@@ -27,7 +28,7 @@ def _load(args) -> ExperimentConfig:
 
 def cmd_init_config(args) -> int:
     save_config(ExperimentConfig(), args.out)
-    print(f"wrote default config to {args.out}")
+    log(f"wrote default config to {args.out}")
     return 0
 
 

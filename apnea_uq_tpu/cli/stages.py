@@ -16,12 +16,39 @@ lazily so ``--help`` stays instant.
 from __future__ import annotations
 
 import os
+import sys
+
+from apnea_uq_tpu.telemetry import log
 
 
 def _registry(args):
     from apnea_uq_tpu.data.registry import ArtifactRegistry
 
     return ArtifactRegistry(args.registry)
+
+
+def _run(args, stage: str, config):
+    """Open the stage's telemetry run log (events.jsonl + config snapshot
+    under ``--run-dir``, defaulting to ``<registry>/runs/<stage>-...``).
+    Device-heavy stages emit their per-epoch / per-eval metric blocks
+    through this; ``apnea-uq telemetry summarize`` reads it back."""
+    from apnea_uq_tpu.telemetry import default_run_dir, start_run
+
+    run_dir = getattr(args, "run_dir", None) or default_run_dir(
+        args.registry, stage
+    )
+    run_log = start_run(run_dir, stage=stage, config=config,
+                        argv=sys.argv[1:])
+    log(f"telemetry -> {run_dir}")
+    return run_log
+
+
+def _add_run_dir_arg(p) -> None:
+    p.add_argument("--run-dir", default=None,
+                   help="Telemetry run directory (events.jsonl + config "
+                        "snapshot); default <registry>/runs/<stage>-"
+                        "<timestamp>-<pid>.  Read it back with "
+                        "`apnea-uq telemetry summarize <run-dir>`.")
 
 
 def _ckpt_root(args) -> str:
@@ -93,15 +120,15 @@ def cmd_ingest(args, config) -> int:
         num_files=args.num_files, workers=args.workers,
     )
     excluded = [r for r in reports if r.excluded]
-    print(f"processed {len(reports)} recordings, excluded {len(excluded)}")
+    log(f"processed {len(reports)} recordings, excluded {len(excluded)}")
     for r in excluded:
-        print(f"  excluded {r.patient_id}: {r.excluded}")
+        log(f"  excluded {r.patient_id}: {r.excluded}")
     if windows is None:
-        print("no windows produced")
+        log("no windows produced")
         return 1
     registry = _registry(args)
     registry.save_arrays(reg.WINDOWS, windows.to_arrays(), config=config.ingest)
-    print(f"saved {len(windows)} windows -> {registry.root}")
+    log(f"saved {len(windows)} windows -> {registry.root}")
     return 0
 
 
@@ -117,7 +144,7 @@ def cmd_prepare(args, config) -> int:
         windows = WindowSet.from_arrays(registry.load_arrays(reg.WINDOWS))
     prepared = prepare_datasets(windows, config.prepare)
     save_prepared(prepared, registry, config.prepare)
-    print(
+    log(
         f"train {prepared.x_train.shape}, test {prepared.x_test.shape}, "
         f"rus {None if prepared.x_test_rus is None else prepared.x_test_rus.shape}"
     )
@@ -140,23 +167,27 @@ def cmd_train(args, config) -> int:
         learning_rate=config.train.learning_rate,
     )
     mesh = _data_mesh()
-    result = fit(
-        model, state, prepared.x_train, prepared.y_train, config.train,
-        mesh=mesh, log_fn=print,
-    )
-    path = save_state(os.path.join(_ckpt_root(args), "baseline"), result.state)
-    print(f"saved baseline checkpoint -> {path} "
-          f"(best epoch {result.best_epoch + 1}, "
-          f"stopped_early={result.stopped_early})")
-    for label, (x, y, _ids) in sets.items():
-        probs = predict_proba_batched(
-            model, result.state.variables(), x,
-            batch_size=config.uq.inference_batch_size, mesh=mesh,
-        )
-        evaluate_classification(
-            probs, y, threshold=config.uq.decision_threshold,
-            description=f"baseline on {label}", verbose=True,
-        )
+    with _run(args, "train", config) as run_log:
+        with run_log.stage("fit"):
+            result = fit(
+                model, state, prepared.x_train, prepared.y_train,
+                config.train, mesh=mesh, log_fn=log, run_log=run_log,
+            )
+        path = save_state(os.path.join(_ckpt_root(args), "baseline"),
+                          result.state)
+        log(f"saved baseline checkpoint -> {path} "
+            f"(best epoch {result.best_epoch + 1}, "
+            f"stopped_early={result.stopped_early})")
+        with run_log.stage("evaluate"):
+            for label, (x, y, _ids) in sets.items():
+                probs = predict_proba_batched(
+                    model, result.state.variables(), x,
+                    batch_size=config.uq.inference_batch_size, mesh=mesh,
+                )
+                evaluate_classification(
+                    probs, y, threshold=config.uq.decision_threshold,
+                    description=f"baseline on {label}", verbose=True,
+                )
     return 0
 
 
@@ -175,11 +206,11 @@ def cmd_train_ensemble(args, config) -> int:
     all_seeds = [cfg.seed_base + i for i in range(cfg.num_members)]
     missing = [s for s in all_seeds if not store.member_exists(s)]
     if not missing:
-        print(f"all {cfg.num_members} members already checkpointed; nothing to do")
+        log(f"all {cfg.num_members} members already checkpointed; nothing to do")
         return 0
     if len(missing) < len(all_seeds):
-        print(f"resuming: {len(all_seeds) - len(missing)} members exist, "
-              f"training {len(missing)}")
+        log(f"resuming: {len(all_seeds) - len(missing)} members exist, "
+            f"training {len(missing)}")
 
     # Train only the missing members, as one concurrent mesh-parallel run.
     import dataclasses
@@ -187,23 +218,27 @@ def cmd_train_ensemble(args, config) -> int:
     run_cfg = dataclasses.replace(cfg, num_members=len(missing))
     # Per-member RNG is derived from the member's global index so a resumed
     # run reproduces exactly the members a fresh run would have produced.
-    result = fit_ensemble(
-        model, prepared.x_train, prepared.y_train, run_cfg,
-        mesh=_mesh(config, num_members=len(missing)),
-        member_indices=[s - cfg.seed_base for s in missing],
-        log_fn=print,
-    )
-    # The result may carry MORE members than requested: with
-    # keep_padded_members the padded lockstep slots come back as real
-    # members, each checkpointed under its global-index seed (bit-identical
-    # to what a fresh larger run would save, so growing N later re-trains
-    # nothing).  skip_existing covers the resume corner where a promoted
-    # slot's seed is already on disk from an earlier run.
-    save_ensemble_result(store, result, seed_base=cfg.seed_base,
-                         skip_existing=True)
-    promoted = result.promoted_members
-    extra = f" (incl. {promoted} promoted padded slots)" if promoted else ""
-    print(f"saved {result.num_members} members{extra} -> {store.root}")
+    with _run(args, "train-ensemble", config) as run_log:
+        with run_log.stage("fit_ensemble"):
+            result = fit_ensemble(
+                model, prepared.x_train, prepared.y_train, run_cfg,
+                mesh=_mesh(config, num_members=len(missing)),
+                member_indices=[s - cfg.seed_base for s in missing],
+                log_fn=log, run_log=run_log,
+            )
+        # The result may carry MORE members than requested: with
+        # keep_padded_members the padded lockstep slots come back as real
+        # members, each checkpointed under its global-index seed
+        # (bit-identical to what a fresh larger run would save, so growing
+        # N later re-trains nothing).  skip_existing covers the resume
+        # corner where a promoted slot's seed is already on disk from an
+        # earlier run.
+        save_ensemble_result(store, result, seed_base=cfg.seed_base,
+                             skip_existing=True)
+        promoted = result.promoted_members
+        extra = (f" (incl. {promoted} promoted padded slots)"
+                 if promoted else "")
+        log(f"saved {result.num_members} members{extra} -> {store.root}")
     return 0
 
 
@@ -232,7 +267,7 @@ def _emit_plots(args, result) -> None:
         from apnea_uq_tpu.uq import save_run_plots
 
         for p in save_run_plots(result, args.plots_dir):
-            print(f"wrote {p}")
+            log(f"wrote {p}")
 
 
 def _add_plots_arg(p) -> None:
@@ -259,21 +294,21 @@ def _add_profile_arg(p) -> None:
 def _print_metrics_doc(doc) -> None:
     """One printer for a run's scalar results — used for live eval output
     AND the `metrics` read-back, so the two can't drift apart."""
-    print(f"=== {doc['label']} ===")
-    print(f"predict: {doc['predict_seconds']:.2f}s for "
-          f"{doc['n_passes']}x{doc['n_windows']} windows")
+    log(f"=== {doc['label']} ===")
+    log(f"predict: {doc['predict_seconds']:.2f}s for "
+        f"{doc['n_passes']}x{doc['n_windows']} windows")
     det = doc.get("deterministic_classification")
     if det is not None:
-        print(f"deterministic accuracy: {det['accuracy']:.4f}")
-    print(f"stochastic-mean accuracy: {doc['classification']['accuracy']:.4f}")
+        log(f"deterministic accuracy: {det['accuracy']:.4f}")
+    log(f"stochastic-mean accuracy: {doc['classification']['accuracy']:.4f}")
     cis = doc["confidence_intervals"]
     for k, v in doc["aggregates"].items():
         ci_lo = cis.get(f"{k}_ci_lower")
         ci_hi = cis.get(f"{k}_ci_upper")
         if ci_lo is not None:
-            print(f"  {k}: {v:.6f}  [{ci_lo:.6f}, {ci_hi:.6f}]")
+            log(f"  {k}: {v:.6f}  [{ci_lo:.6f}, {ci_hi:.6f}]")
         else:
-            print(f"  {k}: {v:.6f}")
+            log(f"  {k}: {v:.6f}")
 
 
 def _print_run(result) -> None:
@@ -291,24 +326,27 @@ def cmd_eval_mcd(args, config) -> int:
     model, template = _baseline_template(config)
     state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
     _prepared, sets = _load_test_sets(registry)
-    for i, (label, (x, y, ids)) in enumerate(sets.items()):
-        # Trace only the device-heavy evaluation; plots/registry writes
-        # would otherwise dominate the XProf host timeline.
-        with profile_trace(getattr(args, "profile_dir", None)):
-            result = run_mcd_analysis(
-                model, state.variables(), x, y, patient_ids=ids,
-                config=config.uq, label=f"CNN_MCD_{label}",
-                seed=config.train.seed,
-                mesh=_mesh(config, num_members=config.uq.mc_passes),
-                detailed=ids is not None and not args.no_detailed,
-                # The reference probes deterministic accuracy once, before
-                # the per-set loop (analyze_mcd_patient_level.py:203-211) —
-                # not once per test set.
-                sanity_check=i == 0,
-            )
-        _print_run(result)
-        save_run(registry, result, config=config.uq)
-        _emit_plots(args, result)
+    with _run(args, "eval-mcd", config) as run_log:
+        for i, (label, (x, y, ids)) in enumerate(sets.items()):
+            # Trace only the device-heavy evaluation; plots/registry writes
+            # would otherwise dominate the XProf host timeline.
+            with run_log.stage(f"CNN_MCD_{label}"), \
+                    profile_trace(getattr(args, "profile_dir", None)):
+                result = run_mcd_analysis(
+                    model, state.variables(), x, y, patient_ids=ids,
+                    config=config.uq, label=f"CNN_MCD_{label}",
+                    seed=config.train.seed,
+                    mesh=_mesh(config, num_members=config.uq.mc_passes),
+                    detailed=ids is not None and not args.no_detailed,
+                    # The reference probes deterministic accuracy once,
+                    # before the per-set loop (analyze_mcd_patient_level
+                    # .py:203-211) — not once per test set.
+                    sanity_check=i == 0,
+                    run_log=run_log,
+                )
+            _print_run(result)
+            save_run(registry, result, config=config.uq)
+            _emit_plots(args, result)
     return 0
 
 
@@ -320,18 +358,21 @@ def cmd_eval_de(args, config) -> int:
     model, member_variables = _restore_members(args, config, args.num_members)
     n_members = len(member_variables)  # resolved count (0 -> all existing)
     _prepared, sets = _load_test_sets(registry)
-    for label, (x, y, ids) in sets.items():
-        with profile_trace(getattr(args, "profile_dir", None)):
-            result = run_de_analysis(
-                model, member_variables, x, y, patient_ids=ids,
-                config=config.uq, label=f"CNN_DE_{label}",
-                seed=config.train.seed,
-                mesh=_mesh(config, num_members=n_members),
-                detailed=ids is not None and not args.no_detailed,
-            )
-        _print_run(result)
-        save_run(registry, result, config=config.uq)
-        _emit_plots(args, result)
+    with _run(args, "eval-de", config) as run_log:
+        for label, (x, y, ids) in sets.items():
+            with run_log.stage(f"CNN_DE_{label}"), \
+                    profile_trace(getattr(args, "profile_dir", None)):
+                result = run_de_analysis(
+                    model, member_variables, x, y, patient_ids=ids,
+                    config=config.uq, label=f"CNN_DE_{label}",
+                    seed=config.train.seed,
+                    mesh=_mesh(config, num_members=n_members),
+                    detailed=ids is not None and not args.no_detailed,
+                    run_log=run_log,
+                )
+            _print_run(result)
+            save_run(registry, result, config=config.uq)
+            _emit_plots(args, result)
     return 0
 
 
@@ -373,7 +414,7 @@ def cmd_metrics(args, config) -> int:
         )
     doc = registry.load_json(key)
     if args.json:
-        print(json.dumps(doc, indent=2, sort_keys=True))
+        log(json.dumps(doc, indent=2, sort_keys=True))
         return 0
     _print_metrics_doc(doc)
     return 0
@@ -387,7 +428,7 @@ def cmd_aggregate_patients(args, config) -> int:
     detailed = registry.load_table(f"{reg.DETAILED_WINDOWS}:{args.label}")
     summary = aggregate_patients(detailed)
     registry.save_table(f"{reg.PATIENT_SUMMARY}:{args.label}", summary)
-    print(patient_summary_report(summary))
+    log(patient_summary_report(summary))
     return 0
 
 
@@ -401,34 +442,34 @@ def cmd_analyze_windows(args, config) -> int:
 
     registry = _registry(args)
     detailed = registry.load_table(f"{reg.DETAILED_WINDOWS}:{args.label}")
-    print(window_level_analysis(detailed, num_bins=args.num_bins).report())
+    log(window_level_analysis(detailed, num_bins=args.num_bins).report())
     if args.calibration or args.calibration_plot:
         # --calibration-plot implies --calibration.  Confidence bins are
         # a separate axis from the entropy bins, hence their own flag.
         summary = calibration_summary(detailed,
                                       num_bins=args.calibration_bins)
-        print("\nCalibration (mean-probability reliability):")
-        print(summary.report())
+        log("\nCalibration (mean-probability reliability):")
+        log(summary.report())
         if args.calibration_plot:
             from apnea_uq_tpu.analysis.plots import plot_reliability_diagram
 
             path = plot_reliability_diagram({args.label: summary.bins},
                                             args.calibration_plot)
-            print(f"reliability diagram -> {path}")
+            log(f"reliability diagram -> {path}")
     if args.retention or args.retention_plot:
         # The thesis headline ("over 99% on the most-confident subset",
         # reference README.md:14) as a reproducible table.
         # --retention-plot implies --retention.
         curve = retention_curve(detailed)
-        print("\nSelective prediction (windows retained by lowest "
-              "uncertainty first):")
-        print(curve.to_string(index=False, float_format="%.4f"))
+        log("\nSelective prediction (windows retained by lowest "
+        "uncertainty first):")
+        log(curve.to_string(index=False, float_format="%.4f"))
         if args.retention_plot:
             from apnea_uq_tpu.analysis.plots import plot_retention_curve
 
             path = plot_retention_curve({args.label: curve},
                                         args.retention_plot)
-            print(f"retention plot -> {path}")
+            log(f"retention plot -> {path}")
     return 0
 
 
@@ -450,13 +491,13 @@ def cmd_correlate(args, config) -> int:
             # summary on the fly (and don't persist — that stage owns it).
             summary = aggregate_patients(detailed)
         corr = patient_accuracy_entropy_correlation(summary)
-        print(f"[{label}] patient accuracy vs mean entropy: "
-              f"r={corr['pearson_r']:.4f} p={corr['p_value']:.2e} "
-              f"(n={corr['n_patients']})")
+        log(f"[{label}] patient accuracy vs mean entropy: "
+            f"r={corr['pearson_r']:.4f} p={corr['p_value']:.2e} "
+            f"(n={corr['n_patients']})")
         mw = uncertainty_correctness_test(detailed)
         verdict = "significant" if mw["significant"] else "not significant"
-        print(f"[{label}] entropy(incorrect) > entropy(correct): "
-              f"U={mw['u_statistic']:.0f} p={mw['p_value']:.2e} ({verdict})")
+        log(f"[{label}] entropy(incorrect) > entropy(correct): "
+            f"U={mw['u_statistic']:.0f} p={mw['p_value']:.2e} ({verdict})")
     return 0
 
 
@@ -475,9 +516,9 @@ def cmd_sweep(args, config) -> int:
         if not args.plot:
             raise SystemExit("--from-csv requires --plot OUT.png")
         frame = pd.read_csv(args.from_csv)
-        print(frame.to_string(index=False))
+        log(frame.to_string(index=False))
         path = plot_convergence(frame, args.plot)
-        print(f"convergence plot -> {path}")
+        log(f"convergence plot -> {path}")
         return 0
 
     from apnea_uq_tpu.analysis.sweep import de_member_sweep, mcd_pass_sweep
@@ -511,10 +552,10 @@ def cmd_sweep(args, config) -> int:
         )
     key = f"sweep:{args.method}"
     registry.save_table(key, frame)
-    print(frame.to_string(index=False))
+    log(frame.to_string(index=False))
     if args.plot:
         path = plot_convergence(frame, args.plot)
-        print(f"convergence plot -> {path}")
+        log(f"convergence plot -> {path}")
     return 0
 
 
@@ -555,7 +596,21 @@ def cmd_figures(args, config) -> int:
             retention, os.path.join(out, "retention_curves.png")),
     ]
     for p in paths:
-        print(f"wrote {p}")
+        log(f"wrote {p}")
+    return 0
+
+
+def cmd_telemetry_summarize(args) -> int:
+    """Render a run directory's ``events.jsonl`` (written by the train/
+    eval stages and bench.py) as the per-stage wall/device-time,
+    throughput and recompile-count table — the read side of the
+    telemetry layer.  Needs no config and never imports jax."""
+    from apnea_uq_tpu.telemetry import summarize_run
+
+    try:
+        log(summarize_run(args.run_dir))
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
     return 0
 
 
@@ -570,10 +625,10 @@ def cmd_cohort(args, config) -> int:
     )
 
     metadata = pd.read_csv(args.metadata_csv, encoding="latin1", low_memory=False)
-    print(format_cohort_report(analyze_cohort(metadata)))
+    log(format_cohort_report(analyze_cohort(metadata)))
     if args.signal_quality:
-        print()
-        print(format_signal_quality_report(analyze_signal_quality(metadata)))
+        log()
+        log(format_signal_quality_report(analyze_signal_quality(metadata)))
     return 0
 
 
@@ -603,15 +658,18 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p = add("train", cmd_train, "Train the baseline 1D-CNN.")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
+    _add_run_dir_arg(p)
 
     p = add("train-ensemble", cmd_train_ensemble,
             "Train the Deep Ensemble (mesh-parallel, resumable).")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
+    _add_run_dir_arg(p)
 
     p = add("eval-mcd", cmd_eval_mcd, "MC-Dropout UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
+    _add_run_dir_arg(p)
     _add_no_detailed_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
@@ -619,6 +677,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p = add("eval-de", cmd_eval_de, "Deep-Ensemble UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
+    _add_run_dir_arg(p)
     p.add_argument("--num-members", type=int, default=5,
                    help="Ensemble members to evaluate (default 5); 0 (or "
                         "negative) evaluates every checkpointed member — "
@@ -691,6 +750,21 @@ def register(sub, add_config_arg, load_config_fn) -> None:
             "SHHS2 cohort demographics (and optional signal quality).")
     p.add_argument("--metadata-csv", required=True)
     p.add_argument("--signal-quality", action="store_true")
+
+    # `telemetry` is a command group, not a stage: its subcommands read
+    # run directories, take no --config, and never import jax.
+    p = sub.add_parser("telemetry",
+                       help="Read back a run's structured telemetry.")
+    tsub = p.add_subparsers(dest="telemetry_command", required=True)
+    ps = tsub.add_parser(
+        "summarize",
+        help="Render a run directory's events.jsonl as a per-stage "
+             "wall/device-time, throughput and recompile-count table.")
+    ps.add_argument("run_dir",
+                    help="Run directory containing events.jsonl (what "
+                         "--run-dir pointed at, or bench.py's "
+                         "BENCH_RUN_DIR).")
+    ps.set_defaults(fn=cmd_telemetry_summarize)
 
     p = add("demo", cmd_demo,
             "Zero-data synthetic smoke demo of the UQ engine.")

@@ -16,6 +16,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from apnea_uq_tpu.telemetry import log
+
 
 def _as1d(a) -> np.ndarray:
     return np.asarray(a).reshape(-1)
@@ -176,10 +178,10 @@ def evaluate_classification(
         "threshold": threshold,
     }
     if verbose:
-        print(f"=== {description or 'Classification evaluation'} ===")
+        log(f"=== {description or 'Classification evaluation'} ===")
         for k in ("accuracy", "roc_auc", "pr_auc", "cohen_kappa", "mcc",
                   "sensitivity", "specificity"):
             v = results[k]
-            print(f"  {k}: {v:.4f}" if isinstance(v, float) else f"  {k}: {v}")
-        print(f"  confusion_matrix [[TN FP][FN TP]]:\n{cm}")
+            log(f"  {k}: {v:.4f}" if isinstance(v, float) else f"  {k}: {v}")
+        log(f"  confusion_matrix [[TN FP][FN TP]]:\n{cm}")
     return results

@@ -43,6 +43,8 @@ from apnea_uq_tpu.models.cnn1d import (
 from apnea_uq_tpu.ops import streaming_auc
 from apnea_uq_tpu.ops.losses import masked_bce_with_logits
 from apnea_uq_tpu.parallel import mesh as mesh_lib
+from apnea_uq_tpu.telemetry import trace as telemetry_trace
+from apnea_uq_tpu.telemetry.steps import StepMetrics
 from apnea_uq_tpu.training.state import TrainState, make_optimizer
 from apnea_uq_tpu.training.trainer import _epoch_jit, _eval_loss_jit, make_train_step
 from apnea_uq_tpu.utils import prng
@@ -186,10 +188,13 @@ def _ensemble_epoch(
     member_keys = jax.vmap(lambda i: jax.random.fold_in(epoch_key, i))(member_ids)
 
     def member_epoch(member_state, key):
-        return _epoch_jit.__wrapped__(
-            model, tx, member_state, x, y, key, batch_size, True,
-            data_sharding, track_metrics
-        )
+        # Labels the vmapped member program in profiler captures: every
+        # op inside carries the "ensemble_member_epoch/" name prefix.
+        with jax.named_scope("ensemble_member_epoch"):
+            return _epoch_jit.__wrapped__(
+                model, tx, member_state, x, y, key, batch_size, True,
+                data_sharding, track_metrics
+            )
 
     epoch_out = jax.vmap(
         member_epoch, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE
@@ -200,11 +205,13 @@ def _ensemble_epoch(
         trained, train_loss = epoch_out
 
     def member_val(member_state):
-        variables = {"params": member_state.params, "batch_stats": member_state.batch_stats}
-        return _eval_loss_jit.__wrapped__(
-            model, variables, x_val, y_val, batch_size, data_sharding,
-            track_metrics
-        )
+        with jax.named_scope("ensemble_member_val"):
+            variables = {"params": member_state.params,
+                         "batch_stats": member_state.batch_stats}
+            return _eval_loss_jit.__wrapped__(
+                model, variables, x_val, y_val, batch_size, data_sharding,
+                track_metrics
+            )
 
     val_out = jax.vmap(member_val, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(trained)
     val_loss = val_out[0] if track_metrics else val_out
@@ -222,6 +229,13 @@ def _epoch_bookkeeping(state, trained, book, train_loss, val_loss, patience):
     """Epoch-end early-stop bookkeeping, shared by the in-HBM scan epoch
     and the streamed epoch: freeze stopped members, track per-member best
     weights/epoch, decrement patience."""
+    with jax.named_scope("ensemble_bookkeeping"):
+        return _epoch_bookkeeping_impl(state, trained, book, train_loss,
+                                       val_loss, patience)
+
+
+def _epoch_bookkeeping_impl(state, trained, book, train_loss, val_loss,
+                            patience):
     best_val, patience_left, active, best_params, best_stats, best_epoch, epochs_run = book
 
     # Freeze members that already stopped.
@@ -613,6 +627,7 @@ def fit_ensemble(
     streaming: Optional[bool] = None,
     prefetch: int = 2,
     log_fn=None,
+    run_log=None,
 ) -> EnsembleFitResult:
     """Train all N members concurrently over the mesh's ensemble axis,
     each member's batches data-parallel over the mesh's ``data`` axis.
@@ -652,6 +667,13 @@ def fit_ensemble(
     returned members, so a promoted slot that keeps improving can extend
     the lockstep beyond where the discarding run would have stopped —
     epochs that train a real member, not discarded padding.
+
+    ``run_log`` (a :class:`apnea_uq_tpu.telemetry.RunLog`) records one
+    ``step`` + one ``ensemble_epoch`` event per lockstep epoch (dispatch
+    vs device time, member-windows/sec, retrace/compile deltas, active
+    members, per-member val losses) and one final ``ensemble_fit``
+    summary event — the canonical source of the effective-member /
+    promoted-slot / wasted-member-epoch accounting bench.py reports.
     """
     if streaming is None:
         streaming = config.streaming
@@ -696,24 +718,38 @@ def fit_ensemble(
         k: [] for k in ("accuracy", "auc", "val_accuracy", "val_auc")
     } if track else {}
     lockstep_epochs = 0
+    step_metrics = StepMetrics(run_log) if run_log is not None else None
     with mesh:
         for epoch in range(config.num_epochs):
             epoch_key = jax.random.fold_in(shuffle_root, epoch)
             lockstep_epochs += 1
-            if streaming:
-                out = _stream_ensemble_epoch(
-                    model, tx, state, book, x, y, x_val, y_val, epoch_key,
-                    member_ids, config.batch_size,
-                    config.early_stopping_patience, mesh, data_sharding,
-                    prefetch, track_metrics=track,
-                )
-            else:
-                out = _ensemble_epoch(
+
+            def run_lockstep_epoch():
+                if streaming:
+                    return _stream_ensemble_epoch(
+                        model, tx, state, book, x, y, x_val, y_val,
+                        epoch_key, member_ids, config.batch_size,
+                        config.early_stopping_patience, mesh, data_sharding,
+                        prefetch, track_metrics=track,
+                    )
+                return _ensemble_epoch(
                     model, tx, state, book, x, y, x_val, y_val, epoch_key,
                     member_ids, config.batch_size,
                     config.early_stopping_patience, data_sharding,
                     track_metrics=track,
                 )
+
+            with telemetry_trace.annotate(f"ensemble/epoch{epoch + 1}"):
+                if step_metrics is not None:
+                    # n_items: member-windows trained this lockstep epoch
+                    # (every slot, promoted or padded, rides the program).
+                    out = step_metrics.measure(
+                        "ensemble_epoch", run_lockstep_epoch,
+                        n_items=int(x.shape[0]) * run.n_padded,
+                        extra={"epoch": epoch + 1},
+                    )
+                else:
+                    out = run_lockstep_epoch()
             state, book, train_loss, val_loss, active = out[:5]
             if track:
                 h_metrics = _host_values(out[5])
@@ -727,6 +763,25 @@ def fit_ensemble(
             losses.append(h_train[:n_members])
             val_losses.append(h_val[:n_members])
             n_active = int(np.sum(h_active[:n_members]))
+            if run_log is not None:
+                record = step_metrics.last
+                run_log.event(
+                    "ensemble_epoch",
+                    epoch=epoch + 1,
+                    active_members=n_active,
+                    n_members=n_members,
+                    loss=[round(float(v), 6) for v in h_train[:n_members]],
+                    val_loss=[round(float(v), 6)
+                              for v in h_val[:n_members]],
+                    device_s=round(record.device_s, 6),
+                    dispatch_s=round(record.dispatch_s, 6),
+                    member_windows_per_s=(
+                        round(record.items_per_s, 3)
+                        if record.items_per_s is not None else None
+                    ),
+                    retraces=record.retraces,
+                    backend_compiles=record.backend_compiles,
+                )
             if log_fn:
                 log_fn(
                     f"epoch {epoch + 1}/{config.num_epochs} "
@@ -746,7 +801,7 @@ def fit_ensemble(
     history = {"loss": np.stack(losses), "val_loss": np.stack(val_losses)}
     for k, v in metric_history.items():
         history[k] = np.stack(v)
-    return EnsembleFitResult(
+    result = EnsembleFitResult(
         state=take(final),
         history=history,
         best_epoch=h_best_epoch[:n_members],
@@ -756,3 +811,20 @@ def fit_ensemble(
         member_ids=np.asarray(run.member_ids)[:n_members],
         lockstep_epochs=lockstep_epochs,
     )
+    if run_log is not None:
+        # The canonical DE cost-accounting record: bench.py and the CLI
+        # source effective_members / promoted / wasted-epoch numbers from
+        # this event instead of recomputing them inline.
+        run_log.event(
+            "ensemble_fit",
+            num_members=result.num_members,
+            num_requested=result.num_requested,
+            promoted_members=result.promoted_members,
+            member_ids=[int(i) for i in result.member_ids],
+            lockstep_epochs=result.lockstep_epochs,
+            epochs_run=[int(e) for e in result.epochs_run],
+            best_epoch=[int(e) for e in result.best_epoch],
+            wasted_member_epochs=result.wasted_member_epochs(),
+            early_stopping_patience=config.early_stopping_patience,
+        )
+    return result

@@ -1,0 +1,56 @@
+"""Run-scoped observability: structured events, step metrics, traces.
+
+The layer every stage reports through (ISSUE 2 tentpole):
+
+- :mod:`~apnea_uq_tpu.telemetry.logging_shim` — ``log()``, the central
+  replacement for bare ``print`` in library code;
+- :mod:`~apnea_uq_tpu.telemetry.runlog` — ``RunLog``/``start_run``: the
+  per-run JSONL event stream (run metadata, stages, epochs, errors);
+- :mod:`~apnea_uq_tpu.telemetry.steps` — ``StepMetrics``: dispatch- vs
+  device-time per step, throughput, XLA recompile counters;
+- :mod:`~apnea_uq_tpu.telemetry.trace` — ``annotate``/``named_scope``
+  profiler labels for the train/UQ hot paths;
+- :mod:`~apnea_uq_tpu.telemetry.summarize` — the
+  ``apnea-uq telemetry summarize`` renderer.
+
+Only the logging shim is imported eagerly (the CLI needs ``log`` before
+anything heavy loads); everything touching jax resolves lazily via PEP
+562 so ``--help`` stays instant.
+"""
+
+from __future__ import annotations
+
+from apnea_uq_tpu.telemetry.logging_shim import get_logger, log
+
+_LAZY = {
+    "RunLog": "runlog",
+    "start_run": "runlog",
+    "current_run": "runlog",
+    "read_events": "runlog",
+    "default_run_dir": "runlog",
+    "config_hash": "runlog",
+    "device_topology": "runlog",
+    "SCHEMA_VERSION": "runlog",
+    "EVENTS_FILENAME": "runlog",
+    "StepMetrics": "steps",
+    "StepRecord": "steps",
+    "compile_counts": "steps",
+    "install_compile_listener": "steps",
+    "annotate": "trace",
+    "named_scope": "trace",
+    "summarize_run": "summarize",
+    "summarize_events": "summarize",
+}
+
+__all__ = ["log", "get_logger"] + sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(
+        importlib.import_module(f"apnea_uq_tpu.telemetry.{module}"), name
+    )

@@ -1,0 +1,59 @@
+"""Central logging shim — the one place library output reaches a stream.
+
+Library code must not call ``print`` directly (``tests/test_no_bare_print.py``
+enforces an allowlist of exactly this file): every user-facing line routes
+through :func:`log`, which writes the plain message to the *current*
+``sys.stdout`` via stdlib logging.  That keeps CLI output byte-identical to
+the historical behavior (tests capture stdout), lets applications redirect
+or silence the library with standard ``logging`` configuration, and — when
+a run log is active (:mod:`apnea_uq_tpu.telemetry.runlog`) — mirrors every
+line into the run's JSONL event stream, so terminal scrollback is never the
+only copy of a run's console transcript.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+LOGGER_NAME = "apnea_uq_tpu"
+
+
+class _StdoutHandler(logging.Handler):
+    """Writes plain messages to the CURRENT ``sys.stdout``, resolved per
+    record — pytest's capsys and ``contextlib.redirect_stdout`` see the
+    lines exactly where they saw the bare-``print`` output this shim
+    replaced (a ``StreamHandler`` would pin the stream object it was
+    constructed with instead)."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            # The package's single allowlisted print call.
+            print(self.format(record), file=sys.stdout)
+        except Exception:  # pragma: no cover - stdlib handler contract
+            self.handleError(record)
+
+
+def get_logger() -> logging.Logger:
+    """The shared library logger, lazily wired to stdout exactly once."""
+    logger = logging.getLogger(LOGGER_NAME)
+    if not any(isinstance(h, _StdoutHandler) for h in logger.handlers):
+        handler = _StdoutHandler()
+        handler.setFormatter(logging.Formatter("%(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def log(message: str = "", *, level: int = logging.INFO) -> None:
+    """Library-wide stdout line: one plain message through the shared
+    logger, mirrored as a ``log`` event into the active run log (if any)."""
+    get_logger().log(level, message)
+    # Local import: runlog never imports this module at import time, but
+    # keeping the edge lazy makes the no-cycle property structural.
+    from apnea_uq_tpu.telemetry import runlog
+
+    active = runlog.current_run()
+    if active is not None:
+        active.event("log", message=str(message))

@@ -1,0 +1,223 @@
+"""Run-scoped structured event log: append-only JSONL per run directory.
+
+Run-directory layout (see docs/OBSERVABILITY.md for the full schema):
+
+    <run_dir>/events.jsonl   one JSON object per line, append-only
+    <run_dir>/config.json    the ExperimentConfig the run started with
+                             (written by start_run when a config is given)
+
+Every event carries the envelope ``{"seq", "ts", "kind"}`` plus a
+``"stage"`` field when emitted inside a :meth:`RunLog.stage` block.  The
+file is flushed per event, so a killed run keeps everything recorded up
+to the kill — the same crash-survivability contract bench.py's progress
+file established for metric blocks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+EVENTS_FILENAME = "events.jsonl"
+
+# Stack of active run logs (innermost last); log() mirrors lines into the
+# top entry and nested helpers (trainer, drivers) can attach their events
+# to the run the CLI stage opened without threading the object everywhere.
+_ACTIVE: List["RunLog"] = []
+
+
+def current_run() -> Optional["RunLog"]:
+    """The innermost active run log, or None outside any run."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def config_hash(config: Any) -> str:
+    """sha256 of the canonical JSON serialization of a config dataclass —
+    two runs share a hash iff they ran the exact same configuration."""
+    from apnea_uq_tpu.config import _to_jsonable
+
+    payload = json.dumps(_to_jsonable(config), sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def device_topology() -> Dict[str, Any]:
+    """Best-effort device/mesh topology for the run_started event; never
+    raises (telemetry must work before — or without — a usable backend)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "platform": devices[0].platform if devices else "unknown",
+            "device_kind": devices[0].device_kind if devices else "unknown",
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+    except Exception as e:  # noqa: BLE001 - backend init can fail freely
+        return {"platform": "unavailable", "error": f"{type(e).__name__}: {e}"}
+
+
+class RunLog:
+    """Append-only JSONL event writer for one run directory.
+
+    ``disabled=True`` yields a no-op instance (used on non-primary hosts of
+    a multi-process run, where every process would otherwise race on the
+    same file); the API surface is identical so callers never branch.
+    """
+
+    def __init__(self, run_dir: str, *, disabled: bool = False,
+                 _clock=time.time):
+        self.run_dir = run_dir
+        self.disabled = disabled
+        self._clock = _clock
+        self._seq = 0
+        self._stages: List[str] = []
+        self._last_exc: Optional[BaseException] = None
+        self._last_error_record: Optional[Dict[str, Any]] = None
+        self._fh = None
+        if not disabled:
+            os.makedirs(run_dir, exist_ok=True)
+            self._fh = open(os.path.join(run_dir, EVENTS_FILENAME), "a")
+
+    # -- core ------------------------------------------------------------
+
+    def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Append one event; returns the full record (envelope included)."""
+        record: Dict[str, Any] = {
+            "seq": self._seq, "ts": round(float(self._clock()), 6),
+            "kind": kind,
+        }
+        if self._stages and "stage" not in fields:
+            record["stage"] = self._stages[-1]
+        record.update(fields)
+        self._seq += 1
+        if self._fh is not None:
+            self._fh.write(json.dumps(record, sort_keys=False) + "\n")
+            self._fh.flush()
+        return record
+
+    def run_started(self, *, stage: Optional[str] = None, config: Any = None,
+                    argv: Optional[List[str]] = None) -> Dict[str, Any]:
+        fields: Dict[str, Any] = {
+            "schema_version": SCHEMA_VERSION,
+            "topology": device_topology(),
+        }
+        if stage is not None:
+            fields["stage"] = stage
+        if config is not None:
+            fields["config_hash"] = config_hash(config)
+        if argv is not None:
+            fields["argv"] = list(argv)
+        return self.event("run_started", **fields)
+
+    @contextlib.contextmanager
+    def stage(self, name: str, **fields: Any):
+        """Bracket a pipeline stage with stage_start/stage_end events;
+        events emitted inside inherit ``stage=name``.  An escaping
+        exception is recorded (status='error' + an ``error`` event) and
+        re-raised."""
+        self.event("stage_start", stage=name, **fields)
+        self._stages.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield self
+        except BaseException as e:
+            wall = time.perf_counter() - t0
+            self._stages.pop()
+            self.error(name, e)
+            self.event("stage_end", stage=name, wall_s=round(wall, 6),
+                       status="error")
+            raise
+        else:
+            wall = time.perf_counter() - t0
+            self._stages.pop()
+            self.event("stage_end", stage=name, wall_s=round(wall, 6),
+                       status="ok")
+
+    def error(self, where: str, exc: BaseException) -> Dict[str, Any]:
+        # One exception, one error event: a failure inside a stage block
+        # unwinds through stage() AND the run's __exit__ (and bench.py's
+        # own handler), each of which reports it here — dedupe by object
+        # identity so `summarize` counts failures, not unwind frames.
+        if exc is self._last_exc and self._last_error_record is not None:
+            return self._last_error_record
+        self._last_exc = exc
+        self._last_error_record = self.event(
+            "error", where=where, error=f"{type(exc).__name__}: {exc}")
+        return self._last_error_record
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self, status: str = "ok") -> None:
+        if self._fh is not None:
+            self.event("run_finished", status=status)
+            self._fh.close()
+            self._fh = None
+        while self in _ACTIVE:
+            _ACTIVE.remove(self)
+
+    def __enter__(self) -> "RunLog":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None and self._fh is not None:
+            self.error("run", exc)
+        self.close(status="ok" if exc_type is None else "error")
+
+
+def default_run_dir(root: str, stage: str) -> str:
+    """``<root>/runs/<stage>-<utc stamp>-<pid>`` — unique per invocation,
+    grouped under the artifact root so runs live next to their outputs."""
+    stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+    return os.path.join(root, "runs", f"{stage}-{stamp}-{os.getpid()}")
+
+
+def start_run(run_dir: str, *, stage: Optional[str] = None,
+              config: Any = None, argv: Optional[List[str]] = None) -> RunLog:
+    """Open a run log, write the run_started event, and make it the
+    active run (so ``telemetry.log`` lines mirror into it).  On a
+    multi-process mesh only process 0 writes; other processes get a
+    disabled no-op log with the same API."""
+    primary = True
+    try:
+        import jax
+
+        primary = jax.process_index() == 0
+    except Exception:  # noqa: BLE001 - no backend => single process
+        pass
+    run_log = RunLog(run_dir, disabled=not primary)
+    if primary:
+        run_log.run_started(stage=stage, config=config, argv=argv)
+        if config is not None:
+            from apnea_uq_tpu.config import _to_jsonable
+
+            with open(os.path.join(run_dir, "config.json"), "w") as f:
+                json.dump(_to_jsonable(config), f, indent=2)
+    _ACTIVE.append(run_log)
+    return run_log
+
+
+def read_events(run_dir: str) -> List[Dict[str, Any]]:
+    """All events of a run, in append order; [] when no log exists yet.
+    Tolerates a truncated final line (a run killed mid-write)."""
+    path = os.path.join(run_dir, EVENTS_FILENAME)
+    if not os.path.exists(path):
+        return []
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail write; everything before it is good
+    return events

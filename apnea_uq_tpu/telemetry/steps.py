@@ -1,0 +1,145 @@
+"""Step-level device metrics: dispatch vs device time, throughput, and
+XLA recompilation counters.
+
+``StepMetrics.measure`` times one dispatched program twice — once to the
+return of the Python call (dispatch time: trace + compile + enqueue) and
+once to ``jax.block_until_ready`` on the result (device time: the whole
+step, compute included).  The gap is what async dispatch hides; a step
+whose dispatch time suddenly matches its device time is retracing.
+
+Recompilations are counted through ``jax.monitoring``'s event-duration
+hooks: JAX records ``.../jaxpr_trace_duration`` on every retrace and
+``.../backend_compile_duration`` on every XLA compile, so a silent
+retrace storm (e.g. a shape-varying member axis in the vmap-over-members
+ensemble path) shows up as a per-step counter instead of a mystery
+slowdown.  The listener is process-global and installed once, lazily.
+
+Attribution caveat: the counters are process-global and unsynchronized,
+so per-step deltas are only attributable while one ``measure`` runs at a
+time (true of every pipeline today, which dispatches steps sequentially
+from the main thread).  Concurrent measurers would cross-attribute each
+other's compiles; totals stay correct either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+_COUNTS: Dict[str, int] = {"retraces": 0, "backend_compiles": 0}
+_INSTALLED = False
+
+
+def _on_event_duration(name: str, secs: float, **kwargs: Any) -> None:
+    if name.endswith("jaxpr_trace_duration"):
+        _COUNTS["retraces"] += 1
+    elif name.endswith("backend_compile_duration"):
+        _COUNTS["backend_compiles"] += 1
+
+
+def install_compile_listener() -> bool:
+    """Idempotently hook the process-global compile counters into
+    ``jax.monitoring``; False when this JAX build has no listener API."""
+    global _INSTALLED
+    if _INSTALLED:
+        return True
+    try:
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+    except Exception:  # noqa: BLE001 - older/newer jax without the hook
+        return False
+    _INSTALLED = True
+    return True
+
+
+def compile_counts() -> Dict[str, int]:
+    """Snapshot of cumulative {retraces, backend_compiles} since install."""
+    install_compile_listener()
+    return dict(_COUNTS)
+
+
+@dataclasses.dataclass
+class StepRecord:
+    """One measured step."""
+
+    label: str
+    dispatch_s: float     # call return: trace/compile/enqueue, no compute
+    device_s: float       # block_until_ready-bounded: the whole step
+    n_items: Optional[int]
+    retraces: int
+    backend_compiles: int
+
+    @property
+    def items_per_s(self) -> Optional[float]:
+        if self.n_items is None or self.device_s <= 0:
+            return None
+        return self.n_items / self.device_s
+
+
+class StepMetrics:
+    """Measure dispatched steps; optionally emit each as a ``step`` event.
+
+    ``run_log`` may be None — the records still accumulate on the host for
+    callers that only want the timings (e.g. the UQ drivers' predict
+    seconds)."""
+
+    def __init__(self, run_log=None):
+        self.run_log = run_log
+        self.records: List[StepRecord] = []
+        install_compile_listener()
+
+    def measure(self, label: str, thunk: Callable[[], Any], *,
+                n_items: Optional[int] = None,
+                extra: Optional[Dict[str, Any]] = None) -> Any:
+        """Run ``thunk``, record dispatch/device time + compile deltas,
+        and return its result (blocked until ready)."""
+        before = compile_counts()
+        t0 = time.perf_counter()
+        out = thunk()
+        dispatch_s = time.perf_counter() - t0
+        jax.block_until_ready(out)
+        device_s = time.perf_counter() - t0
+        after = compile_counts()
+        record = StepRecord(
+            label=label,
+            dispatch_s=dispatch_s,
+            device_s=device_s,
+            n_items=n_items,
+            retraces=after["retraces"] - before["retraces"],
+            backend_compiles=(after["backend_compiles"]
+                              - before["backend_compiles"]),
+        )
+        self.records.append(record)
+        if self.run_log is not None:
+            fields: Dict[str, Any] = {
+                "label": label,
+                "dispatch_s": round(dispatch_s, 6),
+                "device_s": round(device_s, 6),
+                "retraces": record.retraces,
+                "backend_compiles": record.backend_compiles,
+            }
+            if n_items is not None:
+                fields["n_items"] = int(n_items)
+                ips = record.items_per_s
+                if ips is not None:
+                    fields["items_per_s"] = round(ips, 3)
+            fields.update(extra or {})
+            self.run_log.event("step", **fields)
+        return out
+
+    @property
+    def last(self) -> Optional[StepRecord]:
+        return self.records[-1] if self.records else None
+
+    def totals(self) -> Dict[str, float]:
+        return {
+            "steps": len(self.records),
+            "device_s": sum(r.device_s for r in self.records),
+            "dispatch_s": sum(r.dispatch_s for r in self.records),
+            "retraces": sum(r.retraces for r in self.records),
+            "backend_compiles": sum(r.backend_compiles for r in self.records),
+        }

@@ -1,0 +1,195 @@
+"""Render a run's JSONL event log as a human-readable summary.
+
+``apnea-uq telemetry summarize <run-dir>`` — the read side of the
+telemetry layer: per-stage wall/device time, step counts, throughput and
+recompile counters, epoch trajectories, eval predict lines, and errors,
+all derived purely from ``events.jsonl`` (no JAX import, instant)."""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Any, Dict, List, Optional
+
+from apnea_uq_tpu.telemetry.runlog import EVENTS_FILENAME, read_events
+
+_NO_STAGE = "(no stage)"
+
+
+def _iso(ts: Optional[float]) -> str:
+    if ts is None:
+        return "unknown"
+    dt = datetime.datetime.fromtimestamp(float(ts), tz=datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _fmt(value: Optional[float], decimals: int) -> str:
+    return "-" if value is None else f"{value:.{decimals}f}"
+
+
+def _stage_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One row per stage, in first-appearance order, merging stage_end
+    wall-clock with the ``step`` events emitted inside the stage."""
+    order: List[str] = []
+    rows: Dict[str, Dict[str, Any]] = {}
+
+    def row(name: str) -> Dict[str, Any]:
+        if name not in rows:
+            order.append(name)
+            rows[name] = {
+                "stage": name, "wall_s": None, "steps": 0, "device_s": 0.0,
+                "dispatch_s": 0.0, "retraces": 0, "backend_compiles": 0,
+                "n_items": 0,
+            }
+        return rows[name]
+
+    for e in events:
+        kind = e.get("kind")
+        if kind == "stage_start":
+            row(e.get("stage", _NO_STAGE))
+        elif kind == "stage_end":
+            r = row(e.get("stage", _NO_STAGE))
+            r["wall_s"] = (r["wall_s"] or 0.0) + float(e.get("wall_s", 0.0))
+        elif kind == "step":
+            r = row(e.get("stage", _NO_STAGE))
+            r["steps"] += 1
+            r["device_s"] += float(e.get("device_s", 0.0))
+            r["dispatch_s"] += float(e.get("dispatch_s", 0.0))
+            r["retraces"] += int(e.get("retraces", 0))
+            r["backend_compiles"] += int(e.get("backend_compiles", 0))
+            r["n_items"] += int(e.get("n_items", 0) or 0)
+    return [rows[name] for name in order]
+
+
+def _render_stage_table(rows: List[Dict[str, Any]]) -> List[str]:
+    header = ("stage", "wall_s", "steps", "device_s", "dispatch_s",
+              "retraces", "compiles", "items/s")
+    name_w = max([len(header[0])] + [len(r["stage"]) for r in rows])
+    fmt = (f"{{:<{name_w}}}  {{:>9}}  {{:>5}}  {{:>9}}  {{:>10}}  "
+           f"{{:>8}}  {{:>8}}  {{:>10}}")
+    lines = [fmt.format(*header)]
+    for r in rows:
+        items_per_s = None
+        if r["n_items"] and r["device_s"] > 0:
+            items_per_s = r["n_items"] / r["device_s"]
+        lines.append(fmt.format(
+            r["stage"],
+            _fmt(r["wall_s"], 3),
+            r["steps"] if r["steps"] else "-",
+            _fmt(r["device_s"] if r["steps"] else None, 3),
+            _fmt(r["dispatch_s"] if r["steps"] else None, 3),
+            r["retraces"] if r["steps"] else "-",
+            r["backend_compiles"] if r["steps"] else "-",
+            _fmt(items_per_s, 1),
+        ))
+    return lines
+
+
+def _first_last(values: List[float]) -> str:
+    return f"{values[0]:.4f} -> {values[-1]:.4f}"
+
+
+def _latest_run(events: List[Dict[str, Any]]):
+    """Split an appended multi-run log (bench.py reuses BENCH_RUN_DIR, so
+    events.jsonl can hold several runs back-to-back) at its run_started
+    boundaries; returns (latest run's events, count of earlier runs).
+    Merging runs would double-count stage tables and epoch trajectories."""
+    starts = [i for i, e in enumerate(events)
+              if e.get("kind") == "run_started"]
+    if len(starts) <= 1:
+        return events, 0
+    return events[starts[-1]:], len(starts) - 1
+
+
+def summarize_events(run_dir: str,
+                     events: List[Dict[str, Any]]) -> str:
+    events, earlier_runs = _latest_run(events)
+    started = next((e for e in events if e.get("kind") == "run_started"), None)
+    finished = [e for e in events if e.get("kind") == "run_finished"]
+    lines = [f"run: {os.path.basename(os.path.normpath(run_dir))}"]
+
+    topo = (started or {}).get("topology", {})
+    lines.append(
+        f"started: {_iso((started or {}).get('ts'))}"
+        f"  stage: {(started or {}).get('stage', 'unknown')}"
+        f"  platform: {topo.get('platform', 'unknown')}"
+        f"  devices: {topo.get('device_count', '-')}"
+    )
+    cfg = (started or {}).get("config_hash")
+    lines.append(
+        f"config: {cfg[:12] if cfg else '-'}"
+        f"  schema: v{(started or {}).get('schema_version', '?')}"
+        f"  events: {len(events)}"
+        f"  status: {finished[-1].get('status') if finished else 'unknown'}"
+    )
+    if earlier_runs:
+        lines.append(
+            f"(latest of {earlier_runs + 1} runs appended to this log; "
+            f"earlier runs not shown)"
+        )
+
+    rows = _stage_rows(events)
+    if rows:
+        lines.append("")
+        lines.extend(_render_stage_table(rows))
+
+    epochs = [e for e in events if e.get("kind") == "epoch"]
+    if epochs:
+        loss = [float(e["loss"]) for e in epochs if "loss" in e]
+        parts = [f"epochs: {len(epochs)}"]
+        if loss:
+            parts.append(f"loss {_first_last(loss)}")
+        val = [float(e["val_loss"]) for e in epochs if "val_loss" in e]
+        if val:
+            parts.append(f"val_loss {_first_last(val)}")
+        lines.append("")
+        lines.append("  ".join(parts))
+
+    ens_epochs = [e for e in events if e.get("kind") == "ensemble_epoch"]
+    fits = [e for e in events if e.get("kind") == "ensemble_fit"]
+    if ens_epochs or fits:
+        lines.append("")
+        if ens_epochs:
+            lines.append(f"ensemble epochs: {len(ens_epochs)}")
+        for fit in fits:
+            lines.append(
+                f"ensemble fit: {fit.get('num_members')} members"
+                f" (requested {fit.get('num_requested')},"
+                f" promoted {fit.get('promoted_members')})"
+                f"  lockstep epochs {fit.get('lockstep_epochs')}"
+                f"  wasted member-epochs {fit.get('wasted_member_epochs')}"
+            )
+
+    evals = [e for e in events if e.get("kind") == "eval_predict"]
+    if evals:
+        lines.append("")
+        lines.append("evals:")
+        for e in evals:
+            wps = e.get("windows_per_s")
+            lines.append(
+                f"  {e.get('label')}: {e.get('n_passes')}x"
+                f"{e.get('n_windows')} windows in "
+                f"{_fmt(e.get('predict_s'), 3)}s"
+                f" ({_fmt(wps, 1)} windows/s)"
+            )
+
+    errors = [e for e in events if e.get("kind") == "error"]
+    lines.append("")
+    if errors:
+        lines.append(f"errors: {len(errors)}")
+        for e in errors:
+            lines.append(f"  [{e.get('where', '?')}] {e.get('error', '')}")
+    else:
+        lines.append("errors: none")
+    return "\n".join(lines)
+
+
+def summarize_run(run_dir: str) -> str:
+    """Human-readable summary of ``<run_dir>/events.jsonl``."""
+    events = read_events(run_dir)
+    if not events:
+        raise FileNotFoundError(
+            f"no {EVENTS_FILENAME} events under {run_dir!r} — "
+            f"is this a telemetry run directory?"
+        )
+    return summarize_events(run_dir, events)

@@ -1,0 +1,39 @@
+"""Profiler annotation helpers for the hot paths.
+
+Two complementary mechanisms (both near-zero cost when no trace is being
+captured):
+
+- :func:`annotate` — a HOST-side ``jax.profiler.TraceAnnotation`` span:
+  labels a region of the Python timeline (one training epoch, one
+  predict call) in an XProf/TensorBoard capture.
+- ``named_scope`` — re-exported ``jax.named_scope``: labels TRACED
+  computation, so the XLA ops inside a jitted program carry readable
+  name-stack prefixes (``mcd_pass/...``, ``ensemble_member_epoch/...``)
+  in the device timeline instead of fused op soup.
+
+``utils.timing.profile_trace`` starts/stops the capture itself; these
+helpers make what it captures legible.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+named_scope = jax.named_scope
+
+
+@contextlib.contextmanager
+def annotate(name: str, **kwargs):
+    """Host-side trace annotation; degrades to a no-op if the profiler
+    surface is unavailable (annotation must never break a run)."""
+    try:
+        ctx = jax.profiler.TraceAnnotation(name, **kwargs)
+    except Exception:  # noqa: BLE001 - profiler-less builds
+        ctx = None
+    if ctx is None:
+        yield
+        return
+    with ctx:
+        yield

@@ -28,6 +28,8 @@ from apnea_uq_tpu.config import TrainConfig
 from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
 from apnea_uq_tpu.ops import streaming_auc
 from apnea_uq_tpu.ops.losses import masked_bce_with_logits
+from apnea_uq_tpu.telemetry import trace as telemetry_trace
+from apnea_uq_tpu.telemetry.steps import StepMetrics
 from apnea_uq_tpu.training.state import TrainState, make_optimizer
 from apnea_uq_tpu.utils import prng
 
@@ -125,21 +127,24 @@ def _epoch_jit(model, tx, state, x, y, key, batch_size, shuffle,
     idx, mask = _pad_perm(shuffle_key, n, batch_size, shuffle)
 
     def body(carry, inputs):
-        state, mstate = carry
-        batch_idx, batch_mask, step_i = inputs
-        xb = jnp.take(x, batch_idx, axis=0)
-        yb = jnp.take(y, batch_idx, axis=0)
-        if data_sharding is not None:
-            xb = jax.lax.with_sharding_constraint(xb, data_sharding)
-            yb = jax.lax.with_sharding_constraint(yb, data_sharding)
-            batch_mask = jax.lax.with_sharding_constraint(batch_mask, data_sharding)
-        step_rng = jax.random.fold_in(dropout_key, step_i)
-        if track_metrics:
-            state, loss, probs = train_step(state, xb, yb, batch_mask, step_rng)
-            mstate = streaming_auc.metric_update(mstate, probs, yb, batch_mask)
-        else:
-            state, loss = train_step(state, xb, yb, batch_mask, step_rng)
-        return (state, mstate), loss * jnp.sum(batch_mask)
+        # named_scope labels the traced ops, so a profiler capture shows
+        # "train_step/..." in the device timeline instead of fused soup.
+        with jax.named_scope("train_step"):
+            state, mstate = carry
+            batch_idx, batch_mask, step_i = inputs
+            xb = jnp.take(x, batch_idx, axis=0)
+            yb = jnp.take(y, batch_idx, axis=0)
+            if data_sharding is not None:
+                xb = jax.lax.with_sharding_constraint(xb, data_sharding)
+                yb = jax.lax.with_sharding_constraint(yb, data_sharding)
+                batch_mask = jax.lax.with_sharding_constraint(batch_mask, data_sharding)
+            step_rng = jax.random.fold_in(dropout_key, step_i)
+            if track_metrics:
+                state, loss, probs = train_step(state, xb, yb, batch_mask, step_rng)
+                mstate = streaming_auc.metric_update(mstate, probs, yb, batch_mask)
+            else:
+                state, loss = train_step(state, xb, yb, batch_mask, step_rng)
+            return (state, mstate), loss * jnp.sum(batch_mask)
 
     steps = idx.shape[0]
     # None (an empty pytree) when untracked: no dead carry in the scan.
@@ -169,19 +174,20 @@ def _eval_loss_jit(model, variables, x, y, batch_size, data_sharding=None,
     mask = (jnp.arange(total) < n).astype(jnp.float32)
 
     def body(carry, inputs):
-        total_loss, mstate = carry
-        xb, yb, mb = inputs
-        if data_sharding is not None:
-            xb = jax.lax.with_sharding_constraint(xb, data_sharding)
-            yb = jax.lax.with_sharding_constraint(yb, data_sharding)
-            mb = jax.lax.with_sharding_constraint(mb, data_sharding)
-        logits, _ = apply_model(model, variables, xb, mode="eval")
-        loss = masked_bce_with_logits(logits, yb, mb)
-        if track_metrics:
-            mstate = streaming_auc.metric_update(
-                mstate, predict_proba(logits), yb, mb
-            )
-        return (total_loss + loss * jnp.sum(mb), mstate), None
+        with jax.named_scope("eval_loss_step"):
+            total_loss, mstate = carry
+            xb, yb, mb = inputs
+            if data_sharding is not None:
+                xb = jax.lax.with_sharding_constraint(xb, data_sharding)
+                yb = jax.lax.with_sharding_constraint(yb, data_sharding)
+                mb = jax.lax.with_sharding_constraint(mb, data_sharding)
+            logits, _ = apply_model(model, variables, xb, mode="eval")
+            loss = masked_bce_with_logits(logits, yb, mb)
+            if track_metrics:
+                mstate = streaming_auc.metric_update(
+                    mstate, predict_proba(logits), yb, mb
+                )
+            return (total_loss + loss * jnp.sum(mb), mstate), None
 
     shape = lambda a: a.reshape((steps, batch_size) + a.shape[1:])
     mstate0 = streaming_auc.empty_metric_state() if track_metrics else None
@@ -203,10 +209,11 @@ def _predict_jit(model, variables, x, batch_size, data_sharding=None):
     xp = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
 
     def body(_, xb):
-        if data_sharding is not None:
-            xb = jax.lax.with_sharding_constraint(xb, data_sharding)
-        logits, _ = apply_model(model, variables, xb, mode="eval")
-        return None, predict_proba(logits)
+        with jax.named_scope("predict_eval"):
+            if data_sharding is not None:
+                xb = jax.lax.with_sharding_constraint(xb, data_sharding)
+            logits, _ = apply_model(model, variables, xb, mode="eval")
+            return None, predict_proba(logits)
 
     _, probs = jax.lax.scan(body, None, xp.reshape((steps, batch_size) + x.shape[1:]))
     return probs.reshape(-1)[:n]
@@ -364,6 +371,7 @@ def fit(
     streaming: Optional[bool] = None,
     prefetch: int = 2,
     log_fn: Optional[Callable[[str], None]] = None,
+    run_log=None,
 ) -> FitResult:
     """Train with validation-split early stopping; returns best-weight state.
 
@@ -373,6 +381,12 @@ def fit(
     cnn_baseline_train.py:210, has no equivalent).  Results are identical
     to the single-device run — same batches, same order, just computed in
     slices.
+
+    ``run_log`` (a :class:`apnea_uq_tpu.telemetry.RunLog`) records one
+    ``step`` event per dispatched epoch/validation program — dispatch vs
+    ``block_until_ready``-bounded device time, windows/sec throughput,
+    and XLA retrace/compile deltas — plus one structured ``epoch`` event
+    per epoch with the loss trajectory.
     """
     tx = tx if tx is not None else make_optimizer(config.learning_rate)
     if rng is None:
@@ -428,19 +442,32 @@ def fit(
     if streaming and mesh is not None and config.batch_size % mesh.shape["data"] == 0:
         batch_sharding = data_sharding  # place streamed batches pre-sharded
 
+    step_metrics = StepMetrics(run_log) if run_log is not None else None
+
     for epoch in range(config.num_epochs):
         epoch_key = jax.random.fold_in(rng, epoch)
-        if streaming:
-            out = _stream_epoch(
-                model, tx, state, x, y, epoch_key, config.batch_size,
-                config.shuffle, data_sharding, batch_sharding, prefetch,
-                track_metrics=track,
-            )
-        else:
-            out = _epoch_jit(
+
+        def run_epoch():
+            if streaming:
+                return _stream_epoch(
+                    model, tx, state, x, y, epoch_key, config.batch_size,
+                    config.shuffle, data_sharding, batch_sharding, prefetch,
+                    track_metrics=track,
+                )
+            return _epoch_jit(
                 model, tx, state, x, y, epoch_key, config.batch_size,
                 config.shuffle, data_sharding, track_metrics=track,
             )
+
+        with telemetry_trace.annotate(f"fit/epoch{epoch + 1}"):
+            if step_metrics is not None:
+                out = step_metrics.measure(
+                    "train_epoch", run_epoch, n_items=int(x.shape[0]),
+                    extra={"epoch": epoch + 1},
+                )
+            else:
+                out = run_epoch()
+        epoch_record = step_metrics.last if step_metrics is not None else None
         if track:
             state, train_loss, train_acc, train_auc = out
             history["accuracy"].append(float(train_acc))
@@ -448,23 +475,53 @@ def fit(
         else:
             state, train_loss = out
         history["loss"].append(float(train_loss))
+
+        def emit_epoch_event(val_loss=None):
+            if run_log is None:
+                return
+            fields = {"epoch": epoch + 1, "loss": float(train_loss)}
+            if val_loss is not None:
+                fields["val_loss"] = float(val_loss)
+            if track:
+                fields["accuracy"] = history["accuracy"][-1]
+                fields["auc"] = history["auc"][-1]
+            if epoch_record is not None:
+                fields["device_s"] = round(epoch_record.device_s, 6)
+                fields["dispatch_s"] = round(epoch_record.dispatch_s, 6)
+                if epoch_record.items_per_s is not None:
+                    fields["windows_per_s"] = round(
+                        epoch_record.items_per_s, 3
+                    )
+                fields["retraces"] = epoch_record.retraces
+                fields["backend_compiles"] = epoch_record.backend_compiles
+            run_log.event("epoch", **fields)
+
         metric_note = (
             f" acc={history['accuracy'][-1]:.4f} auc={history['auc'][-1]:.4f}"
             if track else ""
         )
 
         if x_val is not None:
-            if streaming:
-                val_out = _stream_eval_loss(
-                    model, state.variables(), x_val, y_val,
-                    config.batch_size, data_sharding, batch_sharding, prefetch,
-                    track_metrics=track,
-                )
-            else:
-                val_out = _eval_loss_jit(
+            def run_val():
+                if streaming:
+                    return _stream_eval_loss(
+                        model, state.variables(), x_val, y_val,
+                        config.batch_size, data_sharding, batch_sharding,
+                        prefetch, track_metrics=track,
+                    )
+                return _eval_loss_jit(
                     model, state.variables(), x_val, y_val,
                     config.batch_size, data_sharding, track_metrics=track,
                 )
+
+            with telemetry_trace.annotate(f"fit/val{epoch + 1}"):
+                if step_metrics is not None:
+                    val_out = step_metrics.measure(
+                        "val_loss", run_val, n_items=int(x_val.shape[0]),
+                        extra={"epoch": epoch + 1},
+                    )
+                else:
+                    val_out = run_val()
             if track:
                 val_loss, val_acc, val_auc = val_out
                 val_loss = float(val_loss)
@@ -475,6 +532,7 @@ def fit(
             else:
                 val_loss = float(val_out)
             history["val_loss"].append(val_loss)
+            emit_epoch_event(val_loss)
             if log_fn:
                 log_fn(f"epoch {epoch + 1}/{config.num_epochs} "
                        f"loss={float(train_loss):.4f} val_loss={val_loss:.4f}"
@@ -491,6 +549,7 @@ def fit(
                     stopped_early = True
                     break
         else:
+            emit_epoch_event()
             if log_fn:
                 log_fn(f"epoch {epoch + 1}/{config.num_epochs} "
                        f"loss={float(train_loss):.4f}{metric_note}")

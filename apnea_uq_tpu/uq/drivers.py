@@ -41,14 +41,17 @@ from apnea_uq_tpu.training.trainer import predict_proba_batched
 from apnea_uq_tpu.uq.bootstrap import bootstrap_aggregates, compute_confidence_intervals
 from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
 from apnea_uq_tpu.uq.predict import (
+    as_stacked_members,
     ensemble_predict,
     ensemble_predict_streaming,
     mc_dropout_predict,
     mc_dropout_predict_streaming,
     effective_batch_size,
 )
+from apnea_uq_tpu.telemetry import trace as telemetry_trace
+from apnea_uq_tpu.telemetry.steps import StepMetrics
 from apnea_uq_tpu.utils import prng
-from apnea_uq_tpu.utils.timing import Timer, block
+from apnea_uq_tpu.utils.timing import block
 
 # The reference's detailed CSV writes binary entropy of the mean prob in
 # BITS with eps 1e-9 (analyze_mcd_patient_level.py:113-115) while the
@@ -196,6 +199,44 @@ def detailed_frame(
     })
 
 
+def _member_count(member_variables) -> int:
+    """Member count of any carrier ``as_stacked_members`` accepts, without
+    forcing the stack copy a plain list would pay."""
+    if isinstance(member_variables, (list, tuple)):
+        return len(member_variables)
+    stacked = as_stacked_members(member_variables)
+    return int(jax.tree.leaves(stacked)[0].shape[0])
+
+
+def _measured_predict(label: str, method: str, predict, n_windows: int,
+                      n_passes: int, run_log):
+    """Run one predictor thunk under StepMetrics: device-bounded predict
+    seconds (``block_until_ready``, not dispatch return), windows/sec,
+    and retrace/compile deltas; emits an ``eval_predict`` event when a
+    run log is attached.  Returns (predictions, predict_seconds)."""
+    metrics = StepMetrics(run_log)
+    with telemetry_trace.annotate(f"{label}.predict"):
+        predictions = metrics.measure(
+            f"{method}_predict", predict, n_items=n_windows
+        )
+    record = metrics.last
+    if run_log is not None:
+        run_log.event(
+            "eval_predict",
+            label=label,
+            method=method,
+            n_passes=int(n_passes),
+            n_windows=int(n_windows),
+            predict_s=round(record.device_s, 6),
+            dispatch_s=round(record.dispatch_s, 6),
+            windows_per_s=(round(record.items_per_s, 3)
+                           if record.items_per_s is not None else None),
+            retraces=record.retraces,
+            backend_compiles=record.backend_compiles,
+        )
+    return predictions, record.device_s
+
+
 def _run_common(
     label: str,
     predictions: np.ndarray,
@@ -257,6 +298,7 @@ def run_mcd_analysis(
     mesh: Optional[jax.sharding.Mesh] = None,
     detailed: bool = True,
     sanity_check: bool = True,
+    run_log=None,
 ) -> UQRunResult:
     """MC-Dropout UQ analysis of one test set (C13/C15).
 
@@ -298,13 +340,13 @@ def run_mcd_analysis(
             " the mesh's data axis divides for exact parity.",
             stacklevel=2,
         )
-    with Timer(f"{label}.predict") as t:
+    def predict():
         if config.mcd_streaming:
             # Host-streamed chunks for sets that exceed HBM; identical
             # results to the in-HBM path.  Streaming (small-memory) and
             # the mesh (many-chips) compose: each chunk shards over
             # (ensemble, data).
-            predictions = mc_dropout_predict_streaming(
+            return mc_dropout_predict_streaming(
                 model, variables, x,
                 n_passes=config.mc_passes,
                 mode=config.mcd_mode,
@@ -312,15 +354,18 @@ def run_mcd_analysis(
                 key=predict_key,
                 mesh=mesh,
             )
-        else:
-            predictions = block(mc_dropout_predict(
-                model, variables, x,
-                n_passes=config.mc_passes,
-                mode=config.mcd_mode,
-                batch_size=config.mcd_batch_size,
-                key=predict_key,
-                mesh=mesh,
-            ))
+        return mc_dropout_predict(
+            model, variables, x,
+            n_passes=config.mc_passes,
+            mode=config.mcd_mode,
+            batch_size=config.mcd_batch_size,
+            key=predict_key,
+            mesh=mesh,
+        )
+
+    predictions, predict_seconds = _measured_predict(
+        label, "mcd", predict, len(x), config.mc_passes, run_log
+    )
     det_probs = (
         _host_predictions(predict_proba_batched(
             model, variables, x, batch_size=config.inference_batch_size,
@@ -331,7 +376,7 @@ def run_mcd_analysis(
     )
     return _run_common(
         label, _host_predictions(predictions), y_true, patient_ids, config,
-        det_probs, t.elapsed_s, detailed, bootstrap_key,
+        det_probs, predict_seconds, detailed, bootstrap_key,
     )
 
 
@@ -348,6 +393,7 @@ def run_de_analysis(
     seed: int = 0,
     mesh: Optional[jax.sharding.Mesh] = None,
     detailed: bool = True,
+    run_log=None,
 ) -> UQRunResult:
     """Deep-Ensemble UQ analysis of one test set (C14/C16).
 
@@ -366,22 +412,26 @@ def run_de_analysis(
                          "got an empty window set")
     if bootstrap_key is None:
         bootstrap_key = prng.bootstrap_key(seed)
-    with Timer(f"{label}.predict") as t:
+    def predict():
         if config.de_streaming:
-            predictions = ensemble_predict_streaming(
+            return ensemble_predict_streaming(
                 model, member_variables, x,
                 batch_size=config.inference_batch_size,
                 mesh=mesh,
             )
-        else:
-            predictions = block(ensemble_predict(
-                model, member_variables, x,
-                batch_size=config.inference_batch_size,
-                mesh=mesh,
-            ))
+        return ensemble_predict(
+            model, member_variables, x,
+            batch_size=config.inference_batch_size,
+            mesh=mesh,
+        )
+
+    predictions, predict_seconds = _measured_predict(
+        label, "de", predict, len(x), _member_count(member_variables),
+        run_log,
+    )
     return _run_common(
         label, _host_predictions(predictions), y_true, patient_ids, config,
-        None, t.elapsed_s, detailed, bootstrap_key,
+        None, predict_seconds, detailed, bootstrap_key,
     )
 
 

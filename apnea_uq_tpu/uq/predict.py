@@ -74,18 +74,22 @@ def _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh):
     chunk = _constrain(chunk, mesh, mesh_lib.AXIS_DATA)
 
     def one_pass(k):
-        # Fresh noise per (pass, chunk): reusing the per-pass key across
-        # chunks would give windows in different chunks identical dropout
-        # masks (correlated noise the reference does not have).
-        k = jax.random.fold_in(k, chunk_idx)
-        logits, _ = apply_model(model, variables, chunk, mode=mode, dropout_rng=k)
-        # Constrain per pass, at the model output: with spmd_axis_name
-        # threading the pass axis, this pins the conv batch itself to
-        # the (pass-shard x window-shard) block — without it the
-        # partitioner is free to replicate windows within ensemble
-        # groups and merely reshard at the end (observed on CPU SPMD),
-        # wasting the data axis.
-        return _constrain(predict_proba(logits), mesh, mesh_lib.AXIS_DATA)
+        # Named scope: profiler captures label the stochastic passes as
+        # "mcd_pass/..." ops instead of anonymous fused convolutions.
+        with jax.named_scope("mcd_pass"):
+            # Fresh noise per (pass, chunk): reusing the per-pass key across
+            # chunks would give windows in different chunks identical dropout
+            # masks (correlated noise the reference does not have).
+            k = jax.random.fold_in(k, chunk_idx)
+            logits, _ = apply_model(model, variables, chunk, mode=mode,
+                                    dropout_rng=k)
+            # Constrain per pass, at the model output: with spmd_axis_name
+            # threading the pass axis, this pins the conv batch itself to
+            # the (pass-shard x window-shard) block — without it the
+            # partitioner is free to replicate windows within ensemble
+            # groups and merely reshard at the end (observed on CPU SPMD),
+            # wasting the data axis.
+            return _constrain(predict_proba(logits), mesh, mesh_lib.AXIS_DATA)
 
     if mesh is None:
         return jax.vmap(one_pass)(keys)  # (T, bs)
@@ -105,8 +109,10 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None):
     chunks = _constrain(chunks, mesh, None, mesh_lib.AXIS_DATA)
 
     def one_chunk(args):
-        chunk, chunk_idx = args
-        return _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh)
+        with jax.named_scope("mcd_chunk"):
+            chunk, chunk_idx = args
+            return _mcd_passes(model, variables, chunk, keys, chunk_idx,
+                               mode, mesh)
 
     probs = jax.lax.map(
         one_chunk, (chunks, jnp.arange(chunks.shape[0]))
@@ -363,13 +369,15 @@ def _ensemble_shard_map_jit(model, stacked_variables, x, batch_size, mesh):
             )
 
             def one_chunk(chunk):
-                logits, _ = apply_model(model, mv, chunk, mode="eval")
-                return predict_proba(logits)
+                with jax.named_scope("de_member_chunk"):
+                    logits, _ = apply_model(model, mv, chunk, mode="eval")
+                    return predict_proba(logits)
 
             probs = jax.lax.map(one_chunk, chunks)      # (chunks, bs_local)
             return probs.reshape(-1)[:m_local]
 
-        return jax.vmap(one_member)(member_vars)        # (N_local, m_local)
+        with jax.named_scope("de_shard_block"):
+            return jax.vmap(one_member)(member_vars)    # (N_local, m_local)
 
     f = _shard_map(
         block,
@@ -383,8 +391,9 @@ def _ensemble_shard_map_jit(model, stacked_variables, x, batch_size, mesh):
 @partial(jax.jit, static_argnames=("model",))
 def _ensemble_chunk_jit(model, stacked_variables, chunk):
     def one_member(member_vars):
-        logits, _ = apply_model(model, member_vars, chunk, mode="eval")
-        return predict_proba(logits)
+        with jax.named_scope("de_member"):
+            logits, _ = apply_model(model, member_vars, chunk, mode="eval")
+            return predict_proba(logits)
 
     return jax.vmap(one_member)(stacked_variables)  # (N, bs)
 

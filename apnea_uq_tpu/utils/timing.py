@@ -1,9 +1,13 @@
 """Wall-clock timing and optional device profiling.
 
 Replaces the reference's ad-hoc ``time.time()`` prints
-(uq_techniques.py:21-23,28-31,339,347) with a reusable context manager that
-blocks on device work (``block_until_ready``) so timings measure compute,
-not dispatch, and can optionally wrap a ``jax.profiler`` trace.
+(uq_techniques.py:21-23,28-31,339,347) with a reusable context manager
+that can block on device work (``block_until_ready``) so timings measure
+compute, not dispatch, and can optionally wrap a ``jax.profiler`` trace.
+
+For per-step dispatch/device breakdowns, throughput, and recompile
+counters, use :class:`apnea_uq_tpu.telemetry.StepMetrics` instead — this
+module is the minimal standalone timer.
 """
 
 from __future__ import annotations
@@ -16,21 +20,49 @@ import jax
 
 
 class Timer:
-    """Context-manager timer: ``with Timer("mcd") as t: ...; t.elapsed_s``."""
+    """Context-manager timer: ``with Timer("mcd") as t: ...; t.elapsed_s``.
 
-    def __init__(self, name: str = "", verbose: bool = False):
+    By default the timer measures wall clock between ``__enter__`` and
+    ``__exit__`` — which, under JAX's async dispatch, may be dispatch
+    time only.  Pass ``block=True`` and hand the timed computation's
+    result to :meth:`wrap` (or assign ``t.result``) and ``__exit__``
+    blocks on it before reading the clock, so ``elapsed_s`` bounds the
+    device work::
+
+        with Timer("predict", block=True) as t:
+            probs = t.wrap(predict(...))
+
+    ``verbose=True`` reports through the central telemetry log (never a
+    bare ``print``), so the line also lands in any active run log.
+    """
+
+    def __init__(self, name: str = "", verbose: bool = False,
+                 block: bool = False):
         self.name = name
         self.verbose = verbose
         self.elapsed_s: float = 0.0
+        self.result: Any = None
+        self._block = block
+
+    def wrap(self, tree: Any) -> Any:
+        """Register ``tree`` as the result ``__exit__`` blocks on."""
+        self.result = tree
+        return tree
 
     def __enter__(self) -> "Timer":
         self._start = time.perf_counter()
         return self
 
-    def __exit__(self, *exc) -> None:
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # On an escaping exception the result (if any) may be garbage;
+        # never block on it — report dispatch-side elapsed instead.
+        if self._block and exc_type is None and self.result is not None:
+            jax.block_until_ready(self.result)
         self.elapsed_s = time.perf_counter() - self._start
         if self.verbose:
-            print(f"[{self.name}] {self.elapsed_s:.3f}s")
+            from apnea_uq_tpu.telemetry import log
+
+            log(f"[{self.name}] {self.elapsed_s:.3f}s")
 
 
 def block(tree: Any) -> Any:

@@ -40,7 +40,11 @@ skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
 BENCH_WATCHDOG_SECS to change or disable (0) the hang watchdog
 (default 45 min), BENCH_INIT_WAIT_SECS to change or disable (0) the
 backend-init retry budget (default 25 min; BENCH_INIT_PROBE_SECS caps
-each individual probe, default 2 min), and two smoke-run knobs:
+each individual probe, default 2 min), BENCH_RUN_DIR for the telemetry
+run directory (default ./bench_run; "" falls back to a temp dir — the
+run log is never disabled, because the DE context block is *sourced*
+from its ensemble_fit events; read it back with
+``apnea-uq telemetry summarize <dir>``), and two smoke-run knobs:
 BENCH_PLATFORM=cpu runs the whole bench off-TPU (the CPU smoke test's
 path; sitecustomize pins JAX_PLATFORMS at interpreter start, so this is
 a config update, not an env passthrough) and BENCH_DTYPE=float32 swaps
@@ -135,6 +139,42 @@ def _progress_record(key: str, value: dict) -> dict:
         data[key] = value
         _atomic_write_json(path, data)
     return value
+
+
+def _bench_run_log():
+    """The bench's run-scoped telemetry log (events.jsonl under
+    BENCH_RUN_DIR).  Opened once per process and reused: bench_de_train
+    and bench_de_earlystop_waste SOURCE their zero-waste accounting from
+    the ``ensemble_fit`` events ``fit_ensemble`` appends here, instead of
+    recomputing it inline — the same record every CLI stage reports
+    through, so BENCH context numbers and run logs cannot drift."""
+    from apnea_uq_tpu import telemetry
+
+    run = telemetry.current_run()
+    if run is None:
+        run_dir = os.environ.get("BENCH_RUN_DIR", "bench_run")
+        if not run_dir:
+            import tempfile
+
+            run_dir = tempfile.mkdtemp(prefix="bench_run_")
+        run = telemetry.start_run(run_dir, stage="bench", argv=sys.argv[1:])
+    return run
+
+
+def _last_ensemble_fit_event(run_log) -> dict:
+    """The most recent ``ensemble_fit`` accounting event in the bench's
+    run log — the telemetry-sourced ground truth for effective-member /
+    promoted-slot / wasted-epoch context fields."""
+    from apnea_uq_tpu.telemetry import read_events
+
+    fits = [e for e in read_events(run_log.run_dir)
+            if e.get("kind") == "ensemble_fit"]
+    if not fits:
+        raise RuntimeError(
+            "fit_ensemble recorded no ensemble_fit telemetry event under "
+            f"{run_log.run_dir!r}; cannot source the DE context block"
+        )
+    return fits[-1]
 
 
 def _emit_bench_error(msg: str) -> None:
@@ -293,12 +333,14 @@ def bench_de_train(progress_key: str = "secondary") -> dict:
         early_stopping_patience=no_stop,
     )
     state0 = create_train_state(model, jax.random.key(0))
-    last_fit = [None]  # only the latest result is read; don't pin old
-                       # member-stacked states (params + opt_state) in HBM
+    run_log = _bench_run_log()
 
     def concurrent():
-        # fetches losses -> forces exec
-        last_fit[0] = fit_ensemble(model, x, y, ens_cfg)
+        # Fetches losses -> forces exec.  The result itself is DROPPED
+        # (no member-stacked params/opt_state pinned in HBM between reps):
+        # the run's accounting lands in the run log's ensemble_fit event,
+        # which the context block below is sourced from.
+        fit_ensemble(model, x, y, ens_cfg, run_log=run_log)
         return 0.0
 
     def sequential_one():
@@ -310,19 +352,25 @@ def bench_de_train(progress_key: str = "secondary") -> dict:
     # timing the two paths back-to-back per rep and taking the median
     # per-rep ratio is stable where independent best-of-N ratios jumped
     # between rounds (r02 recorded 2.63x against a 3.1-5.2x band).
-    concurrent(); sequential_one()  # compile warmup, both paths
     reps = int(os.environ.get("BENCH_DE_REPS", 3))
-    t_conc, ratios = [], []
-    for _ in range(reps):
-        t0 = time.perf_counter(); concurrent()
-        tc = time.perf_counter() - t0
-        t0 = time.perf_counter(); sequential_one()
-        to = time.perf_counter() - t0
-        t_conc.append(tc)
-        ratios.append(n_members * to / tc)
+    with run_log.stage("de_train", members=n_members, windows=n_windows,
+                       epochs=n_epochs, reps=reps):
+        concurrent(); sequential_one()  # compile warmup, both paths
+        t_conc, ratios = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter(); concurrent()
+            tc = time.perf_counter() - t0
+            t0 = time.perf_counter(); sequential_one()
+            to = time.perf_counter() - t0
+            t_conc.append(tc)
+            ratios.append(n_members * to / tc)
 
     t_median = float(np.median(t_conc))
-    effective_members = last_fit[0].num_members
+    # Telemetry-sourced zero-waste accounting: the numbers below come from
+    # the ensemble_fit event the last concurrent rep appended, not from an
+    # inline recomputation (one record, one schema, everywhere).
+    fit_event = _last_ensemble_fit_event(run_log)
+    effective_members = int(fit_event["num_members"])
     result = {
         "metric": f"de{n_members}_train_wallclock",
         "value": round(t_median, 2),
@@ -337,7 +385,7 @@ def bench_de_train(progress_key: str = "secondary") -> dict:
             # Lockstep slots actually trained AND returned (padded slots
             # promoted); the honest per-member price of the concurrent run.
             "effective_members": effective_members,
-            "promoted_members": last_fit[0].promoted_members,
+            "promoted_members": int(fit_event["promoted_members"]),
             "cost_per_member": round(t_median / effective_members, 3),
         },
     }
@@ -367,14 +415,20 @@ def bench_de_earlystop_waste(model, x, y, batch: int) -> dict:
         validation_split=0.1, early_stopping_patience=patience,
         keep_padded_members=True,
     )
-    res = fit_ensemble(model, x, y, cfg)
-    computed = res.num_members * res.lockstep_epochs
-    wasted = res.wasted_member_epochs()
+    run_log = _bench_run_log()
+    with run_log.stage("de_earlystop_waste", patience=patience,
+                       epochs_cap=epochs_cap):
+        fit_ensemble(model, x, y, cfg, run_log=run_log)
+    # Sourced from the run's ensemble_fit telemetry event (same record
+    # the CLI's train-ensemble stage logs), not recomputed inline.
+    ev = _last_ensemble_fit_event(run_log)
+    computed = int(ev["num_members"]) * int(ev["lockstep_epochs"])
+    wasted = int(ev["wasted_member_epochs"])
     return {
         "patience": patience,
         "epochs_cap": epochs_cap,
-        "members": res.num_members,
-        "lockstep_epochs": res.lockstep_epochs,
+        "members": int(ev["num_members"]),
+        "lockstep_epochs": int(ev["lockstep_epochs"]),
         "member_epochs_computed": computed,
         "member_epochs_active": computed - wasted,
         "wasted_member_epochs": wasted,
@@ -531,15 +585,19 @@ def bench_mcd() -> dict:
 
     # The T axis multiplies the chunk's activation footprint; step down on
     # out-of-memory so one bench binary serves every chip size.
-    while True:
-        try:
-            t_framework = _time(framework, x, chunk)
-            break
-        except Exception as e:
-            if chunk <= 128 or not _is_oom(e):
-                raise
-            chunk //= 2
+    run_log = _bench_run_log()
+    with run_log.stage("mcd_framework", windows=n_windows, passes=n_passes):
+        while True:
+            try:
+                t_framework = _time(framework, x, chunk)
+                break
+            except Exception as e:
+                if chunk <= 128 or not _is_oom(e):
+                    raise
+                chunk //= 2
     throughput = n_windows / t_framework
+    run_log.event("bench_throughput", metric="mcd_t50_inference_throughput",
+                  windows_per_s=round(throughput, 1), chunk=chunk)
 
     # Reference-pattern path on the same chip: float32, one jitted full-set
     # stochastic pass per Python-loop iteration (the sequential np.stack
@@ -581,14 +639,15 @@ def bench_mcd() -> dict:
         est = int(0.6 * limit / 2.2e6)
         while n_naive > 1024 and n_naive > est:
             n_naive //= 2
-    while True:
-        try:
-            t_naive_sub = _time(naive, x[:n_naive], warmup=1, reps=2)
-            break
-        except Exception as e:
-            if n_naive <= 1024 or not _is_oom(e):
-                raise
-            n_naive //= 2
+    with run_log.stage("mcd_reference_pattern", n_naive=n_naive):
+        while True:
+            try:
+                t_naive_sub = _time(naive, x[:n_naive], warmup=1, reps=2)
+                break
+            except Exception as e:
+                if n_naive <= 1024 or not _is_oom(e):
+                    raise
+                n_naive //= 2
     t_naive_per_window_pass = t_naive_sub / naive_passes / n_naive
     naive_throughput = 1.0 / (t_naive_per_window_pass * n_passes)
 
@@ -666,25 +725,48 @@ def _start_watchdog():
     return timer
 
 
+def _record_metric_event(run_log, result: dict, role: str) -> None:
+    """Mirror one driver-schema metric block into the run log, so the
+    telemetry capture carries the same headline numbers the JSON line
+    prints (``telemetry summarize`` shows both sides of a run)."""
+    if not isinstance(result, dict):
+        return
+    run_log.event(
+        "bench_metric", role=role, metric=result.get("metric"),
+        value=result.get("value"), unit=result.get("unit"),
+        vs_baseline=result.get("vs_baseline"),
+    )
+
+
 def main() -> None:
     _wait_for_backend()
     watchdog = _start_watchdog()
     _progress_reset()
-    if os.environ.get("BENCH_METRIC") == "de_train":
-        result = _progress_record("primary", bench_de_train("primary"))
-    else:
-        result = _progress_record("primary", bench_mcd())
-        if not os.environ.get("BENCH_SKIP_DE"):
-            result["secondary"] = _progress_record(
-                "secondary", bench_de_train("secondary"))
-    # The final line is assembled FROM the progress file (when enabled),
-    # so the printed result and the crash-surviving on-disk capture are
-    # one and the same artifact and cannot drift.
-    saved = _progress_read()
-    if saved.get("primary"):
-        result = saved["primary"]
-        if "secondary" in saved:
-            result["secondary"] = saved["secondary"]
+    run_log = _bench_run_log()
+    try:
+        if os.environ.get("BENCH_METRIC") == "de_train":
+            result = _progress_record("primary", bench_de_train("primary"))
+        else:
+            result = _progress_record("primary", bench_mcd())
+            if not os.environ.get("BENCH_SKIP_DE"):
+                result["secondary"] = _progress_record(
+                    "secondary", bench_de_train("secondary"))
+        # The final line is assembled FROM the progress file (when
+        # enabled), so the printed result and the crash-surviving on-disk
+        # capture are one and the same artifact and cannot drift.
+        saved = _progress_read()
+        if saved.get("primary"):
+            result = saved["primary"]
+            if "secondary" in saved:
+                result["secondary"] = saved["secondary"]
+        _record_metric_event(run_log, result, "primary")
+        if isinstance(result.get("secondary"), dict):
+            _record_metric_event(run_log, result["secondary"], "secondary")
+    except BaseException as e:
+        run_log.error("bench", e)
+        run_log.close(status="error")
+        raise
+    run_log.close()
     if watchdog is not None:
         watchdog.cancel()
     print(json.dumps(result))

@@ -66,7 +66,7 @@ def test_readme_smoke_recipe_pins_every_smoke_knob():
         )
 
 
-def _smoke_env(progress_file: str) -> dict:
+def _smoke_env(progress_file: str, run_dir: str) -> dict:
     # Strip ambient BENCH_* knobs too: an exported BENCH_SKIP_DE/
     # BENCH_METRIC in a developer shell must not reshape the asserted
     # schema (SMOKE_ENV is the complete knob set for this run).
@@ -75,6 +75,8 @@ def _smoke_env(progress_file: str) -> dict:
            and not k.startswith("BENCH_")}
     env.update(SMOKE_ENV)
     env["BENCH_PROGRESS_FILE"] = progress_file
+    # Keep the telemetry run dir (default ./bench_run) out of the repo cwd.
+    env["BENCH_RUN_DIR"] = run_dir
     # Share the suite's persistent compile cache so repeat runs are warm.
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(os.path.dirname(__file__), ".jax_cache"))
@@ -84,8 +86,9 @@ def _smoke_env(progress_file: str) -> dict:
 @pytest.mark.slow  # fresh interpreter + full-model CPU convs (~3-5 min)
 def test_bench_cpu_smoke_end_to_end(tmp_path):
     progress = str(tmp_path / "progress.json")
+    run_dir = str(tmp_path / "bench_run")
     proc = subprocess.run(
-        [sys.executable, BENCH], cwd=REPO, env=_smoke_env(progress),
+        [sys.executable, BENCH], cwd=REPO, env=_smoke_env(progress, run_dir),
         capture_output=True, text=True, timeout=1200,
     )
     assert proc.returncode == 0, f"bench.py failed:\n{proc.stderr[-3000:]}"
@@ -140,6 +143,41 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     primary_only = {k: v for k, v in result.items() if k != "secondary"}
     assert saved["primary"] == primary_only
 
+    # The run's telemetry event log (BENCH_RUN_DIR) captured the whole
+    # bench: stages bracketed, per-epoch ensemble step metrics with
+    # device-vs-dispatch time and recompile counters, and the canonical
+    # ensemble_fit accounting record the DE context block was SOURCED
+    # from (bench._last_ensemble_fit_event) — not recomputed inline.
+    from apnea_uq_tpu import telemetry
+
+    events = telemetry.read_events(run_dir)
+    kinds = {e["kind"] for e in events}
+    assert {"run_started", "stage_start", "stage_end", "step",
+            "ensemble_epoch", "ensemble_fit", "bench_throughput",
+            "bench_metric", "run_finished"} <= kinds, sorted(kinds)
+    assert events[-1] == {**events[-1], "kind": "run_finished",
+                          "status": "ok"}
+    stages = {e["stage"] for e in events if e["kind"] == "stage_start"}
+    assert {"mcd_framework", "mcd_reference_pattern", "de_train",
+            "de_earlystop_waste"} <= stages, sorted(stages)
+    steps = [e for e in events if e["kind"] == "step"]
+    assert all(e["device_s"] >= e["dispatch_s"] > 0 for e in steps)
+    assert all("retraces" in e and "backend_compiles" in e for e in steps)
+    # The printed DE context and the event log agree because the former
+    # is derived from the latter.
+    fit_events = [e for e in events if e["kind"] == "ensemble_fit"]
+    assert fit_events[-1]["num_members"] == de_ctx["effective_members"]
+    assert (fit_events[-1]["wasted_member_epochs"]
+            == waste["wasted_member_epochs"])
+    metric_events = {e["role"]: e for e in events
+                     if e["kind"] == "bench_metric"}
+    assert metric_events["primary"]["metric"] == result["metric"]
+    assert metric_events["primary"]["value"] == result["value"]
+    assert metric_events["secondary"]["metric"] == sec["metric"]
+    # And the read side renders it without touching jax.
+    text = telemetry.summarize_run(run_dir)
+    assert "de_train" in text and "errors: none" in text
+
 
 @pytest.mark.slow  # real bench subprocess up to the primary metric
 def test_bench_kill_after_primary_keeps_primary_on_disk(tmp_path):
@@ -150,8 +188,9 @@ def test_bench_kill_after_primary_keeps_primary_on_disk(tmp_path):
     import signal
 
     progress = str(tmp_path / "progress.json")
+    run_dir = str(tmp_path / "bench_run")
     proc = subprocess.Popen(
-        [sys.executable, BENCH], cwd=REPO, env=_smoke_env(progress),
+        [sys.executable, BENCH], cwd=REPO, env=_smoke_env(progress, run_dir),
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
     try:
@@ -189,6 +228,15 @@ def test_bench_kill_after_primary_keeps_primary_on_disk(tmp_path):
     assert primary["value"] > 0
     assert primary["vs_baseline"] > 0
     assert primary["context"]["model_flops_per_window"] > 0
+
+    # The telemetry event log shares the crash-survivability contract:
+    # flushed per event, everything up to the kill is on disk (possibly
+    # with a tolerated torn tail), starting with run_started.
+    from apnea_uq_tpu import telemetry
+
+    events = telemetry.read_events(run_dir)
+    assert events and events[0]["kind"] == "run_started"
+    assert not any(e["kind"] == "run_finished" for e in events)
 
 
 class TestProgressFile:
@@ -339,10 +387,11 @@ class TestMainDispatch:
     @pytest.fixture(autouse=True)
     def stub(self, bench_mod, monkeypatch, tmp_path):
         monkeypatch.setenv("BENCH_PLATFORM", "cpu")  # skip the init probe
-        # main() checkpoints each block to the progress file; keep the
-        # dispatch tests' writes out of the repo cwd.
+        # main() checkpoints each block to the progress file and opens a
+        # telemetry run dir; keep both writes out of the repo cwd.
         monkeypatch.setenv("BENCH_PROGRESS_FILE",
                            str(tmp_path / "progress.json"))
+        monkeypatch.setenv("BENCH_RUN_DIR", str(tmp_path / "bench_run"))
         # Every test starts from a clean knob state — ambient exported
         # BENCH_METRIC/BENCH_SKIP_DE must not reroute the branch under
         # test (the same sanitization the subprocess smoke test does).

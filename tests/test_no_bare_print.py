@@ -1,0 +1,61 @@
+"""Repo lint: library code must not call bare ``print`` (ISSUE 2).
+
+Every user-facing line in ``apnea_uq_tpu/`` routes through
+``telemetry.log`` so it can be redirected, silenced, and mirrored into
+the active run's JSONL event stream; a reintroduced ``print`` would leak
+output past all three.  The scan is AST-based (real ``print`` *calls*,
+not substrings), so comments, docstrings, and this rule's own
+documentation never trip it."""
+
+import ast
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "apnea_uq_tpu"
+
+# The only print call sites the library is allowed to keep, by
+# package-relative path.  logging_shim._StdoutHandler.emit IS the
+# central sink every log() line funnels into — by design the one place
+# a print exists.
+ALLOWLIST = {
+    "telemetry/logging_shim.py",
+}
+
+
+def _print_calls(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    return [
+        node.lineno
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "print"
+    ]
+
+
+def test_library_has_no_bare_print_outside_allowlist():
+    offenders = {}
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = str(path.relative_to(PACKAGE))
+        if rel in ALLOWLIST:
+            continue
+        lines = _print_calls(path)
+        if lines:
+            offenders[f"apnea_uq_tpu/{rel}"] = lines
+    assert not offenders, (
+        f"bare print() in library code: {offenders} — route output "
+        "through apnea_uq_tpu.telemetry.log (or add a justified "
+        "ALLOWLIST entry in tests/test_no_bare_print.py)"
+    )
+
+
+def test_allowlisted_files_exist_and_still_print():
+    """A stale allowlist entry is lint rot in the other direction: if the
+    file is gone or no longer prints, the exemption must be deleted."""
+    for rel in ALLOWLIST:
+        path = PACKAGE / rel
+        assert path.exists(), f"allowlisted {rel} no longer exists"
+        assert _print_calls(path), (
+            f"allowlisted {rel} no longer calls print; drop it from "
+            "ALLOWLIST"
+        )

@@ -616,6 +616,74 @@ class TestDeGlobalShell:
 
 
 # ---------------------------------------------------------------------------
+# Checksum-pin workflow self-tests — the shells stay skipped until a
+# reviewer pins their sha256s; `python tests/_reference_exec.py
+# --print-pins` is the one command that closes the loop once the
+# reference checkout is mounted, so the helpers behind it must keep
+# working while the mount is absent.
+# ---------------------------------------------------------------------------
+
+
+class TestPinWorkflow:
+    def test_outstanding_pins_tracks_the_unpinned_table_entries(self):
+        from _reference_exec import _REVIEWED_SHA256, outstanding_pins
+
+        expected = sorted(
+            p for p, v in _REVIEWED_SHA256.items() if v is None)
+        assert outstanding_pins() == expected
+        # Exactly the six driver shells remain unpinned today; when a
+        # reviewer pins them this assertion flips to [] — update it and
+        # delete the skip commentary together.
+        assert [os.path.basename(p) for p in outstanding_pins()] == [
+            "cnn_baseline_train.py", "train_deep_ensemble_cnns.py",
+            "analyze_de_patient_level.py", "analyze_mcd_patient_level.py",
+            "evaluate_de_global.py", "evaluate_mcd_global.py",
+        ]
+
+    def test_compute_pins_hashes_mounted_and_flags_missing(self, tmp_path):
+        import hashlib
+
+        from _reference_exec import compute_pins
+
+        mounted = tmp_path / "reviewed_shell.py"
+        mounted.write_text("SEED = 2025\n")
+        absent = str(tmp_path / "never_mounted.py")
+        pins = compute_pins([str(mounted), absent])
+        assert pins[str(mounted)] == hashlib.sha256(
+            mounted.read_bytes()).hexdigest()
+        assert pins[absent] is None
+
+    def test_format_pins_emits_paste_ready_table_entries(self, tmp_path):
+        from _reference_exec import REF_ROOT, format_pins
+
+        digest = "ab" * 32
+        text = format_pins({
+            f"{REF_ROOT}/models/x.py": digest,
+            str(tmp_path / "gone.py"): None,
+        })
+        # REF_ROOT-relative keys keep the table's f-string idiom; hashes
+        # land quoted with a trailing comma, absences stay explicit.
+        assert 'f"{REF_ROOT}/models/x.py":' in text
+        assert f'"{digest}",' in text
+        assert "None,  # not mounted" in text
+
+    def test_print_pins_cli_reports_each_outstanding_shell(self):
+        import subprocess
+
+        from _reference_exec import outstanding_pins
+
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "_reference_exec.py"),
+             "--print-pins"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        for path in outstanding_pins():
+            assert path[len(REF_ROOT):] in proc.stdout
+
+
+# ---------------------------------------------------------------------------
 # Fake-harness self-tests — run even without the mount, so the recording
 # machinery the shell tests depend on cannot rot while they skip.
 # ---------------------------------------------------------------------------

@@ -1,0 +1,410 @@
+"""The telemetry layer (ISSUE 2 tentpole): JSONL event schema round-trip,
+stage bracketing and error capture, the active-run mirror of ``log()``,
+StepMetrics dispatch/device separation + the jax.monitoring recompile
+counter (fired by a forced retrace), Timer's block-until-ready contract,
+and a golden render of ``telemetry summarize`` over a handwritten event
+log (the summarizer reads events.jsonl alone, so the golden pins both the
+schema and the table format)."""
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apnea_uq_tpu import telemetry
+from apnea_uq_tpu.telemetry.runlog import _ACTIVE, RunLog
+from apnea_uq_tpu.telemetry.steps import StepMetrics, compile_counts, \
+    install_compile_listener
+from apnea_uq_tpu.utils.timing import Timer
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_active_run():
+    """Every test must leave the process-global active-run stack empty —
+    a leaked entry would silently mirror later tests' log() lines."""
+    assert not _ACTIVE, f"active-run stack dirty on entry: {_ACTIVE}"
+    yield
+    leaked = list(_ACTIVE)
+    _ACTIVE.clear()
+    assert not leaked, f"test leaked active run logs: {leaked}"
+
+
+def _fake_clock(start=1_700_000_000.0, step=1.0):
+    state = {"t": start - step}
+
+    def clock():
+        state["t"] += step
+        return state["t"]
+
+    return clock
+
+
+class TestRunLogSchema:
+    def test_event_envelope_and_roundtrip(self, tmp_path):
+        rl = RunLog(str(tmp_path), _clock=_fake_clock())
+        rl.event("custom", alpha=1, beta=[1.5, 2.5])
+        rl.event("custom", gamma="x")
+        rl.close()
+
+        events = telemetry.read_events(str(tmp_path))
+        # close() appends run_finished, so 3 events, seq dense from 0.
+        assert [e["seq"] for e in events] == [0, 1, 2]
+        assert [e["kind"] for e in events] == [
+            "custom", "custom", "run_finished"]
+        assert events[0]["ts"] == 1_700_000_000.0
+        assert events[0]["alpha"] == 1 and events[0]["beta"] == [1.5, 2.5]
+        assert events[1]["gamma"] == "x"
+        assert events[2]["status"] == "ok"
+
+    def test_run_started_carries_topology_config_hash_argv(self, tmp_path):
+        from apnea_uq_tpu.config import ExperimentConfig
+
+        cfg = ExperimentConfig()
+        rl = telemetry.start_run(str(tmp_path), stage="train", config=cfg,
+                                 argv=["train", "--registry", "r"])
+        rl.close()
+        started = telemetry.read_events(str(tmp_path))[0]
+        assert started["kind"] == "run_started"
+        assert started["schema_version"] == telemetry.SCHEMA_VERSION
+        assert started["stage"] == "train"
+        assert started["argv"] == ["train", "--registry", "r"]
+        assert started["config_hash"] == telemetry.config_hash(cfg)
+        topo = started["topology"]
+        assert topo["platform"] == "cpu"
+        assert topo["device_count"] == jax.device_count()
+        # start_run also snapshots the full config next to the events.
+        with open(tmp_path / "config.json") as f:
+            assert "train" in json.load(f)
+
+    def test_config_hash_tracks_config_identity(self):
+        import dataclasses
+
+        from apnea_uq_tpu.config import ExperimentConfig
+
+        a, b = ExperimentConfig(), ExperimentConfig()
+        assert telemetry.config_hash(a) == telemetry.config_hash(b)
+        c = dataclasses.replace(
+            a, train=dataclasses.replace(a.train, num_epochs=99))
+        assert telemetry.config_hash(a) != telemetry.config_hash(c)
+
+    def test_stage_brackets_and_inherits(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        with rl.stage("fit", members=4):
+            rl.event("epoch", loss=0.5)
+        rl.close()
+        start, epoch, end, _fin = telemetry.read_events(str(tmp_path))
+        assert (start["kind"], start["stage"], start["members"]) == (
+            "stage_start", "fit", 4)
+        assert epoch["stage"] == "fit"  # inherited from the open stage
+        assert end["kind"] == "stage_end" and end["status"] == "ok"
+        assert end["wall_s"] >= 0
+
+    def test_stage_records_escaping_exception(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        with pytest.raises(ValueError, match="boom"):
+            with rl.stage("fit"):
+                raise ValueError("boom")
+        rl.close()
+        kinds = [e["kind"] for e in telemetry.read_events(str(tmp_path))]
+        assert kinds == ["stage_start", "error", "stage_end", "run_finished"]
+        events = telemetry.read_events(str(tmp_path))
+        assert events[1]["error"] == "ValueError: boom"
+        assert events[2]["status"] == "error"
+
+    def test_context_manager_exit_records_error_status(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            with RunLog(str(tmp_path)) as rl:
+                rl.event("work")
+                raise RuntimeError("run died")
+        events = telemetry.read_events(str(tmp_path))
+        assert events[-1]["kind"] == "run_finished"
+        assert events[-1]["status"] == "error"
+        assert any(e["kind"] == "error" for e in events)
+
+    def test_one_exception_yields_one_error_event(self, tmp_path):
+        """A failure inside a stage unwinds through stage() AND the run's
+        __exit__ — but one exception must count as one error, or
+        `summarize` inflates the failure count operators triage from."""
+        with pytest.raises(ValueError):
+            with RunLog(str(tmp_path)) as rl:
+                with rl.stage("fit"):
+                    raise ValueError("single failure")
+        events = telemetry.read_events(str(tmp_path))
+        errors = [e for e in events if e["kind"] == "error"]
+        assert len(errors) == 1, errors
+        assert errors[0]["error"] == "ValueError: single failure"
+        # A later, DIFFERENT exception is a new error event.
+        rl2 = RunLog(str(tmp_path))
+        with pytest.raises(ValueError):
+            with rl2.stage("again"):
+                raise ValueError("second failure")
+        rl2.close()
+        errors = [e for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "error"]
+        assert len(errors) == 2
+
+    def test_torn_tail_line_is_tolerated(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        rl.event("whole", n=1)
+        rl.close()
+        path = tmp_path / telemetry.EVENTS_FILENAME
+        with open(path, "a") as f:
+            f.write('{"seq": 99, "kind": "torn')  # killed mid-write
+        events = telemetry.read_events(str(tmp_path))
+        assert [e["kind"] for e in events] == ["whole", "run_finished"]
+
+    def test_read_events_empty_when_no_log(self, tmp_path):
+        assert telemetry.read_events(str(tmp_path / "nowhere")) == []
+
+    def test_disabled_runlog_is_inert_but_api_complete(self, tmp_path):
+        rl = RunLog(str(tmp_path / "sub"), disabled=True)
+        rl.run_started(stage="x")
+        with rl.stage("s"):
+            rl.event("e")
+        rl.close()
+        assert not os.path.exists(tmp_path / "sub")
+
+
+class TestActiveRunMirror:
+    def test_log_mirrors_into_active_run(self, tmp_path, capsys):
+        rl = telemetry.start_run(str(tmp_path), stage="train")
+        assert telemetry.current_run() is rl
+        telemetry.log("hello from the library")
+        rl.close()
+        assert telemetry.current_run() is None
+        assert "hello from the library" in capsys.readouterr().out
+        logs = [e for e in telemetry.read_events(str(tmp_path))
+                if e["kind"] == "log"]
+        assert [e["message"] for e in logs] == ["hello from the library"]
+
+    def test_log_without_active_run_only_prints(self, capsys):
+        telemetry.log("plain line")
+        assert capsys.readouterr().out == "plain line\n"
+
+    def test_log_respects_stdlib_logging_level(self, capsys):
+        logger = telemetry.get_logger()
+        old = logger.level
+        try:
+            logger.setLevel(logging.WARNING)
+            telemetry.log("silenced info line")
+            telemetry.log("warned line", level=logging.WARNING)
+        finally:
+            logger.setLevel(old)
+        out = capsys.readouterr().out
+        assert "silenced info line" not in out
+        assert "warned line" in out
+
+    def test_nested_runs_innermost_wins(self, tmp_path):
+        outer = telemetry.start_run(str(tmp_path / "outer"))
+        inner = telemetry.start_run(str(tmp_path / "inner"))
+        assert telemetry.current_run() is inner
+        inner.close()
+        assert telemetry.current_run() is outer
+        outer.close()
+
+
+class TestStepMetrics:
+    def test_measure_returns_result_and_records(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        metrics = StepMetrics(rl)
+        out = metrics.measure("mul", lambda: jnp.ones((8,)) * 3, n_items=8)
+        rl.close()
+        assert float(out[0]) == 3.0
+        record = metrics.last
+        assert 0 < record.dispatch_s <= record.device_s
+        assert record.items_per_s > 0
+        step = next(e for e in telemetry.read_events(str(tmp_path))
+                    if e["kind"] == "step")
+        assert step["label"] == "mul" and step["n_items"] == 8
+        assert step["device_s"] >= step["dispatch_s"] > 0
+        assert step["items_per_s"] > 0
+        assert {"retraces", "backend_compiles"} <= set(step)
+
+    def test_run_log_optional(self):
+        metrics = StepMetrics(None)
+        assert metrics.measure("host", lambda: 41 + 1) == 42
+        assert metrics.totals()["steps"] == 1
+
+    def test_recompile_counter_fires_on_forced_retrace(self):
+        if not install_compile_listener():
+            pytest.skip("this jax build lacks jax.monitoring listeners")
+
+        @jax.jit
+        def f(v):
+            return v * 2
+
+        metrics = StepMetrics(None)
+        metrics.measure("cold", lambda: f(jnp.ones((3,))))
+        # A new input SHAPE forces a retrace + XLA recompile of f; the
+        # per-step counter delta is exactly what makes a silent retrace
+        # storm (the vmap-over-members failure mode) visible.
+        metrics.measure("retrace", lambda: f(jnp.ones((5,))))
+        cold, retraced = metrics.records
+        assert retraced.retraces >= 1, (cold, retraced)
+        # Same shape again: cached program, no new trace or compile.
+        metrics.measure("warm", lambda: f(jnp.ones((5,))))
+        assert metrics.records[2].retraces == 0
+        assert metrics.records[2].backend_compiles == 0
+
+    def test_compile_counts_snapshot_is_cumulative(self):
+        if not install_compile_listener():
+            pytest.skip("this jax build lacks jax.monitoring listeners")
+
+        @jax.jit
+        def g(v):
+            return v + 1
+
+        before = compile_counts()
+        g(jnp.ones((7,)))
+        after = compile_counts()
+        assert after["retraces"] >= before["retraces"] + 1
+
+
+class TestTimerBlocking:
+    def test_wrap_blocks_result_before_reading_clock(self):
+        with Timer("t", block=True) as t:
+            out = t.wrap(jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))))
+        assert t.result is out
+        assert t.elapsed_s > 0
+
+    def test_block_false_never_blocks(self):
+        with Timer("t") as t:
+            t.result = object()  # not a jax type; blocking on it would raise
+        assert t.elapsed_s > 0
+
+    def test_escaping_exception_skips_blocking(self):
+        with pytest.raises(KeyError):
+            with Timer("t", block=True) as t:
+                t.wrap(object())  # garbage result; must not be blocked on
+                raise KeyError("died mid-computation")
+        assert t.elapsed_s > 0
+
+    def test_verbose_routes_through_telemetry_log(self, tmp_path, capsys):
+        rl = telemetry.start_run(str(tmp_path))
+        with Timer("timed_region", verbose=True):
+            pass
+        rl.close()
+        assert "[timed_region]" in capsys.readouterr().out
+        logs = [e for e in telemetry.read_events(str(tmp_path))
+                if e["kind"] == "log"]
+        assert any("[timed_region]" in e["message"] for e in logs)
+
+
+# Handwritten event log for the golden render: fixed timestamps and
+# pre-rounded floats so the expected text is byte-stable.  Mirrors one
+# tiny train run (two epochs + an eval) the schema docs describe.
+_GOLDEN_EVENTS = [
+    {"seq": 0, "ts": 1700000000.0, "kind": "run_started",
+     "schema_version": 1, "stage": "train",
+     "config_hash": "abcdef0123456789" + "0" * 48,
+     "topology": {"platform": "cpu", "device_count": 8}},
+    {"seq": 1, "ts": 1700000000.1, "kind": "stage_start", "stage": "fit"},
+    {"seq": 2, "ts": 1700000001.0, "kind": "step", "stage": "fit",
+     "label": "train_epoch", "dispatch_s": 0.25, "device_s": 1.0,
+     "retraces": 12, "backend_compiles": 1, "n_items": 512,
+     "items_per_s": 512.0},
+    {"seq": 3, "ts": 1700000001.1, "kind": "epoch", "stage": "fit",
+     "epoch": 1, "loss": 0.68, "val_loss": 0.66},
+    {"seq": 4, "ts": 1700000002.0, "kind": "step", "stage": "fit",
+     "label": "train_epoch", "dispatch_s": 0.05, "device_s": 0.6,
+     "retraces": 0, "backend_compiles": 0, "n_items": 512,
+     "items_per_s": 853.333},
+    {"seq": 5, "ts": 1700000002.1, "kind": "epoch", "stage": "fit",
+     "epoch": 2, "loss": 0.52, "val_loss": 0.55},
+    {"seq": 6, "ts": 1700000002.2, "kind": "stage_end", "stage": "fit",
+     "wall_s": 2.1, "status": "ok"},
+    {"seq": 7, "ts": 1700000002.3, "kind": "stage_start",
+     "stage": "CNN_MCD_Unbalanced"},
+    {"seq": 8, "ts": 1700000003.0, "kind": "eval_predict",
+     "stage": "CNN_MCD_Unbalanced", "label": "CNN_MCD_Unbalanced",
+     "method": "mcd", "n_passes": 50, "n_windows": 1024,
+     "predict_s": 0.5, "dispatch_s": 0.1, "windows_per_s": 2048.0,
+     "retraces": 4, "backend_compiles": 1},
+    {"seq": 9, "ts": 1700000003.1, "kind": "stage_end",
+     "stage": "CNN_MCD_Unbalanced", "wall_s": 0.9, "status": "ok"},
+    {"seq": 10, "ts": 1700000003.2, "kind": "run_finished", "status": "ok"},
+]
+
+_GOLDEN_RENDER = """\
+run: golden
+started: 2023-11-14T22:13:20Z  stage: train  platform: cpu  devices: 8
+config: abcdef012345  schema: v1  events: 11  status: ok
+
+stage                  wall_s  steps   device_s  dispatch_s  retraces  compiles     items/s
+fit                     2.100      2      1.600       0.300        12         1       640.0
+CNN_MCD_Unbalanced      0.900      -          -           -         -         -           -
+
+epochs: 2  loss 0.6800 -> 0.5200  val_loss 0.6600 -> 0.5500
+
+evals:
+  CNN_MCD_Unbalanced: 50x1024 windows in 0.500s (2048.0 windows/s)
+
+errors: none"""
+
+
+class TestSummarize:
+    def _write(self, run_dir, events):
+        os.makedirs(run_dir, exist_ok=True)
+        with open(os.path.join(run_dir, telemetry.EVENTS_FILENAME), "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+    def test_golden_render(self, tmp_path):
+        run_dir = str(tmp_path / "golden")
+        self._write(run_dir, _GOLDEN_EVENTS)
+        assert telemetry.summarize_run(run_dir) == _GOLDEN_RENDER
+
+    def test_missing_run_dir_raises_filenotfound(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="events"):
+            telemetry.summarize_run(str(tmp_path / "void"))
+
+    def test_appended_multi_run_log_renders_latest_run_only(self, tmp_path):
+        """bench.py reuses BENCH_RUN_DIR across invocations, appending
+        whole runs back-to-back into one events.jsonl; summarize must
+        render the latest run (not a merged double-count) and say how
+        many earlier runs the log holds."""
+        run_dir = str(tmp_path / "reused")
+        stale = [dict(e) for e in _GOLDEN_EVENTS]
+        stale[3] = {**stale[3], "loss": 9.99}  # a value only run 1 has
+        self._write(run_dir, stale + _GOLDEN_EVENTS)
+        text = telemetry.summarize_run(run_dir)
+        assert "(latest of 2 runs appended to this log" in text
+        # Stage rows and epoch counts come from the latest run alone.
+        assert "epochs: 2  loss 0.6800 -> 0.5200" in text
+        assert "9.99" not in text
+        assert "fit                     2.100      2" in text
+
+    def test_errors_and_ensemble_fit_sections(self, tmp_path):
+        run_dir = str(tmp_path / "err")
+        self._write(run_dir, [
+            {"seq": 0, "ts": 1700000000.0, "kind": "run_started",
+             "schema_version": 1, "stage": "bench",
+             "topology": {"platform": "cpu", "device_count": 1}},
+            {"seq": 1, "ts": 1700000001.0, "kind": "ensemble_fit",
+             "num_members": 16, "num_requested": 10, "promoted_members": 6,
+             "lockstep_epochs": 40, "wasted_member_epochs": 64},
+            {"seq": 2, "ts": 1700000002.0, "kind": "error",
+             "where": "de_train", "error": "RuntimeError: OOM"},
+        ])
+        text = telemetry.summarize_run(run_dir)
+        assert "16 members (requested 10, promoted 6)" in text
+        assert "wasted member-epochs 64" in text
+        assert "errors: 1" in text
+        assert "[de_train] RuntimeError: OOM" in text
+
+    def test_cli_subcommand_renders(self, tmp_path, capsys):
+        from apnea_uq_tpu.cli.main import main
+
+        run_dir = str(tmp_path / "golden")
+        self._write(run_dir, _GOLDEN_EVENTS)
+        assert main(["telemetry", "summarize", run_dir]) == 0
+        assert _GOLDEN_RENDER in capsys.readouterr().out
+
+    def test_cli_subcommand_rejects_non_run_dir(self, tmp_path):
+        from apnea_uq_tpu.cli.main import main
+
+        with pytest.raises(SystemExit, match="events"):
+            main(["telemetry", "summarize", str(tmp_path)])
