@@ -167,18 +167,22 @@ def cmd_train(args, config) -> int:
         learning_rate=config.train.learning_rate,
     )
     mesh = _data_mesh()
+    from apnea_uq_tpu.telemetry.profiler import maybe_profile
+
     with _run(args, "train", config) as run_log:
-        with run_log.stage("fit"):
+        with run_log.stage("fit", snapshot_memory=True), \
+                maybe_profile(run_log, args.profile, label="train") as prof:
             result = fit(
                 model, state, prepared.x_train, prepared.y_train,
                 config.train, mesh=mesh, log_fn=log, run_log=run_log,
+                profiler=prof,
             )
         path = save_state(os.path.join(_ckpt_root(args), "baseline"),
                           result.state)
         log(f"saved baseline checkpoint -> {path} "
             f"(best epoch {result.best_epoch + 1}, "
             f"stopped_early={result.stopped_early})")
-        with run_log.stage("evaluate"):
+        with run_log.stage("evaluate", snapshot_memory=True):
             for label, (x, y, _ids) in sets.items():
                 probs = predict_proba_batched(
                     model, result.state.variables(), x,
@@ -218,13 +222,17 @@ def cmd_train_ensemble(args, config) -> int:
     run_cfg = dataclasses.replace(cfg, num_members=len(missing))
     # Per-member RNG is derived from the member's global index so a resumed
     # run reproduces exactly the members a fresh run would have produced.
+    from apnea_uq_tpu.telemetry.profiler import maybe_profile
+
     with _run(args, "train-ensemble", config) as run_log:
-        with run_log.stage("fit_ensemble"):
+        with run_log.stage("fit_ensemble", snapshot_memory=True), \
+                maybe_profile(run_log, args.profile,
+                              label="train-ensemble") as prof:
             result = fit_ensemble(
                 model, prepared.x_train, prepared.y_train, run_cfg,
                 mesh=_mesh(config, num_members=len(missing)),
                 member_indices=[s - cfg.seed_base for s in missing],
-                log_fn=log, run_log=run_log,
+                log_fn=log, run_log=run_log, profiler=prof,
             )
         # The result may carry MORE members than requested: with
         # keep_padded_members the padded lockstep slots come back as real
@@ -291,6 +299,27 @@ def _add_profile_arg(p) -> None:
                         "the SURVEY §5.1 tracing hook.")
 
 
+def _add_profile_flag(p) -> None:
+    p.add_argument("--profile", action="store_true",
+                   help="Capture a bounded jax.profiler trace into "
+                        "<run-dir>/profile/<stage> (warmup skip + step "
+                        "budget; telemetry/profiler.py), announced as a "
+                        "profile_captured event in the run's events.jsonl.")
+
+
+def _no_double_profile(args) -> None:
+    """``--profile`` and ``--profile-dir`` both start a jax.profiler
+    session; jax supports one at a time, so nesting them would fail
+    mid-evaluation with a confusing profiler error."""
+    if getattr(args, "profile", False) and getattr(args, "profile_dir", None):
+        raise SystemExit(
+            "--profile and --profile-dir are mutually exclusive "
+            "(one jax.profiler session at a time); pick the bounded "
+            "run-dir capture (--profile) or the explicit directory "
+            "(--profile-dir)."
+        )
+
+
 def _print_metrics_doc(doc) -> None:
     """One printer for a run's scalar results — used for live eval output
     AND the `metrics` read-back, so the two can't drift apart."""
@@ -322,6 +351,9 @@ def cmd_eval_mcd(args, config) -> int:
     from apnea_uq_tpu.uq import run_mcd_analysis, save_run
     from apnea_uq_tpu.utils.timing import profile_trace
 
+    from apnea_uq_tpu.telemetry.profiler import TraceSession
+
+    _no_double_profile(args)
     registry = _registry(args)
     model, template = _baseline_template(config)
     state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
@@ -329,8 +361,11 @@ def cmd_eval_mcd(args, config) -> int:
     with _run(args, "eval-mcd", config) as run_log:
         for i, (label, (x, y, ids)) in enumerate(sets.items()):
             # Trace only the device-heavy evaluation; plots/registry writes
-            # would otherwise dominate the XProf host timeline.
-            with run_log.stage(f"CNN_MCD_{label}"), \
+            # would otherwise dominate the XProf host timeline.  The
+            # --profile session is handed UNENTERED to the driver, which
+            # brackets only the timed predict — the memory pre-pass's
+            # AOT compile stays out of the capture.
+            with run_log.stage(f"CNN_MCD_{label}", snapshot_memory=True), \
                     profile_trace(getattr(args, "profile_dir", None)):
                 result = run_mcd_analysis(
                     model, state.variables(), x, y, patient_ids=ids,
@@ -343,6 +378,9 @@ def cmd_eval_mcd(args, config) -> int:
                     # .py:203-211) — not once per test set.
                     sanity_check=i == 0,
                     run_log=run_log,
+                    profiler=(TraceSession(run_log, label=f"mcd-{label}",
+                                           warmup_steps=0)
+                              if args.profile else None),
                 )
             _print_run(result)
             save_run(registry, result, config=config.uq)
@@ -354,13 +392,16 @@ def cmd_eval_de(args, config) -> int:
     from apnea_uq_tpu.uq import run_de_analysis, save_run
     from apnea_uq_tpu.utils.timing import profile_trace
 
+    from apnea_uq_tpu.telemetry.profiler import TraceSession
+
+    _no_double_profile(args)
     registry = _registry(args)
     model, member_variables = _restore_members(args, config, args.num_members)
     n_members = len(member_variables)  # resolved count (0 -> all existing)
     _prepared, sets = _load_test_sets(registry)
     with _run(args, "eval-de", config) as run_log:
         for label, (x, y, ids) in sets.items():
-            with run_log.stage(f"CNN_DE_{label}"), \
+            with run_log.stage(f"CNN_DE_{label}", snapshot_memory=True), \
                     profile_trace(getattr(args, "profile_dir", None)):
                 result = run_de_analysis(
                     model, member_variables, x, y, patient_ids=ids,
@@ -369,6 +410,9 @@ def cmd_eval_de(args, config) -> int:
                     mesh=_mesh(config, num_members=n_members),
                     detailed=ids is not None and not args.no_detailed,
                     run_log=run_log,
+                    profiler=(TraceSession(run_log, label=f"de-{label}",
+                                           warmup_steps=0)
+                              if args.profile else None),
                 )
             _print_run(result)
             save_run(registry, result, config=config.uq)
@@ -603,15 +647,80 @@ def cmd_figures(args, config) -> int:
 def cmd_telemetry_summarize(args) -> int:
     """Render a run directory's ``events.jsonl`` (written by the train/
     eval stages and bench.py) as the per-stage wall/device-time,
-    throughput and recompile-count table — the read side of the
-    telemetry layer.  Needs no config and never imports jax."""
-    from apnea_uq_tpu.telemetry import summarize_run
+    throughput, recompile-count and HBM/headroom tables — the read side
+    of the telemetry layer.  Needs no config and never imports jax.
+    ``--json`` emits the same fields machine-readable."""
+    import json
+
+    from apnea_uq_tpu.telemetry import summarize_data, summarize_run
 
     try:
-        log(summarize_run(args.run_dir))
+        if args.json:
+            log(json.dumps(summarize_data(args.run_dir), indent=2))
+        else:
+            log(summarize_run(args.run_dir))
     except FileNotFoundError as e:
         raise SystemExit(str(e))
     return 0
+
+
+def cmd_telemetry_compare(args) -> int:
+    """Metric regression gate: compare a baseline and a candidate (each
+    a BENCH_r*.json capture or a telemetry run dir), exit 1 when any
+    metric worsened past its threshold — so bench/CI can gate on the
+    exit code.  Needs no config and never imports jax."""
+    import json
+
+    from apnea_uq_tpu.telemetry import compare as compare_mod
+
+    per_metric = {}
+    for spec in args.metric_threshold or []:
+        name, sep, pct = spec.rpartition("=")
+        if not sep or not name:
+            raise SystemExit(
+                f"--metric-threshold takes NAME=PCT, got {spec!r}")
+        try:
+            per_metric[name] = float(pct)
+        except ValueError:
+            raise SystemExit(
+                f"--metric-threshold {spec!r}: {pct!r} is not a number")
+    directions = {}
+    for spec in args.metric_direction or []:
+        name, sep, word = spec.rpartition("=")
+        if not sep or not name or word not in ("higher", "lower"):
+            raise SystemExit(
+                f"--metric-direction takes NAME=higher|lower, got {spec!r}")
+        directions[name] = word == "higher"
+    try:
+        comparison = compare_mod.compare_paths(
+            args.baseline, args.candidate,
+            threshold_pct=args.threshold_pct,
+            per_metric_threshold=per_metric,
+            per_metric_direction=directions,
+        )
+    except (FileNotFoundError, ValueError, OSError) as e:
+        raise SystemExit(str(e))
+    if args.json:
+        log(json.dumps(compare_mod.comparison_data(comparison), indent=2))
+    else:
+        log(compare_mod.render_comparison(comparison))
+    return 1 if comparison.regressions else 0
+
+
+def cmd_telemetry_watch(args) -> int:
+    """The hardware-watch evidence autopilot: probe the TPU backend with
+    bench's backoff probe and, on the first green probe, run the
+    round-5 evidence ritual (bench capture + TPU-gated tests) into a
+    fresh run dir under ``--out``.  Imports jax only in probe
+    subprocesses, never in this process."""
+    from apnea_uq_tpu.telemetry import watch as watch_mod
+
+    return watch_mod.watch(
+        args.out,
+        budget_s=args.budget_secs,
+        probe_timeout_s=args.probe_secs,
+        skip_tests=args.skip_tests,
+    )
 
 
 def cmd_cohort(args, config) -> int:
@@ -659,12 +768,14 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
     _add_run_dir_arg(p)
+    _add_profile_flag(p)
 
     p = add("train-ensemble", cmd_train_ensemble,
             "Train the Deep Ensemble (mesh-parallel, resumable).")
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
     _add_run_dir_arg(p)
+    _add_profile_flag(p)
 
     p = add("eval-mcd", cmd_eval_mcd, "MC-Dropout UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
@@ -673,6 +784,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     _add_no_detailed_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
+    _add_profile_flag(p)
 
     p = add("eval-de", cmd_eval_de, "Deep-Ensemble UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
@@ -686,6 +798,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     _add_no_detailed_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
+    _add_profile_flag(p)
 
     p = add("metrics", cmd_metrics,
             "Print a stored evaluation's aggregates/CIs/accuracy.")
@@ -752,19 +865,70 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--signal-quality", action="store_true")
 
     # `telemetry` is a command group, not a stage: its subcommands read
-    # run directories, take no --config, and never import jax.
+    # run directories, take no --config, and never import jax in-process
+    # (watch probes the backend in budgeted subprocesses).
     p = sub.add_parser("telemetry",
-                       help="Read back a run's structured telemetry.")
+                       help="Read back, compare, and capture a run's "
+                            "structured telemetry.")
     tsub = p.add_subparsers(dest="telemetry_command", required=True)
     ps = tsub.add_parser(
         "summarize",
-        help="Render a run directory's events.jsonl as a per-stage "
-             "wall/device-time, throughput and recompile-count table.")
+        help="Render a run directory's events.jsonl as per-stage "
+             "wall/device-time, throughput, recompile-count and "
+             "HBM/headroom tables.")
     ps.add_argument("run_dir",
                     help="Run directory containing events.jsonl (what "
                          "--run-dir pointed at, or bench.py's "
                          "BENCH_RUN_DIR).")
+    ps.add_argument("--json", action="store_true",
+                    help="Emit the summary machine-readable (the same "
+                         "fields as the rendered tables).")
     ps.set_defaults(fn=cmd_telemetry_summarize)
+
+    pc = tsub.add_parser(
+        "compare",
+        help="Regression gate: per-metric deltas between a baseline and "
+             "a candidate (BENCH_r*.json files or run dirs); exits 1 on "
+             "any regression past threshold.")
+    pc.add_argument("baseline",
+                    help="Baseline: a BENCH_r*.json capture or a "
+                         "telemetry run directory.")
+    pc.add_argument("candidate",
+                    help="Candidate to gate, same formats.")
+    pc.add_argument("--threshold-pct", type=float, default=5.0,
+                    help="Allowed worsening per metric before it counts "
+                         "as a regression (default 5%%).")
+    pc.add_argument("--metric-threshold", action="append", default=[],
+                    metavar="NAME=PCT",
+                    help="Per-metric threshold override; repeatable.")
+    pc.add_argument("--metric-direction", action="append", default=[],
+                    metavar="NAME=higher|lower",
+                    help="Per-metric better-direction override for "
+                         "metrics whose unit the inference misreads "
+                         "(unknown units default to higher-is-better); "
+                         "repeatable.")
+    pc.add_argument("--json", action="store_true",
+                    help="Emit the comparison machine-readable.")
+    pc.set_defaults(fn=cmd_telemetry_compare)
+
+    pw = tsub.add_parser(
+        "watch",
+        help="Hardware-watch autopilot: probe the TPU backend with "
+             "backoff; on the first green probe run the evidence ritual "
+             "(bench + TPU-gated tests) into a fresh run dir.")
+    pw.add_argument("--out", required=True,
+                    help="Root directory for the watch run dir "
+                         "(<out>/runs/watch-<stamp>-<pid>).")
+    pw.add_argument("--budget-secs", type=float, default=86400.0,
+                    help="Give up after this long without a green probe "
+                         "(default 24h; exit code 2).")
+    pw.add_argument("--probe-secs", type=float, default=120.0,
+                    help="Per-probe subprocess budget (a hung "
+                         "jax.devices() counts as red).")
+    pw.add_argument("--skip-tests", action="store_true",
+                    help="Run only the bench capture, not the TPU-gated "
+                         "pytest step.")
+    pw.set_defaults(fn=cmd_telemetry_watch)
 
     p = add("demo", cmd_demo,
             "Zero-data synthetic smoke demo of the UQ engine.")

@@ -43,6 +43,7 @@ from apnea_uq_tpu.models.cnn1d import (
 from apnea_uq_tpu.ops import streaming_auc
 from apnea_uq_tpu.ops.losses import masked_bce_with_logits
 from apnea_uq_tpu.parallel import mesh as mesh_lib
+from apnea_uq_tpu.telemetry import memory as telemetry_memory
 from apnea_uq_tpu.telemetry import trace as telemetry_trace
 from apnea_uq_tpu.telemetry.steps import StepMetrics
 from apnea_uq_tpu.training.state import TrainState, make_optimizer
@@ -628,6 +629,7 @@ def fit_ensemble(
     prefetch: int = 2,
     log_fn=None,
     run_log=None,
+    profiler=None,
 ) -> EnsembleFitResult:
     """Train all N members concurrently over the mesh's ensemble axis,
     each member's batches data-parallel over the mesh's ``data`` axis.
@@ -674,6 +676,14 @@ def fit_ensemble(
     members, per-member val losses) and one final ``ensemble_fit``
     summary event — the canonical source of the effective-member /
     promoted-slot / wasted-member-epoch accounting bench.py reports.
+    On the in-HBM path it also records the lockstep epoch program's
+    compiled memory analysis once (``memory_profile`` event,
+    telemetry/memory.py) — the HBM price of the whole vmapped ensemble,
+    known before the first epoch dispatches.
+
+    ``profiler`` (a :class:`apnea_uq_tpu.telemetry.profiler.TraceSession`)
+    is stepped once per lockstep epoch, bounding a ``--profile`` capture
+    to the session's warmup/step budget.
     """
     if streaming is None:
         streaming = config.streaming
@@ -723,6 +733,19 @@ def fit_ensemble(
         for epoch in range(config.num_epochs):
             epoch_key = jax.random.fold_in(shuffle_root, epoch)
             lockstep_epochs += 1
+
+            if run_log is not None and not streaming and epoch == 0:
+                # One-time compiled-HBM accounting of the exact lockstep
+                # program (deduped per signature in telemetry.memory):
+                # the member-stacked params/opt-state plus every slot's
+                # activations, priced before epoch 1 dispatches.
+                telemetry_memory.record_jit_memory(
+                    run_log, "ensemble_epoch", _ensemble_epoch,
+                    model, tx, state, book, x, y, x_val, y_val,
+                    epoch_key, member_ids, config.batch_size,
+                    config.early_stopping_patience, data_sharding,
+                    track,
+                )
 
             def run_lockstep_epoch():
                 if streaming:
@@ -788,6 +811,8 @@ def fit_ensemble(
                     f"active={n_active}/{n_members} "
                     f"val_loss={h_val[:n_members].round(4).tolist()}"
                 )
+            if profiler is not None:
+                profiler.step()
             if n_active == 0:
                 break
 

@@ -11,7 +11,15 @@ The layer every stage reports through (ISSUE 2 tentpole):
 - :mod:`~apnea_uq_tpu.telemetry.trace` — ``annotate``/``named_scope``
   profiler labels for the train/UQ hot paths;
 - :mod:`~apnea_uq_tpu.telemetry.summarize` — the
-  ``apnea-uq telemetry summarize`` renderer.
+  ``apnea-uq telemetry summarize`` renderer;
+- :mod:`~apnea_uq_tpu.telemetry.memory` — compiled HBM accounting
+  (``memory_profile`` events) + device memory snapshots (ISSUE 3);
+- :mod:`~apnea_uq_tpu.telemetry.profiler` — bounded programmatic trace
+  capture with warmup skip and a step budget (``profile_captured``);
+- :mod:`~apnea_uq_tpu.telemetry.compare` — the metric regression
+  comparator behind ``apnea-uq telemetry compare``;
+- :mod:`~apnea_uq_tpu.telemetry.watch` — the hardware-watch evidence
+  autopilot behind ``apnea-uq telemetry watch``.
 
 Only the logging shim is imported eagerly (the CLI needs ``log`` before
 anything heavy loads); everything touching jax resolves lazily via PEP
@@ -40,17 +48,43 @@ _LAZY = {
     "named_scope": "trace",
     "summarize_run": "summarize",
     "summarize_events": "summarize",
+    "summarize_data": "summarize",
+    "record_jit_memory": "memory",
+    "snapshot_device_memory": "memory",
+    "device_hbm_limit": "memory",
+    "TraceSession": "profiler",
+    "maybe_profile": "profiler",
+    "compare_paths": "compare",
+    "render_comparison": "compare",
+    # NOT "watch": that name IS the submodule — lazily exporting the
+    # watch() function under it would make telemetry.watch flip between
+    # a function (first access) and the module (after any submodule
+    # import binds the parent attribute).  Call telemetry.watch.watch().
+    "wait_for_green": "watch",
+    "probe_backend": "watch",
 }
 
 __all__ = ["log", "get_logger"] + sorted(_LAZY)
 
 
+# Submodules reachable as lazy attributes (telemetry.watch.watch(...)
+# works without a prior explicit submodule import, and the name always
+# resolves to the module — never to a same-named function inside it).
+_SUBMODULES = frozenset({
+    "runlog", "steps", "trace", "summarize", "memory", "profiler",
+    "compare", "watch", "logging_shim",
+})
+
+
 def __getattr__(name: str):
-    module = _LAZY.get(name)
-    if module is None:
-        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
     import importlib
 
-    return getattr(
-        importlib.import_module(f"apnea_uq_tpu.telemetry.{module}"), name
-    )
+    module = _LAZY.get(name)
+    if module is not None:
+        return getattr(
+            importlib.import_module(f"apnea_uq_tpu.telemetry.{module}"),
+            name,
+        )
+    if name in _SUBMODULES:
+        return importlib.import_module(f"apnea_uq_tpu.telemetry.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,308 @@
+"""Metric regression comparator (ISSUE 3 tentpole, piece 3).
+
+BENCH_r01..r05 were compared by eye; this module makes the comparison a
+tool with an exit code, so bench/CI can *gate* on it:
+
+    apnea-uq telemetry compare BASELINE CANDIDATE [--threshold-pct 5]
+
+``BASELINE``/``CANDIDATE`` are each either a bench capture (a
+``BENCH_r*.json`` file — the driver-schema line bench.py prints) or a
+telemetry run directory (``events.jsonl``; the latest run of an appended
+log).  Metrics are extracted into one namespace, deltas computed per
+metric, and a delta that *worsens* past its threshold is a regression:
+the comparator (and the CLI) report nonzero.
+
+Direction is inferred from the metric's unit — throughput (``.../sec``)
+higher-is-better, seconds/bytes lower-is-better — so a faster candidate
+never "regresses" by being different.  Unknown units default to
+higher-is-better; override per metric with ``--metric-direction
+NAME=lower`` (``per_metric_direction`` programmatically) when that is
+wrong — without it, an unknown-unit lower-is-better metric could never
+regress.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from apnea_uq_tpu.telemetry.runlog import (EVENTS_FILENAME, latest_run,
+                                           read_events)
+
+DEFAULT_THRESHOLD_PCT = 5.0
+
+
+@dataclasses.dataclass
+class Metric:
+    """One comparable scalar: name, value, direction."""
+
+    name: str
+    value: float
+    unit: Optional[str] = None
+    higher_better: bool = True
+
+
+@dataclasses.dataclass
+class MetricDelta:
+    """Baseline-vs-candidate outcome for one metric."""
+
+    name: str
+    baseline: float
+    candidate: float
+    unit: Optional[str]
+    higher_better: bool
+    threshold_pct: float
+    delta_pct: float        # signed (candidate - baseline) / |baseline|
+    regressed: bool
+
+    @property
+    def improved(self) -> bool:
+        if self.delta_pct == 0.0:
+            return False
+        return (self.delta_pct > 0) == self.higher_better
+
+
+@dataclasses.dataclass
+class Comparison:
+    baseline_path: str
+    candidate_path: str
+    deltas: List[MetricDelta]
+    only_in_baseline: List[str]
+    only_in_candidate: List[str]
+
+    @property
+    def regressions(self) -> List[MetricDelta]:
+        return [d for d in self.deltas if d.regressed]
+
+
+def unit_direction(unit: Optional[str]) -> bool:
+    """higher-is-better for throughput-like units, lower for cost-like."""
+    u = (unit or "").lower()
+    if "/sec" in u or "/s" in u or u in ("ratio", "speedup", "x"):
+        return True
+    if u in ("seconds", "s", "ms", "milliseconds") or "byte" in u:
+        return False
+    return True
+
+
+def _metrics_from_bench_doc(doc: Dict[str, Any]) -> Dict[str, Metric]:
+    """The driver-schema blocks of one BENCH_r*.json line: primary +
+    optional secondary metric values and their vs_baseline speedups.
+    A BENCH_PROGRESS_FILE capture wraps the same blocks as
+    ``{"primary": {...}, "secondary": {...}}`` — unwrap it, so the
+    printed line and the crash-surviving progress file gate identically
+    (extracting only the secondary from the wrapper would silently pass
+    a regressed primary)."""
+    if isinstance(doc.get("primary"), dict):
+        merged = dict(doc["primary"])
+        if "secondary" not in merged and isinstance(doc.get("secondary"),
+                                                    dict):
+            merged["secondary"] = doc["secondary"]
+        doc = merged
+    out: Dict[str, Metric] = {}
+
+    def block(d: Dict[str, Any]) -> None:
+        name = d.get("metric")
+        if not name or d.get("value") is None:
+            return
+        unit = d.get("unit")
+        out[name] = Metric(name, float(d["value"]), unit,
+                           unit_direction(unit))
+        if isinstance(d.get("vs_baseline"), (int, float)):
+            out[f"{name}.vs_baseline"] = Metric(
+                f"{name}.vs_baseline", float(d["vs_baseline"]), "ratio",
+                True,
+            )
+
+    block(doc)
+    if isinstance(doc.get("secondary"), dict):
+        block(doc["secondary"])
+    return out
+
+
+def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
+    """Comparable scalars of one run's event log: bench metric mirrors,
+    eval throughput, and the compiled-HBM peaks (so a footprint
+    regression gates like a speed regression)."""
+    out: Dict[str, Metric] = {}
+    for e in events:
+        kind = e.get("kind")
+        if kind == "bench_metric" and e.get("value") is not None:
+            name = e.get("metric") or f"bench.{e.get('role', '?')}"
+            unit = e.get("unit")
+            out[name] = Metric(name, float(e["value"]), unit,
+                               unit_direction(unit))
+            if isinstance(e.get("vs_baseline"), (int, float)):
+                out[f"{name}.vs_baseline"] = Metric(
+                    f"{name}.vs_baseline", float(e["vs_baseline"]),
+                    "ratio", True,
+                )
+        elif kind == "bench_throughput" and e.get("windows_per_s"):
+            name = f"{e.get('metric', 'bench')}.windows_per_s"
+            out[name] = Metric(name, float(e["windows_per_s"]),
+                               "windows/sec", True)
+        elif kind == "eval_predict" and e.get("windows_per_s"):
+            name = f"eval.{e.get('label', '?')}.windows_per_s"
+            out[name] = Metric(name, float(e["windows_per_s"]),
+                               "windows/sec", True)
+        elif kind == "memory_profile" and e.get("peak_bytes") is not None:
+            name = f"memory.{e.get('label', '?')}.peak_bytes"
+            out[name] = Metric(name, float(e["peak_bytes"]), "bytes",
+                               False)
+    return out
+
+
+def load_metrics(path: str) -> Dict[str, Metric]:
+    """Extract the comparable metrics of ``path`` — a BENCH_r*.json file
+    or a telemetry run directory (latest run of an appended log)."""
+    if os.path.isdir(path):
+        events = read_events(path)
+        if not events:
+            raise FileNotFoundError(
+                f"no {EVENTS_FILENAME} events under {path!r} — not a "
+                f"telemetry run directory"
+            )
+        events, _earlier = latest_run(events)
+        return _metrics_from_events(events)
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path!r} is not a bench JSON object")
+    metrics = _metrics_from_bench_doc(doc)
+    if not metrics:
+        raise ValueError(
+            f"{path!r} carries no driver-schema metric blocks "
+            f"(expected 'metric' + 'value' fields)"
+        )
+    return metrics
+
+
+def compare_metrics(
+    baseline: Dict[str, Metric],
+    candidate: Dict[str, Metric],
+    *,
+    threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+    per_metric_threshold: Optional[Dict[str, float]] = None,
+    per_metric_direction: Optional[Dict[str, bool]] = None,
+) -> List[MetricDelta]:
+    """Deltas for every metric present on both sides.  A regression is a
+    direction-adjusted worsening beyond the metric's threshold; an
+    exactly-zero baseline compares by sign only (any worsening from zero
+    regresses, since percent change is undefined).
+    ``per_metric_direction`` maps a metric name to higher-is-better,
+    overriding the unit inference where it guessed wrong."""
+    per_metric_threshold = per_metric_threshold or {}
+    per_metric_direction = per_metric_direction or {}
+    deltas = []
+    for name in sorted(set(baseline) & set(candidate)):
+        b, c = baseline[name], candidate[name]
+        thr = float(per_metric_threshold.get(name, threshold_pct))
+        higher_better = bool(per_metric_direction.get(name,
+                                                      b.higher_better))
+        if b.value == 0.0:
+            delta_pct = float("inf") if c.value != 0.0 else 0.0
+            worsened = (c.value < 0.0) if higher_better else (c.value > 0.0)
+            regressed = worsened
+        else:
+            delta_pct = 100.0 * (c.value - b.value) / abs(b.value)
+            worsening = -delta_pct if higher_better else delta_pct
+            regressed = worsening > thr
+        deltas.append(MetricDelta(
+            name=name, baseline=b.value, candidate=c.value, unit=b.unit,
+            higher_better=higher_better, threshold_pct=thr,
+            delta_pct=delta_pct, regressed=regressed,
+        ))
+    return deltas
+
+
+def compare_paths(
+    baseline_path: str,
+    candidate_path: str,
+    *,
+    threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+    per_metric_threshold: Optional[Dict[str, float]] = None,
+    per_metric_direction: Optional[Dict[str, bool]] = None,
+) -> Comparison:
+    baseline = load_metrics(baseline_path)
+    candidate = load_metrics(candidate_path)
+    common = set(baseline) & set(candidate)
+    if not common:
+        raise ValueError(
+            f"no common metrics between {baseline_path!r} "
+            f"({sorted(baseline)}) and {candidate_path!r} "
+            f"({sorted(candidate)})"
+        )
+    return Comparison(
+        baseline_path=baseline_path,
+        candidate_path=candidate_path,
+        deltas=compare_metrics(
+            baseline, candidate, threshold_pct=threshold_pct,
+            per_metric_threshold=per_metric_threshold,
+            per_metric_direction=per_metric_direction,
+        ),
+        only_in_baseline=sorted(set(baseline) - common),
+        only_in_candidate=sorted(set(candidate) - common),
+    )
+
+
+def comparison_data(comparison: Comparison) -> Dict[str, Any]:
+    """The comparison as one JSON-able document (the ``--json`` shape)."""
+    deltas = []
+    for d in comparison.deltas:
+        doc = dataclasses.asdict(d)
+        if doc["delta_pct"] == float("inf"):
+            # Undefined percent (zero baseline): JSON has no Infinity —
+            # json.dumps would emit a bare `Infinity` token no strict
+            # parser accepts.  null = "no percentage"; `regressed`
+            # still carries the verdict.
+            doc["delta_pct"] = None
+        deltas.append(doc)
+    return {
+        "baseline": comparison.baseline_path,
+        "candidate": comparison.candidate_path,
+        "regressed": bool(comparison.regressions),
+        "deltas": deltas,
+        "only_in_baseline": comparison.only_in_baseline,
+        "only_in_candidate": comparison.only_in_candidate,
+    }
+
+
+def render_comparison(comparison: Comparison) -> str:
+    """Human-readable delta table, regressions flagged."""
+    lines = [
+        f"baseline:  {comparison.baseline_path}",
+        f"candidate: {comparison.candidate_path}",
+        "",
+    ]
+    header = ("metric", "baseline", "candidate", "delta", "threshold",
+              "verdict")
+    # +4: every row's name carries a " (^)" / " (v)" direction suffix.
+    name_w = max([len(header[0])]
+                 + [len(d.name) + 4 for d in comparison.deltas])
+    fmt = (f"{{:<{name_w}}}  {{:>12}}  {{:>12}}  {{:>9}}  {{:>9}}  "
+           f"{{:<10}}")
+    lines.append(fmt.format(*header))
+    for d in comparison.deltas:
+        if d.delta_pct == float("inf"):
+            delta = "inf"
+        else:
+            delta = f"{d.delta_pct:+.1f}%"
+        verdict = ("REGRESSED" if d.regressed
+                   else "improved" if d.improved else "ok")
+        arrow = "^" if d.higher_better else "v"
+        lines.append(fmt.format(
+            f"{d.name} ({arrow})",
+            f"{d.baseline:g}", f"{d.candidate:g}", delta,
+            f"{d.threshold_pct:g}%", verdict,
+        ))
+    for label, names in (("only in baseline", comparison.only_in_baseline),
+                         ("only in candidate", comparison.only_in_candidate)):
+        if names:
+            lines.append("")
+            lines.append(f"{label}: {', '.join(names)}")
+    lines.append("")
+    n_reg = len(comparison.regressions)
+    lines.append(f"regressions: {n_reg or 'none'}")
+    return "\n".join(lines)

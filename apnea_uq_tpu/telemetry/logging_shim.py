@@ -13,14 +13,20 @@ only copy of a run's console transcript.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import sys
 
 LOGGER_NAME = "apnea_uq_tpu"
 
+# Which sys stream narration reaches, resolved per record ("stdout" |
+# "stderr").  Flipped only by :func:`narration_to_stderr`.
+_STREAM_NAME = "stdout"
+
 
 class _StdoutHandler(logging.Handler):
-    """Writes plain messages to the CURRENT ``sys.stdout``, resolved per
+    """Writes plain messages to the CURRENT ``sys.stdout`` (or, inside a
+    :func:`narration_to_stderr` scope, ``sys.stderr``), resolved per
     record — pytest's capsys and ``contextlib.redirect_stdout`` see the
     lines exactly where they saw the bare-``print`` output this shim
     replaced (a ``StreamHandler`` would pin the stream object it was
@@ -29,9 +35,25 @@ class _StdoutHandler(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
         try:
             # The package's single allowlisted print call.
-            print(self.format(record), file=sys.stdout)
+            print(self.format(record), file=getattr(sys, _STREAM_NAME))
         except Exception:  # pragma: no cover - stdlib handler contract
             self.handleError(record)
+
+
+@contextlib.contextmanager
+def narration_to_stderr():
+    """Route library ``log()`` lines to the current ``sys.stderr`` for
+    the duration of the block — for applications whose stdout is a
+    machine interface (bench.py's one-JSON-line driver contract must not
+    gain a second line just because a profiler capture announced
+    itself).  The active-run JSONL mirror is unaffected."""
+    global _STREAM_NAME
+    prev = _STREAM_NAME
+    _STREAM_NAME = "stderr"
+    try:
+        yield
+    finally:
+        _STREAM_NAME = prev
 
 
 def get_logger() -> logging.Logger:

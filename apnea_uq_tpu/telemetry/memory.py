@@ -1,0 +1,199 @@
+"""HBM accounting for the jitted hot paths (ISSUE 3 tentpole, piece 1).
+
+Two complementary sources, both recorded as run-log events so
+``apnea-uq telemetry summarize`` can render a per-stage HBM/headroom
+table and ``telemetry compare`` can gate on footprint regressions:
+
+- :func:`record_jit_memory` — XLA's *static* accounting: lower+compile
+  the exact jitted program a hot path is about to dispatch and record
+  ``Compiled.memory_analysis()`` (argument/output/temp bytes and the
+  derived peak) as a ``memory_profile`` event.  The numbers are what the
+  compiler reserves, so they are exact on TPU — including over the
+  tunneled backend, whose runtime ``memory_stats()`` returns None and
+  hides live usage from us.
+- :func:`snapshot_device_memory` — the *dynamic* view at a stage
+  bracket: ``device.memory_stats()`` (bytes in use / peak / limit, when
+  the runtime exposes them) plus a ``jax.profiler.device_memory_profile``
+  pprof dump saved under ``<run_dir>/memory/``, recorded as a
+  ``memory_snapshot`` event.
+
+Cost note: ``record_jit_memory`` compiles the program a second time
+(AOT ``lower().compile()`` does not share the jit call cache), so call
+sites invoke it once per program signature — a per-run-log memo
+enforces that even when a caller (e.g. bench's repeated ``fit_ensemble``
+reps against one run log) cannot.  Per-RUN, not per-process: a second
+run in the same process (a notebook driver, back-to-back CLI stages)
+must get its own ``memory_profile`` events, or its HBM table comes up
+empty and its footprint metrics silently drop out of the compare gate.
+Everything is best-effort: accounting must never break or slow a run
+beyond that one-time compile.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+# Public HBM capacity per chip kind — the fallback sizing hint when the
+# runtime exposes no memory_stats (the tunneled TPU backend returns
+# None).  bench.py seeds its reference-pattern set size from this table
+# too, so the one copy lives here.
+CHIP_HBM_BYTES: Dict[str, float] = {
+    "TPU v4": 32e9,
+    "TPU v5 lite": 16e9,
+    "TPU v5e": 16e9,
+    "TPU v5": 95e9,   # v5p
+    "TPU v5p": 95e9,
+    "TPU v6 lite": 32e9,
+    "TPU v6e": 32e9,
+}
+
+def _memo(run_log) -> set:
+    """The run log's (label, abstract-signature) dedupe set — keeps
+    repeated dispatches against one run (bench reps, per-test-set eval
+    loops at equal shapes) from paying the AOT compile more than once,
+    while a fresh run log always records afresh."""
+    memo = getattr(run_log, "_memory_profile_memo", None)
+    if memo is None:
+        memo = set()
+        run_log._memory_profile_memo = memo
+    return memo
+
+
+def device_hbm_limit(device=None) -> Optional[int]:
+    """Per-device HBM capacity in bytes: ``memory_stats()['bytes_limit']``
+    when the runtime exposes it, else the public spec for the chip kind,
+    else None (e.g. CPU).  Never raises."""
+    try:
+        if device is None:
+            device = jax.devices()[0]
+        try:
+            stats = device.memory_stats() or {}
+        except Exception:  # noqa: BLE001 - tunneled backends may raise
+            stats = {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit)
+        limit = CHIP_HBM_BYTES.get(device.device_kind)
+        return int(limit) if limit else None
+    except Exception:  # noqa: BLE001 - no backend at all
+        return None
+
+
+def memory_analysis_fields(stats) -> Dict[str, int]:
+    """Flatten a ``CompiledMemoryStats`` into event fields.  ``peak_bytes``
+    is the standard XLA accounting: arguments + outputs + temporaries,
+    minus buffers aliased between them (donations)."""
+    arg = int(getattr(stats, "argument_size_in_bytes", 0))
+    out = int(getattr(stats, "output_size_in_bytes", 0))
+    temp = int(getattr(stats, "temp_size_in_bytes", 0))
+    alias = int(getattr(stats, "alias_size_in_bytes", 0))
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+        "generated_code_bytes": int(
+            getattr(stats, "generated_code_size_in_bytes", 0)
+        ),
+        "peak_bytes": arg + out + temp - alias,
+    }
+
+
+def _abstract_signature(args: tuple, kwargs: dict) -> str:
+    """A cheap process-stable signature of a jitted call's arguments:
+    array leaves become (shape, dtype), everything else (static args,
+    meshes, scalars) its repr — the same distinctions the jit cache key
+    makes, coarse enough to build without tracing."""
+
+    def leaf(a: Any) -> str:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            return f"arr{tuple(shape)}:{dtype}"
+        return repr(a)
+
+    tree = (args, tuple(sorted(kwargs.items())))
+    return str(jax.tree.map(leaf, tree))
+
+
+def record_jit_memory(run_log, label: str, fn, *args,
+                      **kwargs) -> Optional[Dict[str, Any]]:
+    """Lower+compile ``fn(*args, **kwargs)`` (a ``jax.jit``-wrapped
+    callable, invoked exactly as the hot path is about to) and append a
+    ``memory_profile`` event with its compiled memory analysis plus the
+    device's HBM limit and headroom.  Deduped per run log per (label,
+    argument signature); best-effort — returns the event record or None,
+    never raises.  ``APNEA_UQ_MEMORY_PROFILE=0`` disables the accounting
+    entirely — the opt-out for runs where even one extra AOT compile of
+    the heaviest program (absorbed as a disk hit under a warm persistent
+    compilation cache, but a real compile without one) is unwelcome."""
+    if run_log is None or getattr(run_log, "disabled", False):
+        return None
+    if os.environ.get("APNEA_UQ_MEMORY_PROFILE", "1").lower() in (
+            "0", "false", "off"):
+        return None
+    try:
+        memo = _memo(run_log)
+        key = (label, _abstract_signature(args, kwargs))
+        if key in memo:
+            return None
+        # Memoize the ATTEMPT, not the success: on a backend where
+        # memory_analysis() is unimplemented (returns None/raises),
+        # retrying every call would re-pay the full AOT compile — inside
+        # the timed windows the drivers' pre-pass exists to protect.
+        memo.add(key)
+        stats = fn.lower(*args, **kwargs).compile().memory_analysis()
+        if stats is None:
+            return None
+        fields = memory_analysis_fields(stats)
+        device = jax.devices()[0]
+        limit = device_hbm_limit(device)
+        return run_log.event(
+            "memory_profile",
+            label=label,
+            platform=device.platform,
+            device_kind=device.device_kind,
+            hbm_limit_bytes=limit,
+            headroom_bytes=(limit - fields["peak_bytes"]
+                            if limit is not None else None),
+            **fields,
+        )
+    except Exception:  # noqa: BLE001 - accounting must never break a run
+        return None
+
+
+def snapshot_device_memory(run_log, label: str) -> Optional[Dict[str, Any]]:
+    """Append a ``memory_snapshot`` event: the runtime's live-usage
+    counters (when exposed) and a ``jax.profiler.device_memory_profile``
+    pprof dump saved to ``<run_dir>/memory/<label>.pprof.gz``.
+    Best-effort; never raises."""
+    if run_log is None or getattr(run_log, "disabled", False):
+        return None
+    try:
+        fields: Dict[str, Any] = {"label": label}
+        try:
+            device = jax.devices()[0]
+            stats = device.memory_stats() or {}
+        except Exception:  # noqa: BLE001 - backend may be unusable
+            stats = {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            fields[key] = (int(stats[key]) if stats.get(key) is not None
+                           else None)
+        try:
+            profile = jax.profiler.device_memory_profile()
+            rel = os.path.join("memory",
+                               f"{label.replace(os.sep, '_')}.pprof.gz")
+            path = os.path.join(run_log.run_dir, rel)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(profile)
+            fields["profile_path"] = rel
+            fields["profile_bytes"] = len(profile)
+        except Exception:  # noqa: BLE001 - profiler-less builds
+            pass
+        return run_log.event("memory_snapshot", **fields)
+    except Exception:  # noqa: BLE001 - accounting must never break a run
+        return None

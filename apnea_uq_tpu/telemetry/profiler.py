@@ -1,0 +1,160 @@
+"""Bounded programmatic profiler capture (ISSUE 3 tentpole, piece 2).
+
+``utils.timing.profile_trace`` wraps an arbitrary block in a
+``jax.profiler`` trace; that is the right shape for a one-shot eval but
+wrong for training, where tracing every epoch captures the compile storm
+of epoch 1 and produces a dump too large to ship over a tunnel.
+:class:`TraceSession` adds the two bounds a long loop needs:
+
+- **warmup skip** — the trace starts only after ``warmup_steps`` calls
+  to :meth:`TraceSession.step`, so compilation and cache warming stay
+  out of the capture;
+- **step budget** — the trace stops after ``max_steps`` profiled steps,
+  so the artifact stays bounded no matter how long the run is.
+
+The trace directory defaults to ``<run_dir>/profile/<label>`` — the
+capture lives next to the run's ``events.jsonl`` — and the stop is
+announced with a ``profile_captured`` event so tooling (and the
+summarizer) can find it without globbing.
+
+Used as ``--profile`` on the train/train-ensemble/eval-mcd/eval-de CLI
+stages and as ``BENCH_PROFILE`` in bench.py.  With ``warmup_steps=0``
+the session starts capturing at ``__enter__`` and stops at ``__exit__``
+(bracket mode — what the single-dispatch eval stages use).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Dict, Optional
+
+from apnea_uq_tpu.telemetry.logging_shim import log
+
+
+class TraceSession:
+    """Bounded ``jax.profiler`` capture around a stepped loop.
+
+    Call :meth:`step` at every step boundary (the trainers call it once
+    per epoch).  Degrades to inert if the profiler is unavailable or a
+    trace is already active; a session that ends before its warmup is
+    satisfied captures nothing and says so through ``telemetry.log``.
+    """
+
+    def __init__(self, run_log=None, *, label: str = "trace",
+                 trace_dir: Optional[str] = None, warmup_steps: int = 1,
+                 max_steps: int = 4):
+        if trace_dir is None:
+            if run_log is None or getattr(run_log, "run_dir", None) is None:
+                raise ValueError(
+                    "TraceSession needs a run_log (trace goes under its "
+                    "run dir) or an explicit trace_dir"
+                )
+            trace_dir = os.path.join(run_log.run_dir, "profile", label)
+        self.run_log = run_log
+        self.label = label
+        self.trace_dir = trace_dir
+        self.warmup_steps = int(warmup_steps)
+        self.max_steps = int(max_steps)
+        self.steps_seen = 0
+        self.steps_profiled = 0
+        self.started = False
+        self.stopped = False
+        self._broken = False
+
+    # -- capture lifecycle -----------------------------------------------
+
+    def _start(self) -> None:
+        if self.started or self._broken:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self.started = True
+        except Exception as e:  # noqa: BLE001 - a busy/absent profiler
+            self._broken = True  # must never break the run it observes
+            log(f"profiler capture {self.label!r} unavailable: "
+                f"{type(e).__name__}: {e}")
+
+    def _finish(self) -> None:
+        if not self.started or self.stopped:
+            return
+        self.stopped = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            log(f"profiler capture {self.label!r} failed to stop: "
+                f"{type(e).__name__}: {e}")
+            return
+        self._announce()
+
+    def _announce(self) -> None:
+        # No step() ever marked a boundary: a bracket capture (the eval
+        # stages, bench's BENCH_PROFILE pass) covering the whole block.
+        # steps_profiled=None there, so tooling can tell a full bracket
+        # capture from a stepped session that stopped before profiling
+        # anything (e.g. a run exactly as long as its warmup).
+        bracket = self.steps_seen == 0
+        fields: Dict[str, Any] = {
+            "label": self.label,
+            "trace_dir": self._relative_trace_dir(),
+            "mode": "bracket" if bracket else "steps",
+            "steps_profiled": None if bracket else self.steps_profiled,
+            "warmup_steps": self.warmup_steps,
+        }
+        if self.run_log is not None:
+            self.run_log.event("profile_captured", **fields)
+        span = ("whole block" if bracket
+                else f"{self.steps_profiled} step(s)")
+        log(f"profiler trace ({self.label}, {span}) -> {self.trace_dir}")
+
+    def _relative_trace_dir(self) -> str:
+        run_dir = getattr(self.run_log, "run_dir", None)
+        if run_dir:
+            rel = os.path.relpath(self.trace_dir, run_dir)
+            if not rel.startswith(os.pardir):
+                return rel
+        return self.trace_dir
+
+    # -- caller surface ---------------------------------------------------
+
+    def step(self) -> None:
+        """Mark one step boundary: starts the trace once the warmup is
+        skipped, stops it once the step budget is spent."""
+        self.steps_seen += 1
+        if not self.started:
+            if self.steps_seen >= self.warmup_steps:
+                self._start()
+            return
+        if not self.stopped:
+            self.steps_profiled += 1
+            if self.steps_profiled >= self.max_steps:
+                self._finish()
+
+    def __enter__(self) -> "TraceSession":
+        if self.warmup_steps <= 0:
+            self._start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.started:
+            self._finish()
+        elif not self._broken:
+            log(f"profiler capture {self.label!r} requested but the run "
+                f"ended after {self.steps_seen} step(s), inside the "
+                f"{self.warmup_steps}-step warmup; nothing captured")
+
+
+@contextlib.contextmanager
+def maybe_profile(run_log, enabled: bool, **session_kwargs):
+    """``with maybe_profile(run_log, args.profile, label=...) as prof:`` —
+    yields a live :class:`TraceSession` when enabled, else None, so call
+    sites pass ``prof`` straight through as a trainer's ``profiler``."""
+    if not enabled:
+        yield None
+        return
+    with TraceSession(run_log, **session_kwargs) as session:
+        yield session

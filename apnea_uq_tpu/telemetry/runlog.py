@@ -118,18 +118,26 @@ class RunLog:
         return self.event("run_started", **fields)
 
     @contextlib.contextmanager
-    def stage(self, name: str, **fields: Any):
+    def stage(self, name: str, *, snapshot_memory: bool = False,
+              **fields: Any):
         """Bracket a pipeline stage with stage_start/stage_end events;
         events emitted inside inherit ``stage=name``.  An escaping
         exception is recorded (status='error' + an ``error`` event) and
-        re-raised."""
+        re-raised.  ``snapshot_memory=True`` additionally records a
+        device-memory snapshot (``memory_snapshot`` event + pprof dump,
+        telemetry/memory.py) at entry and exit — including the error
+        exit, where an OOM unwind is exactly when you want the numbers."""
         self.event("stage_start", stage=name, **fields)
         self._stages.append(name)
+        if snapshot_memory:
+            self._snapshot_memory(f"{name}.start")
         t0 = time.perf_counter()
         try:
             yield self
         except BaseException as e:
             wall = time.perf_counter() - t0
+            if snapshot_memory:
+                self._snapshot_memory(f"{name}.error")
             self._stages.pop()
             self.error(name, e)
             self.event("stage_end", stage=name, wall_s=round(wall, 6),
@@ -137,9 +145,24 @@ class RunLog:
             raise
         else:
             wall = time.perf_counter() - t0
+            if snapshot_memory:
+                self._snapshot_memory(f"{name}.end")
             self._stages.pop()
             self.event("stage_end", stage=name, wall_s=round(wall, 6),
                        status="ok")
+
+    def _snapshot_memory(self, label: str) -> None:
+        """Lazy, best-effort device-memory snapshot — the import keeps
+        this module (and the jax-free read side) free of jax until a
+        caller opts in."""
+        if self.disabled:
+            return
+        try:
+            from apnea_uq_tpu.telemetry import memory as memory_mod
+
+            memory_mod.snapshot_device_memory(self, label)
+        except Exception:  # noqa: BLE001 - telemetry must never break a run
+            pass
 
     def error(self, where: str, exc: BaseException) -> Dict[str, Any]:
         # One exception, one error event: a failure inside a stage block
@@ -221,3 +244,16 @@ def read_events(run_dir: str) -> List[Dict[str, Any]]:
             except ValueError:
                 continue  # torn tail write; everything before it is good
     return events
+
+
+def latest_run(events: List[Dict[str, Any]]):
+    """Split an appended multi-run log (bench.py reuses BENCH_RUN_DIR, so
+    events.jsonl can hold several runs back-to-back) at its run_started
+    boundaries; returns (latest run's events, count of earlier runs).
+    The ONE run-boundary rule — summarize and compare both consume it,
+    so they can never disagree about which run a dir's metrics are."""
+    starts = [i for i, e in enumerate(events)
+              if e.get("kind") == "run_started"]
+    if len(starts) <= 1:
+        return events, 0
+    return events[starts[-1]:], len(starts) - 1

@@ -11,7 +11,8 @@ import datetime
 import os
 from typing import Any, Dict, List, Optional
 
-from apnea_uq_tpu.telemetry.runlog import EVENTS_FILENAME, read_events
+from apnea_uq_tpu.telemetry.runlog import (EVENTS_FILENAME, latest_run,
+                                           read_events)
 
 _NO_STAGE = "(no stage)"
 
@@ -89,16 +90,91 @@ def _first_last(values: List[float]) -> str:
     return f"{values[0]:.4f} -> {values[-1]:.4f}"
 
 
-def _latest_run(events: List[Dict[str, Any]]):
-    """Split an appended multi-run log (bench.py reuses BENCH_RUN_DIR, so
-    events.jsonl can hold several runs back-to-back) at its run_started
-    boundaries; returns (latest run's events, count of earlier runs).
-    Merging runs would double-count stage tables and epoch trajectories."""
-    starts = [i for i, e in enumerate(events)
-              if e.get("kind") == "run_started"]
-    if len(starts) <= 1:
-        return events, 0
-    return events[starts[-1]:], len(starts) - 1
+def _mb(value: Optional[float]) -> str:
+    """Bytes as MiB with one decimal; '-' for unknown."""
+    return "-" if value is None else f"{value / 2**20:.1f}"
+
+
+def _render_memory_table(mems: List[Dict[str, Any]]) -> List[str]:
+    """The per-program HBM/headroom table from ``memory_profile`` events
+    (compiled memory analysis; telemetry/memory.py)."""
+    header = ("program", "args_mb", "out_mb", "temp_mb", "peak_mb",
+              "limit_mb", "headroom")
+    name_w = max([len(header[0])]
+                 + [len(str(e.get("label", "?"))) for e in mems])
+    fmt = (f"{{:<{name_w}}}  {{:>8}}  {{:>8}}  {{:>8}}  {{:>8}}  "
+           f"{{:>9}}  {{:>8}}")
+    lines = ["hbm (compiled memory analysis):", fmt.format(*header)]
+    for e in mems:
+        limit = e.get("hbm_limit_bytes")
+        peak = e.get("peak_bytes")
+        headroom = "-"
+        if limit and peak is not None:
+            headroom = f"{100.0 * (limit - peak) / limit:.1f}%"
+        lines.append(fmt.format(
+            e.get("label", "?"),
+            _mb(e.get("argument_bytes")),
+            _mb(e.get("output_bytes")),
+            _mb(e.get("temp_bytes")),
+            _mb(peak),
+            _mb(limit),
+            headroom,
+        ))
+    return lines
+
+
+def _render_memory_snapshots(snaps: List[Dict[str, Any]]) -> List[str]:
+    lines = ["hbm snapshots:"]
+    for e in snaps:
+        parts = [f"  {e.get('label', '?')}:"]
+        parts.append(f"in_use={_mb(e.get('bytes_in_use'))}")
+        parts.append(f"peak={_mb(e.get('peak_bytes_in_use'))}")
+        parts.append(f"limit={_mb(e.get('bytes_limit'))}")
+        if e.get("profile_path"):
+            parts.append(f"profile={e['profile_path']}"
+                         f" ({e.get('profile_bytes', '?')} B)")
+        lines.append(" ".join(parts))
+    return lines
+
+
+def _render_profiles(profs: List[Dict[str, Any]]) -> List[str]:
+    lines = ["profiler traces:"]
+    for e in profs:
+        if e.get("steps_profiled") is None:  # bracket capture
+            span = "whole block"
+        else:
+            span = (f"{e['steps_profiled']} step(s) "
+                    f"(warmup {e.get('warmup_steps', '?')})")
+        lines.append(
+            f"  {e.get('label', '?')}: {span} -> {e.get('trace_dir', '?')}"
+        )
+    return lines
+
+
+# The field projections the renderer's capture sections AND the --json
+# document share — one list per event kind, so a field added to one
+# output cannot silently miss the other.
+_MEMORY_PROFILE_FIELDS = (
+    "label", "argument_bytes", "output_bytes", "temp_bytes",
+    "alias_bytes", "peak_bytes", "hbm_limit_bytes", "headroom_bytes",
+    "device_kind")
+_MEMORY_SNAPSHOT_FIELDS = (
+    "label", "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+    "profile_path", "profile_bytes")
+_PROFILE_FIELDS = (
+    "label", "trace_dir", "mode", "steps_profiled", "warmup_steps")
+
+
+def _section(events: List[Dict[str, Any]], kind: str,
+             fields: tuple) -> List[Dict[str, Any]]:
+    return [{k: e.get(k) for k in fields}
+            for e in events if e.get("kind") == kind]
+
+
+# Merging appended runs would double-count stage tables and epoch
+# trajectories — both read paths keep only the latest run (runlog's
+# shared boundary rule).
+_latest_run = latest_run
 
 
 def summarize_events(run_dir: str,
@@ -173,6 +249,21 @@ def summarize_events(run_dir: str,
                 f" ({_fmt(wps, 1)} windows/s)"
             )
 
+    mems = _section(events, "memory_profile", _MEMORY_PROFILE_FIELDS)
+    if mems:
+        lines.append("")
+        lines.extend(_render_memory_table(mems))
+
+    snaps = _section(events, "memory_snapshot", _MEMORY_SNAPSHOT_FIELDS)
+    if snaps:
+        lines.append("")
+        lines.extend(_render_memory_snapshots(snaps))
+
+    profs = _section(events, "profile_captured", _PROFILE_FIELDS)
+    if profs:
+        lines.append("")
+        lines.extend(_render_profiles(profs))
+
     errors = [e for e in events if e.get("kind") == "error"]
     lines.append("")
     if errors:
@@ -193,3 +284,67 @@ def summarize_run(run_dir: str) -> str:
             f"is this a telemetry run directory?"
         )
     return summarize_events(run_dir, events)
+
+
+def summarize_data(run_dir: str) -> Dict[str, Any]:
+    """Machine-readable summary (``telemetry summarize --json``): the
+    same fields the rendered table derives, as one JSON-able document —
+    latest run of an appended log, like the text renderer."""
+    all_events = read_events(run_dir)
+    if not all_events:
+        raise FileNotFoundError(
+            f"no {EVENTS_FILENAME} events under {run_dir!r} — "
+            f"is this a telemetry run directory?"
+        )
+    events, earlier_runs = _latest_run(all_events)
+    started = next((e for e in events if e.get("kind") == "run_started"), None)
+    finished = [e for e in events if e.get("kind") == "run_finished"]
+    topo = (started or {}).get("topology", {})
+
+    rows = _stage_rows(events)
+    for r in rows:
+        # The derived column the table renders; None when undefined.
+        r["items_per_s"] = (
+            r["n_items"] / r["device_s"]
+            if r["n_items"] and r["device_s"] > 0 else None
+        )
+
+    epochs = [e for e in events if e.get("kind") == "epoch"]
+    loss = [float(e["loss"]) for e in epochs if "loss" in e]
+    val = [float(e["val_loss"]) for e in epochs if "val_loss" in e]
+
+    def section(kind: str, fields: tuple) -> List[Dict[str, Any]]:
+        return _section(events, kind, fields)
+
+    return {
+        "run": os.path.basename(os.path.normpath(run_dir)),
+        "started_ts": (started or {}).get("ts"),
+        "stage": (started or {}).get("stage"),
+        "platform": topo.get("platform"),
+        "devices": topo.get("device_count"),
+        "config_hash": (started or {}).get("config_hash"),
+        "schema_version": (started or {}).get("schema_version"),
+        "events": len(events),
+        "status": finished[-1].get("status") if finished else None,
+        "earlier_runs": earlier_runs,
+        "stages": rows,
+        "epochs": {
+            "count": len(epochs),
+            "loss_first": loss[0] if loss else None,
+            "loss_last": loss[-1] if loss else None,
+            "val_loss_first": val[0] if val else None,
+            "val_loss_last": val[-1] if val else None,
+        },
+        "ensemble_fits": section("ensemble_fit", (
+            "num_members", "num_requested", "promoted_members",
+            "lockstep_epochs", "wasted_member_epochs")),
+        "evals": section("eval_predict", (
+            "label", "method", "n_passes", "n_windows", "predict_s",
+            "windows_per_s")),
+        "memory_profiles": section("memory_profile",
+                                   _MEMORY_PROFILE_FIELDS),
+        "memory_snapshots": section("memory_snapshot",
+                                    _MEMORY_SNAPSHOT_FIELDS),
+        "profiles": section("profile_captured", _PROFILE_FIELDS),
+        "errors": section("error", ("where", "error")),
+    }

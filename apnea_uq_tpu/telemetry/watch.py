@@ -1,0 +1,271 @@
+"""Hardware-watch evidence autopilot (ISSUE 3 tentpole, piece 4).
+
+The TPU tunnel has been down for three consecutive rounds, and each
+round the evidence ritual (a bench capture + the TPU-gated tests) had to
+be remembered and run by hand in whatever window the tunnel offered.
+``apnea-uq telemetry watch`` closes that loop: it probes the backend
+with the same budgeted-subprocess probe and backoff schedule bench.py's
+init retry uses (:func:`probe_backend` / :func:`wait_for_green` — bench
+imports them from here), and on the FIRST green probe runs the
+configured evidence ritual into a fresh telemetry run directory:
+
+1. ``python bench.py`` with ``BENCH_RUN_DIR``/``BENCH_PROGRESS_FILE``
+   pointed inside the watch run dir (a BENCH_r06-grade capture);
+2. ``APNEA_UQ_TEST_TPU=1 python -m pytest tests/test_bootstrap.py -k
+   on_tpu`` (the TPU-gated kernel tests).
+
+Every probe attempt, the green transition, and each ritual step's exit
+code land in the run's ``events.jsonl`` (``probe``, ``probe_green``,
+``ritual_step``), with each step's stdout/stderr saved next to it — so
+the evidence of WHEN hardware appeared and what ran is itself a
+telemetry artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from apnea_uq_tpu.telemetry.logging_shim import log
+from apnea_uq_tpu.telemetry.runlog import default_run_dir, start_run
+
+# Backoff schedule shared with bench.py's init retry (its unit tests pin
+# the first two sleeps at 20.0 and 32.0 seconds).
+BACKOFF_INITIAL_S = 20.0
+BACKOFF_FACTOR = 1.6
+BACKOFF_MAX_S = 300.0
+
+_PROBE_SNIPPET = "import jax; assert jax.devices()"
+
+# The repo root (bench.py, tests/) sits two levels above this package.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def probe_backend(probe_timeout_s: float = 120.0) -> Tuple[bool, str]:
+    """One budgeted backend probe: ``jax.devices()`` in a subprocess —
+    the call can hang indefinitely during a tunnel outage, so it must
+    never run in this process.  Returns (green, detail)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            capture_output=True, text=True, timeout=probe_timeout_s,
+        )
+        if r.returncode == 0:
+            return True, "ok"
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        return False, tail[-1] if tail else f"probe exited rc={r.returncode}"
+    except subprocess.TimeoutExpired:
+        return False, (f"probe hung >{probe_timeout_s:.0f}s in "
+                       f"jax.devices() (tunnel-outage pattern)")
+
+
+def wait_for_green(
+    budget_s: float,
+    *,
+    probe_timeout_s: float = 120.0,
+    probe: Optional[Callable[[float], Tuple[bool, str]]] = None,
+    on_attempt: Optional[Callable[[int, bool, str], None]] = None,
+) -> Tuple[bool, int, str]:
+    """Probe with backoff until green or the budget expires.  Returns
+    (green, attempts, last_detail).  The final sleep is clamped to the
+    remaining budget rather than giving up early, and a hang-mode probe
+    never overshoots the deadline — the semantics bench.py's init retry
+    established (its tests pin them)."""
+    probe = probe or probe_backend
+    deadline = time.monotonic() + budget_s
+    delay = BACKOFF_INITIAL_S
+    attempts, last = 0, "no probe ran"
+    while True:
+        attempts += 1
+        probe_budget = min(probe_timeout_s,
+                           max(deadline - time.monotonic(), 1.0))
+        green, last = probe(probe_budget)
+        if on_attempt is not None:
+            on_attempt(attempts, green, last)
+        if green:
+            return True, attempts, last
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False, attempts, last
+        time.sleep(min(delay, remaining))
+        delay = min(delay * BACKOFF_FACTOR, BACKOFF_MAX_S)
+
+
+@dataclasses.dataclass
+class RitualStep:
+    """One command of the evidence ritual."""
+
+    name: str
+    argv: List[str]
+    env: Dict[str, str]
+    # A hung subprocess must not hang the (unattended, up-to-24h) watch:
+    # the TPU-gated pytest step has no internal watchdog, and a tunnel
+    # that flaps AFTER the green probe hangs jax.devices() inside it.
+    timeout_s: float = 7200.0
+
+
+def evidence_ritual_steps(
+    run_dir: str,
+    *,
+    skip_tests: bool = False,
+    repo_root: str = _REPO_ROOT,
+    python: str = sys.executable,
+) -> List[RitualStep]:
+    """The round-5 verdict's two-command hardware ritual, parameterized
+    to land its artifacts inside the watch run directory."""
+    steps = [RitualStep(
+        name="bench",
+        argv=[python, os.path.join(repo_root, "bench.py")],
+        env={
+            "BENCH_RUN_DIR": os.path.join(run_dir, "bench"),
+            "BENCH_PROGRESS_FILE": os.path.join(run_dir,
+                                                "bench_progress.json"),
+        },
+    )]
+    if not skip_tests:
+        steps.append(RitualStep(
+            name="tpu_tests",
+            argv=[python, "-m", "pytest", "tests/test_bootstrap.py",
+                  "-k", "on_tpu", "-q"],
+            env={"APNEA_UQ_TEST_TPU": "1"},
+            timeout_s=3600.0,
+        ))
+    return steps
+
+
+def ritual_preflight(
+    *,
+    skip_tests: bool = False,
+    repo_root: str = _REPO_ROOT,
+) -> List[str]:
+    """Paths the ritual will exec, that do not exist.  Checked BEFORE the
+    (up to 24h) green wait: a site-packages install or a moved checkout
+    must fail in seconds, not crash with a FileNotFoundError the moment
+    the long-awaited hardware window finally opens."""
+    required = [os.path.join(repo_root, "bench.py")]
+    if not skip_tests:
+        required.append(os.path.join(repo_root, "tests",
+                                     "test_bootstrap.py"))
+    return [p for p in required if not os.path.exists(p)]
+
+
+def run_evidence_ritual(
+    run_log,
+    steps: List[RitualStep],
+    *,
+    repo_root: str = _REPO_ROOT,
+    runner: Optional[Callable[..., "subprocess.CompletedProcess"]] = None,
+) -> List[int]:
+    """Execute the ritual steps sequentially, each under its own stage
+    bracket, stdout/stderr saved under the run dir, exit codes recorded
+    as ``ritual_step`` events.  A failing step does not stop the ritual
+    (a red TPU test after a good bench capture must not discard it)."""
+    runner = runner or subprocess.run
+    rcs = []
+    for step in steps:
+        env = dict(os.environ)
+        env.update(step.env)
+        log(f"[watch] running {step.name}: {' '.join(step.argv)}")
+        with run_log.stage(f"ritual:{step.name}"):
+            t0 = time.perf_counter()
+            timed_out = False
+            try:
+                result = runner(step.argv, cwd=repo_root, env=env,
+                                capture_output=True, text=True,
+                                timeout=step.timeout_s)
+                returncode = int(result.returncode)
+            except subprocess.TimeoutExpired as e:
+                # A hung step (tunnel flap mid-ritual) is a failed step,
+                # not a hung watch; partial output is still evidence.
+                timed_out = True
+                returncode = -1
+                result = e
+            wall = time.perf_counter() - t0
+            outputs = {}
+            for stream in ("stdout", "stderr"):
+                text = getattr(result, stream, None) or ""
+                if isinstance(text, bytes):  # TimeoutExpired keeps bytes
+                    text = text.decode(errors="replace")
+                rel = f"{step.name}.{stream}.txt"
+                with open(os.path.join(run_log.run_dir, rel), "w") as f:
+                    f.write(text)
+                outputs[f"{stream}_path"] = rel
+            run_log.event(
+                "ritual_step", name=step.name, argv=step.argv,
+                returncode=returncode, timed_out=timed_out,
+                timeout_s=step.timeout_s,
+                wall_s=round(wall, 3), env_overrides=step.env, **outputs,
+            )
+        log(f"[watch] {step.name} "
+            + (f"timed out after {step.timeout_s:.0f}s"
+               if timed_out else f"finished rc={returncode} in {wall:.0f}s"))
+        rcs.append(returncode)
+    return rcs
+
+
+def watch(
+    out_root: str,
+    *,
+    budget_s: float = 86400.0,
+    probe_timeout_s: float = 120.0,
+    skip_tests: bool = False,
+    repo_root: str = _REPO_ROOT,
+    probe: Optional[Callable[[float], Tuple[bool, str]]] = None,
+    runner=None,
+) -> int:
+    """Watch for the backend to come up, then land the evidence.
+
+    Returns 0 when every ritual step passed, 1 when any step failed
+    (a timed-out step counts as failed), 2 when the ritual never ran —
+    probe budget expired without a green backend (the same exit code
+    bench.py uses for init-retry exhaustion) or the ritual's files are
+    missing from ``repo_root`` (checked up front, so a misconfigured
+    install fails in seconds instead of after the wait)."""
+    missing = ritual_preflight(skip_tests=skip_tests, repo_root=repo_root)
+    if missing:
+        log(f"[watch] evidence ritual misconfigured: {missing} not "
+            f"found — run from a repo checkout (or pass repo_root); "
+            f"refusing to start the probe wait")
+        return 2
+    log(f"[watch] probing backend (budget {budget_s:.0f}s, "
+        f"probe timeout {probe_timeout_s:.0f}s)")
+    attempts_log: List[Dict] = []
+
+    def on_attempt(n: int, green: bool, detail: str) -> None:
+        attempts_log.append({"attempt": n, "green": green,
+                             "detail": detail})
+        log(f"[watch] probe {n}: {'GREEN' if green else detail}")
+
+    green, attempts, last = wait_for_green(
+        budget_s, probe_timeout_s=probe_timeout_s, probe=probe,
+        on_attempt=on_attempt,
+    )
+    if not green:
+        log(f"[watch] backend never came up in {budget_s:.0f}s "
+            f"({attempts} probes; last: {last})")
+        return 2
+    run_dir = default_run_dir(out_root, "watch")
+    run_log = start_run(run_dir, stage="watch")
+    try:
+        for record in attempts_log:
+            run_log.event("probe", **record)
+        run_log.event("probe_green", attempts=attempts)
+        log(f"[watch] backend GREEN after {attempts} probe(s); "
+            f"evidence -> {run_dir}")
+        steps = evidence_ritual_steps(
+            run_dir, skip_tests=skip_tests, repo_root=repo_root,
+        )
+        rcs = run_evidence_ritual(run_log, steps, repo_root=repo_root,
+                                  runner=runner)
+    except BaseException as e:
+        run_log.error("watch", e)
+        run_log.close(status="error")
+        raise
+    status = "ok" if all(rc == 0 for rc in rcs) else "error"
+    run_log.close(status=status)
+    return 0 if status == "ok" else 1

@@ -28,6 +28,7 @@ from apnea_uq_tpu.config import TrainConfig
 from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
 from apnea_uq_tpu.ops import streaming_auc
 from apnea_uq_tpu.ops.losses import masked_bce_with_logits
+from apnea_uq_tpu.telemetry import memory as telemetry_memory
 from apnea_uq_tpu.telemetry import trace as telemetry_trace
 from apnea_uq_tpu.telemetry.steps import StepMetrics
 from apnea_uq_tpu.training.state import TrainState, make_optimizer
@@ -372,6 +373,7 @@ def fit(
     prefetch: int = 2,
     log_fn: Optional[Callable[[str], None]] = None,
     run_log=None,
+    profiler=None,
 ) -> FitResult:
     """Train with validation-split early stopping; returns best-weight state.
 
@@ -386,7 +388,14 @@ def fit(
     ``step`` event per dispatched epoch/validation program — dispatch vs
     ``block_until_ready``-bounded device time, windows/sec throughput,
     and XLA retrace/compile deltas — plus one structured ``epoch`` event
-    per epoch with the loss trajectory.
+    per epoch with the loss trajectory.  With a run log on the in-HBM
+    path, the epoch/validation programs' compiled memory analysis is also
+    recorded once (``memory_profile`` events, telemetry/memory.py) — the
+    HBM cost of the fit, attributed before the first step runs.
+
+    ``profiler`` (a :class:`apnea_uq_tpu.telemetry.profiler.TraceSession`)
+    is stepped once per epoch, bounding a ``--profile`` capture to the
+    session's warmup/step budget.
     """
     tx = tx if tx is not None else make_optimizer(config.learning_rate)
     if rng is None:
@@ -446,6 +455,21 @@ def fit(
 
     for epoch in range(config.num_epochs):
         epoch_key = jax.random.fold_in(rng, epoch)
+
+        if run_log is not None and not streaming and epoch == 0:
+            # One-time compiled-HBM accounting of the exact programs this
+            # fit dispatches (deduped per signature in telemetry.memory).
+            telemetry_memory.record_jit_memory(
+                run_log, "train_epoch", _epoch_jit,
+                model, tx, state, x, y, epoch_key,
+                config.batch_size, config.shuffle, data_sharding, track,
+            )
+            if x_val is not None:
+                telemetry_memory.record_jit_memory(
+                    run_log, "val_loss", _eval_loss_jit,
+                    model, state.variables(), x_val, y_val,
+                    config.batch_size, data_sharding, track,
+                )
 
         def run_epoch():
             if streaming:
@@ -547,13 +571,20 @@ def fit(
                 patience_left -= 1
                 if patience_left <= 0:
                     stopped_early = True
-                    break
         else:
             emit_epoch_event()
             if log_fn:
                 log_fn(f"epoch {epoch + 1}/{config.num_epochs} "
                        f"loss={float(train_loss):.4f}{metric_note}")
             best_epoch = epoch
+
+        # Step the profiler BEFORE the early-stop break (fit_ensemble
+        # does the same): the stopping epoch ran and was captured, so it
+        # must count toward steps_profiled.
+        if profiler is not None:
+            profiler.step()
+        if stopped_early:
+            break
 
     if x_val is not None and config.restore_best_weights and best_epoch >= 0:
         state = state.replace(params=best_params, batch_stats=best_stats)

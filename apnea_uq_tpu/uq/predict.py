@@ -22,10 +22,12 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
 from apnea_uq_tpu.parallel import mesh as mesh_lib
+from apnea_uq_tpu.telemetry import memory as telemetry_memory
 from apnea_uq_tpu.utils import prng
 
 # jax exports shard_map at top level from 0.5; on 0.4.x it lives under
@@ -218,6 +220,8 @@ def mc_dropout_predict_streaming(
     seed: int = 0,
     prefetch: int = 2,
     mesh: Optional[jax.sharding.Mesh] = None,
+    run_log=None,
+    record_memory_only: bool = False,
 ) -> "np.ndarray":
     """(T, M) MCD probabilities with the window set streamed from HOST
     memory: chunks flow through the double-buffered prefetch feed
@@ -246,6 +250,22 @@ def mc_dropout_predict_streaming(
         batch_size = effective_batch_size(batch_size, mesh)
         repl = mesh_lib.replicated(mesh)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
+    if run_log is not None:
+        # Compiled-HBM accounting of the per-chunk program (one event per
+        # signature; telemetry/memory.py): abstract chunk shapes, so the
+        # record costs a compile but never touches the window set.
+        chunk_aval = jax.ShapeDtypeStruct(
+            (batch_size,) + tuple(np.shape(x)[1:]), jnp.float32)
+        telemetry_memory.record_jit_memory(
+            run_log, "mcd_chunk_predict", _mcd_chunk_jit,
+            model, variables, chunk_aval, key, 0, n_passes,
+            _MCD_MODES[mode], mesh,
+        )
+    if record_memory_only:
+        # The drivers' pre-timing pass: the arg transforms and the
+        # memory_profile record ran exactly as a real call's would, but
+        # the AOT compile stays OUT of the measured predict window.
+        return None
     return _stream_chunked(
         x, batch_size, n_passes, prefetch,
         lambda chunk, ci: _mcd_chunk_jit(
@@ -267,6 +287,8 @@ def mc_dropout_predict(
     key: Optional[jax.Array] = None,
     seed: int = 0,
     mesh: Optional[jax.sharding.Mesh] = None,
+    run_log=None,
+    record_memory_only: bool = False,
 ) -> jax.Array:
     """(T, M) positive-class probabilities from T stochastic passes.
 
@@ -301,15 +323,40 @@ def mc_dropout_predict(
         raise ValueError(f"mode must be 'clean' or 'parity', got {mode!r}")
     if key is None:
         key = prng.stochastic_key(seed)
-    x = jnp.asarray(x, jnp.float32)
+    if record_memory_only:
+        # The drivers' pre-timing pass lowers from an abstract window
+        # set: same shape/dtype/sharding (so the compiled program — and
+        # its memory analysis — match the real call), but the whole-set
+        # H2D transfer is not paid twice.
+        x = jax.ShapeDtypeStruct(
+            tuple(np.shape(x)), jnp.float32,
+            sharding=(mesh_lib.replicated(mesh) if mesh is not None
+                      else None))
+    else:
+        x = jnp.asarray(x, jnp.float32)
     if mesh is not None:
         # Same rounding as the streamed path (effective_batch_size),
         # so streamed and in-HBM runs on the same mesh chunk identically
         # and their results stay bit-comparable.
         batch_size = effective_batch_size(batch_size, mesh)
         repl = mesh_lib.replicated(mesh)
-        x = jax.device_put(x, repl)
+        if not record_memory_only:
+            x = jax.device_put(x, repl)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
+    if run_log is not None:
+        # Compiled-HBM accounting (one memory_profile event per program
+        # signature): the whole T-passes-by-chunks program, priced before
+        # it dispatches.
+        telemetry_memory.record_jit_memory(
+            run_log, "mcd_predict", _mcd_jit,
+            model, variables, x, key, n_passes, _MCD_MODES[mode],
+            batch_size, mesh,
+        )
+    if record_memory_only:
+        # The drivers' pre-timing pass: record the program's HBM price
+        # with the exact post-transform args, dispatch nothing — the
+        # AOT compile stays OUT of the measured predict window.
+        return None
     return _mcd_jit(
         model, variables, x, key, n_passes, _MCD_MODES[mode], batch_size, mesh
     )
@@ -421,6 +468,8 @@ def ensemble_predict_streaming(
     batch_size: int = 2048,
     prefetch: int = 2,
     mesh: Optional[jax.sharding.Mesh] = None,
+    run_log=None,
+    record_memory_only: bool = False,
 ) -> "np.ndarray":
     """(N, M) deterministic ensemble probabilities with the window set
     streamed from HOST memory (see :func:`mc_dropout_predict_streaming`):
@@ -436,7 +485,20 @@ def ensemble_predict_streaming(
     """
     member_variables = as_stacked_members(member_variables)
     n_members = jax.tree.leaves(member_variables)[0].shape[0]
+
+    def record_chunk_memory(label, fn, *extra):
+        if run_log is None:
+            return
+        chunk_aval = jax.ShapeDtypeStruct(
+            (batch_size,) + tuple(np.shape(x)[1:]), jnp.float32)
+        telemetry_memory.record_jit_memory(
+            run_log, label, fn, model, member_variables, chunk_aval, *extra
+        )
+
     if mesh is None:
+        record_chunk_memory("de_chunk_predict", _ensemble_chunk_jit)
+        if record_memory_only:
+            return None  # drivers' pre-timing pass (see mc_dropout_predict)
         return _stream_chunked(
             x, batch_size, n_members, prefetch,
             lambda chunk, ci: _ensemble_chunk_jit(model, member_variables, chunk),
@@ -448,6 +510,9 @@ def ensemble_predict_streaming(
     )
     member_variables = mesh_lib.shard_member_tree(member_variables, mesh)
     n_padded = jax.tree.leaves(member_variables)[0].shape[0]
+    record_chunk_memory("de_chunk_predict", _ensemble_chunk_mesh_jit, mesh)
+    if record_memory_only:
+        return None
     probs = _stream_chunked(
         x, batch_size, n_padded, prefetch,
         lambda chunk, ci: _ensemble_chunk_mesh_jit(
@@ -465,6 +530,8 @@ def ensemble_predict(
     *,
     batch_size: int = 2048,
     mesh: Optional[jax.sharding.Mesh] = None,
+    run_log=None,
+    record_memory_only: bool = False,
 ) -> jax.Array:
     """(N, M) deterministic probabilities from N ensemble members.
     All N members' activations for one chunk are live at once, so the
@@ -481,7 +548,15 @@ def ensemble_predict(
     so eval-de scales across a pod instead of leaving chips idle.
     """
     member_variables = as_stacked_members(member_variables)
-    x = jnp.asarray(x, jnp.float32)
+    if record_memory_only:
+        # Abstract window set for the drivers' pre-timing pass: same
+        # program (shape/dtype/sharding), no second whole-set transfer.
+        x = jax.ShapeDtypeStruct(
+            tuple(np.shape(x)), jnp.float32,
+            sharding=(mesh_lib.replicated(mesh) if mesh is not None
+                      else None))
+    else:
+        x = jnp.asarray(x, jnp.float32)
     n_members = jax.tree.leaves(member_variables)[0].shape[0]
     if mesh is not None:
         # device_put needs the member axis divisible by the ensemble axis;
@@ -490,10 +565,27 @@ def ensemble_predict(
         member_variables = jax.tree.map(
             lambda a: _wrap_pad(a, e_axis), member_variables
         )
-        x = jax.device_put(x, mesh_lib.replicated(mesh))
+        if not record_memory_only:
+            x = jax.device_put(x, mesh_lib.replicated(mesh))
         member_variables = mesh_lib.shard_member_tree(member_variables, mesh)
+        if run_log is not None:
+            telemetry_memory.record_jit_memory(
+                run_log, "de_predict", _ensemble_shard_map_jit,
+                model, member_variables, x, batch_size, mesh,
+            )
+        if record_memory_only:
+            return None  # drivers' pre-timing pass (see mc_dropout_predict)
         probs = _ensemble_shard_map_jit(
             model, member_variables, x, batch_size, mesh
         )
         return probs[:n_members]
+    if run_log is not None:
+        # Compiled-HBM accounting (one memory_profile event per program
+        # signature; telemetry/memory.py).
+        telemetry_memory.record_jit_memory(
+            run_log, "de_predict", _ensemble_jit,
+            model, member_variables, x, batch_size,
+        )
+    if record_memory_only:
+        return None
     return _ensemble_jit(model, member_variables, x, batch_size)

@@ -44,11 +44,15 @@ each individual probe, default 2 min), BENCH_RUN_DIR for the telemetry
 run directory (default ./bench_run; "" falls back to a temp dir — the
 run log is never disabled, because the DE context block is *sourced*
 from its ensemble_fit events; read it back with
-``apnea-uq telemetry summarize <dir>``), and two smoke-run knobs:
-BENCH_PLATFORM=cpu runs the whole bench off-TPU (the CPU smoke test's
-path; sitecustomize pins JAX_PLATFORMS at interpreter start, so this is
-a config update, not an env passthrough) and BENCH_DTYPE=float32 swaps
-the bf16 compute dtype (CPU emulates bf16 convs too slowly to smoke).
+``apnea-uq telemetry summarize <dir>``), BENCH_PROFILE=1 to capture one
+steady-state framework MCD pass as a bounded jax.profiler trace under
+<run dir>/profile/ (announced via a profile_captured event; the capture
+runs AFTER the timed reps so it cannot pollute the throughput number),
+and two smoke-run knobs: BENCH_PLATFORM=cpu runs the whole bench off-TPU
+(the CPU smoke test's path; sitecustomize pins JAX_PLATFORMS at
+interpreter start, so this is a config update, not an env passthrough)
+and BENCH_DTYPE=float32 swaps the bf16 compute dtype (CPU emulates bf16
+convs too slowly to smoke).
 """
 
 from __future__ import annotations
@@ -70,18 +74,18 @@ if os.environ.get("BENCH_PLATFORM"):
 import jax.numpy as jnp
 import numpy as np
 
-# Per-chip public specs: (peak dense bf16 TFLOP/s, HBM bytes).  Peak
-# drives the implied-MFU context (reported only for known chips); HBM is
-# the fallback sizing hint when the runtime exposes no memory_stats (the
-# tunneled backend returns None).
-_CHIP_SPECS = {
-    "TPU v4": (275.0, 32e9),
-    "TPU v5 lite": (197.0, 16e9),
-    "TPU v5e": (197.0, 16e9),
-    "TPU v5": (459.0, 95e9),   # v5p
-    "TPU v5p": (459.0, 95e9),
-    "TPU v6 lite": (918.0, 32e9),
-    "TPU v6e": (918.0, 32e9),
+# Per-chip peak dense bf16 TFLOP/s — drives the implied-MFU context
+# (reported only for known chips).  The HBM side of the old spec table
+# lives in telemetry/memory.py (CHIP_HBM_BYTES / device_hbm_limit), the
+# one copy the memory_profile events and this script's sizing hint share.
+_CHIP_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,   # v5p
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
 }
 
 
@@ -195,14 +199,17 @@ def _wait_for_backend() -> None:
     the round-4 capture died in seconds on a fast ``UNAVAILABLE`` from a
     flapping tunnel, and the watchdog only covers the *hang* failure mode).
 
-    Probes ``jax.devices()`` in a budgeted subprocess — the call can hang
-    indefinitely during a tunnel outage, so it must not run in this
-    process — and retries with backoff for up to BENCH_INIT_WAIT_SECS
-    (default 25 min, 0 disables) before emitting the standard error JSON
-    line and exiting non-zero.  Skipped entirely under BENCH_PLATFORM
-    (an explicitly retargeted backend, e.g. the CPU smoke run, has no
-    tunnel to wait for)."""
-    import subprocess
+    The probe loop itself — ``jax.devices()`` in a budgeted subprocess
+    (the call can hang indefinitely during a tunnel outage, so it must
+    not run in this process), backoff between failures, the final sleep
+    clamped to the remaining budget — lives in telemetry/watch.py
+    (``wait_for_green``), where ``apnea-uq telemetry watch`` reuses it as
+    the tunnel-watcher.  Budget: BENCH_INIT_WAIT_SECS (default 25 min, 0
+    disables), per-probe cap BENCH_INIT_PROBE_SECS.  On exhaustion, emit
+    the standard error JSON line and exit non-zero.  Skipped entirely
+    under BENCH_PLATFORM (an explicitly retargeted backend, e.g. the CPU
+    smoke run, has no tunnel to wait for)."""
+    from apnea_uq_tpu.telemetry.watch import wait_for_green
 
     if os.environ.get("BENCH_PLATFORM"):
         return
@@ -210,35 +217,11 @@ def _wait_for_backend() -> None:
     if budget <= 0:
         return
     probe_timeout = float(os.environ.get("BENCH_INIT_PROBE_SECS", 120))
-    deadline = time.monotonic() + budget
-    delay = 20.0
-    attempts, last = 0, "no probe ran"
-    while True:
-        attempts += 1
-        # A hang-mode probe must not overshoot the budget either: cap
-        # the last probe at the remaining time.
-        probe_budget = min(probe_timeout,
-                           max(deadline - time.monotonic(), 1.0))
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", "import jax; assert jax.devices()"],
-                capture_output=True, text=True, timeout=probe_budget,
-            )
-            if r.returncode == 0:
-                return
-            tail = (r.stderr or r.stdout).strip().splitlines()
-            last = tail[-1] if tail else f"probe exited rc={r.returncode}"
-        except subprocess.TimeoutExpired:
-            last = (f"probe hung >{probe_budget:.0f}s in jax.devices() "
-                    f"(tunnel-outage pattern)")
-        # Clamp the final sleep to the remaining budget rather than giving
-        # up when the next full delay would cross the deadline — a tunnel
-        # recovering inside that last window still gets its probe.
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            break
-        time.sleep(min(delay, remaining))
-        delay = min(delay * 1.6, 300.0)
+    green, attempts, last = wait_for_green(
+        budget, probe_timeout_s=probe_timeout
+    )
+    if green:
+        return
     _emit_bench_error(
         f"TPU backend unavailable after {attempts} init probes "
         f"over {budget:.0f}s; last: {last}"
@@ -353,7 +336,8 @@ def bench_de_train(progress_key: str = "secondary") -> dict:
     # per-rep ratio is stable where independent best-of-N ratios jumped
     # between rounds (r02 recorded 2.63x against a 3.1-5.2x band).
     reps = int(os.environ.get("BENCH_DE_REPS", 3))
-    with run_log.stage("de_train", members=n_members, windows=n_windows,
+    with run_log.stage("de_train", snapshot_memory=True,
+                       members=n_members, windows=n_windows,
                        epochs=n_epochs, reps=reps):
         concurrent(); sequential_one()  # compile warmup, both paths
         t_conc, ratios = [], []
@@ -416,8 +400,8 @@ def bench_de_earlystop_waste(model, x, y, batch: int) -> dict:
         keep_padded_members=True,
     )
     run_log = _bench_run_log()
-    with run_log.stage("de_earlystop_waste", patience=patience,
-                       epochs_cap=epochs_cap):
+    with run_log.stage("de_earlystop_waste", snapshot_memory=True,
+                       patience=patience, epochs_cap=epochs_cap):
         fit_ensemble(model, x, y, cfg, run_log=run_log)
     # Sourced from the run's ensemble_fit telemetry event (same record
     # the CLI's train-ensemble stage logs), not recomputed inline.
@@ -586,7 +570,8 @@ def bench_mcd() -> dict:
     # The T axis multiplies the chunk's activation footprint; step down on
     # out-of-memory so one bench binary serves every chip size.
     run_log = _bench_run_log()
-    with run_log.stage("mcd_framework", windows=n_windows, passes=n_passes):
+    with run_log.stage("mcd_framework", snapshot_memory=True,
+                       windows=n_windows, passes=n_passes):
         while True:
             try:
                 t_framework = _time(framework, x, chunk)
@@ -595,6 +580,16 @@ def bench_mcd() -> dict:
                 if chunk <= 128 or not _is_oom(e):
                     raise
                 chunk //= 2
+        if os.environ.get("BENCH_PROFILE"):
+            # One EXTRA steady-state pass under a bounded trace capture,
+            # after the timed reps — the profile can never pollute the
+            # throughput number, and the artifact lands under the run
+            # dir (profile_captured event) like every CLI --profile.
+            from apnea_uq_tpu.telemetry.profiler import TraceSession
+
+            with TraceSession(run_log, label="mcd_framework",
+                              warmup_steps=0, max_steps=1):
+                float(np.asarray(framework(x, chunk)))
     throughput = n_windows / t_framework
     run_log.event("bench_throughput", metric="mcd_t50_inference_throughput",
                   windows_per_s=round(throughput, 1), chunk=chunk)
@@ -628,18 +623,15 @@ def bench_mcd() -> dict:
     # correctness net.
     n_naive = n_windows
     dev = jax.devices()[0]
-    limit = None
-    try:
-        limit = (dev.memory_stats() or {}).get("bytes_limit")
-    except Exception:
-        pass
-    if limit is None:
-        limit = _CHIP_SPECS.get(dev.device_kind, (None, None))[1]
+    from apnea_uq_tpu.telemetry.memory import device_hbm_limit
+
+    limit = device_hbm_limit(dev)
     if limit:
         est = int(0.6 * limit / 2.2e6)
         while n_naive > 1024 and n_naive > est:
             n_naive //= 2
-    with run_log.stage("mcd_reference_pattern", n_naive=n_naive):
+    with run_log.stage("mcd_reference_pattern", snapshot_memory=True,
+                       n_naive=n_naive):
         while True:
             try:
                 t_naive_sub = _time(naive, x[:n_naive], warmup=1, reps=2)
@@ -654,7 +646,7 @@ def bench_mcd() -> dict:
     flops = model_flops_per_window(model_cfg)
     achieved_tflops = throughput * n_passes * flops / 1e12
     kind = dev.device_kind
-    peak = _CHIP_SPECS.get(kind, (None, None))[0]
+    peak = _CHIP_PEAK_TFLOPS.get(kind)
     result = {
         "metric": "mcd_t50_inference_throughput",
         "value": round(throughput, 1),
@@ -739,34 +731,45 @@ def _record_metric_event(run_log, result: dict, role: str) -> None:
 
 
 def main() -> None:
+    from apnea_uq_tpu.telemetry.logging_shim import narration_to_stderr
+
     _wait_for_backend()
     watchdog = _start_watchdog()
     _progress_reset()
-    run_log = _bench_run_log()
-    try:
-        if os.environ.get("BENCH_METRIC") == "de_train":
-            result = _progress_record("primary", bench_de_train("primary"))
-        else:
-            result = _progress_record("primary", bench_mcd())
-            if not os.environ.get("BENCH_SKIP_DE"):
-                result["secondary"] = _progress_record(
-                    "secondary", bench_de_train("secondary"))
-        # The final line is assembled FROM the progress file (when
-        # enabled), so the printed result and the crash-surviving on-disk
-        # capture are one and the same artifact and cannot drift.
-        saved = _progress_read()
-        if saved.get("primary"):
-            result = saved["primary"]
-            if "secondary" in saved:
-                result["secondary"] = saved["secondary"]
-        _record_metric_event(run_log, result, "primary")
-        if isinstance(result.get("secondary"), dict):
-            _record_metric_event(run_log, result["secondary"], "secondary")
-    except BaseException as e:
-        run_log.error("bench", e)
-        run_log.close(status="error")
-        raise
-    run_log.close()
+    # stdout is this script's machine interface — exactly one JSON line.
+    # Library narration (e.g. the BENCH_PROFILE capture announcing its
+    # trace dir) goes to stderr for the duration; the watchdog's and
+    # _emit_bench_error's driver-schema lines print directly to stdout
+    # and are unaffected.
+    with narration_to_stderr():
+        run_log = _bench_run_log()
+        try:
+            if os.environ.get("BENCH_METRIC") == "de_train":
+                result = _progress_record("primary",
+                                          bench_de_train("primary"))
+            else:
+                result = _progress_record("primary", bench_mcd())
+                if not os.environ.get("BENCH_SKIP_DE"):
+                    result["secondary"] = _progress_record(
+                        "secondary", bench_de_train("secondary"))
+            # The final line is assembled FROM the progress file (when
+            # enabled), so the printed result and the crash-surviving
+            # on-disk capture are one and the same artifact and cannot
+            # drift.
+            saved = _progress_read()
+            if saved.get("primary"):
+                result = saved["primary"]
+                if "secondary" in saved:
+                    result["secondary"] = saved["secondary"]
+            _record_metric_event(run_log, result, "primary")
+            if isinstance(result.get("secondary"), dict):
+                _record_metric_event(run_log, result["secondary"],
+                                     "secondary")
+        except BaseException as e:
+            run_log.error("bench", e)
+            run_log.close(status="error")
+            raise
+        run_log.close()
     if watchdog is not None:
         watchdog.cancel()
     print(json.dumps(result))

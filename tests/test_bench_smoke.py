@@ -17,6 +17,7 @@ that impossible:
   explicit platform overrides skip the probe entirely.
 """
 
+import glob
 import importlib.util
 import json
 import os
@@ -49,6 +50,10 @@ SMOKE_ENV = {
     "BENCH_DE_CHUNK": "64",
     "BENCH_BOOT_WINDOWS": "2048",
     "BENCH_WATCHDOG_SECS": "900",
+    # Exercise the bounded trace capture (ISSUE 3): one extra
+    # steady-state MCD pass AFTER the timed reps, profiled into the run
+    # dir — cheap at smoke shapes, and proves the profiler path off-TPU.
+    "BENCH_PROFILE": "1",
 }
 
 
@@ -174,9 +179,44 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert metric_events["primary"]["metric"] == result["metric"]
     assert metric_events["primary"]["value"] == result["value"]
     assert metric_events["secondary"]["metric"] == sec["metric"]
+
+    # ISSUE 3 capture layer, end to end on the real bench: the stage
+    # brackets snapshotted device memory, fit_ensemble priced its
+    # lockstep epoch program (memory_profile), and BENCH_PROFILE left a
+    # bounded trace artifact announced via profile_captured.
+    assert {"memory_snapshot", "memory_profile",
+            "profile_captured"} <= kinds, sorted(kinds)
+    mem_labels = {e["label"] for e in events
+                  if e["kind"] == "memory_profile"}
+    assert "ensemble_epoch" in mem_labels
+    (prof,) = [e for e in events if e["kind"] == "profile_captured"]
+    assert prof["label"] == "mcd_framework"
+    trace_glob = os.path.join(run_dir, prof["trace_dir"],
+                              "plugins", "profile", "*", "*")
+    assert glob.glob(trace_glob), f"no trace artifact at {trace_glob}"
+
     # And the read side renders it without touching jax.
     text = telemetry.summarize_run(run_dir)
     assert "de_train" in text and "errors: none" in text
+    assert "hbm (compiled memory analysis):" in text
+    assert "ensemble_epoch" in text
+    assert "profiler traces:" in text
+
+    # The regression gate closes the loop on the same artifacts: the
+    # capture against itself is clean (exit 0), and an injected -50%
+    # throughput gates nonzero — BENCH_r06 vs r05 will be this command.
+    from apnea_uq_tpu.cli.main import main as cli_main
+
+    baseline = str(tmp_path / "baseline.json")
+    with open(baseline, "w") as f:
+        f.write(lines[0])
+    worse = dict(result)
+    worse["value"] = result["value"] / 2
+    regressed = str(tmp_path / "regressed.json")
+    with open(regressed, "w") as f:
+        json.dump(worse, f)
+    assert cli_main(["telemetry", "compare", baseline, baseline]) == 0
+    assert cli_main(["telemetry", "compare", baseline, regressed]) == 1
 
 
 @pytest.mark.slow  # real bench subprocess up to the primary metric

@@ -81,10 +81,30 @@ def test_full_pipeline(env, order, capsys):
     assert registry.exists(reg.TEST_STD_RUS)
 
     # -- train baseline ---------------------------------------------------
-    assert run("train", "--registry", registry_dir, "--config", config) == 0
+    train_run_dir = str(env["root"] / "train_run")
+    assert run("train", "--registry", registry_dir, "--config", config,
+               "--run-dir", train_run_dir, "--profile") == 0
     out = capsys.readouterr().out
     assert "saved baseline checkpoint" in out
     assert "baseline on Unbalanced" in out
+
+    # --profile left a bounded trace artifact under the run dir and
+    # announced it (ISSUE 3 acceptance); the fit priced its compiled
+    # programs as memory_profile events and the stage brackets took
+    # device-memory snapshots.
+    from apnea_uq_tpu import telemetry
+    train_events = telemetry.read_events(train_run_dir)
+    prof = next(e for e in train_events if e["kind"] == "profile_captured")
+    assert prof["steps_profiled"] >= 1
+    trace_dir = os.path.join(train_run_dir, prof["trace_dir"])
+    assert glob.glob(os.path.join(trace_dir, "plugins", "profile", "*", "*")), \
+        f"no trace artifact under {trace_dir}"
+    mem_labels = {e["label"] for e in train_events
+                  if e["kind"] == "memory_profile"}
+    assert {"train_epoch", "val_loss"} <= mem_labels
+    snap_labels = {e["label"] for e in train_events
+                   if e["kind"] == "memory_snapshot"}
+    assert {"fit.start", "fit.end"} <= snap_labels
 
     # -- train ensemble + idempotent resume -------------------------------
     assert run("train-ensemble", "--registry", registry_dir,
@@ -97,6 +117,11 @@ def test_full_pipeline(env, order, capsys):
     # -- eval-mcd / eval-de -----------------------------------------------
     mcd_plots = str(env["root"] / "mcd_plots")
     profile_dir = str(env["root"] / "trace")
+    # --profile and --profile-dir both start a jax.profiler session;
+    # nesting them must be refused up front, not mid-evaluation.
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        run("eval-mcd", "--registry", registry_dir, "--config", config,
+            "--profile", "--profile-dir", profile_dir)
     assert run("eval-mcd", "--registry", registry_dir, "--config", config,
                "--plots-dir", mcd_plots, "--profile-dir", profile_dir) == 0
     # --profile-dir wraps the evaluation in a jax.profiler trace
@@ -123,9 +148,22 @@ def test_full_pipeline(env, order, capsys):
     assert any("CNN_MCD_Unbalanced_mutual_info" in p for p in mcd_pngs)
 
     de_plots = str(env["root"] / "de_plots")
+    de_run_dir = str(env["root"] / "de_run")
     assert run("eval-de", "--registry", registry_dir, "--config", config,
-               "--num-members", "2", "--plots-dir", de_plots) == 0
+               "--num-members", "2", "--plots-dir", de_plots,
+               "--run-dir", de_run_dir, "--profile") == 0
     capsys.readouterr()
+    # The eval --profile brackets ONLY the timed predict (the driver
+    # enters the session after the HBM pre-pass) — one bracket capture
+    # per test set, each with a real trace artifact.
+    de_events = telemetry.read_events(de_run_dir)
+    de_profs = [e for e in de_events if e["kind"] == "profile_captured"]
+    assert {p["label"] for p in de_profs} == {"de-Unbalanced",
+                                             "de-Balanced_RUS"}
+    for p in de_profs:
+        assert p["mode"] == "bracket" and p["steps_profiled"] is None
+        assert glob.glob(os.path.join(de_run_dir, p["trace_dir"],
+                                      "plugins", "profile", "*", "*"))
     assert registry.exists(f"{reg.DETAILED_WINDOWS}:CNN_DE_Unbalanced")
     assert registry.exists(f"{reg.METRICS}:CNN_DE_Unbalanced")
     preds = registry.load_arrays(f"{reg.RAW_PREDICTIONS}:CNN_DE_Unbalanced")

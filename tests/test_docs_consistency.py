@@ -99,8 +99,24 @@ def test_documented_flags_exist_per_subcommand():
             name, rest = m.group(1), m.group(2)
             if name not in subs:
                 continue  # covered by the other test
+            parser = subs[name]
+            # Descend into nested command groups (`apnea-uq telemetry
+            # compare --json` must be checked against the *compare*
+            # subparser, not the bare `telemetry` group).
+            tokens = rest.split()
+            while tokens:
+                nested = next(
+                    (action.choices for action in parser._actions
+                     if hasattr(action, "choices")
+                     and isinstance(action.choices, dict)),
+                    None,
+                )
+                if not nested or tokens[0] not in nested:
+                    break
+                parser = nested[tokens[0]]
+                tokens = tokens[1:]
             known = {
-                opt for action in subs[name]._actions
+                opt for action in parser._actions
                 for opt in action.option_strings
             }
             for flag in re.findall(r"--[a-z][a-z0-9-]*", rest):

@@ -49,6 +49,23 @@ def test_library_has_no_bare_print_outside_allowlist():
     )
 
 
+def test_issue3_telemetry_modules_are_in_scan_scope():
+    """The rglob scan covers new files implicitly — which also means a
+    MOVED module silently leaves the lint's scope.  Pin the ISSUE 3
+    telemetry modules (memory/profiler/compare/watch) by name: they must
+    exist where the scan looks, stay off the allowlist, and stay clean
+    (watch/compare especially — subprocess-heavy code is where status
+    prints creep back in)."""
+    for rel in ("telemetry/memory.py", "telemetry/profiler.py",
+                "telemetry/compare.py", "telemetry/watch.py"):
+        path = PACKAGE / rel
+        assert path.exists(), f"{rel} moved out of the lint's scan scope"
+        assert rel not in ALLOWLIST, f"{rel} must not be print-exempt"
+        assert not _print_calls(path), (
+            f"{rel} calls bare print(); route through telemetry.log"
+        )
+
+
 def test_allowlisted_files_exist_and_still_print():
     """A stale allowlist entry is lint rot in the other direction: if the
     file is gone or no longer prints, the exemption must be deleted."""

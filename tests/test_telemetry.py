@@ -197,6 +197,26 @@ class TestActiveRunMirror:
         assert "silenced info line" not in out
         assert "warned line" in out
 
+    def test_narration_to_stderr_scopes_stream_and_keeps_mirror(
+            self, tmp_path, capsys):
+        """bench.py's one-JSON-line stdout contract: inside the scope,
+        log() lines land on stderr (never stdout); outside, behavior is
+        restored; the run-log mirror sees both either way."""
+        from apnea_uq_tpu.telemetry.logging_shim import narration_to_stderr
+
+        rl = telemetry.start_run(str(tmp_path))
+        with narration_to_stderr():
+            telemetry.log("narrated aside")
+        telemetry.log("back on stdout")
+        rl.close()
+        captured = capsys.readouterr()
+        assert "narrated aside" in captured.err
+        assert "narrated aside" not in captured.out
+        assert "back on stdout" in captured.out
+        logs = [e["message"] for e in telemetry.read_events(str(tmp_path))
+                if e["kind"] == "log"]
+        assert logs == ["narrated aside", "back on stdout"]
+
     def test_nested_runs_innermost_wins(self, tmp_path):
         outer = telemetry.start_run(str(tmp_path / "outer"))
         inner = telemetry.start_run(str(tmp_path / "inner"))

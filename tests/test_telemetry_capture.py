@@ -1,0 +1,521 @@
+"""HBM accounting + bounded profiler capture (ISSUE 3 tentpole, pieces
+1-2): ``record_jit_memory``'s compiled memory analysis and per-signature
+dedupe, ``snapshot_device_memory``'s pprof dump, the ``snapshot_memory``
+stage brackets (entry/exit/error), ``TraceSession``'s warmup skip and
+step budget producing a REAL CPU trace artifact under the run dir, the
+summarizer's HBM/profile sections (text and ``--json``), and the
+torn-tail-tolerant reader over the new event kinds in an appended
+multi-run log."""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apnea_uq_tpu import telemetry
+from apnea_uq_tpu.telemetry import memory as memory_mod
+from apnea_uq_tpu.telemetry import profiler as profiler_mod
+from apnea_uq_tpu.telemetry.runlog import _ACTIVE, RunLog
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_active_run():
+    assert not _ACTIVE, f"active-run stack dirty on entry: {_ACTIVE}"
+    yield
+    leaked = list(_ACTIVE)
+    _ACTIVE.clear()
+    assert not leaked, f"test leaked active run logs: {leaked}"
+
+
+@jax.jit
+def _double_plus_one(v):
+    return v * 2.0 + 1.0
+
+
+class TestRecordJitMemory:
+    def test_emits_memory_profile_event_with_accounting(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        record = memory_mod.record_jit_memory(
+            rl, "double", _double_plus_one, jnp.ones((16, 8)))
+        rl.close()
+        assert record is not None
+        (event,) = [e for e in telemetry.read_events(str(tmp_path))
+                    if e["kind"] == "memory_profile"]
+        assert event["label"] == "double"
+        assert event["platform"] == "cpu"
+        # XLA's accounting for a (16, 8) f32 arg and same-shape output.
+        assert event["argument_bytes"] == 16 * 8 * 4
+        assert event["output_bytes"] == 16 * 8 * 4
+        assert event["peak_bytes"] == (
+            event["argument_bytes"] + event["output_bytes"]
+            + event["temp_bytes"] - event["alias_bytes"]
+        )
+        # CPU has no HBM spec: limit and headroom are recorded as None
+        # (the summarizer renders '-'), never fabricated.
+        assert event["hbm_limit_bytes"] is None
+        assert event["headroom_bytes"] is None
+
+    def test_dedupes_per_label_and_signature(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        assert memory_mod.record_jit_memory(
+            rl, "double", _double_plus_one, jnp.ones((4, 4))) is not None
+        # Same label + same abstract shapes: the AOT compile must not be
+        # paid again (bench reps, per-test-set eval loops).
+        assert memory_mod.record_jit_memory(
+            rl, "double", _double_plus_one, jnp.ones((4, 4))) is None
+        # A new shape is a new program: recorded again.
+        assert memory_mod.record_jit_memory(
+            rl, "double", _double_plus_one, jnp.ones((8, 4))) is not None
+        rl.close()
+        events = [e for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "memory_profile"]
+        assert len(events) == 2
+
+    def test_memo_is_per_run_not_per_process(self, tmp_path):
+        """A second run in the same process (back-to-back CLI stages, a
+        notebook driver) must get its own memory_profile events — a
+        process-wide memo would leave its HBM table empty and silently
+        drop its footprint metrics from the compare gate."""
+        first = RunLog(str(tmp_path / "one"))
+        assert memory_mod.record_jit_memory(
+            first, "double", _double_plus_one, jnp.ones((4, 4))) is not None
+        first.close()
+        second = RunLog(str(tmp_path / "two"))
+        assert memory_mod.record_jit_memory(
+            second, "double", _double_plus_one, jnp.ones((4, 4))) is not None
+        second.close()
+        for run in ("one", "two"):
+            events = telemetry.read_events(str(tmp_path / run))
+            assert sum(e["kind"] == "memory_profile" for e in events) == 1
+
+    def test_none_and_disabled_run_logs_are_inert(self, tmp_path):
+        calls = []
+
+        class Exploding:
+            def lower(self, *a, **k):  # pragma: no cover - must not run
+                calls.append(1)
+                raise AssertionError("lowered despite no run log")
+
+        assert memory_mod.record_jit_memory(None, "x", Exploding()) is None
+        disabled = RunLog(str(tmp_path / "sub"), disabled=True)
+        assert memory_mod.record_jit_memory(
+            disabled, "x", Exploding()) is None
+        assert not calls  # best-effort means zero work, not caught errors
+
+    def test_never_raises_on_unlowerable_fn(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        assert memory_mod.record_jit_memory(
+            rl, "broken", lambda v: v, jnp.ones((2,))) is None
+        rl.close()  # plain lambda has no .lower; swallowed by design
+
+    def test_env_knob_disables_accounting(self, tmp_path, monkeypatch):
+        """APNEA_UQ_MEMORY_PROFILE=0: the opt-out for runs where even
+        one extra AOT compile of the heaviest program is unwelcome."""
+        monkeypatch.setenv("APNEA_UQ_MEMORY_PROFILE", "0")
+        rl = RunLog(str(tmp_path))
+        assert memory_mod.record_jit_memory(
+            rl, "double", _double_plus_one, jnp.ones((4, 4))) is None
+        rl.close()
+        assert not any(e["kind"] == "memory_profile"
+                       for e in telemetry.read_events(str(tmp_path)))
+
+    def test_memo_covers_attempts_not_just_successes(self, tmp_path):
+        """On a backend where memory_analysis() is unimplemented (None),
+        retrying every call would re-pay the full AOT compile inside the
+        timed windows the drivers' pre-pass protects — one attempt per
+        program, success or not."""
+        lowered = []
+
+        class NoAnalysis:
+            def lower(self, *a, **k):
+                lowered.append(1)
+                return self
+
+            def compile(self):
+                return self
+
+            def memory_analysis(self):
+                return None
+
+        rl = RunLog(str(tmp_path))
+        fn = NoAnalysis()
+        assert memory_mod.record_jit_memory(rl, "x", fn, 1) is None
+        assert memory_mod.record_jit_memory(rl, "x", fn, 1) is None
+        rl.close()
+        assert len(lowered) == 1
+        assert not any(e["kind"] == "memory_profile"
+                       for e in telemetry.read_events(str(tmp_path)))
+
+
+class TestRecordMemoryOnlyPredictors:
+    """The eval drivers' pre-timing pass: record_memory_only=True runs
+    the predictor's arg transforms and emits the memory_profile event,
+    dispatches nothing (returns None) — so the one-time AOT compile
+    stays out of the measured predict window whose windows/sec the
+    compare gate consumes."""
+
+    def _model(self):
+        from apnea_uq_tpu.config import ModelConfig
+        from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+
+        model = AlarconCNN1D(ModelConfig(
+            features=(4,), kernel_sizes=(3,), dropout_rates=(0.2,)))
+        return model, init_variables(model, jax.random.key(0))
+
+    def test_mcd_records_without_dispatch(self, tmp_path, rng):
+        from apnea_uq_tpu.uq import mc_dropout_predict
+
+        model, variables = self._model()
+        x = rng.normal(size=(12, 60, 4)).astype("float32")
+        rl = RunLog(str(tmp_path))
+        out = mc_dropout_predict(model, variables, x, n_passes=3,
+                                 batch_size=8, seed=0, run_log=rl,
+                                 record_memory_only=True)
+        rl.close()
+        assert out is None
+        (event,) = [e for e in telemetry.read_events(str(tmp_path))
+                    if e["kind"] == "memory_profile"]
+        assert event["label"] == "mcd_predict"
+
+    def test_mcd_mesh_record_only_lowers_from_aval(self, tmp_path, rng):
+        """On the mesh path the record-only pass lowers from an abstract
+        window set (same shape/dtype/sharding) — the whole-set H2D
+        transfer must not be paid twice; the real call then reuses the
+        memoized record (one event) and matches its program."""
+        from apnea_uq_tpu.parallel import make_mesh
+        from apnea_uq_tpu.uq import mc_dropout_predict
+
+        model, variables = self._model()
+        x = rng.normal(size=(16, 60, 4)).astype("float32")
+        mesh = make_mesh(num_members=4)  # (ensemble=4, data=2)
+        rl = RunLog(str(tmp_path))
+        assert mc_dropout_predict(model, variables, x, n_passes=4,
+                                  batch_size=8, seed=0, mesh=mesh,
+                                  run_log=rl,
+                                  record_memory_only=True) is None
+        probs = mc_dropout_predict(model, variables, x, n_passes=4,
+                                   batch_size=8, seed=0, mesh=mesh,
+                                   run_log=rl)
+        rl.close()
+        assert probs.shape == (4, 16)
+        events = [e for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "memory_profile"]
+        assert [e["label"] for e in events] == ["mcd_predict"]
+
+    def test_de_records_without_dispatch_and_memo_absorbs_real_call(
+            self, tmp_path, rng):
+        from apnea_uq_tpu.uq import ensemble_predict
+        from apnea_uq_tpu.uq.predict import stack_member_variables
+
+        model, variables = self._model()
+        members = stack_member_variables([variables, variables])
+        x = rng.normal(size=(12, 60, 4)).astype("float32")
+        rl = RunLog(str(tmp_path))
+        assert ensemble_predict(model, members, x, batch_size=8,
+                                run_log=rl,
+                                record_memory_only=True) is None
+        probs = ensemble_predict(model, members, x, batch_size=8,
+                                 run_log=rl)
+        rl.close()
+        assert probs.shape[0] == 2
+        events = [e for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "memory_profile"]
+        assert [e["label"] for e in events] == ["de_predict"]
+
+
+class TestDeviceHbmLimit:
+    class _FakeDevice:
+        def __init__(self, kind, stats):
+            self.device_kind = kind
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    def test_runtime_bytes_limit_wins(self):
+        dev = self._FakeDevice("TPU v4", {"bytes_limit": 123})
+        assert memory_mod.device_hbm_limit(dev) == 123
+
+    def test_spec_fallback_when_runtime_hides_stats(self):
+        # The tunneled TPU backend returns None from memory_stats; the
+        # public per-chip spec is the fallback sizing hint.
+        dev = self._FakeDevice("TPU v4", None)
+        assert memory_mod.device_hbm_limit(dev) == int(32e9)
+
+    def test_unknown_chip_is_none(self):
+        assert memory_mod.device_hbm_limit(
+            self._FakeDevice("Quantum v1", {})) is None
+
+
+class TestSnapshotDeviceMemory:
+    def test_writes_pprof_dump_and_event(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        jnp.ones((32,)).block_until_ready()  # something live to profile
+        record = memory_mod.snapshot_device_memory(rl, "fit.start")
+        rl.close()
+        assert record is not None
+        (event,) = [e for e in telemetry.read_events(str(tmp_path))
+                    if e["kind"] == "memory_snapshot"]
+        assert event["label"] == "fit.start"
+        assert {"bytes_in_use", "peak_bytes_in_use",
+                "bytes_limit"} <= set(event)
+        path = os.path.join(str(tmp_path), event["profile_path"])
+        assert os.path.exists(path)
+        assert os.path.getsize(path) == event["profile_bytes"] > 0
+
+    def test_stage_snapshot_memory_brackets_entry_and_exit(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        with rl.stage("fit", snapshot_memory=True):
+            pass
+        rl.close()
+        labels = [e["label"] for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "memory_snapshot"]
+        assert labels == ["fit.start", "fit.end"]
+
+    def test_stage_error_exit_snapshots_too(self, tmp_path):
+        # An OOM unwind is exactly when you want the numbers.
+        rl = RunLog(str(tmp_path))
+        with pytest.raises(RuntimeError):
+            with rl.stage("fit", snapshot_memory=True):
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        rl.close()
+        labels = [e["label"] for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "memory_snapshot"]
+        assert labels == ["fit.start", "fit.error"]
+
+
+class TestTraceSession:
+    """The off-TPU profiler smoke: CPU start_trace/stop_trace must leave
+    a real trace artifact under the run dir (ISSUE 3 acceptance)."""
+
+    def _trace_artifacts(self, trace_dir):
+        return glob.glob(
+            os.path.join(trace_dir, "plugins", "profile", "*", "*"))
+
+    def test_warmup_skip_and_step_budget(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        with profiler_mod.TraceSession(rl, label="train", warmup_steps=1,
+                                       max_steps=2) as session:
+            assert not session.started
+            for _ in range(4):
+                _double_plus_one(jnp.ones((4,))).block_until_ready()
+                session.step()
+            # step 1 satisfied the warmup (trace starts AFTER it, so the
+            # compile storm stays out); steps 2-3 were profiled; step 4
+            # landed after the budget stopped the trace.
+            assert session.started and session.stopped
+            assert session.steps_profiled == 2
+        rl.close()
+        (event,) = [e for e in telemetry.read_events(str(tmp_path))
+                    if e["kind"] == "profile_captured"]
+        assert event["label"] == "train"
+        assert event["mode"] == "steps"
+        assert event["steps_profiled"] == 2
+        assert event["warmup_steps"] == 1
+        # trace_dir is relative to the run dir, and the capture is real.
+        assert not os.path.isabs(event["trace_dir"])
+        trace_dir = os.path.join(str(tmp_path), event["trace_dir"])
+        assert self._trace_artifacts(trace_dir)
+
+    def test_bracket_mode_captures_whole_block(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        with profiler_mod.TraceSession(rl, label="mcd-Unbalanced",
+                                       warmup_steps=0) as session:
+            assert session.started  # warmup 0: capturing from __enter__
+            _double_plus_one(jnp.ones((8,))).block_until_ready()
+        rl.close()
+        (event,) = [e for e in telemetry.read_events(str(tmp_path))
+                    if e["kind"] == "profile_captured"]
+        # A bracket capture has no step stream: mode tells tooling this
+        # is a full-block capture, not a stepped session that profiled
+        # zero steps.
+        assert event["mode"] == "bracket"
+        assert event["steps_profiled"] is None
+        trace_dir = os.path.join(str(tmp_path), event["trace_dir"])
+        assert self._trace_artifacts(trace_dir)
+
+    def test_unsatisfied_warmup_captures_nothing(self, tmp_path, capsys):
+        rl = RunLog(str(tmp_path))
+        with profiler_mod.TraceSession(rl, label="short",
+                                       warmup_steps=5) as session:
+            session.step()
+        rl.close()
+        assert not session.started
+        assert not any(e["kind"] == "profile_captured"
+                       for e in telemetry.read_events(str(tmp_path)))
+        assert "inside the 5-step warmup" in capsys.readouterr().out
+
+    def test_requires_run_log_or_trace_dir(self):
+        with pytest.raises(ValueError, match="trace_dir"):
+            profiler_mod.TraceSession(None, label="x")
+
+    def test_maybe_profile_disabled_yields_none(self, tmp_path):
+        rl = RunLog(str(tmp_path))
+        with profiler_mod.maybe_profile(rl, False, label="x") as prof:
+            assert prof is None
+        rl.close()
+
+    def test_fit_steps_profiler_once_per_computed_epoch(self, rng):
+        """Every epoch that ran must step the profiler — INCLUDING the
+        epoch whose validation loss triggers early stopping (the capture
+        covered it, so it counts toward the step budget)."""
+        from apnea_uq_tpu.config import ModelConfig, TrainConfig
+        from apnea_uq_tpu.models import AlarconCNN1D
+        from apnea_uq_tpu.training import create_train_state, fit
+
+        class Counting:
+            steps = 0
+
+            def step(self):
+                self.steps += 1
+
+        model = AlarconCNN1D(ModelConfig(
+            features=(4,), kernel_sizes=(3,), dropout_rates=(0.2,)))
+        x = rng.normal(size=(96, 60, 4)).astype("float32")
+        y = rng.integers(0, 2, 96).astype("int8")
+        state = create_train_state(model, jax.random.key(0))
+        cfg = TrainConfig(batch_size=32, num_epochs=12,
+                          validation_split=0.25,
+                          early_stopping_patience=1, seed=1)
+        profiler = Counting()
+        result = fit(model, state, x, y, cfg, profiler=profiler)
+        assert profiler.steps == len(result.history["loss"])
+
+
+# Handwritten events for the read-side tests: the summarizer and the
+# comparator consume events.jsonl alone, so fixed payloads pin the
+# schema without a TPU (or even a jit) in the loop.
+def _run_events(with_capture: bool):
+    events = [
+        {"seq": 0, "ts": 1700000000.0, "kind": "run_started",
+         "schema_version": 1, "stage": "train",
+         "topology": {"platform": "tpu", "device_count": 8}},
+    ]
+    if with_capture:
+        events += [
+            {"seq": 1, "ts": 1700000001.0, "kind": "memory_profile",
+             "label": "ensemble_epoch", "platform": "tpu",
+             "device_kind": "TPU v4", "argument_bytes": 512 * 2**20,
+             "output_bytes": 64 * 2**20, "temp_bytes": 7616 * 2**20,
+             "alias_bytes": 0, "generated_code_bytes": 2**20,
+             "peak_bytes": 8192 * 2**20,
+             "hbm_limit_bytes": 32 * 2**30,
+             "headroom_bytes": 24 * 2**30},
+            {"seq": 2, "ts": 1700000002.0, "kind": "memory_snapshot",
+             "label": "fit.start", "bytes_in_use": 1024, "peak_bytes_in_use": 2048,
+             "bytes_limit": None, "profile_path": "memory/fit.start.pprof.gz",
+             "profile_bytes": 908},
+            {"seq": 3, "ts": 1700000003.0, "kind": "profile_captured",
+             "label": "train", "trace_dir": "profile/train",
+             "mode": "steps", "steps_profiled": 4, "warmup_steps": 1},
+            {"seq": 4, "ts": 1700000004.0, "kind": "profile_captured",
+             "label": "mcd-Unbalanced", "trace_dir": "profile/mcd",
+             "mode": "bracket", "steps_profiled": None,
+             "warmup_steps": 0},
+        ]
+    events.append({"seq": len(events), "ts": 1700000009.0,
+                   "kind": "run_finished", "status": "ok"})
+    return events
+
+
+def _write_events(run_dir, events):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, telemetry.EVENTS_FILENAME), "a") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+class TestSummarizeCaptureSections:
+    def test_renders_hbm_table_snapshots_and_traces(self, tmp_path):
+        run_dir = str(tmp_path / "run")
+        _write_events(run_dir, _run_events(with_capture=True))
+        text = telemetry.summarize_run(run_dir)
+        assert "hbm (compiled memory analysis):" in text
+        # 8192 MiB peak against a 32768 MiB limit = 75.0% headroom.
+        assert "ensemble_epoch" in text
+        assert "8192.0" in text and "32768.0" in text and "75.0%" in text
+        assert "hbm snapshots:" in text
+        assert "profile=memory/fit.start.pprof.gz (908 B)" in text
+        assert "profiler traces:" in text
+        assert "train: 4 step(s) (warmup 1) -> profile/train" in text
+        assert "mcd-Unbalanced: whole block -> profile/mcd" in text
+
+    def test_sections_absent_without_capture_events(self, tmp_path):
+        run_dir = str(tmp_path / "plain")
+        _write_events(run_dir, _run_events(with_capture=False))
+        text = telemetry.summarize_run(run_dir)
+        for heading in ("hbm (compiled", "hbm snapshots:",
+                        "profiler traces:"):
+            assert heading not in text
+
+    def test_torn_tail_multi_run_latest_has_captures(self, tmp_path):
+        """Satellite: the torn-tail-tolerant reader over the new kinds —
+        an appended two-run log where only the LATEST run carries them,
+        plus a kill-mid-write tail on a memory_profile line."""
+        run_dir = str(tmp_path / "reused")
+        _write_events(run_dir, _run_events(with_capture=False))
+        _write_events(run_dir, _run_events(with_capture=True))
+        with open(os.path.join(run_dir, telemetry.EVENTS_FILENAME), "a") as f:
+            f.write('{"seq": 99, "kind": "memory_profile", "label": "to')
+        events = telemetry.read_events(run_dir)
+        assert sum(e["kind"] == "run_started" for e in events) == 2
+        assert not any(e.get("label") == "to" for e in events)
+        text = telemetry.summarize_run(run_dir)
+        assert "(latest of 2 runs appended to this log" in text
+        assert "hbm (compiled memory analysis):" in text
+        data = telemetry.summarize_data(run_dir)
+        assert data["earlier_runs"] == 1
+        assert [m["label"] for m in data["memory_profiles"]] == [
+            "ensemble_epoch"]
+
+    def test_multi_run_latest_without_captures_hides_stale_table(
+            self, tmp_path):
+        # The capture-bearing run is the STALE one: its HBM numbers must
+        # not leak into the latest run's summary.
+        run_dir = str(tmp_path / "reused2")
+        _write_events(run_dir, _run_events(with_capture=True))
+        _write_events(run_dir, _run_events(with_capture=False))
+        text = telemetry.summarize_run(run_dir)
+        assert "hbm (compiled memory analysis):" not in text
+        assert telemetry.summarize_data(run_dir)["memory_profiles"] == []
+
+
+class TestSummarizeJson:
+    def test_json_carries_the_rendered_fields(self, tmp_path):
+        run_dir = str(tmp_path / "run")
+        _write_events(run_dir, _run_events(with_capture=True))
+        data = telemetry.summarize_data(run_dir)
+        assert data["stage"] == "train"
+        assert data["platform"] == "tpu" and data["devices"] == 8
+        assert data["status"] == "ok" and data["errors"] == []
+        (mem,) = data["memory_profiles"]
+        assert mem["label"] == "ensemble_epoch"
+        assert mem["peak_bytes"] == 8192 * 2**20
+        assert mem["hbm_limit_bytes"] == 32 * 2**30
+        (snap,) = data["memory_snapshots"]
+        assert snap["profile_path"] == "memory/fit.start.pprof.gz"
+        stepped, bracket = data["profiles"]
+        assert stepped == {"label": "train", "trace_dir": "profile/train",
+                           "mode": "steps", "steps_profiled": 4,
+                           "warmup_steps": 1}
+        assert bracket["mode"] == "bracket"
+        assert bracket["steps_profiled"] is None
+
+    def test_cli_json_flag_round_trips(self, tmp_path, capsys):
+        from apnea_uq_tpu.cli.main import main
+
+        run_dir = str(tmp_path / "run")
+        _write_events(run_dir, _run_events(with_capture=True))
+        assert main(["telemetry", "summarize", run_dir, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc == telemetry.summarize_data(run_dir)
+
+    def test_cli_json_missing_dir_exits_cleanly(self, tmp_path):
+        from apnea_uq_tpu.cli.main import main
+
+        with pytest.raises(SystemExit, match="events"):
+            main(["telemetry", "summarize", str(tmp_path / "void"),
+                  "--json"])

@@ -1,0 +1,435 @@
+"""The regression gate + hardware-watch autopilot (ISSUE 3 tentpole,
+pieces 3-4): ``telemetry compare`` turning a synthetic injected
+regression into a nonzero exit (bench JSON and run-dir sources,
+direction inference, per-metric thresholds), and ``telemetry watch``
+running the evidence ritual on a mocked green probe — probe trail,
+ritual_step events, saved stdout/stderr, exit-code contract."""
+
+import glob
+import json
+import os
+import time
+import types
+
+import pytest
+
+from apnea_uq_tpu import telemetry
+from apnea_uq_tpu.cli.main import main
+from apnea_uq_tpu.telemetry import compare as compare_mod
+from apnea_uq_tpu.telemetry import watch as watch_mod
+from apnea_uq_tpu.telemetry.runlog import _ACTIVE
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_active_run():
+    assert not _ACTIVE, f"active-run stack dirty on entry: {_ACTIVE}"
+    yield
+    leaked = list(_ACTIVE)
+    _ACTIVE.clear()
+    assert not leaked, f"test leaked active run logs: {leaked}"
+
+
+def _bench_json(path, value, *, de_ratio=None):
+    """A minimal BENCH_r*.json capture in the driver schema."""
+    doc = {"metric": "mcd_t50_inference_throughput", "value": value,
+           "unit": "windows/sec/chip", "vs_baseline": 1.0}
+    if de_ratio is not None:
+        doc["secondary"] = {"metric": "de_concurrent_speedup",
+                            "value": de_ratio, "unit": "ratio"}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def _run_dir(path, *, peak_bytes, windows_per_s, runs=1):
+    """A telemetry run dir whose events carry one HBM peak and one bench
+    throughput; ``runs>1`` appends stale runs with garbage values first
+    (the comparator must read the latest run only)."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, telemetry.EVENTS_FILENAME), "w") as f:
+        for i in range(runs):
+            latest = i == runs - 1
+            events = [
+                {"seq": 0, "ts": 1.0, "kind": "run_started",
+                 "schema_version": 1, "stage": "bench",
+                 "topology": {"platform": "tpu", "device_count": 8}},
+                {"seq": 1, "ts": 2.0, "kind": "memory_profile",
+                 "label": "ensemble_epoch",
+                 "peak_bytes": peak_bytes if latest else 1},
+                {"seq": 2, "ts": 3.0, "kind": "bench_throughput",
+                 "metric": "mcd_t50_inference_throughput",
+                 "windows_per_s": windows_per_s if latest else 10**9},
+                {"seq": 3, "ts": 4.0, "kind": "run_finished",
+                 "status": "ok"},
+            ]
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+class TestCompare:
+    def test_injected_regression_gates_nonzero(self, tmp_path, capsys):
+        """The ISSUE 3 acceptance path: a synthetic -10% throughput drop
+        must flip the CLI exit code to 1."""
+        base = _bench_json(tmp_path / "r05.json", 1000.0)
+        cand = _bench_json(tmp_path / "r06.json", 900.0)
+        comparison = compare_mod.compare_paths(base, cand)
+        (delta,) = comparison.regressions
+        assert delta.name == "mcd_t50_inference_throughput"
+        assert delta.delta_pct == pytest.approx(-10.0)
+        assert main(["telemetry", "compare", base, cand]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSED" in out and "regressions: 1" in out
+
+    def test_improvement_and_within_threshold_exit_zero(self, tmp_path,
+                                                        capsys):
+        base = _bench_json(tmp_path / "b.json", 1000.0)
+        # +30%: far past the threshold, but in the GOOD direction — a
+        # faster candidate must never "regress" by being different.
+        faster = _bench_json(tmp_path / "f.json", 1300.0)
+        assert main(["telemetry", "compare", base, faster]) == 0
+        assert "improved" in capsys.readouterr().out
+        # -4%: worsening, but inside the default 5% threshold.
+        close = _bench_json(tmp_path / "c.json", 960.0)
+        assert main(["telemetry", "compare", base, close]) == 0
+        assert "ok" in capsys.readouterr().out
+
+    def test_run_dir_sources_and_bytes_direction(self, tmp_path):
+        """Run-dir metrics gate too, with unit-inferred direction: an
+        HBM peak GROWING is the regression (lower-is-better), and the
+        latest run of an appended log is the one compared."""
+        base = _run_dir(tmp_path / "base", peak_bytes=8 * 2**30,
+                        windows_per_s=5000.0)
+        cand = _run_dir(tmp_path / "cand", peak_bytes=10 * 2**30,
+                        windows_per_s=5000.0, runs=3)
+        comparison = compare_mod.compare_paths(base, cand)
+        (delta,) = comparison.regressions
+        assert delta.name == "memory.ensemble_epoch.peak_bytes"
+        assert not delta.higher_better
+        assert delta.delta_pct == pytest.approx(25.0)
+        # A SHRINKING peak is an improvement, not a regression.
+        slim = _run_dir(tmp_path / "slim", peak_bytes=6 * 2**30,
+                        windows_per_s=5000.0)
+        assert compare_mod.compare_paths(base, slim).regressions == []
+
+    def test_per_metric_threshold_override(self, tmp_path):
+        base = _bench_json(tmp_path / "b.json", 1000.0, de_ratio=4.0)
+        cand = _bench_json(tmp_path / "c.json", 990.0, de_ratio=3.0)
+        # DE speedup fell 25%: regression at the default 5%...
+        assert main(["telemetry", "compare", base, cand]) == 1
+        # ...but an explicit 30% band for that one metric absorbs it.
+        assert main(["telemetry", "compare", base, cand,
+                     "--metric-threshold", "de_concurrent_speedup=30"]) == 0
+        # And a global loose threshold with a TIGHT per-metric override
+        # still trips on the overridden metric alone.
+        assert main(["telemetry", "compare", base, cand,
+                     "--threshold-pct", "50",
+                     "--metric-threshold", "de_concurrent_speedup=10"]) == 1
+
+    def test_bad_threshold_spec_and_missing_inputs_exit_cleanly(
+            self, tmp_path):
+        base = _bench_json(tmp_path / "b.json", 1.0)
+        with pytest.raises(SystemExit, match="NAME=PCT"):
+            main(["telemetry", "compare", base, base,
+                  "--metric-threshold", "oops"])
+        with pytest.raises(SystemExit, match="not a number"):
+            main(["telemetry", "compare", base, base,
+                  "--metric-threshold", "x=fast"])
+        with pytest.raises(SystemExit):
+            main(["telemetry", "compare", base,
+                  str(tmp_path / "missing.json")])
+        empty = tmp_path / "not_a_run"
+        empty.mkdir()
+        with pytest.raises(SystemExit, match="events"):
+            main(["telemetry", "compare", base, str(empty)])
+
+    def test_disjoint_metric_sets_raise(self, tmp_path):
+        base = _bench_json(tmp_path / "b.json", 1000.0)
+        cand = _run_dir(tmp_path / "cand", peak_bytes=1, windows_per_s=0)
+        with pytest.raises(SystemExit, match="no common metrics"):
+            main(["telemetry", "compare", base, str(cand)])
+
+    def test_progress_file_wrapper_gates_the_primary_too(self, tmp_path):
+        """A BENCH_PROGRESS_FILE capture wraps the driver blocks as
+        {"primary": ..., "secondary": ...}; the comparator must unwrap
+        it — extracting only the secondary would silently pass a
+        regressed primary metric."""
+        base = _bench_json(tmp_path / "printed.json", 1000.0, de_ratio=4.0)
+        progress = tmp_path / "progress.json"
+        with open(progress, "w") as f:
+            json.dump({
+                "primary": {"metric": "mcd_t50_inference_throughput",
+                            "value": 500.0, "unit": "windows/sec/chip"},
+                "secondary": {"metric": "de_concurrent_speedup",
+                              "value": 4.0, "unit": "ratio"},
+            }, f)
+        comparison = compare_mod.compare_paths(base, str(progress))
+        names = {d.name for d in comparison.deltas}
+        assert {"mcd_t50_inference_throughput",
+                "de_concurrent_speedup"} <= names
+        (reg,) = comparison.regressions
+        assert reg.name == "mcd_t50_inference_throughput"
+        assert main(["telemetry", "compare", base, str(progress)]) == 1
+
+    def test_one_sided_metrics_listed_never_regress(self, tmp_path):
+        base = _bench_json(tmp_path / "b.json", 1000.0, de_ratio=4.0)
+        cand = _bench_json(tmp_path / "c.json", 1000.0)  # no secondary
+        comparison = compare_mod.compare_paths(base, cand)
+        assert "de_concurrent_speedup" in comparison.only_in_baseline
+        assert comparison.regressions == []
+
+    def test_json_output_shape(self, tmp_path, capsys):
+        base = _bench_json(tmp_path / "b.json", 1000.0)
+        cand = _bench_json(tmp_path / "c.json", 800.0)
+        assert main(["telemetry", "compare", base, cand, "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["regressed"] is True
+        # Two metrics: the throughput and its unchanged .vs_baseline.
+        delta = next(d for d in doc["deltas"]
+                     if d["name"] == "mcd_t50_inference_throughput")
+        assert delta["regressed"] is True
+        assert delta["delta_pct"] == pytest.approx(-20.0)
+
+    def test_metric_direction_override_gates_unknown_units(self, tmp_path):
+        """An unknown-unit lower-is-better metric (a future latency or
+        loss scalar) defaults to higher-is-better and could never
+        regress; --metric-direction NAME=lower closes that hole."""
+        def score_json(path, value):
+            with open(path, "w") as f:
+                json.dump({"metric": "val_loss", "value": value,
+                           "unit": "score"}, f)  # unknown unit
+            return str(path)
+
+        base = score_json(tmp_path / "b.json", 100.0)
+        worse = score_json(tmp_path / "c.json", 150.0)
+        # Default inference: higher-is-better, +50% looks like progress.
+        assert main(["telemetry", "compare", base, worse]) == 0
+        assert main(["telemetry", "compare", base, worse,
+                     "--metric-direction", "val_loss=lower"]) == 1
+        # And the override works in the permissive direction too.
+        assert main(["telemetry", "compare", worse, base,
+                     "--metric-direction", "val_loss=lower"]) == 0
+        with pytest.raises(SystemExit, match="higher|lower"):
+            main(["telemetry", "compare", base, worse,
+                  "--metric-direction", "val_loss=down"])
+
+    def test_zero_baseline_json_has_no_infinity_token(self, tmp_path,
+                                                      capsys):
+        """json.dumps(float('inf')) emits a bare `Infinity` no strict
+        parser accepts; the undefined-percent case must serialize as
+        null."""
+        base = _bench_json(tmp_path / "b.json", 0.0)
+        cand = _bench_json(tmp_path / "c.json", 5.0)
+        assert main(["telemetry", "compare", base, cand, "--json"]) == 0
+        out = capsys.readouterr().out
+        assert "Infinity" not in out
+        doc = json.loads(out)  # strict parse must succeed
+        delta = next(d for d in doc["deltas"]
+                     if d["name"] == "mcd_t50_inference_throughput")
+        assert delta["delta_pct"] is None and not delta["regressed"]
+
+    def test_zero_baseline_compares_by_sign(self):
+        metrics = {"m": compare_mod.Metric("m", 0.0, "seconds", False)}
+        worse = {"m": compare_mod.Metric("m", 3.0, "seconds", False)}
+        (delta,) = compare_mod.compare_metrics(metrics, worse)
+        assert delta.regressed and delta.delta_pct == float("inf")
+        same = {"m": compare_mod.Metric("m", 0.0, "seconds", False)}
+        (delta,) = compare_mod.compare_metrics(metrics, same)
+        assert not delta.regressed
+
+    def test_unit_direction_inference(self):
+        assert compare_mod.unit_direction("windows/sec/chip")
+        assert compare_mod.unit_direction("ratio")
+        assert not compare_mod.unit_direction("seconds")
+        assert not compare_mod.unit_direction("bytes")
+        assert compare_mod.unit_direction(None)  # unknown: higher wins
+
+
+def _green_probe(timeout_s):
+    return True, "ok"
+
+
+def _fake_runner(records, rc_by_name=None, hang=()):
+    """A subprocess.run stand-in that records each ritual invocation;
+    steps named in ``hang`` raise TimeoutExpired like a tunnel-flap
+    hang hitting the step's timeout."""
+    import subprocess
+
+    rc_by_name = rc_by_name or {}
+
+    def runner(argv, cwd=None, env=None, capture_output=None, text=None,
+               timeout=None):
+        name = "tpu_tests" if "pytest" in " ".join(argv) else "bench"
+        records.append({"name": name, "argv": argv, "cwd": cwd,
+                        "env": env, "timeout": timeout})
+        if name in hang:
+            raise subprocess.TimeoutExpired(argv, timeout,
+                                            output=f"{name} partial\n")
+        return types.SimpleNamespace(
+            returncode=rc_by_name.get(name, 0),
+            stdout=f"{name} stdout\n", stderr="")
+
+    return runner
+
+
+class TestWatch:
+    def test_green_probe_runs_evidence_ritual(self, tmp_path):
+        """The ISSUE 3 acceptance path: a mocked green probe must
+        execute the ritual into a fresh run dir, with the probe trail
+        and per-step exit codes as telemetry."""
+        records = []
+        rc = watch_mod.watch(str(tmp_path), probe=_green_probe,
+                             runner=_fake_runner(records), budget_s=60.0)
+        assert rc == 0
+        (run_dir,) = glob.glob(str(tmp_path / "runs" / "watch-*"))
+        events = telemetry.read_events(run_dir)
+        kinds = [e["kind"] for e in events]
+        assert kinds.count("probe") == 1
+        assert "probe_green" in kinds
+        steps = [e for e in events if e["kind"] == "ritual_step"]
+        assert [s["name"] for s in steps] == ["bench", "tpu_tests"]
+        assert all(s["returncode"] == 0 for s in steps)
+        assert events[-1] == {**events[-1], "kind": "run_finished",
+                              "status": "ok"}
+        # The bench step lands its capture INSIDE the watch run dir, and
+        # the TPU-gated tests get their env switch.
+        bench, tests = records
+        assert bench["env"]["BENCH_RUN_DIR"].startswith(run_dir)
+        assert bench["env"]["BENCH_PROGRESS_FILE"].startswith(run_dir)
+        assert bench["cwd"] == watch_mod._REPO_ROOT
+        assert tests["env"]["APNEA_UQ_TEST_TPU"] == "1"
+        assert "-k" in tests["argv"] and "on_tpu" in tests["argv"]
+        # Each step's stdout is preserved next to its event.
+        for step in steps:
+            path = os.path.join(run_dir, step["stdout_path"])
+            with open(path) as f:
+                assert f"{step['name']} stdout" in f.read()
+
+    def test_failing_step_does_not_stop_ritual(self, tmp_path):
+        # A red TPU test after a good bench capture must not discard it.
+        records = []
+        rc = watch_mod.watch(
+            str(tmp_path), probe=_green_probe,
+            runner=_fake_runner(records, {"bench": 1}), budget_s=60.0)
+        assert rc == 1
+        assert [r["name"] for r in records] == ["bench", "tpu_tests"]
+        (run_dir,) = glob.glob(str(tmp_path / "runs" / "watch-*"))
+        events = telemetry.read_events(run_dir)
+        rcs = [e["returncode"] for e in events
+               if e["kind"] == "ritual_step"]
+        assert rcs == [1, 0]
+        assert events[-1]["status"] == "error"
+
+    def test_hung_step_times_out_instead_of_hanging_watch(self, tmp_path):
+        """A tunnel flap AFTER the green probe hangs jax.devices() inside
+        the tpu_tests subprocess; the step timeout turns that into a
+        failed step (partial output preserved), never a hung watch."""
+        records = []
+        rc = watch_mod.watch(
+            str(tmp_path), probe=_green_probe,
+            runner=_fake_runner(records, hang=("tpu_tests",)),
+            budget_s=60.0)
+        assert rc == 1
+        assert records[0]["timeout"] == 7200.0  # bench's step budget
+        assert records[1]["timeout"] == 3600.0
+        (run_dir,) = glob.glob(str(tmp_path / "runs" / "watch-*"))
+        events = telemetry.read_events(run_dir)
+        hung = next(e for e in events if e["kind"] == "ritual_step"
+                    and e["name"] == "tpu_tests")
+        assert hung["timed_out"] is True and hung["returncode"] == -1
+        with open(os.path.join(run_dir, hung["stdout_path"])) as f:
+            assert "tpu_tests partial" in f.read()
+
+    def test_missing_ritual_files_fail_fast_before_the_wait(self, tmp_path):
+        # A site-packages install (no bench.py next to the package) must
+        # fail in seconds, not after a 24h probe wait.
+        def no_probe(timeout_s):  # pragma: no cover - must not run
+            raise AssertionError("probe must not run when preflight fails")
+
+        rc = watch_mod.watch(str(tmp_path / "out"), probe=no_probe,
+                             repo_root=str(tmp_path / "not_a_checkout"),
+                             budget_s=60.0)
+        assert rc == 2
+        assert not glob.glob(str(tmp_path / "out" / "runs" / "*"))
+
+    def test_skip_tests_runs_bench_only(self, tmp_path):
+        records = []
+        assert watch_mod.watch(str(tmp_path), probe=_green_probe,
+                               runner=_fake_runner(records),
+                               skip_tests=True, budget_s=60.0) == 0
+        assert [r["name"] for r in records] == ["bench"]
+
+    def test_expired_budget_exits_2_without_a_run_dir(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+
+        def never_green(timeout_s):
+            return False, "UNAVAILABLE: flapping tunnel"
+
+        def no_ritual(*a, **k):  # pragma: no cover - must not run
+            raise AssertionError("ritual must not run without green")
+
+        rc = watch_mod.watch(str(tmp_path), probe=never_green,
+                             runner=no_ritual, budget_s=0.2)
+        assert rc == 2
+        # Exit 2 mirrors bench's init-retry exhaustion, and no empty
+        # evidence dir is left behind to look like a capture.
+        assert not glob.glob(str(tmp_path / "runs" / "*"))
+
+    def test_wait_for_green_backoff_schedule(self, monkeypatch):
+        # The schedule bench.py's init retry pinned: 20s, then x1.6.
+        sleeps = []
+        monkeypatch.setattr(time, "sleep", sleeps.append)
+        outcomes = iter([(False, "red"), (False, "red"), (True, "ok")])
+        attempts_seen = []
+        green, attempts, last = watch_mod.wait_for_green(
+            600.0, probe=lambda t: next(outcomes),
+            on_attempt=lambda n, g, d: attempts_seen.append((n, g)))
+        assert green and attempts == 3 and last == "ok"
+        assert sleeps == [20.0, 32.0]
+        assert attempts_seen == [(1, False), (2, False), (3, True)]
+
+    def test_probe_backend_green_on_cpu(self):
+        # The real probe: jax.devices() in a budgeted subprocess — on
+        # the CPU suite backend it must come back green.
+        green, detail = watch_mod.probe_backend(probe_timeout_s=120.0)
+        assert green and detail == "ok"
+
+    def test_cli_watch_wires_probe_and_ritual(self, tmp_path, monkeypatch,
+                                              capsys):
+        records = []
+        monkeypatch.setattr(watch_mod, "probe_backend", _green_probe)
+        monkeypatch.setattr(watch_mod, "subprocess",
+                            types.SimpleNamespace(
+                                run=_fake_runner(records)))
+        assert main(["telemetry", "watch", "--out", str(tmp_path),
+                     "--budget-secs", "60", "--skip-tests"]) == 0
+        assert [r["name"] for r in records] == ["bench"]
+        out = capsys.readouterr().out
+        assert "backend GREEN" in out
+        assert "bench finished rc=0" in out
+
+    def test_telemetry_watch_name_is_always_the_submodule(self):
+        """`telemetry.watch` must resolve to the watch MODULE on every
+        access path (attribute and from-import), never flip to the
+        watch() function depending on import order; the lazy function
+        exports from it keep working."""
+        import types as types_mod
+
+        from apnea_uq_tpu import telemetry
+
+        assert isinstance(telemetry.watch, types_mod.ModuleType)
+        assert telemetry.watch is watch_mod
+        assert telemetry.wait_for_green is watch_mod.wait_for_green
+        assert telemetry.probe_backend is watch_mod.probe_backend
+        assert "watch" not in telemetry.__all__
+
+    def test_evidence_ritual_steps_are_parameterized(self, tmp_path):
+        steps = watch_mod.evidence_ritual_steps(str(tmp_path))
+        assert [s.name for s in steps] == ["bench", "tpu_tests"]
+        bench = steps[0]
+        assert bench.argv[1].endswith("bench.py")
+        assert bench.env["BENCH_RUN_DIR"] == str(tmp_path / "bench")
+        only_bench = watch_mod.evidence_ritual_steps(str(tmp_path),
+                                                     skip_tests=True)
+        assert [s.name for s in only_bench] == ["bench"]
