@@ -930,6 +930,13 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                          "pytest step.")
     pw.set_defaults(fn=cmd_telemetry_watch)
 
+    # `lint` is jax-free like the telemetry read side: a pure-AST scan
+    # (apnea_uq_tpu/lint/) that takes no --config and must stay runnable
+    # on machines where the backend (or jax itself) is unusable.
+    from apnea_uq_tpu.lint import cli as lint_cli
+
+    lint_cli.register(sub)
+
     p = add("demo", cmd_demo,
             "Zero-data synthetic smoke demo of the UQ engine.")
     p.add_argument("--num-models", type=int, default=5)

@@ -1,0 +1,24 @@
+"""``apnea-uq lint`` — AST rule engine for JAX/TPU correctness hazards.
+
+Static guards for the failure modes that otherwise only surface as wrong
+numbers or telemetry anomalies after an expensive device run: PRNG key
+reuse (correlated MCD/DE streams), reads of donated buffers, host syncs
+inside the telemetry layer's timed windows, jit retrace hazards, drift
+between emitted telemetry events and ``docs/OBSERVABILITY.md``, and bare
+``print`` calls.
+
+Jax-free by design (pure ``ast``/``tokenize``), so it runs anywhere
+tier-1 runs.  Public surface:
+
+- :func:`apnea_uq_tpu.lint.engine.run_lint` — programmatic entry;
+- :mod:`apnea_uq_tpu.lint.cli` — the ``apnea-uq lint`` subcommand;
+- ``docs/LINT.md`` — the rule catalog and suppression syntax.
+"""
+
+from apnea_uq_tpu.lint.engine import (  # noqa: F401
+    Finding,
+    LintResult,
+    RULES,
+    run_lint,
+)
+from apnea_uq_tpu.lint.report import render_json, render_text, result_data  # noqa: F401

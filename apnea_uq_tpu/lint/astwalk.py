@@ -1,0 +1,305 @@
+"""Shared AST plumbing for the lint rules: scope-linear walks with
+branch signatures and loop ancestry.
+
+The correctness rules all reason the same way: *within one function
+scope, in source order, did X happen between/inside Y?*  This module
+gives them that spine once:
+
+- :func:`scopes` — every function body (plus the module body) as its own
+  scope; nested functions are excluded from their parent's walk so a
+  closure's key use never aliases its enclosing function's.
+- :class:`ScopeWalk` — calls and name-bindings of one scope in execution
+  order, each tagged with a **branch signature** (which arm of which
+  ``if``/``try``/``match`` it sits in — two calls in *exclusive* arms
+  never conflict) and the stack of enclosing loops (a consumer inside a
+  loop repeats per iteration).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.random.split' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def names_in(node: ast.AST) -> Tuple[str, ...]:
+    """Every Name identifier referenced anywhere in an expression."""
+    return tuple(sorted({
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    }))
+
+
+def scopes(tree: ast.Module) -> Iterator[Tuple[Optional[ast.AST], List[ast.stmt]]]:
+    """(scope_node, body) for the module and every (nested) function.
+    scope_node is None for the module body."""
+    yield None, list(tree.body)
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNCTION_NODES):
+            yield node, list(node.body)
+
+
+# Branch signature: ((branch_node_id, arm_index), ...) innermost-last.
+BranchSig = Tuple[Tuple[int, int], ...]
+
+
+def compatible(a: BranchSig, b: BranchSig) -> bool:
+    """True unless a and b sit in *different* arms of the same branch
+    node — only then can the two events never occur in one execution."""
+    arms_a = dict(a)
+    for node_id, arm in b:
+        if node_id in arms_a and arms_a[node_id] != arm:
+            return False
+    return True
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    node: ast.Call
+    order: int
+    branch: BranchSig
+    loops: Tuple[int, ...]        # ids of enclosing For/While, outermost first
+    stmt: ast.stmt                # the statement the call executes in
+
+
+@dataclasses.dataclass(frozen=True)
+class Binding:
+    name: str
+    order: int
+    branch: BranchSig
+    loops: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSite:
+    name: str
+    order: int
+    branch: BranchSig
+    loops: Tuple[int, ...]
+    node: ast.Name
+    stmt: ast.stmt
+
+
+class ScopeWalk:
+    """Execution-ordered calls and name bindings of ONE scope body.
+
+    Nested function/class bodies are not descended into (they are their
+    own scopes); lambda bodies and comprehensions stay in this scope —
+    they execute inline.  Binding records for a statement are emitted
+    *after* the calls in its value, matching evaluation order (so
+    ``k = fold_in(k, i)`` reads the old ``k`` before rebinding it).
+    """
+
+    def __init__(self, body: List[ast.stmt]):
+        self.calls: List[CallSite] = []
+        self.bindings: List[Binding] = []
+        self.loads: List[LoadSite] = []
+        self.loop_bodies: Dict[int, List[Binding]] = {}
+        self._order = 0
+        self._walk_body(body, (), ())
+
+    # -- recording ---------------------------------------------------------
+
+    def _next(self) -> int:
+        self._order += 1
+        return self._order
+
+    def _record_expr(self, node: Optional[ast.AST], branch: BranchSig,
+                     loops: Tuple[int, ...], stmt: ast.stmt) -> None:
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, _FUNCTION_NODES + (ast.ClassDef,)):
+                # own scope; but its *name* is a binding here, handled by
+                # the statement walk (defs are statements, not exprs)
+                continue
+            if isinstance(sub, ast.Call):
+                self._add_call(sub, branch, loops, stmt)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                self.loads.append(LoadSite(
+                    name=sub.id, order=self._next(), branch=branch,
+                    loops=loops, node=sub, stmt=stmt,
+                ))
+            elif isinstance(sub, ast.NamedExpr):
+                self._bind_target(sub.target, branch, loops)
+            elif isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                  ast.GeneratorExp)):
+                for gen in sub.generators:
+                    self._bind_target(gen.target, branch, loops)
+
+    def _add_call(self, call: ast.Call, branch: BranchSig,
+                  loops: Tuple[int, ...], stmt: ast.stmt) -> None:
+        site = CallSite(node=call, order=self._next(), branch=branch,
+                        loops=loops, stmt=stmt)
+        self.calls.append(site)
+
+    def _bind_target(self, target: ast.AST, branch: BranchSig,
+                     loops: Tuple[int, ...]) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                b = Binding(name=sub.id, order=self._next(), branch=branch,
+                            loops=loops)
+                self.bindings.append(b)
+                for loop_id in loops:
+                    self.loop_bodies.setdefault(loop_id, []).append(b)
+
+    # -- statement walk ----------------------------------------------------
+
+    def _walk_body(self, body: List[ast.stmt], branch: BranchSig,
+                   loops: Tuple[int, ...]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, branch, loops)
+
+    def _walk_stmt(self, stmt: ast.stmt, branch: BranchSig,
+                   loops: Tuple[int, ...]) -> None:
+        if isinstance(stmt, _FUNCTION_NODES + (ast.ClassDef,)):
+            # Decorators/defaults evaluate in THIS scope; the body doesn't.
+            for dec in getattr(stmt, "decorator_list", []):
+                self._record_expr(dec, branch, loops, stmt)
+            args = getattr(stmt, "args", None)
+            if args is not None:
+                for default in list(args.defaults) + [
+                        d for d in args.kw_defaults if d is not None]:
+                    self._record_expr(default, branch, loops, stmt)
+            self._bind_target(ast.Name(id=stmt.name, ctx=ast.Store()),
+                              branch, loops)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._record_expr(stmt.value, branch, loops, stmt)
+            for t in stmt.targets:
+                self._bind_target(t, branch, loops)
+            return
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            self._record_expr(stmt.value, branch, loops, stmt)
+            self._bind_target(stmt.target, branch, loops)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._record_expr(stmt.iter, branch, loops, stmt)
+            inner = loops + (id(stmt),)
+            self.loop_bodies.setdefault(id(stmt), [])
+            self._bind_target(stmt.target, branch, inner)
+            self._walk_body(stmt.body, branch, inner)
+            self._walk_body(stmt.orelse, branch, loops)
+            return
+        if isinstance(stmt, ast.While):
+            inner = loops + (id(stmt),)
+            self.loop_bodies.setdefault(id(stmt), [])
+            self._record_expr(stmt.test, branch, inner, stmt)
+            self._walk_body(stmt.body, branch, inner)
+            self._walk_body(stmt.orelse, branch, loops)
+            return
+        if isinstance(stmt, ast.If):
+            self._record_expr(stmt.test, branch, loops, stmt)
+            self._walk_body(stmt.body, branch + ((id(stmt), 0),), loops)
+            self._walk_body(stmt.orelse, branch + ((id(stmt), 1),), loops)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._record_expr(item.context_expr, branch, loops, stmt)
+                if item.optional_vars is not None:
+                    self._bind_target(item.optional_vars, branch, loops)
+            self._walk_body(stmt.body, branch, loops)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, branch + ((id(stmt), 0),), loops)
+            for i, handler in enumerate(stmt.handlers):
+                if handler.name:
+                    self._bind_target(
+                        ast.Name(id=handler.name, ctx=ast.Store()),
+                        branch + ((id(stmt), i + 1),), loops)
+                self._walk_body(handler.body,
+                                branch + ((id(stmt), i + 1),), loops)
+            self._walk_body(stmt.orelse, branch + ((id(stmt), 0),), loops)
+            self._walk_body(stmt.finalbody, branch, loops)
+            return
+        if isinstance(stmt, ast.Match):
+            self._record_expr(stmt.subject, branch, loops, stmt)
+            for i, case in enumerate(stmt.cases):
+                self._walk_body(case.body, branch + ((id(stmt), i),), loops)
+            return
+        # Expr / Return / Raise / Assert / Delete / Global / Import / ...
+        for field in ast.iter_child_nodes(stmt):
+            if isinstance(field, ast.expr):
+                self._record_expr(field, branch, loops, stmt)
+
+    # -- queries -----------------------------------------------------------
+
+    def bindings_between(self, names: Tuple[str, ...], start: int,
+                         end: int) -> List[Binding]:
+        wanted = set(names)
+        return [b for b in self.bindings
+                if b.name in wanted and start < b.order <= end]
+
+    def loop_binds(self, loop_id: int, names: Tuple[str, ...]) -> bool:
+        wanted = set(names)
+        return any(b.name in wanted for b in self.loop_bodies.get(loop_id, []))
+
+    def stmt_targets(self, stmt: ast.stmt) -> Tuple[str, ...]:
+        """Plain names the statement (re)binds — used to clear taint for
+        ``x, y = f(x, ...)`` in the same statement as the call."""
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = [stmt.target]
+        names = []
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    names.append(sub.id)
+        return tuple(names)
+
+
+def module_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Top-level function defs by name (for cross-function follows)."""
+    return {
+        node.name: node for node in tree.body
+        if isinstance(node, _FUNCTION_NODES)
+    }
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local-name -> canonical dotted module/function path for every
+    import in the module (``import numpy as np`` -> {'np': 'numpy'};
+    ``from jax import random`` -> {'random': 'jax.random'})."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for alias in node.names:
+                out[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    return out
+
+
+def canonical_call(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    """The call's dotted name with its leading segment resolved through
+    the module's imports: ``jr.split`` -> ``jax.random.split``."""
+    name = call_name(call)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    resolved = aliases.get(head, head)
+    return f"{resolved}.{rest}" if rest else resolved

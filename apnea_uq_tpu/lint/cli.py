@@ -1,0 +1,67 @@
+"""The ``apnea-uq lint`` subcommand.
+
+``apnea-uq lint [paths ...] [--json] [--rule NAME ...]`` — exits 0 when
+every finding is suppressed (with a justification), 1 otherwise, 2 on
+usage errors.  With no paths it lints the installed package plus the
+repo's ``bench.py`` when one sits next to it — the exact scope the
+tier-1 gate (``tests/test_lint.py``) runs.
+
+Kept jax-free end to end: the handler imports only the engine, the
+reporters, and ``telemetry.log`` (the stdlib logging shim).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from apnea_uq_tpu.telemetry import log
+
+
+def default_paths() -> List[str]:
+    """The package directory, plus ``bench.py`` beside it when present."""
+    import apnea_uq_tpu
+
+    pkg_dir = os.path.dirname(os.path.abspath(apnea_uq_tpu.__file__))
+    paths = [pkg_dir]
+    bench = os.path.join(os.path.dirname(pkg_dir), "bench.py")
+    if os.path.exists(bench):
+        paths.append(bench)
+    return paths
+
+
+def cmd_lint(args) -> int:
+    from apnea_uq_tpu.lint.engine import run_lint
+    from apnea_uq_tpu.lint.report import render_json, render_text
+
+    paths = args.paths or default_paths()
+    try:
+        result = run_lint(paths, rules=args.rule or None)
+    except (FileNotFoundError, ValueError, SyntaxError) as e:
+        # Usage errors exit 2, distinct from exit 1 = real findings, so
+        # CI gating on the exit code can't mistake a typo for a clean or
+        # dirty tree.
+        log(f"apnea-uq lint: {e}")
+        raise SystemExit(2)
+    log(render_json(result) if args.json else render_text(result))
+    return 1 if result.unsuppressed else 0
+
+
+def register(sub) -> None:
+    """Attach the ``lint`` subcommand to the CLI's subparser registry."""
+    p = sub.add_parser(
+        "lint",
+        help="AST lint for JAX/TPU correctness hazards (PRNG key reuse, "
+             "donated-buffer reads, host syncs in timed regions, retrace "
+             "hazards, telemetry schema drift, bare prints).")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="Files/directories to lint; default: the "
+                        "apnea_uq_tpu package plus bench.py beside it.")
+    p.add_argument("--json", action="store_true",
+                   help="Emit findings machine-readable (full audit "
+                        "trail, suppressed findings included).")
+    p.add_argument("--rule", action="append", default=[],
+                   metavar="NAME",
+                   help="Run only this rule (repeatable); default: all "
+                        "registered rules — see docs/LINT.md.")
+    p.set_defaults(fn=cmd_lint)

@@ -1,0 +1,317 @@
+"""`apnea-uq lint` core: files, suppressions, the rule registry, the runner.
+
+The hazards that actually corrupt a JAX/TPU run — PRNG key reuse that
+silently correlates stochastic passes, reads of donated buffers, host
+syncs inside the telemetry layer's timed windows, retrace storms — only
+surface as wrong numbers or telemetry anomalies *after* an expensive
+device run.  This engine makes them a static, pre-run exit code instead:
+an AST walk over the package (plus ``bench.py``), a registry of rules
+(:mod:`apnea_uq_tpu.lint.rules`), inline suppressions that *require* a
+written justification, and text/JSON reporters behind
+``apnea-uq lint [paths] [--json] [--rule ...]``.
+
+Deliberately **jax-free**: the linter parses source, it never imports the
+code under analysis, so it runs anywhere tier-1 runs — including
+machines where the TPU tunnel (or jax itself) is unusable.  A test pins
+this by poisoning ``jax``/``flax`` in ``sys.modules`` around a lint run.
+
+Suppression syntax (both placements)::
+
+    risky_call()  # apnea-lint: disable=prng-key-reuse -- chunk fold below
+    # apnea-lint: disable=host-sync-in-timed-region -- indices must be host
+    idx = np.asarray(device_perm)
+
+A trailing comment suppresses its own line; a standalone comment
+suppresses the next code line.  The justification after ``--`` is
+mandatory: a bare ``disable=`` does not suppress (the finding stands,
+annotated), so every exemption in the tree explains itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+SEVERITIES = ("error", "warning")
+
+# `# apnea-lint: disable=rule-a,rule-b -- why this is fine here`
+_SUPPRESS_RE = re.compile(
+    r"#\s*apnea-lint:\s*disable=([a-z0-9\-,\s]+?)\s*(?:--\s*(\S.*))?$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit, suppressed or not (suppressed hits stay reportable
+    so ``--json`` output shows the full audit trail, but only
+    unsuppressed ones fail the run)."""
+
+    rule: str
+    severity: str
+    path: str           # repo-root-relative display path
+    line: int
+    message: str
+    suppressed: bool = False
+    justification: Optional[str] = None
+
+    def render(self) -> str:
+        tag = f"{self.path}:{self.line}: [{self.rule}] {self.severity}"
+        text = f"{tag}: {self.message}"
+        if self.suppressed:
+            text += f"  (suppressed: {self.justification})"
+        return text
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    rules: Tuple[str, ...]
+    justification: Optional[str]
+    comment_line: int
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """One parsed file: AST plus the line->suppression map."""
+
+    path: str                   # display path (repo-root relative)
+    abspath: str
+    text: str
+    tree: ast.Module
+    suppressions: Dict[int, List[Suppression]]
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Everything a rule sees: the parsed in-scope files and the repo
+    root (rules that cross-check docs — the telemetry schema rule —
+    resolve ``docs/*.md`` against it)."""
+
+    files: List[SourceFile]
+    repo_root: str
+
+    def file_named(self, suffix: str) -> Optional[SourceFile]:
+        """The scanned file whose path ends with ``suffix`` (posix-style),
+        or None when it is out of scope."""
+        norm = suffix.replace(os.sep, "/")
+        for f in self.files:
+            if f.path.replace(os.sep, "/").endswith(norm):
+                return f
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    severity: str
+    summary: str
+    check: Callable[[LintContext], Iterable[Finding]]
+
+
+# Populated by @register_rule at apnea_uq_tpu.lint.rules import time.
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(name: str, severity: str, summary: str):
+    """Decorator: register ``check(context) -> iterable[Finding]`` under
+    ``name``.  Rules construct findings via :func:`make_finding` so the
+    severity never drifts from the registration."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"severity must be one of {SEVERITIES}, got {severity!r}")
+
+    def wrap(fn: Callable[[LintContext], Iterable[Finding]]) -> Rule:
+        rule = Rule(name=name, severity=severity, summary=summary, check=fn)
+        RULES[name] = rule
+        return fn
+
+    return wrap
+
+
+def make_finding(rule: str, path: str, line: int, message: str) -> Finding:
+    return Finding(rule=rule, severity=RULES[rule].severity, path=path,
+                   line=int(line), message=message)
+
+
+# ------------------------------------------------------------ suppressions --
+
+def _code_lines(tokens) -> List[int]:
+    """Line numbers that carry actual code tokens (suppression comments on
+    their own line attach to the next one of these)."""
+    skip = {tokenize.COMMENT, tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+            tokenize.DEDENT, tokenize.ENCODING, tokenize.ENDMARKER}
+    return sorted({t.start[0] for t in tokens if t.type not in skip})
+
+
+def parse_suppressions(text: str) -> Dict[int, List[Suppression]]:
+    """``{code_line: [Suppression, ...]}`` for one file.
+
+    Trailing comments bind to their own line; standalone comments bind to
+    the next code line (so a suppression can sit above a long call).
+    """
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return {}
+    code_lines = _code_lines(tokens)
+    out: Dict[int, List[Suppression]] = {}
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = tuple(
+            r.strip() for r in m.group(1).split(",") if r.strip()
+        )
+        justification = m.group(2).strip() if m.group(2) else None
+        standalone = not tok.line[: tok.start[1]].strip()
+        if standalone:
+            target = next(
+                (ln for ln in code_lines if ln > tok.start[0]), None
+            )
+        else:
+            target = tok.start[0]
+        if target is None:
+            continue
+        out.setdefault(target, []).append(
+            Suppression(rules=rules, justification=justification,
+                        comment_line=tok.start[0])
+        )
+    return out
+
+
+def apply_suppressions(finding: Finding, sf: SourceFile) -> Finding:
+    """Resolve one finding against its file's suppression map: a justified
+    match suppresses; an unjustified match leaves the finding standing,
+    annotated — the 'missing justification = finding' contract."""
+    for sup in sf.suppressions.get(finding.line, []):
+        if finding.rule not in sup.rules and "all" not in sup.rules:
+            continue
+        if sup.justification:
+            return dataclasses.replace(
+                finding, suppressed=True, justification=sup.justification
+            )
+        return dataclasses.replace(
+            finding,
+            message=(finding.message
+                     + "  [suppression comment lacks a justification: use "
+                       "`# apnea-lint: disable=" + finding.rule
+                     + " -- <why>`]"),
+        )
+    return finding
+
+
+# ------------------------------------------------------------------ runner --
+
+def _iter_py_files(paths: Iterable[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                files.extend(
+                    os.path.join(root, n) for n in sorted(names)
+                    if n.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            files.append(p)
+        else:
+            raise FileNotFoundError(f"lint path is neither a directory nor "
+                                    f"a .py file: {p}")
+    # De-duplicate while keeping order (a dir plus a file inside it).
+    seen, unique = set(), []
+    for f in files:
+        if f not in seen:
+            seen.add(f)
+            unique.append(f)
+    return unique
+
+
+def default_repo_root(paths: Iterable[str]) -> str:
+    """Best-effort repo root: the parent of the first scanned
+    ``apnea_uq_tpu`` package directory, else the common parent."""
+    abspaths = [os.path.abspath(p) for p in paths]
+    for p in abspaths:
+        parts = p.replace(os.sep, "/").split("/")
+        if "apnea_uq_tpu" in parts:
+            idx = parts.index("apnea_uq_tpu")
+            return os.sep.join(parts[:idx]) or os.sep
+    first = abspaths[0]
+    return first if os.path.isdir(first) else os.path.dirname(first)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    files_scanned: int
+    rules_run: Tuple[str, ...]
+    # Repo-root-relative paths actually scanned: lets callers (e.g. the
+    # tier-1 gate) pin that a module has not silently MOVED out of the
+    # lint's scope — the rglob covers new files implicitly, which also
+    # means a relocated one leaves coverage without any test failing.
+    scanned_paths: Tuple[str, ...] = ()
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+
+def load_files(paths: Iterable[str], repo_root: str) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    for abspath in _iter_py_files(paths):
+        # Explicit UTF-8: the linter must behave identically under a
+        # C-locale CI container, where the default codec would choke on
+        # the package's own docstrings.
+        with open(abspath, encoding="utf-8") as fh:
+            text = fh.read()
+        rel = os.path.relpath(abspath, repo_root)
+        tree = ast.parse(text, filename=abspath)  # SyntaxError propagates
+        out.append(SourceFile(
+            path=rel, abspath=abspath, text=text, tree=tree,
+            suppressions=parse_suppressions(text),
+        ))
+    return out
+
+
+def run_lint(paths: Iterable[str], *, rules: Optional[Iterable[str]] = None,
+             repo_root: Optional[str] = None) -> LintResult:
+    """Run the (selected) rules over ``paths``; findings come back sorted
+    by (path, line, rule) with suppressions already resolved."""
+    from apnea_uq_tpu.lint import rules as _rules_pkg  # registers RULES
+
+    del _rules_pkg
+    paths = list(paths)
+    if not paths:
+        raise ValueError("run_lint needs at least one path")
+    if repo_root is None:
+        repo_root = default_repo_root(paths)
+    if rules is None:
+        selected = tuple(sorted(RULES))
+    else:
+        # Order-preserving dedupe: `--rule x --rule x` (easy via CI
+        # templates that append flags) must not double every finding.
+        selected = tuple(dict.fromkeys(rules))
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {unknown}; available: {sorted(RULES)}"
+        )
+    files = load_files(paths, repo_root)
+    context = LintContext(files=files, repo_root=repo_root)
+    by_path = {f.path: f for f in files}
+    findings: List[Finding] = []
+    for name in selected:
+        for finding in RULES[name].check(context):
+            sf = by_path.get(finding.path)
+            if sf is not None:
+                finding = apply_suppressions(finding, sf)
+            findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return LintResult(findings=findings, files_scanned=len(files),
+                      rules_run=selected,
+                      scanned_paths=tuple(f.path for f in files))

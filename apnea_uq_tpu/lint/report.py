@@ -1,0 +1,56 @@
+"""Text and JSON reporters for lint results.
+
+Both render the same resolved findings; ``--json`` is the machine side
+(stable field set, sorted — the golden test pins it) and the text side
+is the human one, grouped per file with a one-line summary.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from apnea_uq_tpu.lint.engine import LintResult
+
+
+def result_data(result: LintResult) -> Dict[str, Any]:
+    """The ``--json`` document: every finding (suppressed included, so
+    the suppression audit trail is machine-readable) plus the summary."""
+    findings: List[Dict[str, Any]] = [
+        {
+            "rule": f.rule,
+            "severity": f.severity,
+            "path": f.path.replace("\\", "/"),
+            "line": f.line,
+            "message": f.message,
+            "suppressed": f.suppressed,
+            "justification": f.justification,
+        }
+        for f in result.findings
+    ]
+    return {
+        "findings": findings,
+        "summary": {
+            "files_scanned": result.files_scanned,
+            "rules_run": list(result.rules_run),
+            "findings": len(result.findings),
+            "suppressed": sum(1 for f in result.findings if f.suppressed),
+            "unsuppressed": len(result.unsuppressed),
+        },
+    }
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(result_data(result), indent=2, sort_keys=False)
+
+
+def render_text(result: LintResult) -> str:
+    lines: List[str] = []
+    for f in result.findings:
+        lines.append(f.render())
+    n_sup = sum(1 for f in result.findings if f.suppressed)
+    lines.append(
+        f"{result.files_scanned} file(s), {len(result.rules_run)} rule(s): "
+        f"{len(result.unsuppressed)} finding(s), {n_sup} suppressed"
+    )
+    return "\n".join(lines)

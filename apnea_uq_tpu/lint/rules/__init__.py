@@ -1,0 +1,17 @@
+"""Rule registry population: importing this package registers every
+shipped rule with :data:`apnea_uq_tpu.lint.engine.RULES`.
+
+One module per rule family; see ``docs/LINT.md`` for the operator-facing
+catalog (what each rule catches, why it matters on TPU, how to
+suppress).  Rules are pure AST analyses — importing them must never pull
+in jax/flax (a test enforces this by poisoning those modules).
+"""
+
+from apnea_uq_tpu.lint.rules import (  # noqa: F401  (import = register)
+    bare_print,
+    donation,
+    host_sync,
+    prng,
+    retrace,
+    telemetry_schema,
+)

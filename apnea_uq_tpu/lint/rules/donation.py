@@ -1,0 +1,224 @@
+"""donated-buffer-read: using an argument after passing it to a jitted
+callee that donates it.
+
+``donate_argnames``/``donate_argnums`` hand the argument's HBM to XLA;
+after the call the Python name still points at a deleted buffer.  On TPU
+a later read raises at best and aliases garbage at worst — and on CPU
+(where donation is ignored) the same code passes every test, which is
+exactly why this needs a static guard: the tier-1 suite runs off-TPU.
+
+Pass 1 collects every function in scope jitted with donation — decorator
+forms (``@partial(jax.jit, ..., donate_argnames=...)``) and rebinding
+forms (``g = jax.jit(f, donate_argnums=...)``) — and maps donated
+positions/names onto the wrapped function's signature.
+
+Pass 2 walks every scope: after a *direct call by name* to a donating
+function, the plain-name arguments bound to donated parameters are
+tainted; a later load of a tainted name in a compatible branch, before
+any rebind, is a finding.  A call inside a loop whose donated args are
+never rebound in that loop is the same bug one iteration later — also
+flagged.
+
+Escapes that intentionally do NOT taint: attribute access on the jitted
+function (``f.lower(...)`` — AOT lowering is abstract; ``f.__wrapped__``
+is the undonated plain function) and passing the function itself as a
+value (``record_jit_memory(log, "label", f, *args)`` lowers, never
+executes).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from apnea_uq_tpu.lint import astwalk
+from apnea_uq_tpu.lint.engine import Finding, LintContext, make_finding, register_rule
+
+_JIT_TAILS = ("jax.jit", "jax.pjit", "pjit.pjit", "jax.experimental.pjit.pjit")
+
+
+def _is_jit_name(name: Optional[str]) -> bool:
+    return name is not None and (name in _JIT_TAILS or name.endswith(".jit")
+                                 or name == "jit")
+
+
+def _constants_of(value: ast.AST, typ: type) -> List:
+    """Constant literals of ``typ`` in a single constant or a
+    tuple/list display (the spellings jit kwargs take in practice)."""
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return [e.value for e in value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, typ)]
+    if isinstance(value, ast.Constant) and isinstance(value.value, typ):
+        return [value.value]
+    return []
+
+
+def literal_name_num_kwargs(call: ast.Call, names_kw: str,
+                            nums_kw: str) -> Tuple[List[str], List[int]]:
+    """(str literals under ``names_kw``, int literals under ``nums_kw``)
+    on a jit(...) call — shared by the donation rule (donate_argnames/
+    argnums) and the retrace rule (static_argnames/argnums)."""
+    names: List[str] = []
+    nums: List[int] = []
+    for kw in call.keywords:
+        if kw.arg == names_kw:
+            names.extend(_constants_of(kw.value, str))
+        elif kw.arg == nums_kw:
+            nums.extend(_constants_of(kw.value, int))
+    return names, nums
+
+
+def _donation_kwargs(call: ast.Call) -> Tuple[List[str], List[int]]:
+    return literal_name_num_kwargs(call, "donate_argnames", "donate_argnums")
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    args = fn.args
+    return [a.arg for a in args.posonlyargs + args.args]
+
+
+def _jit_call_in(expr: ast.AST, aliases) -> Optional[ast.Call]:
+    """The jit(...)/partial(jit, ...) Call inside a decorator or an
+    assignment value, else None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = astwalk.canonical_call(expr, aliases)
+    if _is_jit_name(name):
+        return expr
+    if name in ("functools.partial", "partial") and expr.args:
+        inner = astwalk.dotted_name(expr.args[0])
+        if inner is not None:
+            head, _, rest = inner.partition(".")
+            resolved = aliases.get(head, head)
+            full = f"{resolved}.{rest}" if rest else resolved
+            if _is_jit_name(full):
+                return expr
+    return None
+
+
+def collect_donating_functions(context: LintContext) -> Dict[str, Dict]:
+    """{bare name: {"donated": set of param names, "params": [names],
+    "path": file}} for every donating jitted function in scope."""
+    out: Dict[str, Dict] = {}
+    for sf in context.files:
+        aliases = astwalk.import_aliases(sf.tree)
+        defs: Dict[str, ast.AST] = {
+            node.name: node for node in ast.walk(sf.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in defs.values():
+            for dec in node.decorator_list:
+                call = _jit_call_in(dec, aliases)
+                if call is None:
+                    continue
+                names, nums = _donation_kwargs(call)
+                params = _param_names(node)
+                donated = set(names)
+                donated.update(params[i] for i in nums if i < len(params))
+                if donated:
+                    out[node.name] = {"donated": donated, "params": params,
+                                      "path": sf.path}
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            call = _jit_call_in(node.value, aliases)
+            if call is None or not call.args:
+                continue
+            names, nums = _donation_kwargs(call)
+            if not names and not nums:
+                continue
+            wrapped = astwalk.dotted_name(call.args[0])
+            params = _param_names(defs[wrapped]) if wrapped in defs else []
+            donated = set(names)
+            donated.update(params[i] for i in nums if i < len(params))
+            if donated:
+                out[node.targets[0].id] = {"donated": donated,
+                                           "params": params, "path": sf.path}
+    return out
+
+
+def _donated_arg_names(call: ast.Call, info: Dict) -> Set[str]:
+    """Plain-Name arguments of this call bound to donated parameters."""
+    donated: Set[str] = set()
+    params = info["params"]
+    for pos, arg in enumerate(call.args):
+        if isinstance(arg, ast.Name) and pos < len(params) \
+                and params[pos] in info["donated"]:
+            donated.add(arg.id)
+    for kw in call.keywords:
+        if kw.arg in info["donated"] and isinstance(kw.value, ast.Name):
+            donated.add(kw.value.id)
+    return donated
+
+
+@register_rule(
+    "donated-buffer-read", "error",
+    "an argument is read after being passed to a jit-compiled callee "
+    "that donates it — the buffer no longer exists on TPU (CPU tests "
+    "cannot catch this)",
+)
+def check(context: LintContext) -> Iterator[Finding]:
+    donating = collect_donating_functions(context)
+    if not donating:
+        return
+    for sf in context.files:
+        for _scope, body in astwalk.scopes(sf.tree):
+            walk = astwalk.ScopeWalk(body)
+            reported = set()
+            for site in walk.calls:
+                func = site.node.func
+                if not isinstance(func, ast.Name):
+                    continue
+                info = donating.get(func.id)
+                if info is None:
+                    continue
+                if isinstance(site.stmt, (ast.Return, ast.Raise)):
+                    # Control leaves the scope with the call; no later
+                    # load (and no next loop iteration) can observe the
+                    # donated buffer.
+                    continue
+                names = _donated_arg_names(site.node, info)
+                for name in sorted(names):
+                    yield from _check_taint(
+                        sf, walk, site, func.id, name, reported)
+
+
+def _check_taint(sf, walk: astwalk.ScopeWalk, site: astwalk.CallSite,
+                 callee: str, name: str, reported: set) -> Iterator[Finding]:
+    rebinds = [b.order for b in walk.bindings
+               if b.name == name and b.order > site.order]
+    first_rebind = min(rebinds) if rebinds else None
+    for load in walk.loads:
+        if load.name != name or load.stmt is site.stmt:
+            continue
+        if load.order <= site.order:
+            continue
+        if first_rebind is not None and load.order > first_rebind:
+            break  # the name is fresh again (loads are order-sorted)
+        if not astwalk.compatible(site.branch, load.branch):
+            continue
+        mark = (sf.path, load.node.lineno, name)
+        if mark not in reported:
+            reported.add(mark)
+            yield make_finding(
+                "donated-buffer-read", sf.path, load.node.lineno,
+                f"`{name}` was donated to `{callee}` on line "
+                f"{site.node.lineno} (donate_argnames/argnums); its buffer "
+                f"no longer exists — use the callee's return value or "
+                f"copy before the call",
+            )
+        break  # one finding per (call, name) is enough
+    # Loop hazard: donation inside a loop that never rebinds the name.
+    if site.loops:
+        innermost = site.loops[-1]
+        if not walk.loop_binds(innermost, (name,)):
+            mark = (sf.path, site.node.lineno, name, "loop")
+            if mark not in reported:
+                reported.add(mark)
+                yield make_finding(
+                    "donated-buffer-read", sf.path, site.node.lineno,
+                    f"`{name}` is donated to `{callee}` inside a loop that "
+                    f"never rebinds it: the next iteration passes an "
+                    f"already-deleted buffer",
+                )

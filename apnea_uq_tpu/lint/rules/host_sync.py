@@ -1,0 +1,219 @@
+"""host-sync-in-timed-region: device/host round-trips inside the
+telemetry layer's honest-timing windows.
+
+``StepMetrics.measure`` times a thunk twice — dispatch (call return) and
+device (``block_until_ready`` on the result) — and that decomposition is
+the whole point of the telemetry layer: the gap is what async dispatch
+hides.  A host sync *inside* the thunk (``.item()``, ``float()``/``int()``
+on a device array, ``np.asarray``, ``jax.device_get``, an inner
+``block_until_ready``, the repo's ``host_values`` helper) serializes the
+device work mid-window, double-counts it into dispatch time, and makes
+``dispatch_s`` vs ``device_s`` lie.  The same applies to
+``Timer(block=True)`` bodies, whose contract is one block at ``__exit__``.
+
+Scope: thunks passed to ``<StepMetrics instance>.measure(label, thunk)``
+where the receiver is assigned from ``StepMetrics(...)`` in the same
+module, and ``with Timer(..., block=True)`` bodies.  Lambda thunks are
+scanned directly; named thunks resolve to function defs in the same
+module and the scan follows further same-module calls two levels deep —
+enough to reach the streamed-epoch helpers the trainers actually
+dispatch through, without whole-program call-graph analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from apnea_uq_tpu.lint import astwalk
+from apnea_uq_tpu.lint.engine import Finding, LintContext, make_finding, register_rule
+
+_FOLLOW_DEPTH = 2
+
+# Numpy module spellings that force a device->host copy via asarray/array.
+_NUMPY_MODULES = {"numpy"}
+_JAX_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_HOST_VALUE_HELPERS = {"host_values", "_host_values", "_host_predictions"}
+
+
+def _numpy_aliases(aliases: Dict[str, str]) -> Set[str]:
+    return {local for local, full in aliases.items() if full in _NUMPY_MODULES}
+
+
+def _sync_reason(call: ast.Call, aliases: Dict[str, str],
+                 np_names: Set[str]) -> Optional[str]:
+    """Why this call is a host sync, or None."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "item" and not call.args and not call.keywords:
+            return ".item() forces a device->host transfer"
+        if func.attr == "block_until_ready":
+            return ".block_until_ready() serializes the dispatch stream"
+        if (isinstance(func.value, ast.Name) and func.value.id in np_names
+                and func.attr in ("asarray", "array")):
+            return (f"{func.value.id}.{func.attr}(...) copies the device "
+                    f"array to host")
+    name = astwalk.canonical_call(call, aliases)
+    if name in _JAX_SYNC_CALLS:
+        return f"{name}(...) blocks on device work"
+    if name in _HOST_VALUE_HELPERS or (
+            name is not None and name.split(".")[-1] in _HOST_VALUE_HELPERS):
+        return "host_values(...) fetches device shards to host"
+    if isinstance(func, ast.Name) and func.id in ("float", "int") \
+            and len(call.args) == 1 and not call.keywords:
+        arg = call.args[0]
+        # float(x.shape[0]) / int(len(...)) are host-side already; only a
+        # Name or a Call result plausibly holds a device array.
+        if isinstance(arg, ast.Name):
+            return (f"{func.id}({arg.id}) on a device array blocks until "
+                    f"it is computed")
+        if isinstance(arg, ast.Call):
+            inner = astwalk.canonical_call(arg, aliases)
+            if inner != "len" and not (inner or "").startswith("range"):
+                return (f"{func.id}(...) on a call result blocks if it is "
+                        f"a device array")
+    return None
+
+
+def _scan_region(sf, region: ast.AST, aliases, np_names,
+                 module_fns: Dict[str, ast.AST], entered_at: int,
+                 label: str, depth: int, visited: Set[int],
+                 reported: Set[Tuple]) -> Iterator[Finding]:
+    """Flag syncs in ``region`` and follow same-module callees."""
+    callees: List[str] = []
+    for node in ast.walk(region):
+        if not isinstance(node, ast.Call):
+            continue
+        reason = _sync_reason(node, aliases, np_names)
+        if reason is not None:
+            mark = (sf.path, node.lineno)
+            if mark not in reported:
+                reported.add(mark)
+                yield make_finding(
+                    "host-sync-in-timed-region", sf.path, node.lineno,
+                    f"{reason} inside the timed region entered at line "
+                    f"{entered_at} ({label}) — it double-counts device "
+                    f"work into the dispatch-side timing",
+                )
+        elif isinstance(node.func, ast.Name) and node.func.id in module_fns:
+            callees.append((node.func.id, node.lineno))
+    if depth <= 0:
+        return
+    for callee, use_line in callees:
+        fn = _resolve_fn(module_fns, callee, use_line)
+        if fn is None or id(fn) in visited:
+            continue
+        visited.add(id(fn))
+        yield from _scan_region(
+            sf, fn, aliases, np_names, module_fns, entered_at,
+            f"{label} -> {callee}", depth - 1, visited, reported)
+
+
+def _stepmetrics_receivers(tree: ast.Module, aliases) -> Set[str]:
+    """Names assigned (anywhere in the module) from a StepMetrics(...)
+    construction — the receivers whose .measure() defines a timed window."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        makes_metrics = any(
+            isinstance(sub, ast.Call)
+            and (astwalk.canonical_call(sub, aliases) or "").split(".")[-1]
+            == "StepMetrics"
+            for sub in ast.walk(node.value)
+        )
+        if not makes_metrics:
+            continue
+        for t in node.targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+def _local_functions(tree: ast.Module) -> Dict[str, List[ast.AST]]:
+    """EVERY function def in the module by name (module-level and nested) —
+    named thunks are usually closures right next to the measure call.
+    Names can repeat across functions (every driver calls its closure
+    ``thunk``), so each name keeps all defs, line-sorted."""
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    for defs in out.values():
+        defs.sort(key=lambda d: d.lineno)
+    return out
+
+
+def _resolve_fn(module_fns: Dict[str, List[ast.AST]], name: str,
+                use_line: int) -> Optional[ast.AST]:
+    """The def a name most plausibly refers to at ``use_line``: the
+    nearest preceding definition (Python closure semantics), else the
+    first one (module-level helpers defined below their caller)."""
+    defs = module_fns.get(name)
+    if not defs:
+        return None
+    preceding = [d for d in defs if d.lineno <= use_line]
+    return preceding[-1] if preceding else defs[0]
+
+
+def _is_timing_timer(call: ast.Call, aliases) -> bool:
+    """`Timer(..., block=True)` from utils.timing (threading.Timer never
+    takes block=)."""
+    name = astwalk.canonical_call(call, aliases)
+    if name is None or name.split(".")[-1] != "Timer":
+        return False
+    if name.startswith("threading."):
+        return False
+    return any(kw.arg == "block" and isinstance(kw.value, ast.Constant)
+               and kw.value.value is True for kw in call.keywords)
+
+
+@register_rule(
+    "host-sync-in-timed-region", "warning",
+    "a host sync (.item(), float()/int() on arrays, np.asarray, "
+    "device_get, block_until_ready, host_values) inside a StepMetrics "
+    "window or Timer(block=True) body corrupts the dispatch-vs-device "
+    "timing the telemetry layer exists to measure",
+)
+def check(context: LintContext) -> Iterator[Finding]:
+    for sf in context.files:
+        aliases = astwalk.import_aliases(sf.tree)
+        np_names = _numpy_aliases(aliases)
+        module_fns = _local_functions(sf.tree)
+        receivers = _stepmetrics_receivers(sf.tree, aliases)
+        reported: Set[Tuple] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "measure":
+                recv = node.func.value
+                if not (isinstance(recv, ast.Name) and recv.id in receivers):
+                    continue
+                if len(node.args) < 2:
+                    continue
+                thunk = node.args[1]
+                region: Optional[ast.AST] = None
+                label = "StepMetrics.measure thunk"
+                if isinstance(thunk, ast.Lambda):
+                    region = thunk.body
+                elif isinstance(thunk, ast.Name):
+                    fn = _resolve_fn(module_fns, thunk.id, node.lineno)
+                    if fn is not None:
+                        region = fn
+                        label = f"StepMetrics.measure thunk `{thunk.id}`"
+                if region is None:
+                    continue
+                yield from _scan_region(
+                    sf, region, aliases, np_names, module_fns,
+                    node.lineno, label, _FOLLOW_DEPTH,
+                    {id(region)}, reported)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ctx_expr = item.context_expr
+                    if isinstance(ctx_expr, ast.Call) and _is_timing_timer(
+                            ctx_expr, aliases):
+                        for stmt in node.body:
+                            yield from _scan_region(
+                                sf, stmt, aliases, np_names, module_fns,
+                                node.lineno, "Timer(block=True) body",
+                                _FOLLOW_DEPTH, set(), reported)

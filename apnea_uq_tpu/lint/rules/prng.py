@@ -1,0 +1,128 @@
+"""prng-key-reuse: one key expression feeding ≥2 ``jax.random``
+consumers without an intervening split/fold/reassignment.
+
+Why it matters here specifically: the paper's epistemic-uncertainty
+decomposition reads *disagreement* between MC-Dropout passes and between
+Deep-Ensemble members.  A reused key silently correlates those streams —
+identical dropout masks across passes, identical shuffles across
+members — which deflates the disagreement and invalidates the MI/variance
+numbers while every shape and loss still looks healthy.  Nothing crashes;
+the uncertainty is just wrong.
+
+What counts as consumption: any ``jax.random.*`` call taking the key as
+its first argument.  Derivations (``split``/``fold_in``/``clone``) are
+consumers too — JAX's contract is use-once even for them — but the
+idiomatic derivation fan-out stays legal:
+
+- ``fold_in(key, a)`` + ``fold_in(key, b)`` with *different* data args is
+  the stream-derivation pattern (``utils/prng.py``) — allowed;
+  the same data arg twice duplicates a stream — flagged.
+- ``split(key)`` twice yields bit-identical children — flagged.
+- a sampler (``uniform``/``normal``/``bernoulli``/``permutation``/...)
+  plus ANY second consumer of the same key — flagged.
+- a sampler consuming a key inside a loop that never rebinds any name in
+  the key expression — the per-iteration-identical-noise hazard — flagged
+  even with a single call site.
+
+Scope: direct ``jax.random.*`` calls (through import aliases).  Keys
+threaded through helper wrappers (e.g. ``prng.stream``) are derivations
+by construction and are not tracked across the call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from apnea_uq_tpu.lint import astwalk
+from apnea_uq_tpu.lint.engine import Finding, LintContext, make_finding, register_rule
+
+# jax.random attributes that do NOT consume a key first-arg.
+_NON_CONSUMERS = {
+    "key", "PRNGKey", "key_data", "wrap_key_data", "key_impl",
+    "default_prng_impl", "rbg_key", "threefry2x32_key", "seed_with_impl",
+}
+_DERIVERS = {"split", "fold_in", "clone"}
+
+
+def _consumer(call: ast.Call, aliases) -> Optional[str]:
+    """The jax.random function name when this call consumes a key."""
+    name = astwalk.canonical_call(call, aliases)
+    if name is None or not name.startswith("jax.random."):
+        return None
+    fn = name.split(".", 2)[2]
+    if "." in fn or fn in _NON_CONSUMERS:
+        return None
+    if not call.args:
+        return None
+    return fn
+
+
+def _data_arg_src(call: ast.Call) -> Optional[str]:
+    """Source of fold_in's second argument (the stream discriminator)."""
+    if len(call.args) >= 2:
+        return ast.unparse(call.args[1])
+    return None
+
+
+def _check_scope(sf, walk: astwalk.ScopeWalk, aliases) -> Iterator[Finding]:
+    consumers: List[Tuple[astwalk.CallSite, str, str, Tuple[str, ...]]] = []
+    for site in walk.calls:
+        fn = _consumer(site.node, aliases)
+        if fn is None:
+            continue
+        key_src = ast.unparse(site.node.args[0])
+        consumers.append((site, fn, key_src, astwalk.names_in(site.node.args[0])))
+
+    reported = set()
+    for i, (a, fn_a, key_a, names_a) in enumerate(consumers):
+        for b, fn_b, key_b, _names_b in consumers[i + 1:]:
+            if key_a != key_b or not astwalk.compatible(a.branch, b.branch):
+                continue
+            if walk.bindings_between(names_a, a.order, b.order):
+                continue  # key rebound between the two uses
+            both_derive = fn_a in _DERIVERS and fn_b in _DERIVERS
+            if both_derive:
+                if fn_a != fn_b:
+                    continue  # split+fold_in mix: distinct derivations
+                if (fn_a == "fold_in"
+                        and _data_arg_src(a.node) != _data_arg_src(b.node)):
+                    continue  # fold_in fan-out with distinct stream ids
+            mark = (sf.path, b.node.lineno, key_a)
+            if mark in reported:
+                continue
+            reported.add(mark)
+            yield make_finding(
+                "prng-key-reuse", sf.path, b.node.lineno,
+                f"key `{key_a}` already consumed by jax.random.{fn_a} on "
+                f"line {a.node.lineno}; reusing it in jax.random.{fn_b} "
+                f"correlates the two streams (split or fold_in first)",
+            )
+        # Single-site loop hazard: a sampler drawing from a key the loop
+        # never rebinds produces identical noise every iteration.
+        if fn_a not in _DERIVERS and a.loops:
+            innermost = a.loops[-1]
+            if not walk.loop_binds(innermost, names_a):
+                mark = (sf.path, a.node.lineno, key_a, "loop")
+                if mark not in reported:
+                    reported.add(mark)
+                    yield make_finding(
+                        "prng-key-reuse", sf.path, a.node.lineno,
+                        f"jax.random.{fn_a} consumes `{key_a}` inside a "
+                        f"loop that never rebinds it: every iteration "
+                        f"draws the SAME stream (fold_in the loop index "
+                        f"first)",
+                    )
+
+
+@register_rule(
+    "prng-key-reuse", "error",
+    "the same PRNG key feeds two jax.random consumers without an "
+    "intervening split/fold_in — correlated streams corrupt the "
+    "MCD/DE uncertainty decomposition",
+)
+def check(context: LintContext) -> Iterator[Finding]:
+    for sf in context.files:
+        aliases = astwalk.import_aliases(sf.tree)
+        for _scope, body in astwalk.scopes(sf.tree):
+            yield from _check_scope(sf, astwalk.ScopeWalk(body), aliases)

@@ -1,0 +1,144 @@
+"""jit-retrace-hazard: patterns that defeat jit's compilation cache.
+
+A retrace storm never crashes — it shows up as a mystery slowdown (or as
+the telemetry layer's ``retraces``/``backend_compiles`` counters ticking
+per step, which is how ISSUE 2/3 observed these post-hoc).  Three
+statically catchable shapes:
+
+1. **jit-in-loop** — ``jax.jit(...)`` (or ``partial(jax.jit, ...)``)
+   evaluated inside a ``for``/``while`` body: every iteration builds a
+   fresh wrapper with an empty cache, so every iteration traces AND
+   compiles.  Lambdas and locally-defined functions jitted in a loop are
+   the canonical spelling of this; hoisting the jit out of the loop (or
+   jitting a module-level function) fixes it.
+2. **unhashable static at the call site** — an argument bound to a
+   ``static_argnames``/``static_argnums`` parameter of an in-scope jitted
+   function is a list/dict/set display.  jit statics key the compile
+   cache by hash; this raises ``Unhashable static arguments`` at call
+   time, on device, after minutes of setup.
+3. **unhashable static default** — the jitted function declares a static
+   parameter whose *default value* is a mutable display: the hazard of
+   (2) baked into the signature.
+
+The donation rule's pass-1 machinery is reused to map static names onto
+signatures.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from apnea_uq_tpu.lint import astwalk
+from apnea_uq_tpu.lint.engine import Finding, LintContext, make_finding, register_rule
+from apnea_uq_tpu.lint.rules.donation import (
+    _jit_call_in,
+    _param_names,
+    literal_name_num_kwargs,
+)
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def _static_kwargs(call: ast.Call) -> Tuple[List[str], List[int]]:
+    return literal_name_num_kwargs(call, "static_argnames", "static_argnums")
+
+
+def _collect_static_functions(context: LintContext) -> Dict[str, Dict]:
+    """{bare name: {"static": set[str], "params": [...], "defaults":
+    {param: default node}}} for jit-decorated defs in scope."""
+    out: Dict[str, Dict] = {}
+    for sf in context.files:
+        aliases = astwalk.import_aliases(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                call = _jit_call_in(dec, aliases)
+                if call is None:
+                    continue
+                names, nums = _static_kwargs(call)
+                params = _param_names(node)
+                static = set(names)
+                static.update(params[i] for i in nums if i < len(params))
+                if not static:
+                    continue
+                defaults: Dict[str, ast.AST] = {}
+                pos_with_defaults = params[len(params)
+                                           - len(node.args.defaults):]
+                for p, d in zip(pos_with_defaults, node.args.defaults):
+                    defaults[p] = d
+                for a, d in zip(node.args.kwonlyargs, node.args.kw_defaults):
+                    if d is not None:
+                        defaults[a.arg] = d
+                out[node.name] = {"static": static, "params": params,
+                                  "defaults": defaults, "path": sf.path,
+                                  "line": node.lineno}
+    return out
+
+
+@register_rule(
+    "jit-retrace-hazard", "warning",
+    "a pattern that defeats jit's compile cache: jit() constructed "
+    "inside a loop, or a list/dict/set bound to a static argument "
+    "(unhashable statics fail at call time)",
+)
+def check(context: LintContext) -> Iterator[Finding]:
+    statics = _collect_static_functions(context)
+    # (3) unhashable static defaults, once per definition.
+    for name, info in statics.items():
+        for param, default in info["defaults"].items():
+            if param in info["static"] and isinstance(default, _UNHASHABLE):
+                yield make_finding(
+                    "jit-retrace-hazard", info["path"], default.lineno,
+                    f"`{name}` declares static argument `{param}` with an "
+                    f"unhashable (list/dict/set) default — jit statics key "
+                    f"the compile cache by hash and raise on these",
+                )
+    for sf in context.files:
+        aliases = astwalk.import_aliases(sf.tree)
+        for _scope, body in astwalk.scopes(sf.tree):
+            walk = astwalk.ScopeWalk(body)
+            for site in walk.calls:
+                # (1) jit wrapper constructed inside a loop.
+                if site.loops and _jit_call_in(site.node, aliases) is not None:
+                    # Decorated defs never appear here: decorators are
+                    # recorded against the def statement, outside loops
+                    # unless the def itself is loop-local — which is the
+                    # hazard.
+                    yield make_finding(
+                        "jit-retrace-hazard", sf.path, site.node.lineno,
+                        "jax.jit(...) evaluated inside a loop: every "
+                        "iteration builds a fresh wrapper with an empty "
+                        "compile cache and retraces — hoist the jitted "
+                        "function out of the loop",
+                    )
+                    continue
+                # (2) unhashable display bound to a static parameter.
+                func = site.node.func
+                if isinstance(func, ast.Name) and func.id in statics:
+                    info = statics[func.id]
+                    yield from _unhashable_static_args(
+                        sf, site.node, func.id, info)
+
+
+def _unhashable_static_args(sf, call: ast.Call, callee: str,
+                            info: Dict) -> Iterator[Finding]:
+    params = info["params"]
+    bound: List[Tuple[str, ast.AST]] = []
+    for pos, arg in enumerate(call.args):
+        if pos < len(params):
+            bound.append((params[pos], arg))
+    for kw in call.keywords:
+        if kw.arg is not None:
+            bound.append((kw.arg, kw.value))
+    for param, arg in bound:
+        if param in info["static"] and isinstance(arg, _UNHASHABLE):
+            yield make_finding(
+                "jit-retrace-hazard", sf.path, arg.lineno,
+                f"call to `{callee}` binds an unhashable "
+                f"{type(arg).__name__.lower()} to static argument "
+                f"`{param}` — jit raises `Non-hashable static arguments` "
+                f"at dispatch; pass a tuple (or mark the arg non-static)",
+            )

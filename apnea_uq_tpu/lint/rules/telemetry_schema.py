@@ -1,0 +1,232 @@
+"""telemetry-event-schema: every event kind and field the code emits must
+be declared in docs/OBSERVABILITY.md — and documented kinds must exist.
+
+The telemetry layer's value is that ``events.jsonl`` is a *schema*, not a
+printf stream: ``summarize``/``compare`` and the bench smoke test all key
+on documented kinds and fields.  An undocumented field is a field those
+consumers silently drop; an undocumented kind is a table the operator
+cannot interpret.  The previous guard was a hand-rolled docs test; this
+rule parses both sides — the doc's "Event kinds" bullet list and every
+``<anything>.event("kind", field=...)`` call in scope — statically, with
+no imports (so the ``telemetry.watch`` import-order workaround that
+module loading once tripped over stays irrelevant here by construction).
+
+What the field extractor resolves, per call: literal keyword arguments,
+and ``**d`` splats where ``d`` is built in the same function from a dict
+display, constant-key subscript assignments, and ``d.update({literal})``.
+Dynamic extensions (``d.update(extra or {})``, f-string keys, splatting a
+parameter) are untrackable statically and are skipped — the resolvable
+keys are still checked.
+
+The reverse (phantom) direction — a documented kind no code emits — runs
+only when the scan scope contains the full emission universe (both
+``telemetry/runlog.py`` and ``bench.py``); linting a single file must not
+claim kinds emitted elsewhere are phantoms.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from apnea_uq_tpu.lint.engine import Finding, LintContext, make_finding, register_rule
+
+DOC_RELPATH = os.path.join("docs", "OBSERVABILITY.md")
+
+# Envelope fields RunLog.event stamps on every record; `stage` is also a
+# legal explicit kwarg (runlog.stage passes it) without per-kind mention.
+_ENVELOPE_FIELDS = {"seq", "ts", "kind", "stage"}
+
+_KIND_BULLET_RE = re.compile(r"^- \*\*(.+?)\*\*", re.M)
+_BACKTICK_TOKEN_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+
+
+def parse_documented_kinds(doc_text: str) -> Dict[str, Tuple[int, Set[str]]]:
+    """{kind: (doc line, field tokens documented in its bullet)} from the
+    "Event kinds" bullet list.  A bold header may name several kinds
+    (``**`stage_start` / `stage_end`**``); they share the bullet body."""
+    out: Dict[str, Tuple[int, Set[str]]] = {}
+    lines = doc_text.splitlines()
+    bullets: List[Tuple[int, str]] = []  # (start line idx, bullet text)
+    current: Optional[List] = None
+    for i, line in enumerate(lines):
+        if _KIND_BULLET_RE.match(line):
+            if current is not None:
+                bullets.append((current[0], "\n".join(current[1])))
+            current = [i, [line]]
+        elif current is not None:
+            if line.startswith(("  ", "\t")) or not line.strip():
+                current[1].append(line)
+            else:
+                bullets.append((current[0], "\n".join(current[1])))
+                current = None
+    if current is not None:
+        bullets.append((current[0], "\n".join(current[1])))
+    for start, text in bullets:
+        # A header may carry several bold segments ("**`probe`** /
+        # **`probe_green`** / **`ritual_step`**") — every backticked
+        # token inside ANY bold span of the bullet's first line is a
+        # kind this bullet declares.
+        first_line = text.lstrip("\n").splitlines()[0]
+        kinds = [
+            tok
+            for bold in re.findall(r"\*\*(.+?)\*\*", first_line)
+            for tok in _BACKTICK_TOKEN_RE.findall(bold)
+        ]
+        if not kinds:
+            continue
+        fields = set(_BACKTICK_TOKEN_RE.findall(text))
+        for kind in kinds:
+            # A kind may be described in several bullets (the event list
+            # plus e.g. the HBM section's prose) — union the fields and
+            # keep the first mention's line.
+            if kind in out:
+                line, existing = out[kind]
+                out[kind] = (line, existing | fields)
+            else:
+                out[kind] = (start + 1, fields)
+    return out
+
+
+def _resolve_splat_keys(func: Optional[ast.AST], name: str) -> Set[str]:
+    """Statically resolvable keys of ``**name`` inside ``func``: dict
+    displays assigned to the name, constant-key subscript stores, and
+    ``.update({literal})`` calls.  Dynamic extensions (parameter splats,
+    computed keys, ``.update(expr)``) contribute nothing — the
+    resolvable keys are still checked, the rest is invisible here."""
+    keys: Set[str] = set()
+    if func is None:
+        return keys
+
+    def take_dict(value: Optional[ast.AST]) -> None:
+        if isinstance(value, ast.Dict):
+            keys.update(k.value for k in value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str))
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == name:
+                    take_dict(node.value)
+                elif (isinstance(target, ast.Subscript)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == name
+                        and isinstance(target.slice, ast.Constant)
+                        and isinstance(target.slice.value, str)):
+                    keys.add(target.slice.value)
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name) and node.target.id == name:
+            take_dict(node.value)
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == name
+                and len(node.args) == 1 and not node.keywords):
+            take_dict(node.args[0])
+    return keys
+
+
+def _enclosing_function(tree: ast.Module, call: ast.Call) -> Optional[ast.AST]:
+    best: Optional[ast.AST] = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if (node.lineno <= call.lineno
+                    and call.end_lineno <= (node.end_lineno or node.lineno)):
+                if best is None or node.lineno >= best.lineno:
+                    best = node
+    return best
+
+
+def iter_event_emissions(tree: ast.Module):
+    """(call, kind, resolvable fields) for every ``X.event("kind", ...)``
+    call with a constant kind."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "event"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        kind = node.args[0].value
+        fields: Set[str] = set()
+        for kw in node.keywords:
+            if kw.arg is not None:
+                fields.add(kw.arg)
+            elif isinstance(kw.value, ast.Name):
+                fields.update(_resolve_splat_keys(
+                    _enclosing_function(tree, node), kw.value.id))
+        yield node, kind, fields
+
+
+@register_rule(
+    "telemetry-event-schema", "error",
+    "every RunLog event kind and resolvable field emitted in scope must "
+    "be declared in docs/OBSERVABILITY.md's event-kind catalog (and "
+    "documented kinds must be emitted somewhere)",
+)
+def check(context: LintContext) -> Iterator[Finding]:
+    emitting = [
+        (sf, list(iter_event_emissions(sf.tree))) for sf in context.files
+    ]
+    if not any(emissions for _sf, emissions in emitting):
+        return
+    # "Full emission universe" = the repo checkout's gate scope (the
+    # package's runlog plus bench.py's mirror events).  Outside it — a
+    # pip-installed package lints itself with no repo docs around, or a
+    # user lints one emitting file of their own — the doc simply isn't
+    # expected to exist, and demanding it would turn the 'runs anywhere'
+    # CLI permanently red on clean installs.
+    full_scope = (context.file_named("telemetry/runlog.py") is not None
+                  and context.file_named("bench.py") is not None)
+    doc_path = os.path.join(context.repo_root, DOC_RELPATH)
+    if not os.path.exists(doc_path):
+        if full_scope:
+            sf = next(sf for sf, emissions in emitting if emissions)
+            yield make_finding(
+                "telemetry-event-schema", sf.path, 1,
+                f"events are emitted in scope but {DOC_RELPATH} was not "
+                f"found under the repo root ({context.repo_root}); the "
+                f"event schema must be documented there",
+            )
+        return
+    with open(doc_path, encoding="utf-8") as fh:
+        documented = parse_documented_kinds(fh.read())
+    emitted_kinds: Set[str] = set()
+    for sf, emissions in emitting:
+        for call, kind, fields in emissions:
+            emitted_kinds.add(kind)
+            if kind not in documented:
+                yield make_finding(
+                    "telemetry-event-schema", sf.path, call.lineno,
+                    f"event kind `{kind}` is not declared in the "
+                    f"{DOC_RELPATH} event catalog",
+                )
+                continue
+            _doc_line, doc_fields = documented[kind]
+            undocumented = sorted(
+                fields - doc_fields - _ENVELOPE_FIELDS
+            )
+            if undocumented:
+                yield make_finding(
+                    "telemetry-event-schema", sf.path, call.lineno,
+                    f"event `{kind}` emits field(s) {undocumented} not "
+                    f"named in its {DOC_RELPATH} bullet",
+                )
+    # Phantom kinds: only meaningful when the whole emission universe is
+    # in scope (the package's runlog plus bench.py's mirror events).
+    if full_scope:
+        doc_rel = os.path.relpath(doc_path, context.repo_root)
+        for kind, (line, _fields) in sorted(documented.items()):
+            if kind not in emitted_kinds:
+                yield Finding(
+                    rule="telemetry-event-schema", severity="error",
+                    path=doc_rel, line=line,
+                    message=(f"documented event kind `{kind}` is emitted "
+                             f"nowhere in the scanned code — stale docs or "
+                             f"a lost emission site"),
+                )

@@ -373,6 +373,7 @@ def _stream_ensemble_epoch(
     # _epoch_jit's, in two vectorized dispatches.
     split_keys = jax.vmap(jax.random.split)(member_keys)   # (N, 2)
     dropout_keys = split_keys[:, 1]
+    # apnea-lint: disable=host-sync-in-timed-region -- per-member permutations must land on host to slice the host-resident dataset; computed once before the first step dispatches, so nothing in flight is serialized
     idx = np.asarray(jax.vmap(
         lambda k: _pad_perm(k, n, batch_size, True)[0]
     )(split_keys[:, 0]))                                   # (N, steps, bs)

@@ -34,7 +34,7 @@ class _StdoutHandler(logging.Handler):
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
-            # The package's single allowlisted print call.
+            # apnea-lint: disable=bare-print -- the central sink every log() line funnels into; by design the one print in the library
             print(self.format(record), file=getattr(sys, _STREAM_NAME))
         except Exception:  # pragma: no cover - stdlib handler contract
             self.handleError(record)

@@ -289,6 +289,7 @@ def _stream_epoch(model, tx, state, x, y, key, batch_size, shuffle,
 
     n = x.shape[0]
     shuffle_key, dropout_key = jax.random.split(key)
+    # apnea-lint: disable=host-sync-in-timed-region -- the permutation must land on host to slice the host-resident dataset; it runs once, before the first batch dispatches, so no in-flight device work is serialized
     idx, mask = (np.asarray(a) for a in _pad_perm(shuffle_key, n, batch_size, shuffle))
 
     def batches():

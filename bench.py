@@ -185,6 +185,10 @@ def _emit_bench_error(msg: str) -> None:
     """The driver-schema error line; shared by every give-up path (init
     retry exhaustion, hang watchdog) so the parsers downstream see one
     shape."""
+    # The driver-schema stdout contract: this line must be raw stdout,
+    # not telemetry.log (which an active run log would also mirror and
+    # narration_to_stderr would redirect away from the parser).
+    # apnea-lint: disable=bare-print -- bench stdout IS the machine interface; see one-JSON-line contract in tests/test_bench_smoke.py
     print(json.dumps({
         "metric": "bench_error",
         "value": 0,
@@ -772,6 +776,7 @@ def main() -> None:
         run_log.close()
     if watchdog is not None:
         watchdog.cancel()
+    # apnea-lint: disable=bare-print -- the ONE result line of the stdout machine contract (driver schema); must not route through telemetry.log
     print(json.dumps(result))
 
 

@@ -1,0 +1,13 @@
+"""bare-print NEGATIVE fixture: `print` appears only in non-call
+positions — docstrings, comments, strings — and output routes through
+telemetry.log."""
+
+from apnea_uq_tpu.telemetry import log
+
+
+def report(value):
+    """Docstrings may say print() freely."""
+    # comments may say print() freely
+    message = "the word print(x) in a string is not a call"
+    log(f"value={value} {message}")
+    return value

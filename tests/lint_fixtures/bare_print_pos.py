@@ -1,0 +1,6 @@
+"""bare-print POSITIVE fixture. Never imported."""
+
+
+def report(value):
+    print(f"value={value}")             # FINDING: bare print in library code
+    return value

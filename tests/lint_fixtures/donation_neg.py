@@ -1,0 +1,32 @@
+"""donated-buffer-read NEGATIVE fixture: correct donation discipline."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def step(state, batch):
+    return state + batch
+
+
+def rebind_same_statement(state, batches):
+    for b in batches:
+        state = step(state, b)          # donated AND rebound each iteration
+    return state
+
+
+def exclusive_arms(state, batch, flag):
+    if flag:
+        return step(state, batch)
+    return state * 2                    # other arm never follows the call
+
+
+def lower_is_abstract(state, batch):
+    lowered = step.lower(state, batch)  # AOT lowering never donates
+    return lowered, state
+
+
+def wrapped_is_plain(state, batch):
+    out = step.__wrapped__(state, batch)  # undecorated function: no donation
+    return out + state

@@ -1,0 +1,32 @@
+"""donated-buffer-read POSITIVE fixture. Never imported."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def step(state, batch):
+    return state + batch
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step_by_num(carry, x):
+    return carry * x
+
+
+def read_after_donation(state, batch):
+    new_state = step(state, batch)
+    return new_state + state            # FINDING: state's buffer is gone
+
+
+def read_after_argnums(carry, x):
+    out = step_by_num(carry, x)
+    return out, carry.sum()             # FINDING: carry donated by position
+
+
+def donate_in_loop(state, batches):
+    total = 0.0
+    for b in batches:
+        total = total + step(state, b)  # FINDING: state never rebound
+    return total

@@ -1,0 +1,38 @@
+"""host-sync-in-timed-region NEGATIVE fixture: honest timed windows."""
+
+import threading
+
+import jax
+import numpy as np
+
+from apnea_uq_tpu.telemetry.steps import StepMetrics
+from apnea_uq_tpu.utils.timing import Timer
+
+
+def clean_thunk(run_log, x):
+    metrics = StepMetrics(run_log)
+
+    def thunk():
+        n = int(x.shape[0])             # shape access is host-side already
+        return jax.numpy.sum(x) / n
+
+    out = metrics.measure("good", thunk)
+    return float(out)                   # sync AFTER the window: fine
+
+
+def sync_outside_window(run_log, x):
+    metrics = StepMetrics(run_log)
+    probs = metrics.measure("good", lambda: jax.numpy.tanh(x))
+    return np.asarray(probs)            # after measure returned: fine
+
+
+def non_blocking_timer(x):
+    with Timer("dispatch-only") as t:   # no block=True: wall-clock timer
+        y = np.asarray(x) * 2
+    return y, t.elapsed_s
+
+
+def threading_timer_is_not_ours(secs, fire):
+    timer = threading.Timer(secs, fire)
+    timer.start()
+    return timer

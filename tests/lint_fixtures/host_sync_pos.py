@@ -1,0 +1,44 @@
+"""host-sync-in-timed-region POSITIVE fixture. Never imported."""
+
+import jax
+import numpy as np
+
+from apnea_uq_tpu.telemetry.steps import StepMetrics
+from apnea_uq_tpu.utils.timing import Timer
+
+
+def lambda_thunk_item(run_log, x):
+    metrics = StepMetrics(run_log)
+    # FINDING: .item() inside the measured thunk
+    return metrics.measure("bad", lambda: jax.numpy.sum(x).item())
+
+
+def named_thunk_asarray(run_log, x):
+    metrics = StepMetrics(run_log)
+
+    def thunk():
+        probs = jax.numpy.tanh(x)
+        return np.asarray(probs)        # FINDING: D2H copy mid-window
+
+    return metrics.measure("bad", thunk)
+
+
+def followed_helper_sync(run_log, x):
+    metrics = StepMetrics(run_log)
+
+    def thunk():
+        return _helper(x)
+
+    return metrics.measure("bad", thunk)
+
+
+def _helper(x):
+    y = jax.numpy.exp(x)
+    return float(jax.device_get(y)[0])  # FINDING (reached via follow)
+
+
+def timer_block_body(x):
+    with Timer("predict", block=True) as t:
+        y = t.wrap(jax.numpy.sum(x))
+        z = float(y)                    # FINDING: blocks inside the body
+    return z

@@ -1,0 +1,34 @@
+"""prng-key-reuse NEGATIVE fixture: idiomatic key discipline, no findings."""
+
+import jax
+
+
+def split_then_use(key):
+    k1, k2 = jax.random.split(key)
+    return jax.random.uniform(k1, (4,)) + jax.random.normal(k2, (4,))
+
+
+def fold_in_fanout(key):
+    init = jax.random.fold_in(key, 0)
+    shuffle = jax.random.fold_in(key, 1)    # distinct stream ids: fine
+    return init, shuffle
+
+
+def rebound_key(key, chunk_idx):
+    key = jax.random.fold_in(key, chunk_idx)
+    return jax.random.uniform(key, (4,))    # fresh key after rebind
+
+
+def exclusive_branches(key, flag):
+    if flag:
+        return jax.random.uniform(key, (4,))
+    else:
+        return jax.random.normal(key, (4,))  # never both in one execution
+
+
+def derived_per_iteration(key, n):
+    total = 0.0
+    for i in range(n):
+        k = jax.random.fold_in(key, i)       # loop-varying derivation
+        total = total + jax.random.uniform(k)
+    return total

@@ -1,0 +1,34 @@
+"""prng-key-reuse POSITIVE fixture: every block must fire. Never imported."""
+
+import jax
+
+
+def sampler_reuse(key):
+    a = jax.random.uniform(key, (4,))
+    b = jax.random.normal(key, (4,))     # FINDING: key consumed twice
+    return a + b
+
+
+def split_twice(key):
+    k1, k2 = jax.random.split(key)
+    k3, k4 = jax.random.split(key)       # FINDING: identical children
+    return k1, k2, k3, k4
+
+
+def fold_in_same_stream(key, i):
+    a = jax.random.fold_in(key, i)
+    b = jax.random.fold_in(key, i)       # FINDING: duplicate stream
+    return a, b
+
+
+def sampler_then_derive(key):
+    noise = jax.random.normal(key, (2,))
+    child = jax.random.split(key)        # FINDING: key already consumed
+    return noise, child
+
+
+def sampler_in_loop(key, xs):
+    out = []
+    for x in xs:
+        out.append(jax.random.uniform(key) + x)   # FINDING: same stream/iter
+    return out

@@ -1,0 +1,24 @@
+"""jit-retrace-hazard NEGATIVE fixture: cache-friendly jit use."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def kernel(x, opts=("a",)):             # hashable tuple default
+    return x
+
+
+_double = jax.jit(lambda v: v * 2)      # wrapper built once at module scope
+
+
+def reuse_wrapper_in_loop(xs):
+    out = []
+    for x in xs:
+        out.append(_double(x))          # cached across iterations
+    return out
+
+
+def hashable_static_call(x):
+    return kernel(x, opts=("a", "b"))   # tuple: hashable static

@@ -1,0 +1,37 @@
+"""jit-retrace-hazard POSITIVE fixture. Never imported."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def kernel(x, opts=("a",)):
+    return x
+
+
+@partial(jax.jit, static_argnames=("table",))
+def bad_default(x, table=[1, 2]):       # FINDING: unhashable static default
+    return x
+
+
+def jit_in_loop(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)    # FINDING: fresh wrapper per iter
+        out.append(f(x))
+    return out
+
+
+def local_def_jitted_in_loop(xs):
+    total = 0.0
+    while xs:
+        def body(v):
+            return v + 1
+
+        total += jax.jit(body)(xs.pop())  # FINDING: empty cache per iter
+    return total
+
+
+def unhashable_static_call(x):
+    return kernel(x, opts=["a", "b"])   # FINDING: list bound to static arg

@@ -1,0 +1,7 @@
+"""Suppression round-trip fixture: a disable comment WITHOUT the required
+justification does not suppress — the finding stands, annotated."""
+
+
+def unjustified(value):
+    print(value)  # apnea-lint: disable=bare-print
+    return value

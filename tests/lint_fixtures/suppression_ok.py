@@ -1,0 +1,13 @@
+"""Suppression round-trip fixture: a real violation, legally suppressed
+(trailing and standalone placements, both WITH justifications)."""
+
+
+def trailing(value):
+    print(value)  # apnea-lint: disable=bare-print -- fixture: this sink is the machine interface
+    return value
+
+
+def standalone(value):
+    # apnea-lint: disable=bare-print -- fixture: justified on its own line
+    print(value)
+    return value

@@ -69,6 +69,14 @@ def test_readme_smoke_recipe_pins_every_smoke_knob():
             f"README off-TPU smoke recipe is missing {k}={v}; keep it in "
             f"sync with tests/test_bench_smoke.py SMOKE_ENV"
         )
+    # The recipe's pre-flight includes the static hazard gate (ISSUE 4):
+    # `apnea-uq lint` must stay in the README smoke section, since it is
+    # the one check that runs in seconds and catches the bug classes
+    # (donation reads, key reuse) a CPU smoke run can NEVER observe.
+    assert "apnea-uq lint" in readme, (
+        "README smoke recipe lost the `apnea-uq lint` gate; the static "
+        "hazard lint is part of the pre-capture ritual"
+    )
 
 
 def _smoke_env(progress_file: str, run_dir: str) -> dict:

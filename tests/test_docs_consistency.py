@@ -11,7 +11,7 @@ from apnea_uq_tpu.cli.main import build_parser
 
 REPO = Path(__file__).resolve().parent.parent
 DOCS = [REPO / "README.md", REPO / "docs" / "MIGRATION.md",
-        REPO / "docs" / "OBSERVABILITY.md"]
+        REPO / "docs" / "OBSERVABILITY.md", REPO / "docs" / "LINT.md"]
 
 # README "Environment": packages claimed absent at runtime.  The claim
 # rotted once (r2 verdict: sklearn/scipy imports on the prepare and
