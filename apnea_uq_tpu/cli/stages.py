@@ -292,6 +292,27 @@ def _add_no_detailed_arg(p) -> None:
                         "compute aggregates + CIs only.")
 
 
+def _add_full_probs_arg(p) -> None:
+    p.add_argument("--full-probs", action="store_true",
+                   help="Disable the fused on-device uncertainty "
+                        "reduction: ship the full (K, M) probability "
+                        "matrix device->host and decompose from it "
+                        "(UQConfig.fused_reduction=False).  The parity "
+                        "escape hatch — fused and full metric documents "
+                        "agree to <=1e-6; full-probs runs additionally "
+                        "persist the raw_predictions artifact.")
+
+
+def _eval_uq_config(args, config):
+    """The UQConfig an eval stage actually runs: ``--full-probs`` flips
+    the fused default off for this invocation only."""
+    if getattr(args, "full_probs", False):
+        import dataclasses
+
+        return dataclasses.replace(config.uq, fused_reduction=False)
+    return config.uq
+
+
 def _add_profile_arg(p) -> None:
     p.add_argument("--profile-dir", default=None,
                    help="Wrap the evaluation in a jax.profiler trace and "
@@ -325,7 +346,8 @@ def _print_metrics_doc(doc) -> None:
     AND the `metrics` read-back, so the two can't drift apart."""
     log(f"=== {doc['label']} ===")
     log(f"predict: {doc['predict_seconds']:.2f}s for "
-        f"{doc['n_passes']}x{doc['n_windows']} windows")
+        f"{doc['n_passes']}x{doc['n_windows']} windows"
+        + (" (fused reduction)" if doc.get("fused") else ""))
     det = doc.get("deterministic_classification")
     if det is not None:
         log(f"deterministic accuracy: {det['accuracy']:.4f}")
@@ -358,6 +380,7 @@ def cmd_eval_mcd(args, config) -> int:
     model, template = _baseline_template(config)
     state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
     _prepared, sets = _load_test_sets(registry)
+    uq_config = _eval_uq_config(args, config)
     with _run(args, "eval-mcd", config) as run_log:
         for i, (label, (x, y, ids)) in enumerate(sets.items()):
             # Trace only the device-heavy evaluation; plots/registry writes
@@ -369,7 +392,7 @@ def cmd_eval_mcd(args, config) -> int:
                     profile_trace(getattr(args, "profile_dir", None)):
                 result = run_mcd_analysis(
                     model, state.variables(), x, y, patient_ids=ids,
-                    config=config.uq, label=f"CNN_MCD_{label}",
+                    config=uq_config, label=f"CNN_MCD_{label}",
                     seed=config.train.seed,
                     mesh=_mesh(config, num_members=config.uq.mc_passes),
                     detailed=ids is not None and not args.no_detailed,
@@ -383,7 +406,7 @@ def cmd_eval_mcd(args, config) -> int:
                               if args.profile else None),
                 )
             _print_run(result)
-            save_run(registry, result, config=config.uq)
+            save_run(registry, result, config=uq_config)
             _emit_plots(args, result)
     return 0
 
@@ -399,13 +422,14 @@ def cmd_eval_de(args, config) -> int:
     model, member_variables = _restore_members(args, config, args.num_members)
     n_members = len(member_variables)  # resolved count (0 -> all existing)
     _prepared, sets = _load_test_sets(registry)
+    uq_config = _eval_uq_config(args, config)
     with _run(args, "eval-de", config) as run_log:
         for label, (x, y, ids) in sets.items():
             with run_log.stage(f"CNN_DE_{label}", snapshot_memory=True), \
                     profile_trace(getattr(args, "profile_dir", None)):
                 result = run_de_analysis(
                     model, member_variables, x, y, patient_ids=ids,
-                    config=config.uq, label=f"CNN_DE_{label}",
+                    config=uq_config, label=f"CNN_DE_{label}",
                     seed=config.train.seed,
                     mesh=_mesh(config, num_members=n_members),
                     detailed=ids is not None and not args.no_detailed,
@@ -415,7 +439,7 @@ def cmd_eval_de(args, config) -> int:
                               if args.profile else None),
                 )
             _print_run(result)
-            save_run(registry, result, config=config.uq)
+            save_run(registry, result, config=uq_config)
             _emit_plots(args, result)
     return 0
 
@@ -698,6 +722,13 @@ def cmd_telemetry_compare(args) -> int:
             per_metric_threshold=per_metric,
             per_metric_direction=directions,
         )
+    except compare_mod.NoComparableMetrics as e:
+        # A bench_error capture (or an otherwise metric-free source) is a
+        # usage error: exit 2, like lint's bad-input path — never a clean
+        # exit-0 "no regressions" over zero metrics, and distinct from
+        # exit 1 = a real regression.
+        log(f"apnea-uq telemetry compare: {e}")
+        raise SystemExit(2)
     except (FileNotFoundError, ValueError, OSError) as e:
         raise SystemExit(str(e))
     if args.json:
@@ -782,6 +813,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--ckpt-dir", default=None)
     _add_run_dir_arg(p)
     _add_no_detailed_arg(p)
+    _add_full_probs_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
     _add_profile_flag(p)
@@ -796,6 +828,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                         "incl. padded slots promoted by "
                         "EnsembleConfig.keep_padded_members.")
     _add_no_detailed_arg(p)
+    _add_full_probs_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
     _add_profile_flag(p)

@@ -144,6 +144,16 @@ class UQConfig:
     # so HBM-exceeding sets stream through ALL chips.
     mcd_streaming: bool = False
     de_streaming: bool = False
+    # Fused on-device uncertainty reduction (the default): the prediction
+    # programs collapse each chunk's K resident passes/members to the
+    # per-window sufficient statistics (mean, variance, H[E[p]], E[H[p]];
+    # uq/metrics.py) so an eval ships (4, M) floats device->host instead
+    # of the full (K, M) probability matrix — a ~K/4x D2H reduction plus
+    # the dropped whole-set H2D re-upload, with per-window metrics equal
+    # to the full-probs path to <=1e-6 (f32).  False restores the full
+    # (K, M) stack (CLI: --full-probs) for parity work and the
+    # raw-predictions artifact.
+    fused_reduction: bool = True
     # Windows per device chunk.  MCD's T axis multiplies the activation
     # footprint (T x mcd_batch_size rows live at once), so its chunk is
     # smaller; 512 measured fastest at T=50 on a 16-GB v5e chip, where

@@ -26,7 +26,8 @@ WINDOWS = "windows"                            # L1 -> L2: ingested window set (
 TRAIN_STD_SMOTE = "train_std_smote"            # L2 -> L3: balanced training set
 TEST_STD_UNBALANCED = "test_std_unbalanced"    # L2 -> L3/L5: full test set
 TEST_STD_RUS = "test_std_rus"                  # L2 -> L3/L5: RUS-balanced test set
-RAW_PREDICTIONS = "raw_predictions"            # L5 side: (K, M) probability stack
+RAW_PREDICTIONS = "raw_predictions"            # L5 side: (K, M) probability stack (full-probs evals)
+UQ_STATS = "uq_stats"                          # L5 side: (4, M) sufficient statistics (fused evals)
 DETAILED_WINDOWS = "detailed_windows"          # L5 -> L6: per-window CSV
 METRICS = "metrics"                            # L5 side: aggregates/CIs/classification JSON
 PATIENT_SUMMARY = "patient_summary"            # L6 -> L7: per-patient CSV

@@ -15,7 +15,7 @@ from jax.scipy.special import xlogy
 _LN2 = 0.6931471805599453
 
 
-def binary_entropy(p, *, base: str = "nats", eps: float = 1e-10):
+def binary_entropy(p, *, base: str = "nats", eps: float = 1e-10, dtype=None):
     """Entropy of a Bernoulli(p) distribution, elementwise.
 
     ``base='nats'`` matches scipy.stats.entropy on [1-p, p]
@@ -24,7 +24,17 @@ def binary_entropy(p, *, base: str = "nats", eps: float = 1e-10):
 
     Probabilities are clipped to [eps, 1-eps] before the log, mirroring the
     reference's ``safe_entropy`` clipping (uq_techniques.py:37).
+
+    ``dtype`` promotes ``p`` before the clip/log: a sub-float32 input
+    (bf16 probabilities from a ``compute_dtype='bfloat16'`` model) would
+    otherwise flush 1-eps to 1.0 and evaluate the transcendental at ~3
+    significant digits — the fused on-device reduction passes
+    ``dtype=jnp.float32`` so its accumulation precision never depends on
+    the model's compute dtype.
     """
+    p = jnp.asarray(p)
+    if dtype is not None:
+        p = p.astype(dtype)
     p = jnp.clip(p, eps, 1.0 - eps)
     # xlogy gives 0*log(0) = 0, which matters in float32 where 1-eps can
     # round to exactly 1.0 for eps below the float32 ulp.

@@ -34,6 +34,14 @@ from apnea_uq_tpu.telemetry.runlog import (EVENTS_FILENAME, latest_run,
 DEFAULT_THRESHOLD_PCT = 5.0
 
 
+class NoComparableMetrics(ValueError):
+    """A source parsed cleanly but carries nothing gateable — e.g. a
+    ``bench_error`` capture (a run that never measured anything).  The
+    CLI maps this to the usage-error exit code (2), distinct from exit 1
+    = a real regression: a gate fed an error capture must fail the
+    *invocation*, never report a clean pass over zero metrics."""
+
+
 @dataclasses.dataclass
 class Metric:
     """One comparable scalar: name, value, direction."""
@@ -90,11 +98,19 @@ def unit_direction(unit: Optional[str]) -> bool:
 def _metrics_from_bench_doc(doc: Dict[str, Any]) -> Dict[str, Metric]:
     """The driver-schema blocks of one BENCH_r*.json line: primary +
     optional secondary metric values and their vs_baseline speedups.
-    A BENCH_PROGRESS_FILE capture wraps the same blocks as
-    ``{"primary": {...}, "secondary": {...}}`` — unwrap it, so the
-    printed line and the crash-surviving progress file gate identically
-    (extracting only the secondary from the wrapper would silently pass
-    a regressed primary)."""
+    Two wrappers are unwrapped first: a BENCH_PROGRESS_FILE capture's
+    ``{"primary": {...}, "secondary": {...}}``, and the watch/driver
+    capture shape that stores the parsed stdout line under ``"parsed"``
+    (the repo's archived BENCH_r*.json files) — in both cases the
+    wrapped blocks must gate exactly like the printed line (extracting
+    only part of a wrapper would silently pass a regressed metric).
+
+    ``bench_error`` records (the give-up line every failed capture
+    prints: value 0, unit "error") are NOT metrics — comparing two of
+    them would "pass" on the constant zero — so they are skipped here
+    and surface upstream as :class:`NoComparableMetrics`."""
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
     if isinstance(doc.get("primary"), dict):
         merged = dict(doc["primary"])
         if "secondary" not in merged and isinstance(doc.get("secondary"),
@@ -106,6 +122,8 @@ def _metrics_from_bench_doc(doc: Dict[str, Any]) -> Dict[str, Metric]:
     def block(d: Dict[str, Any]) -> None:
         name = d.get("metric")
         if not name or d.get("value") is None:
+            return
+        if name == "bench_error" or d.get("unit") == "error":
             return
         unit = d.get("unit")
         out[name] = Metric(name, float(d["value"]), unit,
@@ -143,10 +161,19 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
             name = f"{e.get('metric', 'bench')}.windows_per_s"
             out[name] = Metric(name, float(e["windows_per_s"]),
                                "windows/sec", True)
-        elif kind == "eval_predict" and e.get("windows_per_s"):
-            name = f"eval.{e.get('label', '?')}.windows_per_s"
-            out[name] = Metric(name, float(e["windows_per_s"]),
-                               "windows/sec", True)
+        elif kind == "eval_predict":
+            if e.get("windows_per_s"):
+                name = f"eval.{e.get('label', '?')}.windows_per_s"
+                out[name] = Metric(name, float(e["windows_per_s"]),
+                                   "windows/sec", True)
+            if e.get("d2h_bytes") is not None:
+                # Estimated device->host result volume of the predict —
+                # the fused-reduction win (bytes: lower is better), so a
+                # future change that silently re-inflates the transfer
+                # gates like any other regression.
+                name = f"eval.{e.get('label', '?')}.d2h_bytes"
+                out[name] = Metric(name, float(e["d2h_bytes"]), "bytes",
+                                   False)
         elif kind == "memory_profile" and e.get("peak_bytes") is not None:
             name = f"memory.{e.get('label', '?')}.peak_bytes"
             out[name] = Metric(name, float(e["peak_bytes"]), "bytes",
@@ -165,16 +192,36 @@ def load_metrics(path: str) -> Dict[str, Metric]:
                 f"telemetry run directory"
             )
         events, _earlier = latest_run(events)
-        return _metrics_from_events(events)
+        metrics = _metrics_from_events(events)
+        if not metrics:
+            # Same contract as the bench-JSON branch: a source with
+            # nothing gateable is a usage error, never a clean pass
+            # (nor a spurious exit-1 "regression" from the no-common-
+            # metrics check downstream).
+            raise NoComparableMetrics(
+                f"no comparable metrics in source {path!r}: the run's "
+                f"events carry no bench/eval throughput, d2h, or "
+                f"memory-peak metrics"
+            )
+        return metrics
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
         raise ValueError(f"{path!r} is not a bench JSON object")
     metrics = _metrics_from_bench_doc(doc)
     if not metrics:
-        raise ValueError(
-            f"{path!r} carries no driver-schema metric blocks "
-            f"(expected 'metric' + 'value' fields)"
+        inner = doc.get("parsed") if isinstance(doc.get("parsed"),
+                                                dict) else doc
+        detail = (
+            "its payload is a bench_error record — the capture failed "
+            "before measuring anything"
+            if isinstance(inner, dict)
+            and (inner.get("metric") == "bench_error"
+                 or inner.get("unit") == "error")
+            else "expected driver-schema 'metric' + 'value' blocks"
+        )
+        raise NoComparableMetrics(
+            f"no comparable metrics in source {path!r}: {detail}"
         )
     return metrics
 

@@ -242,12 +242,19 @@ def summarize_events(run_dir: str,
         lines.append("evals:")
         for e in evals:
             wps = e.get("windows_per_s")
-            lines.append(
+            line = (
                 f"  {e.get('label')}: {e.get('n_passes')}x"
                 f"{e.get('n_windows')} windows in "
                 f"{_fmt(e.get('predict_s'), 3)}s"
                 f" ({_fmt(wps, 1)} windows/s)"
             )
+            # Runs predating the fused reduction carry neither field;
+            # render their lines unchanged.
+            if e.get("fused") is not None:
+                d2h = e.get("d2h_bytes")
+                line += (f" [{'fused' if e['fused'] else 'full-probs'}"
+                         f", d2h {_mb(d2h)} MiB]")
+            lines.append(line)
 
     mems = _section(events, "memory_profile", _MEMORY_PROFILE_FIELDS)
     if mems:
@@ -340,7 +347,7 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
             "lockstep_epochs", "wasted_member_epochs")),
         "evals": section("eval_predict", (
             "label", "method", "n_passes", "n_windows", "predict_s",
-            "windows_per_s")),
+            "windows_per_s", "fused", "d2h_bytes")),
         "memory_profiles": section("memory_profile",
                                    _MEMORY_PROFILE_FIELDS),
         "memory_snapshots": section("memory_snapshot",

@@ -7,7 +7,9 @@ from apnea_uq_tpu.uq.drivers import (
     UQEvaluation,
     UQRunResult,
     detailed_frame,
+    detailed_frame_from_stats,
     evaluate_uq,
+    evaluate_uq_from_stats,
     run_de_analysis,
     run_mcd_analysis,
     run_metrics_document,
@@ -15,7 +17,11 @@ from apnea_uq_tpu.uq.drivers import (
     save_run,
     save_run_plots,
 )
-from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
+from apnea_uq_tpu.uq.metrics import (
+    decompose_from_stats,
+    sufficient_stats,
+    uq_evaluation_dist,
+)
 from apnea_uq_tpu.uq.predict import (
     ensemble_predict,
     ensemble_predict_streaming,
@@ -25,6 +31,10 @@ from apnea_uq_tpu.uq.predict import (
 
 __all__ = [
     "uq_evaluation_dist",
+    "sufficient_stats",
+    "decompose_from_stats",
+    "evaluate_uq_from_stats",
+    "detailed_frame_from_stats",
     "bootstrap_aggregates",
     "bootstrap_metrics",
     "compute_confidence_intervals",

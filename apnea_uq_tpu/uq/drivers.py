@@ -40,7 +40,13 @@ from apnea_uq_tpu.evaluation.classification import evaluate_classification
 from apnea_uq_tpu.ops.entropy import binary_entropy
 from apnea_uq_tpu.training.trainer import predict_proba_batched
 from apnea_uq_tpu.uq.bootstrap import bootstrap_aggregates, compute_confidence_intervals
-from apnea_uq_tpu.uq.metrics import uq_evaluation_dist
+from apnea_uq_tpu.uq.metrics import (
+    N_STAT_ROWS,
+    STAT_MEAN,
+    STAT_VARIANCE,
+    decompose_from_stats,
+    uq_evaluation_dist,
+)
 from apnea_uq_tpu.uq.predict import (
     as_stacked_members,
     ensemble_predict,
@@ -80,43 +86,38 @@ class UQEvaluation:
 
 @dataclasses.dataclass
 class UQRunResult:
-    """One driver run on one test set."""
+    """One driver run on one test set.
+
+    A fused run (``UQConfig.fused_reduction``, the default) never
+    materializes the (K, M) probability matrix on host: ``predictions``
+    is None and ``stats`` carries the (4, M) sufficient-statistics stack
+    the decomposition (and the detailed frame) derive from.  A
+    full-probs run (``--full-probs``) is the converse."""
 
     label: str
-    predictions: np.ndarray               # (K, M) probability stack
+    predictions: Optional[np.ndarray]     # (K, M) probability stack (full-probs runs)
     evaluation: UQEvaluation
     detailed: Optional[pd.DataFrame]      # reference detailed-CSV schema
     classification: Dict                  # stochastic-mean-prob metric suite
     deterministic_classification: Optional[Dict]  # eval-mode sanity check
     predict_seconds: float
     y_true: Optional[np.ndarray] = None   # (M,) labels (for per-class plots)
+    stats: Optional[np.ndarray] = None    # (4, M) sufficient stats (fused runs)
+    fused: bool = False
 
 
-def evaluate_uq(
-    predictions,
-    y_true,
-    config: UQConfig = UQConfig(),
-    *,
-    key: Optional[jax.Array] = None,
-    base: str = "nats",
-) -> UQEvaluation:
-    """Metric aggregates + bootstrap CIs from a (K, M) prediction stack.
-
-    One fused on-device computation replacing evaluate_uq_methods'
-    host-NumPy metric pass + B×(metric pass) bootstrap loop
-    (uq_techniques.py:323,341-346).
-    """
-    predictions = np.asarray(predictions)
-    if predictions.ndim == 3 and predictions.shape[-1] == 1:
-        predictions = predictions[..., 0]
-    metrics = uq_evaluation_dist(predictions, y_true, base=base, eps=config.entropy_eps)
+def _finish_evaluation(metrics, y_true, config: UQConfig,
+                       n_passes: int, n_windows: int,
+                       key: Optional[jax.Array]) -> UQEvaluation:
+    """Metric dict -> bootstrap CIs + host aggregates: the shared back
+    half of :func:`evaluate_uq` and :func:`evaluate_uq_from_stats` (the
+    bootstrap consumes only the per-window metric vectors, never the
+    (K, M) stack, so both routes feed it identically)."""
     boot = bootstrap_aggregates(
-        predictions,
+        None,
         y_true,
         n_bootstrap=config.n_bootstrap,
         key=key,
-        base=base,
-        eps=config.entropy_eps,
         metrics=metrics,
         engine=config.bootstrap_engine,
     )
@@ -142,17 +143,58 @@ def evaluate_uq(
             "mutual_info",
         )
     }
-    k_passes, m = (
-        predictions.shape if predictions.ndim >= 2 else (1, predictions.shape[0])
-    )
     return UQEvaluation(
         aggregates=aggregates,
         confidence_intervals=compute_confidence_intervals(
             boot, alpha=config.bootstrap_alpha
         ),
         per_window=per_window,
-        n_passes=int(k_passes),
-        n_windows=int(m),
+        n_passes=int(n_passes),
+        n_windows=int(n_windows),
+    )
+
+
+def evaluate_uq(
+    predictions,
+    y_true,
+    config: UQConfig = UQConfig(),
+    *,
+    key: Optional[jax.Array] = None,
+    base: str = "nats",
+) -> UQEvaluation:
+    """Metric aggregates + bootstrap CIs from a (K, M) prediction stack.
+
+    One fused on-device computation replacing evaluate_uq_methods'
+    host-NumPy metric pass + B×(metric pass) bootstrap loop
+    (uq_techniques.py:323,341-346).
+    """
+    predictions = np.asarray(predictions)
+    if predictions.ndim == 3 and predictions.shape[-1] == 1:
+        predictions = predictions[..., 0]
+    metrics = uq_evaluation_dist(predictions, y_true, base=base, eps=config.entropy_eps)
+    k_passes, m = (
+        predictions.shape if predictions.ndim >= 2 else (1, predictions.shape[0])
+    )
+    return _finish_evaluation(metrics, y_true, config, k_passes, m, key)
+
+
+def evaluate_uq_from_stats(
+    stats,
+    y_true,
+    n_passes: int,
+    config: UQConfig = UQConfig(),
+    *,
+    key: Optional[jax.Array] = None,
+) -> UQEvaluation:
+    """Metric aggregates + bootstrap CIs from a (4, M) sufficient-
+    statistics stack (the fused predictors' output).  ``n_passes`` is
+    recorded for provenance only — the statistics already integrated the
+    K axis on device.  Same metric dict, same bootstrap stream, same CI
+    formulas as :func:`evaluate_uq` on the corresponding full stack."""
+    stats = np.asarray(stats)
+    metrics = decompose_from_stats(stats, y_true)
+    return _finish_evaluation(
+        metrics, y_true, config, n_passes, stats.shape[1], key
     )
 
 
@@ -173,8 +215,38 @@ def detailed_frame(
     predictions = np.asarray(predictions)
     if predictions.ndim == 3 and predictions.shape[-1] == 1:
         predictions = predictions[..., 0]
-    mean_prob = predictions.mean(axis=0)
-    variance = predictions.var(axis=0)
+    return _assemble_detailed(
+        predictions.mean(axis=0), predictions.var(axis=0), y_true,
+        patient_ids, threshold,
+    )
+
+
+def detailed_frame_from_stats(
+    stats,
+    y_true,
+    patient_ids=None,
+    *,
+    threshold: float = 0.5,
+) -> pd.DataFrame:
+    """The reference detailed-CSV frame from a (4, M) sufficient-
+    statistics stack: mean and variance are the first two stat rows,
+    and the bits-base entropy column is (in both routes) derived from
+    the mean probability — so a fused run's CSV matches a full-probs
+    run's to float32 rounding."""
+    stats = np.asarray(stats)
+    if stats.ndim != 2 or stats.shape[0] != N_STAT_ROWS:
+        raise ValueError(
+            f"expected ({N_STAT_ROWS}, M) sufficient statistics, got "
+            f"shape {stats.shape}"
+        )
+    return _assemble_detailed(
+        stats[STAT_MEAN], stats[STAT_VARIANCE], y_true, patient_ids,
+        threshold,
+    )
+
+
+def _assemble_detailed(mean_prob, variance, y_true, patient_ids,
+                       threshold: float) -> pd.DataFrame:
     entropy = np.asarray(
         binary_entropy(
             mean_prob, base=DETAILED_ENTROPY_BASE, eps=DETAILED_ENTROPY_EPS
@@ -193,9 +265,9 @@ def detailed_frame(
         COL_PATIENT: patient_ids,
         COL_WINDOW: np.arange(m),
         COL_TRUE_LABEL: y_true.astype(np.int64),
-        COL_PRED_LABEL: (mean_prob > threshold).astype(np.int64),
-        COL_PROB: mean_prob.astype(np.float64),
-        COL_VARIANCE: variance.astype(np.float64),
+        COL_PRED_LABEL: (np.asarray(mean_prob) > threshold).astype(np.int64),
+        COL_PROB: np.asarray(mean_prob, np.float64),
+        COL_VARIANCE: np.asarray(variance, np.float64),
         COL_ENTROPY: entropy.astype(np.float64),
     })
 
@@ -210,11 +282,20 @@ def _member_count(member_variables) -> int:
 
 
 def _measured_predict(label: str, method: str, predict, n_windows: int,
-                      n_passes: int, run_log):
+                      n_passes: int, run_log, *, fused: bool = False):
     """Run one predictor thunk under StepMetrics: device-bounded predict
     seconds (``block_until_ready``, not dispatch return), windows/sec,
     and retrace/compile deltas; emits an ``eval_predict`` event when a
-    run log is attached.  Returns (predictions, predict_seconds)."""
+    run log is attached.  The event carries ``fused`` and a ``d2h_bytes``
+    estimate — result rows x windows x 4 bytes (f32): the LOGICAL
+    prediction-result payload, 4 stat rows fused vs K probability rows
+    full, so the ~K/4x reduction is a gateable telemetry number, not
+    prose.  It is a lower bound on the wire transfer: streamed paths
+    also fetch the wrap-padded window columns (and, on full-probs mesh
+    DE, the padded member rows) that are sliced off on host — padding
+    overhead is a constant factor of the same rows, so the fused/full
+    ratio it gates is unaffected.  Returns (predictions,
+    predict_seconds)."""
     metrics = StepMetrics(run_log)
     with telemetry_trace.annotate(f"{label}.predict"):
         predictions = metrics.measure(
@@ -222,6 +303,7 @@ def _measured_predict(label: str, method: str, predict, n_windows: int,
         )
     record = metrics.last
     if run_log is not None:
+        result_rows = N_STAT_ROWS if fused else int(n_passes)
         run_log.event(
             "eval_predict",
             label=label,
@@ -234,13 +316,15 @@ def _measured_predict(label: str, method: str, predict, n_windows: int,
                            if record.items_per_s is not None else None),
             retraces=record.retraces,
             backend_compiles=record.backend_compiles,
+            fused=bool(fused),
+            d2h_bytes=result_rows * int(n_windows) * 4,
         )
     return predictions, record.device_s
 
 
 def _run_common(
     label: str,
-    predictions: np.ndarray,
+    predictions: Optional[np.ndarray],
     y_true,
     patient_ids,
     config: UQConfig,
@@ -248,8 +332,23 @@ def _run_common(
     predict_seconds: float,
     detailed: bool,
     bootstrap_key: Optional[jax.Array],
+    *,
+    stats: Optional[np.ndarray] = None,
+    n_passes: Optional[int] = None,
 ) -> UQRunResult:
-    evaluation = evaluate_uq(predictions, y_true, config, key=bootstrap_key)
+    """Shared metric/CSV/classification pipeline.  Exactly one of
+    ``predictions`` ((K, M) full probabilities) and ``stats`` ((4, M)
+    fused sufficient statistics, with ``n_passes`` for provenance) is
+    given; everything downstream of the decomposition is identical."""
+    if (predictions is None) == (stats is None):
+        raise ValueError("pass exactly one of predictions / stats")
+    if stats is not None:
+        evaluation = evaluate_uq_from_stats(
+            stats, y_true, n_passes, config, key=bootstrap_key
+        )
+    else:
+        evaluation = evaluate_uq(predictions, y_true, config,
+                                 key=bootstrap_key)
     mean_prob = evaluation.per_window["mean_pred"]
     classification = evaluate_classification(
         mean_prob, y_true,
@@ -265,13 +364,18 @@ def _run_common(
             threshold=config.decision_threshold,
             description=f"{label} (deterministic)",
         )
-    frame = (
-        detailed_frame(
-            predictions, y_true, patient_ids, threshold=config.decision_threshold
-        )
-        if detailed
-        else None
-    )
+    frame = None
+    if detailed:
+        if stats is not None:
+            frame = detailed_frame_from_stats(
+                stats, y_true, patient_ids,
+                threshold=config.decision_threshold,
+            )
+        else:
+            frame = detailed_frame(
+                predictions, y_true, patient_ids,
+                threshold=config.decision_threshold,
+            )
     return UQRunResult(
         label=label,
         predictions=predictions,
@@ -281,6 +385,8 @@ def _run_common(
         deterministic_classification=det,
         predict_seconds=predict_seconds,
         y_true=np.asarray(y_true).reshape(-1),
+        stats=stats,
+        fused=stats is not None,
     )
 
 
@@ -342,6 +448,14 @@ def run_mcd_analysis(
             " the mesh's data axis divides for exact parity.",
             stacklevel=2,
         )
+    # Fused reduction (the default): the chunked prediction programs emit
+    # the (4, M) per-window sufficient statistics instead of the (K, M)
+    # probability matrix — the K axis never leaves the device, and the
+    # decomposition below consumes the stats directly (no probability
+    # re-upload).  The entropy base/eps baked into the on-device stats
+    # are exactly what evaluate_uq would apply host-side.
+    stat_spec = ("nats", config.entropy_eps) if config.fused_reduction else None
+
     def predict(record_memory_only=False):
         if config.mcd_streaming:
             # Host-streamed chunks for sets that exceed HBM; identical
@@ -357,6 +471,7 @@ def run_mcd_analysis(
                 mesh=mesh,
                 run_log=run_log,
                 record_memory_only=record_memory_only,
+                stats=stat_spec,
             )
         return mc_dropout_predict(
             model, variables, x,
@@ -367,6 +482,7 @@ def run_mcd_analysis(
             mesh=mesh,
             run_log=run_log,
             record_memory_only=record_memory_only,
+            stats=stat_spec,
         )
 
     if run_log is not None:
@@ -380,7 +496,8 @@ def run_mcd_analysis(
     # here keeps the pre-pass AOT compile out of the trace artifact.
     with profiler if profiler is not None else contextlib.nullcontext():
         predictions, predict_seconds = _measured_predict(
-            label, "mcd", predict, len(x), config.mc_passes, run_log
+            label, "mcd", predict, len(x), config.mc_passes, run_log,
+            fused=stat_spec is not None,
         )
     det_probs = (
         _host_predictions(predict_proba_batched(
@@ -390,9 +507,14 @@ def run_mcd_analysis(
         if sanity_check
         else None
     )
+    fetched = _host_predictions(predictions)
     return _run_common(
-        label, _host_predictions(predictions), y_true, patient_ids, config,
+        label,
+        None if stat_spec is not None else fetched,
+        y_true, patient_ids, config,
         det_probs, predict_seconds, detailed, bootstrap_key,
+        stats=fetched if stat_spec is not None else None,
+        n_passes=config.mc_passes,
     )
 
 
@@ -429,6 +551,12 @@ def run_de_analysis(
                          "got an empty window set")
     if bootstrap_key is None:
         bootstrap_key = prng.bootstrap_key(seed)
+    # Fused reduction (see run_mcd_analysis): members integrate on device
+    # into (4, M) sufficient statistics — duplicate wrap-padded members
+    # are excluded inside the jit on mesh paths.
+    stat_spec = ("nats", config.entropy_eps) if config.fused_reduction else None
+    n_members = _member_count(member_variables)
+
     def predict(record_memory_only=False):
         if config.de_streaming:
             return ensemble_predict_streaming(
@@ -437,6 +565,7 @@ def run_de_analysis(
                 mesh=mesh,
                 run_log=run_log,
                 record_memory_only=record_memory_only,
+                stats=stat_spec,
             )
         return ensemble_predict(
             model, member_variables, x,
@@ -444,6 +573,7 @@ def run_de_analysis(
             mesh=mesh,
             run_log=run_log,
             record_memory_only=record_memory_only,
+            stats=stat_spec,
         )
 
     if run_log is not None:
@@ -452,12 +582,17 @@ def run_de_analysis(
         predict(record_memory_only=True)
     with profiler if profiler is not None else contextlib.nullcontext():
         predictions, predict_seconds = _measured_predict(
-            label, "de", predict, len(x), _member_count(member_variables),
-            run_log,
+            label, "de", predict, len(x), n_members, run_log,
+            fused=stat_spec is not None,
         )
+    fetched = _host_predictions(predictions)
     return _run_common(
-        label, _host_predictions(predictions), y_true, patient_ids, config,
+        label,
+        None if stat_spec is not None else fetched,
+        y_true, patient_ids, config,
         None, predict_seconds, detailed, bootstrap_key,
+        stats=fetched if stat_spec is not None else None,
+        n_passes=n_members,
     )
 
 
@@ -547,6 +682,7 @@ def run_metrics_document(result: UQRunResult) -> Dict:
         "n_passes": ev.n_passes,
         "n_windows": ev.n_windows,
         "predict_seconds": result.predict_seconds,
+        "fused": bool(result.fused),
         "aggregates": dict(ev.aggregates),
         "confidence_intervals": dict(ev.confidence_intervals),
         "classification": dict(result.classification),
@@ -562,19 +698,28 @@ def save_run(registry, result: UQRunResult, *, config=None) -> Dict[str, str]:
     """Persist a run's artifacts under canonical registry keys.
 
     raw predictions -> ``raw_predictions:<label>`` (the reference's
-    mc_raw_pred*.npy dump, analyze_mcd_patient_level.py:100), the
-    detailed frame -> ``detailed_windows:<label>`` (the L5->L6 CSV), and
-    the scalar results -> ``metrics:<label>`` (JSON: aggregates, CIs,
-    classification suite).
+    mc_raw_pred*.npy dump, analyze_mcd_patient_level.py:100; full-probs
+    runs only — a fused run never materializes the (K, M) stack, so it
+    saves its (4, M) sufficient statistics as ``uq_stats:<label>``
+    instead), the detailed frame -> ``detailed_windows:<label>`` (the
+    L5->L6 CSV), and the scalar results -> ``metrics:<label>`` (JSON:
+    aggregates, CIs, classification suite).
     """
     from apnea_uq_tpu.data import registry as reg
 
     paths = {}
-    paths["raw_predictions"] = registry.save_arrays(
-        f"{reg.RAW_PREDICTIONS}:{result.label}",
-        {"predictions": result.predictions},
-        config=config,
-    )
+    if result.predictions is not None:
+        paths["raw_predictions"] = registry.save_arrays(
+            f"{reg.RAW_PREDICTIONS}:{result.label}",
+            {"predictions": result.predictions},
+            config=config,
+        )
+    if result.stats is not None:
+        paths["uq_stats"] = registry.save_arrays(
+            f"{reg.UQ_STATS}:{result.label}",
+            {"stats": result.stats},
+            config=config,
+        )
     if result.detailed is not None:
         paths["detailed_windows"] = registry.save_table(
             f"{reg.DETAILED_WINDOWS}:{result.label}", result.detailed, config=config

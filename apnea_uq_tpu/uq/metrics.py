@@ -29,18 +29,74 @@ import jax.numpy as jnp
 
 from apnea_uq_tpu.ops.entropy import binary_entropy
 
+# Per-window sufficient-statistic rows of the decomposition: everything
+# downstream (mutual information, the aggregate dict, the bootstrap) is a
+# pure function of these four vectors — the K axis never needs to leave
+# the device.  The fused predictors (uq/predict.py) emit exactly this
+# stack per chunk, so an eval ships (4, M) floats device->host instead of
+# the full (K, M) probability matrix.
+STAT_MEAN, STAT_VARIANCE, STAT_TOTAL, STAT_ALEATORIC = range(4)
+N_STAT_ROWS = 4
 
-@partial(jax.jit, static_argnames=("base",))
-def _uq_core(predictions: jax.Array, y_true: jax.Array, base: str, eps: float) -> Dict[str, jax.Array]:
-    predictions = predictions.astype(jnp.float32)
-    mean_pred = jnp.mean(predictions, axis=0)          # (M,)
-    pred_variance = jnp.var(predictions, axis=0)       # (M,) population variance, np.var parity
-    total = binary_entropy(mean_pred, base=base, eps=eps)               # H[E[p]]
-    aleatoric = jnp.mean(binary_entropy(predictions, base=base, eps=eps), axis=0)  # E[H[p]]
+
+def sufficient_stats(predictions: jax.Array, *, base: str = "nats",
+                     eps: float = 1e-10) -> jax.Array:
+    """(K, n) probabilities -> the (4, n) per-window sufficient statistics
+    [mean, population variance, H[E[p]], E[H[p]]].  Traceable; accumulates
+    in float32 regardless of the input dtype (bf16 probabilities under
+    ``compute_dtype='bfloat16'`` must not lose the K-axis reduction
+    precision).  This IS the first half of :func:`_uq_core` — the full
+    and fused paths share it, so their per-window values agree by
+    construction, not by keeping two formula copies in sync."""
+    p = predictions.astype(jnp.float32)
+    mean_pred = jnp.mean(p, axis=0)
+    pred_variance = jnp.var(p, axis=0)   # population variance, np.var parity
+    total = binary_entropy(mean_pred, base=base, eps=eps,
+                           dtype=jnp.float32)                  # H[E[p]]
+    aleatoric = jnp.mean(binary_entropy(p, base=base, eps=eps,
+                                        dtype=jnp.float32), axis=0)  # E[H[p]]
+    return jnp.stack([mean_pred, pred_variance, total, aleatoric])
+
+
+def _decompose(stats: jax.Array, y_true: jax.Array) -> Dict[str, jax.Array]:
+    """(4, M) sufficient statistics -> the full metric dict (traceable)."""
+    stats = stats.astype(jnp.float32)
+    mean_pred = stats[STAT_MEAN]
+    pred_variance = stats[STAT_VARIANCE]
+    total = stats[STAT_TOTAL]
+    aleatoric = stats[STAT_ALEATORIC]
     mutual_info = jnp.maximum(total - aleatoric, 0.0)  # uq_techniques.py:91
     return _aggregate(
         mean_pred, pred_variance, total, aleatoric, mutual_info, y_true
     )
+
+
+@jax.jit
+def decompose_from_stats(stats, y_true) -> Dict[str, jax.Array]:
+    """Metric dict from a (4, M) sufficient-statistics stack (the fused
+    predictors' output).  Produces the exact dict :func:`uq_evaluation_dist`
+    returns for the full (K, M) stack — same ``_aggregate``, same keys —
+    because both routes run :func:`_decompose` on :func:`sufficient_stats`
+    output; only where the stats are computed differs (per device chunk
+    vs. one whole-set reduction)."""
+    stats = jnp.asarray(stats)
+    if stats.ndim != 2 or stats.shape[0] != N_STAT_ROWS:
+        raise ValueError(
+            f"expected ({N_STAT_ROWS}, M) sufficient statistics, got "
+            f"shape {stats.shape}"
+        )
+    y_true = jnp.asarray(y_true)
+    if y_true.shape[0] != stats.shape[1]:
+        raise ValueError(
+            f"labels ({y_true.shape[0]}) do not match stat windows "
+            f"({stats.shape[1]})"
+        )
+    return _decompose(stats, y_true)
+
+
+@partial(jax.jit, static_argnames=("base",))
+def _uq_core(predictions: jax.Array, y_true: jax.Array, base: str, eps: float) -> Dict[str, jax.Array]:
+    return _decompose(sufficient_stats(predictions, base=base, eps=eps), y_true)
 
 
 @jax.jit

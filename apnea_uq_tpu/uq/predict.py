@@ -28,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
 from apnea_uq_tpu.parallel import mesh as mesh_lib
 from apnea_uq_tpu.telemetry import memory as telemetry_memory
+from apnea_uq_tpu.uq.metrics import N_STAT_ROWS, sufficient_stats
 from apnea_uq_tpu.utils import prng
 
 # jax exports shard_map at top level from 0.5; on 0.4.x it lives under
@@ -38,6 +39,18 @@ else:
     from jax.experimental.shard_map import shard_map as _shard_map
 
 _MCD_MODES = {"clean": "mcd_clean", "parity": "mcd_parity"}
+
+
+def _uq_stats(probs: jax.Array, base: str, eps: float) -> jax.Array:
+    """(K, n) chunk probabilities -> (4, n) fused per-window sufficient
+    statistics (uq/metrics.py), reduced on device in float32.  Because the
+    statistics are per-window functions of the K resident passes/members,
+    computing them per chunk equals computing them on the assembled
+    (K, M) matrix — wrap-padded window columns produce padded stat
+    columns that the callers slice off exactly as they slice padded
+    probability columns."""
+    with jax.named_scope("uq_stats"):
+        return sufficient_stats(probs, base=base, eps=eps)
 
 
 def _constrain(a: jax.Array, mesh, *axes: Optional[str]) -> jax.Array:
@@ -134,15 +147,67 @@ def _mcd_chunk_jit(model, variables, chunk, key, chunk_idx, n_passes, mode,
     return _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("model", "n_passes", "mode", "batch_size", "base",
+                     "mesh"),
+)
+def _mcd_stats_jit(model, variables, x, key, n_passes, mode, batch_size,
+                   base, eps, mesh=None):
+    """Fused in-HBM MCD program: same chunked T-pass body as
+    :func:`_mcd_jit` (same keys, same masks, same sharding), but each
+    chunk's (T, bs) probabilities collapse on device to the (4, bs)
+    sufficient statistics before ``lax.map`` stacks them — so the
+    program's output (and its D2H cost) is (4, M) instead of (T, M),
+    and the (chunks, T, bs) probability stack never materializes in
+    HBM at all."""
+    keys = jax.random.split(key, n_passes)
+    chunks, m = _chunk(x, batch_size)
+    chunks = _constrain(chunks, mesh, None, mesh_lib.AXIS_DATA)
+
+    def one_chunk(args):
+        with jax.named_scope("mcd_chunk"):
+            chunk, chunk_idx = args
+            probs = _mcd_passes(model, variables, chunk, keys, chunk_idx,
+                                mode, mesh)
+            return _constrain(_uq_stats(probs, base, eps), mesh, None,
+                              mesh_lib.AXIS_DATA)
+
+    stats = jax.lax.map(
+        one_chunk, (chunks, jnp.arange(chunks.shape[0]))
+    )                                                 # (chunks, 4, bs)
+    stats = jnp.transpose(stats, (1, 0, 2)).reshape(N_STAT_ROWS, -1)
+    return stats[:, :m]
+
+
+@partial(jax.jit,
+         static_argnames=("model", "n_passes", "mode", "base", "mesh"))
+def _mcd_chunk_stats_jit(model, variables, chunk, key, chunk_idx, n_passes,
+                         mode, base, eps, mesh=None):
+    """Fused streamed unit of work: all T passes of ONE chunk
+    (:func:`_mcd_chunk_jit`'s exact body — same key discipline, same
+    sharding) reduced on device to the chunk's (4, bs) sufficient
+    statistics, so the per-chunk D2H fetch shrinks from T rows to 4."""
+    keys = jax.random.split(key, n_passes)
+    probs = _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh)
+    return _constrain(_uq_stats(probs, base, eps), mesh, None,
+                      mesh_lib.AXIS_DATA)
+
+
 def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute,
                     sharding=None):
     """Shared host-streamed chunk loop: wrap-padded chunks flow through
     the prefetch feed, ``compute(chunk, ci) -> (n_rows, bs)`` runs on
-    device, and a one-deep result queue overlaps each chunk's D2H fetch
-    with the next chunk's compute.  Returns the (n_rows, M) assembly.
-    ``sharding`` places each chunk directly onto a mesh (window axis over
-    ``data``), so the H2D transfer lands shard-wise instead of bouncing
-    through one device."""
+    device (``n_rows`` = the stacked output rows: T passes / N members
+    for full probabilities, ``N_STAT_ROWS`` for fused sufficient
+    statistics), and a bounded result queue — up to ``prefetch`` pending
+    chunks, matching the feed depth — overlaps each chunk's D2H fetch
+    with the following chunks' compute.  Returns the (n_rows, M)
+    assembly.  ``sharding`` places each chunk directly onto a mesh
+    (window axis over ``data``), so the H2D transfer lands shard-wise
+    instead of bouncing through one device."""
+    import collections
+
     import numpy as np
 
     from apnea_uq_tpu.data.feed import prefetch_to_device
@@ -158,22 +223,29 @@ def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute,
             yield x[rows]
 
     out = np.empty((n_rows, n_chunks * batch_size), np.float32)
-    pending = None
+
+    def fetch(pending) -> None:
+        pci, p = pending
+        out[:, pci * batch_size:(pci + 1) * batch_size] = host_values(p)
+
+    # The result queue depth follows the feed depth: with prefetch chunks
+    # in flight on the H2D side, up to the same number of dispatched
+    # results stay un-fetched on the D2H side, so fetch overlap scales
+    # with the pipeline instead of being pinned at one pending chunk.
     # Chunk results come back through the multi-process-safe fetch: on a
     # process-spanning mesh each per-chunk output is not fully addressable
     # and a bare np.asarray would raise.  All processes run this loop in
     # lockstep (same chunks, same order), which host_values requires.
+    depth = max(1, int(prefetch))
+    pending: collections.deque = collections.deque()
     for ci, chunk in enumerate(
         prefetch_to_device(chunks(), size=prefetch, sharding=sharding)
     ):
-        probs = compute(chunk, ci)
-        if pending is not None:
-            pci, p = pending
-            out[:, pci * batch_size:(pci + 1) * batch_size] = host_values(p)
-        pending = (ci, probs)
-    if pending is not None:
-        pci, p = pending
-        out[:, pci * batch_size:(pci + 1) * batch_size] = host_values(p)
+        pending.append((ci, compute(chunk, ci)))
+        if len(pending) > depth:
+            fetch(pending.popleft())
+    while pending:
+        fetch(pending.popleft())
     return out[:, :m]
 
 
@@ -222,6 +294,7 @@ def mc_dropout_predict_streaming(
     mesh: Optional[jax.sharding.Mesh] = None,
     run_log=None,
     record_memory_only: bool = False,
+    stats=None,
 ) -> "np.ndarray":
     """(T, M) MCD probabilities with the window set streamed from HOST
     memory: chunks flow through the double-buffered prefetch feed
@@ -233,6 +306,12 @@ def mc_dropout_predict_streaming(
     :func:`mc_dropout_predict` for the same key and ``mesh`` — both
     paths chunk at :func:`effective_batch_size`, so toggling
     streaming never changes predictions.
+
+    ``stats=(entropy_base, eps)`` switches to the fused reduction: each
+    chunk's T resident passes collapse on device to the per-window
+    sufficient statistics (uq/metrics.py) and the return value is the
+    ``(N_STAT_ROWS, M)`` stack — the per-chunk D2H fetch shrinks from T
+    rows to 4 while the stochastic passes themselves are unchanged.
 
     ``mesh`` composes both scaling axes: each streamed chunk's T passes
     shard over ``ensemble`` and its windows over ``data`` (the same
@@ -250,6 +329,25 @@ def mc_dropout_predict_streaming(
         batch_size = effective_batch_size(batch_size, mesh)
         repl = mesh_lib.replicated(mesh)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
+    # ONE (label, fn, per-chunk args) definition drives both the memory
+    # pricing and the streamed dispatch, so the priced program cannot
+    # drift from the executed one.
+    if stats is not None:
+        base, eps = stats
+        eps = float(eps)
+        label, fn, n_rows = ("mcd_chunk_predict_fused", _mcd_chunk_stats_jit,
+                             N_STAT_ROWS)
+
+        def chunk_args(chunk, ci):
+            return (model, variables, chunk, key, ci, n_passes,
+                    _MCD_MODES[mode], base, eps, mesh)
+    else:
+        label, fn, n_rows = "mcd_chunk_predict", _mcd_chunk_jit, n_passes
+
+        def chunk_args(chunk, ci):
+            return (model, variables, chunk, key, ci, n_passes,
+                    _MCD_MODES[mode], mesh)
+
     if run_log is not None:
         # Compiled-HBM accounting of the per-chunk program (one event per
         # signature; telemetry/memory.py): abstract chunk shapes, so the
@@ -257,9 +355,7 @@ def mc_dropout_predict_streaming(
         chunk_aval = jax.ShapeDtypeStruct(
             (batch_size,) + tuple(np.shape(x)[1:]), jnp.float32)
         telemetry_memory.record_jit_memory(
-            run_log, "mcd_chunk_predict", _mcd_chunk_jit,
-            model, variables, chunk_aval, key, 0, n_passes,
-            _MCD_MODES[mode], mesh,
+            run_log, label, fn, *chunk_args(chunk_aval, 0)
         )
     if record_memory_only:
         # The drivers' pre-timing pass: the arg transforms and the
@@ -267,11 +363,8 @@ def mc_dropout_predict_streaming(
         # the AOT compile stays OUT of the measured predict window.
         return None
     return _stream_chunked(
-        x, batch_size, n_passes, prefetch,
-        lambda chunk, ci: _mcd_chunk_jit(
-            model, variables, chunk, key, ci, n_passes, _MCD_MODES[mode],
-            mesh,
-        ),
+        x, batch_size, n_rows, prefetch,
+        lambda chunk, ci: fn(*chunk_args(chunk, ci)),
         sharding=_chunk_sharding(mesh, batch_size),
     )
 
@@ -289,8 +382,16 @@ def mc_dropout_predict(
     mesh: Optional[jax.sharding.Mesh] = None,
     run_log=None,
     record_memory_only: bool = False,
+    stats=None,
 ) -> jax.Array:
     """(T, M) positive-class probabilities from T stochastic passes.
+
+    ``stats=(entropy_base, eps)`` switches to the fused reduction:
+    the same chunked T-pass program reduces each chunk on device to the
+    per-window sufficient statistics (uq/metrics.py ``sufficient_stats``)
+    and returns the ``(N_STAT_ROWS, M)`` stack instead of (T, M) — the
+    K-axis never leaves the device and the (chunks, T, bs) probability
+    stack never materializes in HBM.
 
     ``mesh`` spreads the work over a device mesh — passes over its
     ``ensemble`` axis, windows over ``data`` — replacing the reference's
@@ -343,23 +444,28 @@ def mc_dropout_predict(
         if not record_memory_only:
             x = jax.device_put(x, repl)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
+    # ONE (label, fn, args) tuple drives both the memory pricing and the
+    # dispatch, so the priced program cannot drift from the executed one.
+    if stats is not None:
+        base, eps = stats
+        label, fn = "mcd_predict_fused", _mcd_stats_jit
+        args = (model, variables, x, key, n_passes, _MCD_MODES[mode],
+                batch_size, base, float(eps), mesh)
+    else:
+        label, fn = "mcd_predict", _mcd_jit
+        args = (model, variables, x, key, n_passes, _MCD_MODES[mode],
+                batch_size, mesh)
     if run_log is not None:
         # Compiled-HBM accounting (one memory_profile event per program
         # signature): the whole T-passes-by-chunks program, priced before
         # it dispatches.
-        telemetry_memory.record_jit_memory(
-            run_log, "mcd_predict", _mcd_jit,
-            model, variables, x, key, n_passes, _MCD_MODES[mode],
-            batch_size, mesh,
-        )
+        telemetry_memory.record_jit_memory(run_log, label, fn, *args)
     if record_memory_only:
         # The drivers' pre-timing pass: record the program's HBM price
         # with the exact post-transform args, dispatch nothing — the
         # AOT compile stays OUT of the measured predict window.
         return None
-    return _mcd_jit(
-        model, variables, x, key, n_passes, _MCD_MODES[mode], batch_size, mesh
-    )
+    return fn(*args)
 
 
 def stack_member_variables(member_variables: list) -> dict:
@@ -460,6 +566,65 @@ def _ensemble_chunk_mesh_jit(model, stacked_variables, chunk, mesh):
     return f(stacked_variables, chunk)
 
 
+@partial(jax.jit, static_argnames=("model", "batch_size", "base"))
+def _ensemble_stats_jit(model, stacked_variables, x, batch_size, base, eps):
+    """Fused in-HBM DE program: :func:`_ensemble_jit`'s chunked member
+    vmap with each chunk's (N, bs) probabilities collapsed on device to
+    the (4, bs) sufficient statistics — output (and D2H) is (4, M)."""
+    chunks, m = _chunk(x, batch_size)
+
+    def one_chunk(chunk):
+        probs = _ensemble_chunk_jit.__wrapped__(model, stacked_variables,
+                                                chunk)
+        return _uq_stats(probs, base, eps)
+
+    stats = jax.lax.map(one_chunk, chunks)              # (chunks, 4, bs)
+    stats = jnp.transpose(stats, (1, 0, 2)).reshape(N_STAT_ROWS, -1)
+    return stats[:, :m]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "batch_size", "n_members", "base", "mesh"),
+)
+def _ensemble_shard_map_stats_jit(model, stacked_variables, x, batch_size,
+                                  n_members, base, eps, mesh):
+    """Fused mesh DE program: the explicit shard_map block of
+    :func:`_ensemble_shard_map_jit` computes the (N_padded, M)
+    probabilities exactly as the full path does, then — still inside the
+    jit — the wrap-padded duplicate members are sliced OFF before the
+    member-axis reduction (a duplicate member in the mean/variance would
+    skew every statistic) and the (4, M) sufficient statistics come out.
+    The cross-device member reduction is GSPMD's to schedule; the math
+    per (member, window) is unchanged."""
+    probs = _ensemble_shard_map_jit.__wrapped__(
+        model, stacked_variables, x, batch_size, mesh
+    )
+    return _constrain(_uq_stats(probs[:n_members], base, eps), mesh, None,
+                      mesh_lib.AXIS_DATA)
+
+
+@partial(jax.jit, static_argnames=("model", "base"))
+def _ensemble_chunk_stats_jit(model, stacked_variables, chunk, base, eps):
+    """Fused streamed DE unit: one chunk through all members
+    (:func:`_ensemble_chunk_jit`), reduced on device to (4, bs)."""
+    probs = _ensemble_chunk_jit.__wrapped__(model, stacked_variables, chunk)
+    return _uq_stats(probs, base, eps)
+
+
+@partial(jax.jit, static_argnames=("model", "n_members", "base", "mesh"))
+def _ensemble_chunk_mesh_stats_jit(model, stacked_variables, chunk,
+                                   n_members, base, eps, mesh):
+    """Fused streamed+mesh DE unit: the shard_map chunk block of
+    :func:`_ensemble_chunk_mesh_jit`, wrap-padded duplicate members
+    sliced off inside the jit, then the (4, bs) reduction."""
+    probs = _ensemble_chunk_mesh_jit.__wrapped__(
+        model, stacked_variables, chunk, mesh
+    )
+    return _constrain(_uq_stats(probs[:n_members], base, eps), mesh, None,
+                      mesh_lib.AXIS_DATA)
+
+
 def ensemble_predict_streaming(
     model: AlarconCNN1D,
     member_variables,
@@ -470,13 +635,20 @@ def ensemble_predict_streaming(
     mesh: Optional[jax.sharding.Mesh] = None,
     run_log=None,
     record_memory_only: bool = False,
+    stats=None,
 ) -> "np.ndarray":
     """(N, M) deterministic ensemble probabilities with the window set
     streamed from HOST memory (see :func:`mc_dropout_predict_streaming`):
-    chunks flow through the prefetch feed, a one-deep result queue
-    overlaps D2H with the next chunk's compute, and HBM holds
-    O(prefetch x batch_size) windows plus the stacked members.  Identical
-    results to :func:`ensemble_predict` (deterministic eval mode).
+    chunks flow through the prefetch feed, a bounded result queue
+    (depth = ``prefetch``) overlaps D2H with the following chunks'
+    compute, and HBM holds O(prefetch x batch_size) windows plus the
+    stacked members.  Identical results to :func:`ensemble_predict`
+    (deterministic eval mode).
+
+    ``stats=(entropy_base, eps)`` switches to the fused reduction: each
+    chunk's member probabilities collapse on device to the per-window
+    sufficient statistics and the return value is ``(N_STAT_ROWS, M)``
+    (wrap-padded duplicate members are excluded inside the jit).
 
     ``mesh`` shards each streamed chunk's members over ``ensemble`` and
     windows over ``data`` (the shard_map layout of the in-HBM mesh path),
@@ -485,42 +657,55 @@ def ensemble_predict_streaming(
     """
     member_variables = as_stacked_members(member_variables)
     n_members = jax.tree.leaves(member_variables)[0].shape[0]
+    if stats is not None:
+        base, eps = stats
+        eps = float(eps)
+    if mesh is not None:
+        e_axis = mesh.shape[mesh_lib.AXIS_ENSEMBLE]
+        batch_size = effective_batch_size(batch_size, mesh)
+        member_variables = jax.tree.map(
+            lambda a: _wrap_pad(a, e_axis), member_variables
+        )
+        member_variables = mesh_lib.shard_member_tree(member_variables, mesh)
+    n_padded = jax.tree.leaves(member_variables)[0].shape[0]
 
-    def record_chunk_memory(label, fn, *extra):
-        if run_log is None:
-            return
+    # ONE (label, fn, per-chunk args, output rows) definition drives both
+    # the memory pricing and the streamed dispatch, so the priced program
+    # cannot drift from the executed one.  Full-probs mesh chunks come
+    # back with the wrap-padded member rows (sliced off after assembly);
+    # fused chunks exclude the duplicates inside the jit.
+    if mesh is None and stats is None:
+        label, fn, n_rows = "de_chunk_predict", _ensemble_chunk_jit, n_members
+        chunk_args = lambda chunk, ci: (model, member_variables, chunk)
+    elif mesh is None:
+        label, fn, n_rows = ("de_chunk_predict_fused",
+                             _ensemble_chunk_stats_jit, N_STAT_ROWS)
+        chunk_args = lambda chunk, ci: (model, member_variables, chunk,
+                                        base, eps)
+    elif stats is None:
+        label, fn, n_rows = ("de_chunk_predict", _ensemble_chunk_mesh_jit,
+                             n_padded)
+        chunk_args = lambda chunk, ci: (model, member_variables, chunk, mesh)
+    else:
+        label, fn, n_rows = ("de_chunk_predict_fused",
+                             _ensemble_chunk_mesh_stats_jit, N_STAT_ROWS)
+        chunk_args = lambda chunk, ci: (model, member_variables, chunk,
+                                        n_members, base, eps, mesh)
+
+    if run_log is not None:
         chunk_aval = jax.ShapeDtypeStruct(
             (batch_size,) + tuple(np.shape(x)[1:]), jnp.float32)
         telemetry_memory.record_jit_memory(
-            run_log, label, fn, model, member_variables, chunk_aval, *extra
+            run_log, label, fn, *chunk_args(chunk_aval, 0)
         )
-
-    if mesh is None:
-        record_chunk_memory("de_chunk_predict", _ensemble_chunk_jit)
-        if record_memory_only:
-            return None  # drivers' pre-timing pass (see mc_dropout_predict)
-        return _stream_chunked(
-            x, batch_size, n_members, prefetch,
-            lambda chunk, ci: _ensemble_chunk_jit(model, member_variables, chunk),
-        )
-    e_axis = mesh.shape[mesh_lib.AXIS_ENSEMBLE]
-    batch_size = effective_batch_size(batch_size, mesh)
-    member_variables = jax.tree.map(
-        lambda a: _wrap_pad(a, e_axis), member_variables
-    )
-    member_variables = mesh_lib.shard_member_tree(member_variables, mesh)
-    n_padded = jax.tree.leaves(member_variables)[0].shape[0]
-    record_chunk_memory("de_chunk_predict", _ensemble_chunk_mesh_jit, mesh)
     if record_memory_only:
-        return None
-    probs = _stream_chunked(
-        x, batch_size, n_padded, prefetch,
-        lambda chunk, ci: _ensemble_chunk_mesh_jit(
-            model, member_variables, chunk, mesh
-        ),
+        return None  # drivers' pre-timing pass (see mc_dropout_predict)
+    out = _stream_chunked(
+        x, batch_size, n_rows, prefetch,
+        lambda chunk, ci: fn(*chunk_args(chunk, ci)),
         sharding=_chunk_sharding(mesh, batch_size),
     )
-    return probs[:n_members]
+    return out if stats is not None else out[:n_members]
 
 
 def ensemble_predict(
@@ -532,11 +717,18 @@ def ensemble_predict(
     mesh: Optional[jax.sharding.Mesh] = None,
     run_log=None,
     record_memory_only: bool = False,
+    stats=None,
 ) -> jax.Array:
     """(N, M) deterministic probabilities from N ensemble members.
     All N members' activations for one chunk are live at once, so the
     footprint scales with ``n_members * batch_size`` rows (see the HBM
     note on :func:`mc_dropout_predict`).
+
+    ``stats=(entropy_base, eps)`` switches to the fused reduction: the
+    member probabilities collapse on device to the per-window sufficient
+    statistics and the return value is ``(N_STAT_ROWS, M)`` — on the mesh
+    path the wrap-padded duplicate members are sliced off INSIDE the jit,
+    before the member-axis reduction.
 
     ``member_variables`` is a list of per-member variable pytrees, an
     already-stacked pytree with a leading member axis, or a
@@ -558,9 +750,13 @@ def ensemble_predict(
     else:
         x = jnp.asarray(x, jnp.float32)
     n_members = jax.tree.leaves(member_variables)[0].shape[0]
+    if stats is not None:
+        base, eps = stats
+        eps = float(eps)
     if mesh is not None:
         # device_put needs the member axis divisible by the ensemble axis;
-        # wrap-pad it and slice the duplicate rows back off below.
+        # wrap-pad it and slice the duplicate rows back off below (the
+        # fused program slices them off inside the jit instead).
         e_axis = mesh.shape[mesh_lib.AXIS_ENSEMBLE]
         member_variables = jax.tree.map(
             lambda a: _wrap_pad(a, e_axis), member_variables
@@ -568,24 +764,29 @@ def ensemble_predict(
         if not record_memory_only:
             x = jax.device_put(x, mesh_lib.replicated(mesh))
         member_variables = mesh_lib.shard_member_tree(member_variables, mesh)
-        if run_log is not None:
-            telemetry_memory.record_jit_memory(
-                run_log, "de_predict", _ensemble_shard_map_jit,
-                model, member_variables, x, batch_size, mesh,
-            )
-        if record_memory_only:
-            return None  # drivers' pre-timing pass (see mc_dropout_predict)
-        probs = _ensemble_shard_map_jit(
-            model, member_variables, x, batch_size, mesh
-        )
-        return probs[:n_members]
+
+    # ONE (label, fn, args) tuple drives both the memory pricing and the
+    # dispatch, so the priced program cannot drift from the executed one.
+    if mesh is not None and stats is not None:
+        label, fn = "de_predict_fused", _ensemble_shard_map_stats_jit
+        args = (model, member_variables, x, batch_size, n_members, base,
+                eps, mesh)
+    elif mesh is not None:
+        label, fn = "de_predict", _ensemble_shard_map_jit
+        args = (model, member_variables, x, batch_size, mesh)
+    elif stats is not None:
+        label, fn = "de_predict_fused", _ensemble_stats_jit
+        args = (model, member_variables, x, batch_size, base, eps)
+    else:
+        label, fn = "de_predict", _ensemble_jit
+        args = (model, member_variables, x, batch_size)
     if run_log is not None:
         # Compiled-HBM accounting (one memory_profile event per program
         # signature; telemetry/memory.py).
-        telemetry_memory.record_jit_memory(
-            run_log, "de_predict", _ensemble_jit,
-            model, member_variables, x, batch_size,
-        )
+        telemetry_memory.record_jit_memory(run_log, label, fn, *args)
     if record_memory_only:
-        return None
-    return _ensemble_jit(model, member_variables, x, batch_size)
+        return None  # drivers' pre-timing pass (see mc_dropout_predict)
+    out = fn(*args)
+    if mesh is not None and stats is None:
+        out = out[:n_members]  # drop the wrap-padded duplicate members
+    return out

@@ -34,7 +34,10 @@ numbers (the failure mode of three consecutive bench rounds).
 Env knobs: BENCH_WINDOWS/PASSES/CHUNK (MCD), BENCH_MEMBERS/TRAIN_WINDOWS/
 EPOCHS/BATCH/DE_REPS (DE), BENCH_METRIC=de_train for the DE metric alone,
 BENCH_SKIP_DE=1 to skip the DE secondary, BENCH_SKIP_STREAMED=1 to skip
-the streamed-overhead context, BENCH_DE_CHUNK for its DE chunk size,
+the streamed-overhead context, BENCH_SKIP_FUSED=1 to skip the
+fused-reduction context (fused (4, M) sufficient-stats output vs the
+full (T, M) probability round-trip, end-to-end incl. host fetch),
+BENCH_DE_CHUNK for its DE chunk size,
 BENCH_WASTE_EPOCHS for the early-stop-waste context's epoch cap (0
 skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
 BENCH_WATCHDOG_SECS to change or disable (0) the hang watchdog
@@ -542,6 +545,45 @@ def bench_streamed(model, variables, x_host, n_passes, chunk) -> dict:
     }
 
 
+def bench_fused(model, variables, x_host, n_passes, chunk) -> dict:
+    """Fused-reduction payoff at the bench shapes: the same T-pass MCD
+    program timed end-to-end (host fetch included) returning the full
+    (T, M) probability matrix vs the fused (4, M) sufficient-statistics
+    stack (``stats=('nats', 1e-10)``) — the measured cost of shipping
+    the K axis off device, next to the exact D2H byte counts the
+    ``eval_predict`` telemetry estimates."""
+    from apnea_uq_tpu.uq import mc_dropout_predict
+    from apnea_uq_tpu.uq.metrics import N_STAT_ROWS
+    from apnea_uq_tpu.utils import prng
+
+    def t_end_to_end(fn, reps=2):
+        fn()  # warmup/compile
+        best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    key = prng.stochastic_key(1)
+    t_full = t_end_to_end(lambda: np.asarray(mc_dropout_predict(
+        model, variables, x_host, n_passes=n_passes, mode="clean",
+        batch_size=chunk, key=key,
+    )))
+    t_fused = t_end_to_end(lambda: np.asarray(mc_dropout_predict(
+        model, variables, x_host, n_passes=n_passes, mode="clean",
+        batch_size=chunk, key=key, stats=("nats", 1e-10),
+    )))
+    m = int(np.shape(x_host)[0])
+    return {
+        "full_probs_s": round(t_full, 3),
+        "fused_s": round(t_fused, 3),
+        "fused_vs_full": round(t_fused / t_full, 3),
+        "d2h_bytes_full": n_passes * m * 4,
+        "d2h_bytes_fused": N_STAT_ROWS * m * 4,
+    }
+
+
 def bench_mcd() -> dict:
     from apnea_uq_tpu.config import ModelConfig
     from apnea_uq_tpu.models import AlarconCNN1D, apply_model, init_variables, predict_proba
@@ -689,6 +731,14 @@ def bench_mcd() -> dict:
             model, variables, np.asarray(x), n_passes, chunk
         ),
         skip=bool(os.environ.get("BENCH_SKIP_STREAMED")),
+    )
+    # Fused on-device UQ reduction vs the full (T, M) round-trip at the
+    # same shapes — the measured D2H win behind the eval default
+    # (UQConfig.fused_reduction).
+    result["context"]["fused_reduction"] = _guarded(
+        lambda: bench_fused(model, variables, np.asarray(x), n_passes,
+                            chunk),
+        skip=bool(os.environ.get("BENCH_SKIP_FUSED")),
     )
     return result
 

@@ -147,6 +147,13 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert "error" not in streamed, streamed
     for key in ("mcd_streamed_vs_inhbm", "de10_streamed_vs_inhbm"):
         assert streamed[key] > 0, (key, streamed)
+    fused = ctx["fused_reduction"]
+    assert "error" not in fused, fused
+    assert fused["fused_s"] > 0 and fused["fused_vs_full"] > 0
+    # d2h accounting: full = passes x windows x 4 bytes, fused = 4 rows
+    # x windows x 4 bytes (at the smoke's BENCH_PASSES=4 they coincide).
+    assert fused["d2h_bytes_full"] == 4 * 256 * 4
+    assert fused["d2h_bytes_fused"] == 4 * 256 * 4
 
     # The printed line was assembled from the on-disk progress capture:
     # the two artifacts are the same result by construction.

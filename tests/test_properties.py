@@ -85,6 +85,67 @@ def test_bootstrap_cis_ordered(preds, y, seed):
         assert lo - 1e-9 <= mean <= hi + 1e-9
 
 
+# Awkward (M, batch_size, K) shapes for the fused-vs-full parity sweep:
+# M < batch_size (single partial chunk), M an exact chunk multiple, a
+# single exact chunk, K=1 (degenerate variance/MI), and a wrap-padding
+# multi-chunk shape.  FIXED combos (not drawn dimensions) so Hypothesis
+# searches values/seeds while each shape's programs compile once.
+_FUSED_SHAPES = (
+    (5, 16, 3),    # M < batch_size: one wrap-padded partial chunk
+    (32, 16, 2),   # M an exact multiple of the chunk
+    (16, 16, 4),   # a single exact chunk
+    (11, 4, 1),    # K=1 across wrap-padded chunks
+    (21, 8, 5),    # multi-chunk with a padded tail
+)
+
+
+@settings(max_examples=15, deadline=None)
+@given(
+    shape=st.sampled_from(_FUSED_SHAPES),
+    mode=st.sampled_from(["clean", "parity"]),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_fused_stats_match_full_probs_everywhere(shape, mode, seed):
+    """ISSUE 6 satellite: fused-vs-full parity over awkward shapes in
+    BOTH BatchNorm modes.  The fused reduction runs inside the same
+    chunked program as the full path, so per-window statistics must
+    match ``sufficient_stats`` of the full stack to <=1e-6 — in
+    'parity' mode the wrap-padded rows DO enter the BN batch statistics
+    (as they do on the full path), but they must never leak into the
+    fused per-window stats of real windows beyond that shared effect."""
+    import jax
+
+    from apnea_uq_tpu.config import ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.uq import mc_dropout_predict, sufficient_stats
+    from apnea_uq_tpu.uq.metrics import N_STAT_ROWS
+
+    m, batch_size, k = shape
+    model = AlarconCNN1D(ModelConfig(
+        features=(4,), kernel_sizes=(3,), dropout_rates=(0.3,)
+    ))
+    variables = init_variables(model, jax.random.key(0))
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(m, 60, 4)).astype(np.float32)
+    key = jax.random.key(seed)
+    full = np.asarray(mc_dropout_predict(
+        model, variables, x, n_passes=k, mode=mode,
+        batch_size=batch_size, key=key,
+    ))
+    fused = np.asarray(mc_dropout_predict(
+        model, variables, x, n_passes=k, mode=mode,
+        batch_size=batch_size, key=key, stats=("nats", 1e-10),
+    ))
+    assert full.shape == (k, m) and fused.shape == (N_STAT_ROWS, m)
+    np.testing.assert_allclose(
+        fused, np.asarray(sufficient_stats(full)), rtol=0, atol=1e-6
+    )
+    if k == 1:
+        np.testing.assert_array_equal(fused[1], 0.0)  # variance
+        # total == aleatoric -> MI clamps to exactly 0 downstream.
+        np.testing.assert_allclose(fused[2], fused[3], atol=1e-7)
+
+
 @settings(max_examples=40, deadline=None)
 @given(
     n_groups=st.integers(2, 60),

@@ -171,6 +171,123 @@ class TestCompare:
         assert reg.name == "mcd_t50_inference_throughput"
         assert main(["telemetry", "compare", base, str(progress)]) == 1
 
+    def test_bench_error_capture_is_exit_2_usage_error(self, tmp_path,
+                                                       capsys):
+        """ISSUE 6 satellite: a BENCH_*.json whose payload is a
+        bench_error record must exit 2 with a clear "no comparable
+        metrics in source" message — never extract bench_error=0 as a
+        metric and report a clean exit-0 pass over it."""
+        err_doc = {"metric": "bench_error", "value": 0, "unit": "error",
+                   "vs_baseline": 0, "error": "TPU backend unavailable"}
+        bare = tmp_path / "err.json"
+        with open(bare, "w") as f:
+            json.dump(err_doc, f)
+        # The archived watch/driver capture shape wraps the parsed
+        # stdout line under "parsed" (the repo's BENCH_r05.json).
+        wrapped = tmp_path / "r05.json"
+        with open(wrapped, "w") as f:
+            json.dump({"n": 5, "cmd": "python bench.py", "rc": 2,
+                       "tail": "...", "parsed": err_doc}, f)
+        good = _bench_json(tmp_path / "good.json", 1000.0)
+        for src in (str(bare), str(wrapped)):
+            for argv in ([src, good], [good, src], [src, src]):
+                with pytest.raises(SystemExit) as exc:
+                    main(["telemetry", "compare", *argv])
+                assert exc.value.code == 2, argv
+            assert "no comparable metrics in source" in \
+                capsys.readouterr().out
+        # A parse-dead capture (parsed: null, the r03/r04 shape) is the
+        # same usage error.
+        dead = tmp_path / "r03.json"
+        with open(dead, "w") as f:
+            json.dump({"n": 3, "cmd": "python bench.py", "rc": 1,
+                       "tail": "", "parsed": None}, f)
+        with pytest.raises(SystemExit) as exc:
+            main(["telemetry", "compare", str(dead), good])
+        assert exc.value.code == 2
+
+    def test_metric_free_run_dir_is_exit_2_usage_error(self, tmp_path,
+                                                       capsys):
+        """A run directory with events but nothing gateable (e.g. a
+        train-only run) follows the same exit-2 contract as a
+        bench_error capture — not an exit-1 'regression' from the
+        no-common-metrics check."""
+        run_dir = tmp_path / "train_only"
+        os.makedirs(run_dir)
+        with open(os.path.join(run_dir, telemetry.EVENTS_FILENAME),
+                  "w") as f:
+            for e in ({"seq": 0, "ts": 1.0, "kind": "run_started",
+                       "schema_version": 1, "stage": "train"},
+                      {"seq": 1, "ts": 2.0, "kind": "epoch", "epoch": 1,
+                       "loss": 0.5},
+                      {"seq": 2, "ts": 3.0, "kind": "run_finished",
+                       "status": "ok"}):
+                f.write(json.dumps(e) + "\n")
+        good = _bench_json(tmp_path / "good.json", 1000.0)
+        with pytest.raises(SystemExit) as exc:
+            main(["telemetry", "compare", str(run_dir), good])
+        assert exc.value.code == 2
+        assert "no comparable metrics in source" in capsys.readouterr().out
+
+    def test_parsed_wrapper_real_capture_gates_normally(self, tmp_path):
+        """A real metric line under the watch-capture "parsed" wrapper
+        (the repo's BENCH_r01/r02 shape) unwraps and gates like the bare
+        driver line."""
+        base = _bench_json(tmp_path / "printed.json", 1000.0)
+        wrapped = tmp_path / "r01.json"
+        with open(wrapped, "w") as f:
+            json.dump({"n": 1, "cmd": "python bench.py", "rc": 0,
+                       "tail": "...",
+                       "parsed": {"metric": "mcd_t50_inference_throughput",
+                                  "value": 900.0,
+                                  "unit": "windows/sec/chip",
+                                  "vs_baseline": 1.0}}, f)
+        comparison = compare_mod.compare_paths(base, str(wrapped))
+        (reg,) = comparison.regressions
+        assert reg.name == "mcd_t50_inference_throughput"
+        assert reg.delta_pct == pytest.approx(-10.0)
+        assert main(["telemetry", "compare", base, str(wrapped)]) == 1
+
+    def test_archived_bench_r05_exits_2(self, capsys):
+        """The repo's own BENCH_r05.json (a tunnel-outage bench_error
+        capture) is the motivating fixture — gate it for real."""
+        r05 = os.path.join(os.path.dirname(__file__), "..", "BENCH_r05.json")
+        if not os.path.exists(r05):
+            pytest.skip("archived BENCH_r05.json not present")
+        with pytest.raises(SystemExit) as exc:
+            main(["telemetry", "compare", r05, r05])
+        assert exc.value.code == 2
+        assert "bench_error" in capsys.readouterr().out
+
+    def test_eval_d2h_bytes_gates_lower_is_better(self, tmp_path):
+        """eval_predict d2h_bytes (the fused-reduction win) gates as a
+        bytes metric: a candidate re-inflating the transfer regresses."""
+        def run_with_d2h(path, d2h):
+            os.makedirs(path, exist_ok=True)
+            events = [
+                {"seq": 0, "ts": 1.0, "kind": "run_started",
+                 "schema_version": 1, "stage": "eval-mcd"},
+                {"seq": 1, "ts": 2.0, "kind": "eval_predict",
+                 "label": "CNN_MCD_Unbalanced", "windows_per_s": 5000.0,
+                 "fused": d2h < 10**6, "d2h_bytes": d2h},
+                {"seq": 2, "ts": 3.0, "kind": "run_finished",
+                 "status": "ok"},
+            ]
+            with open(os.path.join(path, telemetry.EVENTS_FILENAME),
+                      "w") as f:
+                for e in events:
+                    f.write(json.dumps(e) + "\n")
+            return str(path)
+
+        fused = run_with_d2h(tmp_path / "fused", 4 * 1024 * 4)
+        full = run_with_d2h(tmp_path / "full", 50 * 1024 * 4)
+        comparison = compare_mod.compare_paths(fused, full)
+        (delta,) = comparison.regressions
+        assert delta.name == "eval.CNN_MCD_Unbalanced.d2h_bytes"
+        assert not delta.higher_better
+        # The reverse direction (full -> fused) is an improvement.
+        assert compare_mod.compare_paths(full, fused).regressions == []
+
     def test_one_sided_metrics_listed_never_regress(self, tmp_path):
         base = _bench_json(tmp_path / "b.json", 1000.0, de_ratio=4.0)
         cand = _bench_json(tmp_path / "c.json", 1000.0)  # no secondary

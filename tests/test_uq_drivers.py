@@ -112,22 +112,98 @@ class TestEndToEnd:
         pids = np.array([f"P{i % 4}" for i in range(64)])
         return model, variables, x, y, pids
 
-    def test_mcd_run(self, setup):
+    def test_mcd_run_fused_default(self, setup):
+        """The default driver config runs the fused reduction: no (K, M)
+        stack on host, a (4, M) sufficient-statistics stack instead, and
+        the full metric/CSV/classification pipeline downstream."""
         model, variables, x, y, pids = setup
         cfg = UQConfig(mc_passes=8, n_bootstrap=20, inference_batch_size=32,
                        mcd_batch_size=32)
+        assert cfg.fused_reduction
         result = run_mcd_analysis(
             model, variables, x, y, patient_ids=pids, config=cfg,
             predict_key=jax.random.key(1),
         )
-        assert result.predictions.shape == (8, 64)
-        assert ((result.predictions >= 0) & (result.predictions <= 1)).all()
-        # Stochastic passes actually differ (dropout active).
-        assert result.predictions.std(axis=0).max() > 0
+        assert result.fused and result.predictions is None
+        assert result.stats.shape == (4, 64)
+        # Stochastic passes actually differ (dropout active) -> nonzero
+        # predictive variance somewhere.
+        assert result.stats[1].max() > 0
+        assert result.evaluation.n_passes == 8
         assert result.detailed is not None and len(result.detailed) == 64
         assert result.deterministic_classification is not None
         assert 0.0 <= result.classification["accuracy"] <= 1.0
         assert result.predict_seconds > 0
+
+    def test_mcd_fused_matches_full_probs(self, setup):
+        """Fused vs --full-probs on the same key: aggregates, per-window
+        vectors, CIs, and the detailed frame agree to <=1e-6 (the ISSUE 6
+        acceptance tolerance)."""
+        import dataclasses
+
+        model, variables, x, y, pids = setup
+        fused_cfg = UQConfig(mc_passes=8, n_bootstrap=20,
+                             inference_batch_size=32, mcd_batch_size=32)
+        full_cfg = dataclasses.replace(fused_cfg, fused_reduction=False)
+        a = run_mcd_analysis(model, variables, x, y, patient_ids=pids,
+                             config=fused_cfg, predict_key=jax.random.key(1),
+                             sanity_check=False)
+        b = run_mcd_analysis(model, variables, x, y, patient_ids=pids,
+                             config=full_cfg, predict_key=jax.random.key(1),
+                             sanity_check=False)
+        assert not b.fused and b.predictions.shape == (8, 64)
+        assert b.stats is None
+        for k in a.evaluation.aggregates:
+            assert a.evaluation.aggregates[k] == pytest.approx(
+                b.evaluation.aggregates[k], abs=1e-6), k
+        for k in a.evaluation.per_window:
+            np.testing.assert_allclose(
+                a.evaluation.per_window[k], b.evaluation.per_window[k],
+                rtol=0, atol=1e-6, err_msg=k)
+        for k in a.evaluation.confidence_intervals:
+            assert a.evaluation.confidence_intervals[k] == pytest.approx(
+                b.evaluation.confidence_intervals[k], abs=1e-5), k
+        pd.testing.assert_frame_equal(
+            a.detailed, b.detailed, check_exact=False, rtol=1e-5,
+            atol=1e-7)
+        assert a.classification["accuracy"] == pytest.approx(
+            b.classification["accuracy"])
+
+    def test_fused_event_reports_d2h_reduction(self, setup, tmp_path):
+        """eval_predict telemetry: fused=true and a d2h_bytes estimate
+        exactly (4/K)x the full-probs run's (ISSUE 6 acceptance)."""
+        import dataclasses
+
+        from apnea_uq_tpu import telemetry
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        model, variables, x, y, pids = setup
+        fused_cfg = UQConfig(mc_passes=8, n_bootstrap=5,
+                             inference_batch_size=32, mcd_batch_size=32)
+        rl = RunLog(str(tmp_path))
+        run_mcd_analysis(model, variables, x, y, config=fused_cfg,
+                         predict_key=jax.random.key(1), run_log=rl,
+                         sanity_check=False, detailed=False)
+        run_mcd_analysis(model, variables, x, y,
+                         config=dataclasses.replace(fused_cfg,
+                                                    fused_reduction=False),
+                         predict_key=jax.random.key(1), run_log=rl,
+                         sanity_check=False, detailed=False)
+        rl.close()
+        fused_ev, full_ev = [
+            e for e in telemetry.read_events(str(tmp_path))
+            if e["kind"] == "eval_predict"
+        ]
+        assert fused_ev["fused"] is True and full_ev["fused"] is False
+        assert fused_ev["d2h_bytes"] == 4 * 64 * 4
+        assert full_ev["d2h_bytes"] == 8 * 64 * 4
+        assert fused_ev["d2h_bytes"] / full_ev["d2h_bytes"] == \
+            pytest.approx(4 / 8)
+        # The fused program was priced under its own memory label.
+        labels = {e["label"]
+                  for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "memory_profile"}
+        assert {"mcd_predict_fused", "mcd_predict"} <= labels
 
     def test_mcd_parity_mode_runs(self, setup):
         model, variables, x, y, pids = setup
@@ -218,9 +294,13 @@ class TestEndToEnd:
                              detailed=False, sanity_check=False, mesh=mesh4)
 
     def test_de_run_and_registry(self, setup, tmp_path):
+        """Full-probs DE run: the (N, M) stack and its raw_predictions
+        artifact (the fused default's registry shape is covered by
+        test_de_fused_registry_saves_stats)."""
         model, variables, x, y, pids = setup
         members = [init_variables(model, jax.random.key(s)) for s in range(3)]
-        cfg = UQConfig(n_bootstrap=20, inference_batch_size=32)
+        cfg = UQConfig(n_bootstrap=20, inference_batch_size=32,
+                       fused_reduction=False)
         result = run_de_analysis(
             model, members, x, y, patient_ids=pids, config=cfg,
             label="DE_test",
@@ -256,20 +336,66 @@ class TestEndToEnd:
         assert doc["classification"]["confusion_matrix"] == np.asarray(
             result.classification["confusion_matrix"]
         ).tolist()
+        assert doc["fused"] is False
+
+    def test_de_fused_registry_saves_stats(self, setup, tmp_path):
+        """A fused DE run persists uq_stats:<label> (no raw_predictions —
+        the (N, M) stack never existed on host) and a metrics doc whose
+        numbers match a full-probs run's to <=1e-6."""
+        model, variables, x, y, pids = setup
+        members = [init_variables(model, jax.random.key(s)) for s in range(3)]
+        cfg = UQConfig(n_bootstrap=20, inference_batch_size=32)
+        result = run_de_analysis(
+            model, members, x, y, patient_ids=pids, config=cfg,
+            label="DE_fused",
+        )
+        assert result.fused and result.predictions is None
+        registry = ArtifactRegistry(str(tmp_path))
+        paths = save_run(registry, result)
+        assert set(paths) == {"uq_stats", "detailed_windows", "metrics"}
+        stats = registry.load_arrays("uq_stats:DE_fused")["stats"]
+        assert stats.shape == (4, 64)
+        np.testing.assert_allclose(stats, result.stats)
+        doc = registry.load_json("metrics:DE_fused")
+        assert doc["fused"] is True and doc["n_passes"] == 3
+        import dataclasses
+        full = run_de_analysis(
+            model, members, x, y, patient_ids=pids,
+            config=dataclasses.replace(cfg, fused_reduction=False),
+            label="DE_fused",
+        )
+        for k in doc["aggregates"]:
+            assert doc["aggregates"][k] == pytest.approx(
+                full.evaluation.aggregates[k], abs=1e-6), k
 
     def test_mcd_streaming_config(self, setup):
         """UQConfig.mcd_streaming routes prediction through the host-
         streamed path with identical results."""
         model, variables, x, y, pids = setup
-        base = UQConfig(mc_passes=6, n_bootstrap=10, mcd_batch_size=32)
+        # full-probs configs: this test pins the streamed == in-HBM RAW
+        # prediction identity (the fused streamed/in-HBM equivalence is
+        # test_uq_predict.py::TestFusedStats).
+        base = UQConfig(mc_passes=6, n_bootstrap=10, mcd_batch_size=32,
+                        fused_reduction=False)
         stream = UQConfig(mc_passes=6, n_bootstrap=10, mcd_batch_size=32,
-                          mcd_streaming=True)
+                          mcd_streaming=True, fused_reduction=False)
         a = run_mcd_analysis(model, variables, x, y, config=base, seed=4,
                              detailed=False, sanity_check=False)
         b = run_mcd_analysis(model, variables, x, y, config=stream, seed=4,
                              detailed=False, sanity_check=False)
         np.testing.assert_array_equal(a.predictions, b.predictions)
         assert a.evaluation.confidence_intervals == b.evaluation.confidence_intervals
+        # And the fused default streams identically too (stats route).
+        fa = run_mcd_analysis(model, variables, x, y,
+                              config=UQConfig(mc_passes=6, n_bootstrap=10,
+                                              mcd_batch_size=32),
+                              seed=4, detailed=False, sanity_check=False)
+        fb = run_mcd_analysis(model, variables, x, y,
+                              config=UQConfig(mc_passes=6, n_bootstrap=10,
+                                              mcd_batch_size=32,
+                                              mcd_streaming=True),
+                              seed=4, detailed=False, sanity_check=False)
+        np.testing.assert_array_equal(fa.stats, fb.stats)
 
     def test_mcd_streaming_with_mesh(self, setup):
         """Streaming + mesh compose in the driver (VERDICT r2 #5): the
@@ -279,9 +405,10 @@ class TestEndToEnd:
 
         model, variables, x, y, pids = setup
         mesh = make_mesh(num_members=4)  # (4, 2) on the 8-device rig
-        base = UQConfig(mc_passes=6, n_bootstrap=10, mcd_batch_size=32)
+        base = UQConfig(mc_passes=6, n_bootstrap=10, mcd_batch_size=32,
+                        fused_reduction=False)
         stream = UQConfig(mc_passes=6, n_bootstrap=10, mcd_batch_size=32,
-                          mcd_streaming=True)
+                          mcd_streaming=True, fused_reduction=False)
         a = run_mcd_analysis(model, variables, x, y, config=base, seed=4,
                              detailed=False, sanity_check=False, mesh=mesh)
         b = run_mcd_analysis(model, variables, x, y, config=stream, seed=4,
@@ -294,9 +421,10 @@ class TestEndToEnd:
         streamed path with identical results."""
         model, variables, x, y, pids = setup
         members = [init_variables(model, jax.random.key(s)) for s in range(2)]
-        base = UQConfig(n_bootstrap=10, inference_batch_size=32)
+        base = UQConfig(n_bootstrap=10, inference_batch_size=32,
+                        fused_reduction=False)
         stream = UQConfig(n_bootstrap=10, inference_batch_size=32,
-                          de_streaming=True)
+                          de_streaming=True, fused_reduction=False)
         a = run_de_analysis(model, members, x, y, config=base, seed=4,
                             detailed=False)
         b = run_de_analysis(model, members, x, y, config=stream, seed=4,

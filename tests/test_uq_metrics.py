@@ -111,3 +111,54 @@ def test_bits_vs_nats():
     bits = uq_evaluation_dist(preds, y, base="bits")
     np.testing.assert_allclose(np.asarray(nats["total_pred_entropy"]), np.log(2), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(bits["total_pred_entropy"]), 1.0, rtol=1e-6)
+
+
+class TestSufficientStats:
+    """sufficient_stats + decompose_from_stats == uq_evaluation_dist —
+    the fused path's founding identity: both routes literally share
+    ``_decompose``, so the dicts must agree key-for-key."""
+
+    def test_decompose_matches_full(self, rng):
+        from apnea_uq_tpu.uq import decompose_from_stats, sufficient_stats
+
+        preds = rng.uniform(0.0, 1.0, size=(12, 250)).astype(np.float32)
+        y = rng.integers(0, 2, 250)
+        full = uq_evaluation_dist(preds, y)
+        via_stats = decompose_from_stats(sufficient_stats(preds), y)
+        assert set(full) == set(via_stats)
+        for k in full:
+            np.testing.assert_allclose(
+                np.asarray(via_stats[k]), np.asarray(full[k]),
+                rtol=0, atol=1e-7, err_msg=k,
+            )
+
+    def test_stats_rows_and_f32_accumulation(self, rng):
+        from apnea_uq_tpu.uq import sufficient_stats
+        from apnea_uq_tpu.uq.metrics import (
+            N_STAT_ROWS, STAT_ALEATORIC, STAT_MEAN, STAT_TOTAL,
+            STAT_VARIANCE,
+        )
+
+        preds = rng.uniform(0.0, 1.0, size=(7, 40)).astype(np.float32)
+        s = np.asarray(sufficient_stats(preds))
+        assert s.shape == (N_STAT_ROWS, 40) and s.dtype == np.float32
+        np.testing.assert_allclose(s[STAT_MEAN], preds.mean(0), atol=1e-6)
+        np.testing.assert_allclose(s[STAT_VARIANCE], preds.var(0), atol=1e-6)
+        # bf16 input must still accumulate in f32: mean/variance within
+        # bf16 INPUT rounding (~3 decimal digits on the values), not
+        # degraded further by a bf16 reduction; entropies finite and
+        # ordered (Jensen).
+        import jax.numpy as jnp
+
+        s16 = np.asarray(sufficient_stats(jnp.asarray(preds, jnp.bfloat16)))
+        assert s16.dtype == np.float32
+        np.testing.assert_allclose(s16[STAT_MEAN], preds.mean(0), atol=1e-2)
+        assert np.all(s16[STAT_TOTAL] >= s16[STAT_ALEATORIC] - 1e-5)
+
+    def test_decompose_shape_and_label_validation(self, rng):
+        from apnea_uq_tpu.uq import decompose_from_stats
+
+        with pytest.raises(ValueError, match="sufficient statistics"):
+            decompose_from_stats(rng.uniform(size=(3, 10)), np.zeros(10))
+        with pytest.raises(ValueError, match="labels"):
+            decompose_from_stats(rng.uniform(size=(4, 10)), np.zeros(11))

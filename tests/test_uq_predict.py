@@ -337,3 +337,185 @@ class TestStreamingMeshComposition:
             )
             assert streamed.shape == (n, 70)
             np.testing.assert_allclose(streamed, hbm, rtol=1e-6, atol=1e-7)
+
+
+STAT_SPEC = ("nats", 1e-10)  # the drivers' default (base, entropy_eps)
+
+
+def _stats_of(probs):
+    """Host reference: sufficient statistics of a full (K, M) stack."""
+    from apnea_uq_tpu.uq.metrics import sufficient_stats
+
+    return np.asarray(sufficient_stats(np.asarray(probs)))
+
+
+class TestFusedStats:
+    """``stats=(base, eps)`` on every predictor: the fused on-device
+    reduction must equal ``sufficient_stats`` of the full-probs output to
+    <=1e-6 on EVERY path family (ISSUE 6 acceptance) — in-HBM, streamed,
+    mesh-sharded, and streamed+mesh, for MCD and DE — because the fused
+    programs run the identical prediction body and only move the
+    reduction inside the jit."""
+
+    TOL = dict(rtol=0, atol=1e-6)
+
+    def test_mcd_in_hbm_and_streamed(self, rng):
+        from apnea_uq_tpu.uq import mc_dropout_predict_streaming
+
+        model = _tiny()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(75, 60, 4)).astype(np.float32)  # wrap-pads
+        key = jax.random.key(11)
+        ref = _stats_of(mc_dropout_predict(
+            model, variables, x, n_passes=5, batch_size=32, key=key))
+        fused = np.asarray(mc_dropout_predict(
+            model, variables, x, n_passes=5, batch_size=32, key=key,
+            stats=STAT_SPEC))
+        assert fused.shape == (4, 75)
+        np.testing.assert_allclose(fused, ref, **self.TOL)
+        streamed = mc_dropout_predict_streaming(
+            model, variables, x, n_passes=5, batch_size=32, key=key,
+            stats=STAT_SPEC)
+        assert streamed.shape == (4, 75)
+        np.testing.assert_allclose(streamed, ref, **self.TOL)
+
+    def test_mcd_mesh_paths(self, rng):
+        from apnea_uq_tpu.parallel import make_mesh
+        from apnea_uq_tpu.uq import mc_dropout_predict_streaming
+
+        model = _tiny()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(100, 60, 4)).astype(np.float32)
+        key = jax.random.key(7)
+        mesh = make_mesh(num_members=4)  # (ensemble=4, data=2)
+        ref = _stats_of(mc_dropout_predict(
+            model, variables, x, n_passes=6, batch_size=32, key=key,
+            mesh=mesh))
+        fused = np.asarray(mc_dropout_predict(
+            model, variables, x, n_passes=6, batch_size=32, key=key,
+            mesh=mesh, stats=STAT_SPEC))
+        np.testing.assert_allclose(fused, ref, **self.TOL)
+        streamed = mc_dropout_predict_streaming(
+            model, variables, x, n_passes=6, batch_size=32, key=key,
+            mesh=mesh, stats=STAT_SPEC)
+        np.testing.assert_allclose(streamed, ref, **self.TOL)
+
+    def test_de_all_paths_and_wrap_padded_members(self, rng):
+        """n=3 members on a 4-wide ensemble axis: the mesh paths wrap-pad
+        the member axis for placement — the duplicate member must be
+        sliced off INSIDE the fused jit, before the member-axis
+        reduction, or every statistic skews toward member 0."""
+        from apnea_uq_tpu.parallel import make_mesh
+        from apnea_uq_tpu.uq import ensemble_predict_streaming
+
+        model = _tiny()
+        members = [init_variables(model, jax.random.key(s)) for s in range(3)]
+        x = rng.normal(size=(70, 60, 4)).astype(np.float32)
+        mesh = make_mesh(num_members=4)  # (4, 2): pads 3 -> 4 members
+        ref = _stats_of(ensemble_predict(model, members, x, batch_size=32))
+        for name, fused in (
+            ("in-hbm", np.asarray(ensemble_predict(
+                model, members, x, batch_size=32, stats=STAT_SPEC))),
+            ("streamed", np.asarray(ensemble_predict_streaming(
+                model, members, x, batch_size=32, stats=STAT_SPEC))),
+            ("mesh", np.asarray(ensemble_predict(
+                model, members, x, batch_size=32, mesh=mesh,
+                stats=STAT_SPEC))),
+            ("mesh+streamed", np.asarray(ensemble_predict_streaming(
+                model, members, x, batch_size=32, mesh=mesh,
+                stats=STAT_SPEC))),
+        ):
+            assert fused.shape == (4, 70), name
+            np.testing.assert_allclose(fused, ref, err_msg=name, **self.TOL)
+
+    def test_single_pass_collapses_uncertainty(self, rng):
+        """K=1: variance exactly 0 and total == aleatoric per window."""
+        model = _tiny()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(10, 60, 4)).astype(np.float32)
+        fused = np.asarray(mc_dropout_predict(
+            model, variables, x, n_passes=1, batch_size=8,
+            key=jax.random.key(2), stats=STAT_SPEC))
+        np.testing.assert_array_equal(fused[1], 0.0)
+        np.testing.assert_allclose(fused[2], fused[3], rtol=0, atol=1e-7)
+
+    def test_record_memory_only_prices_fused_program(self, tmp_path, rng):
+        from apnea_uq_tpu import telemetry
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        model = _tiny()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(12, 60, 4)).astype(np.float32)
+        rl = RunLog(str(tmp_path))
+        assert mc_dropout_predict(
+            model, variables, x, n_passes=3, batch_size=8, seed=0,
+            run_log=rl, record_memory_only=True, stats=STAT_SPEC) is None
+        rl.close()
+        (event,) = [e for e in telemetry.read_events(str(tmp_path))
+                    if e["kind"] == "memory_profile"]
+        assert event["label"] == "mcd_predict_fused"
+
+
+class TestStreamChunkedQueueDepth:
+    """The D2H result queue depth follows ``prefetch`` (bounded), so
+    fetch overlap scales with the feed depth instead of being pinned at
+    one pending chunk (ISSUE 6 satellite)."""
+
+    def _run(self, prefetch, n=50, bs=8, monkeypatch=None):
+        from apnea_uq_tpu.uq import predict as predict_mod
+
+        x = np.arange(n, dtype=np.float32)[:, None]
+        in_flight = []
+        max_pending = 0
+        fetch_order = []
+
+        def compute(chunk, ci):
+            in_flight.append(ci)
+            nonlocal max_pending
+            max_pending = max(max_pending, len(in_flight))
+            # One output row: the chunk's first column (identity-ish).
+            return jax.numpy.asarray(chunk[:, 0])[None, :]
+
+        # _stream_chunked imports host_values lazily per call, so patching
+        # the multihost module attribute intercepts every fetch.
+        from apnea_uq_tpu.utils import multihost
+
+        orig = multihost.host_values
+
+        def tracking_host_values(tree):
+            if in_flight:
+                fetch_order.append(in_flight.pop(0))
+            return orig(tree)
+
+        monkeypatch.setattr(multihost, "host_values", tracking_host_values)
+        out = predict_mod._stream_chunked(x, bs, 1, prefetch, compute)
+        np.testing.assert_allclose(out[0], x[:, 0])
+        return max_pending, fetch_order
+
+    def test_depth_follows_prefetch(self, monkeypatch):
+        # prefetch=1 -> at most 1 un-fetched result; prefetch=4 -> up to 4.
+        shallow, order1 = self._run(1, monkeypatch=monkeypatch)
+        deep, order4 = self._run(4, monkeypatch=monkeypatch)
+        assert shallow <= 2  # the new chunk + <=1 pending
+        assert deep == 5     # the new chunk + 4 pending
+        # Results are fetched in chunk order regardless of depth, and
+        # every chunk is fetched exactly once.
+        assert order1 == sorted(order1) and order4 == sorted(order4)
+        assert len(order4) == -(-50 // 8)
+
+    def test_results_identical_across_depths(self, rng):
+        """Queue depth is a scheduling knob, never a results knob."""
+        from apnea_uq_tpu.uq import mc_dropout_predict_streaming
+
+        model = _tiny()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(41, 60, 4)).astype(np.float32)
+        key = jax.random.key(5)
+        runs = [
+            mc_dropout_predict_streaming(
+                model, variables, x, n_passes=3, batch_size=8, key=key,
+                prefetch=p)
+            for p in (1, 2, 5)
+        ]
+        np.testing.assert_array_equal(runs[0], runs[1])
+        np.testing.assert_array_equal(runs[0], runs[2])
