@@ -51,6 +51,20 @@ def _add_run_dir_arg(p) -> None:
                         "`apnea-uq telemetry summarize <run-dir>`.")
 
 
+def _compile_env(args, config):
+    """Activate the compile-cost subsystem for a device-heavy stage:
+    persistent XLA cache under <registry>/xla-cache + the AOT program
+    store under <registry>/program-store (CompileCacheConfig knobs /
+    env overrides; APNEA_UQ_COMPILE_CACHE=0 disables).  Identical XLA
+    compiles become disk hits across processes, and `apnea-uq
+    warm-cache` can precompile the whole zoo ahead of time."""
+    from apnea_uq_tpu import compilecache
+
+    return compilecache.activate(
+        config.compilecache, registry_root=getattr(args, "registry", None)
+    )
+
+
 def _ckpt_root(args) -> str:
     if getattr(args, "ckpt_dir", None):
         return args.ckpt_dir
@@ -169,7 +183,7 @@ def cmd_train(args, config) -> int:
     mesh = _data_mesh()
     from apnea_uq_tpu.telemetry.profiler import maybe_profile
 
-    with _run(args, "train", config) as run_log:
+    with _compile_env(args, config), _run(args, "train", config) as run_log:
         with run_log.stage("fit", snapshot_memory=True), \
                 maybe_profile(run_log, args.profile, label="train") as prof:
             result = fit(
@@ -224,7 +238,8 @@ def cmd_train_ensemble(args, config) -> int:
     # run reproduces exactly the members a fresh run would have produced.
     from apnea_uq_tpu.telemetry.profiler import maybe_profile
 
-    with _run(args, "train-ensemble", config) as run_log:
+    with _compile_env(args, config), \
+            _run(args, "train-ensemble", config) as run_log:
         with run_log.stage("fit_ensemble", snapshot_memory=True), \
                 maybe_profile(run_log, args.profile,
                               label="train-ensemble") as prof:
@@ -247,6 +262,49 @@ def cmd_train_ensemble(args, config) -> int:
         extra = (f" (incl. {promoted} promoted padded slots)"
                  if promoted else "")
         log(f"saved {result.num_members} members{extra} -> {store.root}")
+    return 0
+
+
+def cmd_warm_cache(args, config) -> int:
+    """Precompile the hot-path program zoo for this config (ISSUE 7):
+    every program a later train / train-ensemble / eval-mcd / eval-de
+    run would compile is compiled NOW — exportable ones serialized into
+    the program store, every backend compile banked in the persistent
+    XLA cache — so production stages start hot instead of paying
+    multi-minute cold-start compiles per process."""
+    from apnea_uq_tpu.compilecache import zoo
+
+    registry = _registry(args)
+    groups = tuple(g.strip() for g in args.programs.split(",") if g.strip())
+    bad = set(groups) - set(zoo.WARM_GROUPS)
+    if bad:
+        raise SystemExit(
+            f"warm-cache: unknown --programs group(s) {sorted(bad)}; "
+            f"valid: {','.join(zoo.WARM_GROUPS)}"
+        )
+    with _compile_env(args, config) as store, \
+            _run(args, "warm-cache", config) as run_log:
+        if store is None:
+            raise SystemExit(
+                "warm-cache: the compile-cost subsystem is disabled "
+                "(CompileCacheConfig.enabled=false or "
+                "APNEA_UQ_COMPILE_CACHE=0); nothing to warm"
+            )
+        with run_log.stage("warm_cache", snapshot_memory=True):
+            warmed = zoo.warm_cache(
+                registry, config, num_members=args.num_members,
+                groups=groups, ckpt_root=_ckpt_root(args),
+                run_log=run_log,
+            )
+        fresh = sum(1 for w in warmed if w["source"] == "jit")
+        total = sum(w["lower_s"] + w["compile_s"] for w in warmed)
+        for w in warmed:
+            log(f"  {w['label']}: {w['source']}"
+                f" (lower {w['lower_s']:.2f}s"
+                f" compile {w['compile_s']:.2f}s)")
+        log(f"warmed {len(warmed)} program(s) ({fresh} freshly compiled, "
+            f"{len(warmed) - fresh} already hot) in {total:.1f}s"
+            + (f" -> {store.root}" if store.root else ""))
     return 0
 
 
@@ -381,7 +439,8 @@ def cmd_eval_mcd(args, config) -> int:
     state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
     _prepared, sets = _load_test_sets(registry)
     uq_config = _eval_uq_config(args, config)
-    with _run(args, "eval-mcd", config) as run_log:
+    with _compile_env(args, config), \
+            _run(args, "eval-mcd", config) as run_log:
         for i, (label, (x, y, ids)) in enumerate(sets.items()):
             # Trace only the device-heavy evaluation; plots/registry writes
             # would otherwise dominate the XProf host timeline.  The
@@ -423,7 +482,8 @@ def cmd_eval_de(args, config) -> int:
     n_members = len(member_variables)  # resolved count (0 -> all existing)
     _prepared, sets = _load_test_sets(registry)
     uq_config = _eval_uq_config(args, config)
-    with _run(args, "eval-de", config) as run_log:
+    with _compile_env(args, config), \
+            _run(args, "eval-de", config) as run_log:
         for label, (x, y, ids) in sets.items():
             with run_log.stage(f"CNN_DE_{label}", snapshot_memory=True), \
                     profile_trace(getattr(args, "profile_dir", None)):
@@ -807,6 +867,23 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--ckpt-dir", default=None)
     _add_run_dir_arg(p)
     _add_profile_flag(p)
+
+    p = add("warm-cache", cmd_warm_cache,
+            "Precompile the hot-path program zoo (AOT program store + "
+            "persistent XLA cache) so later stages start hot.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--ckpt-dir", default=None)
+    _add_run_dir_arg(p)
+    p.add_argument("--programs", default=",".join(
+        ("eval-mcd", "eval-de", "train", "train-ensemble")),
+        help="Comma-separated stage groups to warm "
+             "(eval-mcd,eval-de,train,train-ensemble; default all).")
+    p.add_argument("--num-members", type=int, default=0,
+                   help="Ensemble members the later eval-de will run "
+                        "with (must match its --num-members; default 0 "
+                        "= every checkpointed member when an ensemble "
+                        "store exists, else the configured "
+                        "EnsembleConfig.num_members).")
 
     p = add("eval-mcd", cmd_eval_mcd, "MC-Dropout UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)

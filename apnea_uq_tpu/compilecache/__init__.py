@@ -1,0 +1,56 @@
+"""Compile-cost subsystem: persistent XLA cache + AOT program store.
+
+Pay for XLA compilation once per (program, shapes, topology, code
+version), not once per process (ISSUE 7):
+
+- :mod:`~apnea_uq_tpu.compilecache.store` — the core:
+  :func:`enable_persistent_cache` (JAX's on-disk compilation cache under
+  ``<registry>/xla-cache``), :class:`ProgramStore` (``jax.export``-
+  serialized named hot-path programs with compile-on-miss fallback),
+  :func:`get_program` (one lowering shared between HBM pricing and
+  execution), and :func:`activate` (the per-stage context the CLI uses);
+- :mod:`~apnea_uq_tpu.compilecache.zoo` — the named program zoo behind
+  ``apnea-uq warm-cache``: precompile every hot-path program a config
+  will run, so production eval/train starts hot;
+- :mod:`~apnea_uq_tpu.compilecache.probe` — the cold-vs-warm start probe
+  bench.py's ``compile`` context block runs in subprocesses.
+
+Everything resolves lazily (PEP 562): importing this package costs no
+jax import, and the AST linter scans it without executing anything.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "ProgramStore": "store",
+    "Program": "store",
+    "get_program": "store",
+    "active_store": "store",
+    "use_store": "store",
+    "activate": "store",
+    "enable_persistent_cache": "store",
+    "program_signature": "store",
+    "store_key": "store",
+    "backend_fingerprint": "store",
+    "warm_cache": "zoo",
+    "GROUP_LABELS": "zoo",
+    "WARM_GROUPS": "zoo",
+}
+
+__all__ = sorted(_LAZY)
+
+_SUBMODULES = frozenset({"store", "zoo", "probe"})
+
+
+def __getattr__(name: str):
+    import importlib
+
+    module = _LAZY.get(name)
+    if module is not None:
+        return getattr(
+            importlib.import_module(f"apnea_uq_tpu.compilecache.{module}"),
+            name,
+        )
+    if name in _SUBMODULES:
+        return importlib.import_module(f"apnea_uq_tpu.compilecache.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,96 @@
+"""Cold-vs-warm start probe: ``python -m apnea_uq_tpu.compilecache.probe``.
+
+One subprocess = one process start.  The probe wires the persistent XLA
+cache and the program store at the given directories, acquires and runs
+the fused MCD predict program once at the given shapes, and prints ONE
+JSON line with the in-process timings::
+
+    {"acquire_s": ..., "predict_s": ..., "total_s": ...,
+     "source": "jit" | "store", "backend_compiles": N,
+     "persistent_cache_misses": N}
+
+bench.py's ``compile`` context block runs it twice against the same
+fresh directories — the first run is the true cold start (trace + lower
++ XLA compile), the second the warmed start (store hit + cache hit) —
+and reports both sides plus the process wall clock, so the cold-start
+cost the subsystem removes is a measured number, not prose.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apnea_uq_tpu.compilecache.probe")
+    parser.add_argument("--cache-dir", required=True)
+    parser.add_argument("--store-dir", required=True)
+    parser.add_argument("--windows", type=int, default=2048)
+    parser.add_argument("--passes", type=int, default=50)
+    parser.add_argument("--chunk", type=int, default=512)
+    parser.add_argument("--platform", default=None,
+                        help="Retarget the backend (the BENCH_PLATFORM "
+                             "dance: a config update, because "
+                             "sitecustomize pins JAX_PLATFORMS at boot).")
+    parser.add_argument("--dtype", default="bfloat16")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from apnea_uq_tpu.compilecache.store import (
+        ProgramStore, enable_persistent_cache, use_store,
+    )
+    from apnea_uq_tpu.config import ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.telemetry.steps import compile_counts
+    from apnea_uq_tpu.uq.predict import mc_dropout_predict
+    from apnea_uq_tpu.utils import prng
+
+    # Explicit dirs always win (force=True): the probe measures THESE
+    # caches, whatever the ambient environment configured.
+    enable_persistent_cache(args.cache_dir, force=True)
+    store = ProgramStore(args.store_dir)
+    model = AlarconCNN1D(ModelConfig(compute_dtype=args.dtype))
+    variables = init_variables(model, jax.random.key(0))
+    x = np.zeros((args.windows, 60, 4), np.float32)
+    key = prng.stochastic_key(1)
+
+    before = compile_counts()
+    t0 = time.perf_counter()
+    with use_store(store):
+        stats = mc_dropout_predict(
+            model, variables, x, n_passes=args.passes, mode="clean",
+            batch_size=args.chunk, key=key, stats=("nats", 1e-10),
+        )
+    acquired = time.perf_counter()
+    np.asarray(stats)  # force execution + D2H
+    done = time.perf_counter()
+    after = compile_counts()
+    acquisition = store.history[0] if store.history else {}
+    # The one result line is the machine interface; the module prints
+    # nothing else to stdout.
+    # apnea-lint: disable=bare-print -- the probe's stdout IS the machine interface bench.py parses (one JSON line)
+    print(json.dumps({
+        "acquire_s": round(acquired - t0, 3),
+        "predict_s": round(done - acquired, 3),
+        "total_s": round(done - t0, 3),
+        "source": acquisition.get("source"),
+        "backend_compiles": (after["backend_compiles"]
+                             - before["backend_compiles"]),
+        "persistent_cache_misses": (after["persistent_cache_misses"]
+                                    - before["persistent_cache_misses"]),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,634 @@
+"""Persistent compile cache + AOT program store (ISSUE 7 tentpole).
+
+Every process start used to recompile the full program zoo — the four
+predict families, ``train_epoch``/``val_loss``, the lockstep
+``ensemble_epoch`` — from scratch, and the HBM accounting paid a *second*
+AOT ``lower().compile()`` on top because it could not share the jit call
+cache.  This module makes recompilation a one-time cost per (program,
+shapes, topology, code version), in three layers:
+
+1. **Persistent XLA cache** (:func:`enable_persistent_cache`): JAX's
+   ``jax_compilation_cache_dir`` pointed at ``<registry>/xla-cache``
+   (env-overridable) with the min-entry-size / min-compile-time knobs
+   from :class:`~apnea_uq_tpu.config.CompileCacheConfig`, so identical
+   backend compiles are disk hits across processes.
+2. **:class:`ProgramStore`** — an explicit AOT store for the *named*
+   hot-path programs: each is re-expressed as a jitted wrapper over its
+   array leaves (static/aux leaves closed over; typed PRNG keys travel
+   as their ``uint32`` key data, because ``jax.export`` cannot serialize
+   extended key dtypes), exported via ``jax.export``, serialized to
+   ``<store>/<key>.jaxprog``, and keyed by (label, abstract argument
+   signature incl. shardings, jax/jaxlib version, backend+topology
+   fingerprint, package source hash).  A warmed second process
+   deserializes the StableHLO — no trace/lower — and its backend compile
+   of the identical module is a persistent-cache disk hit, so the hot
+   path runs with **zero fresh XLA compiles**.  Both processes execute
+   through ``jax.jit(exported.call)`` compiled from the *deserialized*
+   bytes, which is what makes the two modules byte-identical.
+3. **One lowering, shared** (:func:`get_program`): the returned
+   :class:`Program` carries the compiled executable *and* its
+   ``memory_analysis()`` fields, persisted alongside the serialized
+   program — ``record_jit_memory`` consumes them instead of paying its
+   own AOT compile, and the execution path dispatches the same
+   executable.  Compile-on-miss is always the fallback; every failure
+   mode (unexportable program, missing store, version skew) degrades to
+   the plain jit path.
+
+Every acquisition is recorded as a ``compile_event`` telemetry event
+(label, ``source=jit|store|cache``, hit/miss, lower/compile seconds,
+compile-counter deltas) so ``telemetry summarize`` can render the hit
+ratio and ``telemetry compare`` can gate cold-start regressions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+import hashlib
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apnea_uq_tpu.telemetry import log
+from apnea_uq_tpu.telemetry.memory import memory_analysis_fields
+from apnea_uq_tpu.telemetry.runlog import current_run
+from apnea_uq_tpu.telemetry.steps import compile_counts
+
+STORE_SUFFIX = ".jaxprog"
+META_SUFFIX = ".json"
+
+# Innermost-last stack of active stores; get_program is a no-op (None)
+# outside any activation so library callers see byte-identical behavior
+# unless a CLI stage / warm-cache / test opted in.
+_ACTIVE: List["ProgramStore"] = []
+
+
+def active_store() -> Optional["ProgramStore"]:
+    """The innermost active program store, or None outside any."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+@contextlib.contextmanager
+def use_store(store: "ProgramStore"):
+    """Make ``store`` the active store for the block."""
+    _ACTIVE.append(store)
+    try:
+        yield store
+    finally:
+        while store in _ACTIVE:
+            _ACTIVE.remove(store)
+
+
+def _cache_disabled() -> bool:
+    return os.environ.get("APNEA_UQ_COMPILE_CACHE", "1").lower() in (
+        "0", "false", "off")
+
+
+def enable_persistent_cache(
+    cache_dir: str,
+    *,
+    min_entry_size_bytes: int = 0,
+    min_compile_time_secs: float = 0.0,
+    force: bool = False,
+) -> Dict[str, Any]:
+    """Point JAX's persistent compilation cache at ``cache_dir`` with the
+    given thresholds.  When a cache dir is already configured (the
+    ``JAX_COMPILATION_CACHE_DIR`` env var, a test rig, a notebook) that
+    choice — thresholds included — wins unless ``force``.  Returns the
+    previous values of every config entry changed, for restoration."""
+    prev: Dict[str, Any] = {}
+    if jax.config.jax_compilation_cache_dir and not force:
+        return prev
+    for name, value in (
+        ("jax_compilation_cache_dir", cache_dir),
+        ("jax_persistent_cache_min_entry_size_bytes",
+         int(min_entry_size_bytes)),
+        ("jax_persistent_cache_min_compile_time_secs",
+         float(min_compile_time_secs)),
+    ):
+        prev[name] = getattr(jax.config, name)
+        jax.config.update(name, value)
+    return prev
+
+
+@contextlib.contextmanager
+def activate(cc_config=None, registry_root: Optional[str] = None):
+    """Activate the whole compile-cost subsystem for a stage: wire the
+    persistent XLA cache (default ``<registry>/xla-cache``, env override
+    ``APNEA_UQ_XLA_CACHE_DIR``) and push a :class:`ProgramStore`
+    (default ``<registry>/program-store``, env override
+    ``APNEA_UQ_PROGRAM_STORE_DIR``).  Yields the store, or None when the
+    subsystem is disabled (``CompileCacheConfig.enabled`` false or
+    ``APNEA_UQ_COMPILE_CACHE=0``).  Restores any jax config entries it
+    changed on exit."""
+    if _cache_disabled() or (cc_config is not None
+                             and not cc_config.enabled):
+        yield None
+        return
+    cache_dir = (
+        (cc_config.cache_dir if cc_config is not None else "")
+        or os.environ.get("APNEA_UQ_XLA_CACHE_DIR", "")
+        or (os.path.join(registry_root, "xla-cache") if registry_root
+            else "")
+    )
+    prev: Dict[str, Any] = {}
+    if cache_dir:
+        prev = enable_persistent_cache(
+            cache_dir,
+            min_entry_size_bytes=(cc_config.min_entry_size_bytes
+                                  if cc_config is not None else 0),
+            min_compile_time_secs=(cc_config.min_compile_time_secs
+                                   if cc_config is not None else 0.0),
+            # An explicit config/env dir is a deliberate operator choice;
+            # only the registry-derived default defers to a pre-set cache.
+            force=bool((cc_config is not None and cc_config.cache_dir)
+                       or os.environ.get("APNEA_UQ_XLA_CACHE_DIR")),
+        )
+    store_dir = None
+    if cc_config is None or cc_config.program_store:
+        store_dir = (
+            (cc_config.store_dir if cc_config is not None else "")
+            or os.environ.get("APNEA_UQ_PROGRAM_STORE_DIR", "")
+            or (os.path.join(registry_root, "program-store")
+                if registry_root else "")
+        ) or None
+    store = ProgramStore(store_dir)
+    try:
+        with use_store(store):
+            yield store
+    finally:
+        for name, value in prev.items():
+            jax.config.update(name, value)
+
+
+# ------------------------------------------------------------- keying ----
+
+def _source_version() -> str:
+    """Code-version component of the store key: hash of every ``.py``
+    source in the package (a code change must invalidate stored
+    programs — the serialized StableHLO was traced from the old code).
+    ``APNEA_UQ_SOURCE_VERSION`` overrides (tests pin staleness with it)."""
+    override = os.environ.get("APNEA_UQ_SOURCE_VERSION")
+    if override:
+        return override
+    return _hashed_package_source()
+
+
+@functools.lru_cache(maxsize=1)
+def _hashed_package_source() -> str:
+    import apnea_uq_tpu
+
+    root = os.path.dirname(os.path.abspath(apnea_uq_tpu.__file__))
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            h.update(os.path.relpath(path, root).encode())
+            with open(path, "rb") as f:
+                h.update(hashlib.sha256(f.read()).digest())
+    return h.hexdigest()
+
+
+def backend_fingerprint() -> str:
+    """Backend + topology component of the store key: a program compiled
+    for one platform/device-kind/device-count must never be offered to
+    another."""
+    try:
+        devices = jax.devices()
+        return (f"{devices[0].platform}/{devices[0].device_kind}"
+                f"/d{len(devices)}/p{jax.process_count()}")
+    except Exception:  # noqa: BLE001 - no backend: key still forms
+        return "nobackend"
+
+
+def _is_array_leaf(leaf: Any) -> bool:
+    return hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+
+
+def _is_typed_key(leaf: Any) -> bool:
+    try:
+        return _is_array_leaf(leaf) and jnp.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key)
+    except Exception:  # noqa: BLE001 - exotic dtype objects
+        return False
+
+
+def _sharding_desc(leaf: Any) -> str:
+    """The sharding component of a leaf's signature: the sharding when it
+    is pinned (a committed array, or an aval carrying one), else "" —
+    so the record_memory_only pre-pass (avals with explicit shardings)
+    and the real call (committed arrays) key identically, while programs
+    lowered at different placements never collide."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None:
+        return ""
+    if isinstance(leaf, jax.ShapeDtypeStruct) or getattr(
+            leaf, "_committed", False):
+        return str(sharding)
+    return ""
+
+
+def program_signature(args: tuple, kwargs: dict) -> str:
+    """Process-stable abstract signature of a call: array leaves become
+    (shape, dtype, pinned sharding), everything else its repr — the same
+    distinctions the jit cache key makes, plus placement."""
+    flat, treedef = jax.tree.flatten((args, dict(kwargs)))
+    parts = []
+    for leaf in flat:
+        if _is_array_leaf(leaf):
+            parts.append(
+                f"arr{tuple(leaf.shape)}:{leaf.dtype}:{_sharding_desc(leaf)}"
+            )
+        elif callable(leaf) and not isinstance(leaf, type):
+            # Function leaves (optax transforms are namedtuples of
+            # closures): repr embeds the process-local address, which
+            # would make the key differ on every process/activation —
+            # the qualname is the stable identity (the code-version hash
+            # already covers behavioral drift).
+            parts.append(
+                f"fn:{getattr(leaf, '__module__', '?')}."
+                f"{getattr(leaf, '__qualname__', repr(leaf))}"
+            )
+        else:
+            parts.append(repr(leaf))
+    return f"{treedef}|{';'.join(parts)}"
+
+
+def store_key(label: str, signature: str) -> str:
+    """sha256 over every invalidation axis of one stored program."""
+    import jaxlib
+
+    material = json.dumps({
+        "label": label,
+        "signature": signature,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": backend_fingerprint(),
+        "source": _source_version(),
+    }, sort_keys=True)
+    return hashlib.sha256(material.encode()).hexdigest()
+
+
+# ------------------------------------------------------- program build ----
+
+@dataclasses.dataclass
+class Program:
+    """One acquired hot-path program: a callable executable plus the
+    memory-analysis fields priced when it was first compiled.
+
+    ``source`` is how THIS acquisition resolved: ``"jit"`` = fresh
+    trace+lower+compile (miss), ``"store"`` = deserialized from the
+    on-disk program store (no trace/lower; backend compile via the
+    persistent cache), ``"cache"`` = the in-process memo.  Call it with
+    the exact (positionally-bound) argument structure it was built from;
+    static/aux leaves are baked and only the array leaves are consumed.
+    """
+
+    label: str
+    source: str
+    key: str
+    signature: str
+    memory_fields: Optional[Dict[str, int]]
+    lower_s: float
+    compile_s: float
+    executable: Any
+    _treedef: Any
+    _arr_idx: Tuple[int, ...]
+    _key_impls: Dict[int, str]
+
+    def __call__(self, *args, **kwargs):
+        flat, treedef = jax.tree.flatten((args, dict(kwargs)))
+        if treedef != self._treedef:
+            raise ValueError(
+                f"program {self.label!r} called with argument structure "
+                f"{treedef}, but it was built for {self._treedef}"
+            )
+        arrs = [
+            jax.random.key_data(flat[i]) if i in self._key_impls
+            else flat[i]
+            for i in self._arr_idx
+        ]
+        return self.executable(*arrs)
+
+
+def _split_leaves(args: tuple, kwargs: dict):
+    """(flat leaves, treedef, array positions, aux leaves, key impls)."""
+    flat, treedef = jax.tree.flatten((args, dict(kwargs)))
+    arr_idx: List[int] = []
+    aux: Dict[int, Any] = {}
+    key_impls: Dict[int, str] = {}
+    for i, leaf in enumerate(flat):
+        if _is_array_leaf(leaf):
+            arr_idx.append(i)
+            if _is_typed_key(leaf):
+                key_impls[i] = str(jax.random.key_impl(leaf))
+        else:
+            aux[i] = leaf
+    return flat, treedef, tuple(arr_idx), aux, key_impls
+
+
+def _leaf_specs(flat, arr_idx, key_impls):
+    """ShapeDtypeStructs for the wrapper's array arguments.  Uncommitted
+    leaves in a program that has any mesh-sharded (NamedSharding) leaf
+    are exported replicated over that mesh — ``jax.export`` gives every
+    arg a placement, and a bare single-device default would conflict
+    with the multi-device assignment at lowering time."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = None
+    for i in arr_idx:
+        sharding = getattr(flat[i], "sharding", None)
+        if isinstance(sharding, NamedSharding) and _sharding_desc(flat[i]):
+            mesh = sharding.mesh
+            break
+    replicated = (NamedSharding(mesh, PartitionSpec()) if mesh is not None
+                  else None)
+    specs = []
+    for i in arr_idx:
+        leaf = flat[i]
+        if i in key_impls:
+            leaf = jax.random.key_data(leaf)
+        sharding = (getattr(leaf, "sharding", None)
+                    if _sharding_desc(leaf) else None) or replicated
+        specs.append(jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype,
+                                          sharding=sharding))
+    return specs
+
+
+def _make_wrapper(fn: Callable, treedef, n_leaves: int, arr_idx, aux,
+                  key_impls) -> Callable:
+    """The exportable twin of ``fn(*args, **kwargs)``: a function of the
+    array leaves only.  Static/aux leaves are closed over, typed PRNG
+    keys arrive as uint32 key data and are re-wrapped — the numerics are
+    the original program's, inlined under one jit."""
+
+    def wrapper(*arrs):
+        leaves: List[Any] = [None] * n_leaves
+        for i, value in aux.items():
+            leaves[i] = value
+        for pos, arr in zip(arr_idx, arrs):
+            leaves[pos] = (
+                jax.random.wrap_key_data(arr, impl=key_impls[pos])
+                if pos in key_impls else arr
+            )
+        args, kwargs = jax.tree.unflatten(treedef, leaves)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def _donated_leaf_positions(args: tuple, kwargs: dict, donate_args,
+                            arr_idx) -> Tuple[int, ...]:
+    """Wrapper-parameter indices of the leaves under the donated
+    positional args — donation must survive the re-expression, or the
+    stored twin of a donating program (the lockstep ensemble epoch)
+    would double its HBM footprint."""
+    if not donate_args:
+        return ()
+    donated_flat: set = set()
+    offset = 0
+    for pos, arg in enumerate(args):
+        n = len(jax.tree.flatten(arg)[0])
+        if pos in donate_args:
+            donated_flat.update(range(offset, offset + n))
+        offset += n
+    # kwargs flatten after args in the ((args, kwargs)) tree; donation is
+    # positional-only here, so kwargs leaves are never donated.
+    return tuple(
+        wrapper_pos for wrapper_pos, flat_pos in enumerate(arr_idx)
+        if flat_pos in donated_flat
+    )
+
+
+class ProgramStore:
+    """On-disk + in-memory store of AOT-compiled named programs.
+
+    ``root=None`` keeps the store purely in-process (the one-lowering
+    sharing still works; nothing persists).  All failures degrade to
+    returning ``None`` from :meth:`get`, never raising into a run."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root
+        self._programs: Dict[str, Program] = {}
+        self._failed: set = set()
+        # Chronological compile_event field dicts (run-log-independent
+        # mirror, so warm-cache and the bench probe can report sources
+        # without re-reading events.jsonl).
+        self.history: List[Dict[str, Any]] = []
+
+    # -- paths ------------------------------------------------------------
+
+    def _blob_path(self, key: str) -> str:
+        return os.path.join(self.root, key + STORE_SUFFIX)
+
+    def _meta_path(self, key: str) -> str:
+        return os.path.join(self.root, key + META_SUFFIX)
+
+    def _persist(self, key: str, blob: bytes, meta: Dict[str, Any]) -> None:
+        if self.root is None:
+            return
+        try:
+            if jax.process_index() != 0:
+                return  # one writer on multi-process topologies
+        except Exception:  # noqa: BLE001 - no backend: single process
+            pass
+        os.makedirs(self.root, exist_ok=True)
+        for path, data in ((self._blob_path(key), blob),
+                           (self._meta_path(key),
+                            json.dumps(meta, indent=2).encode())):
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+
+    def _load_serialized(self, key: str):
+        """(blob, meta) when both files exist and parse, else None."""
+        if self.root is None:
+            return None
+        blob_path, meta_path = self._blob_path(key), self._meta_path(key)
+        if not (os.path.exists(blob_path) and os.path.exists(meta_path)):
+            return None
+        try:
+            with open(blob_path, "rb") as f:
+                blob = f.read()
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if meta.get("key") != key:
+            return None
+        return blob, meta
+
+    # -- acquisition ------------------------------------------------------
+
+    def get(self, label: str, fn: Callable, args: tuple, kwargs: dict,
+            *, exportable: bool = True, donate_args: Tuple[int, ...] = (),
+            run_log=None) -> Optional[Program]:
+        """Acquire the compiled program for ``fn(*args, **kwargs)``:
+        in-process memo, then the on-disk store (``exportable`` programs
+        only), then compile-on-miss (exporting + persisting when
+        possible).  Returns None when acquisition failed — callers fall
+        back to the plain jit path.  Emits one ``compile_event`` per
+        acquisition."""
+        try:
+            signature = program_signature(args, kwargs)
+            key = store_key(label, signature)
+        except Exception:  # noqa: BLE001 - unkeyable args: jit fallback
+            return None
+        if key in self._failed:
+            return None
+        cached = self._programs.get(key)
+        if cached is not None:
+            program = dataclasses.replace(cached, source="cache")
+            self._event(program, run_log, lower_s=0.0, compile_s=0.0,
+                        deltas={})
+            return program
+        try:
+            program = self._acquire(label, fn, args, kwargs, signature,
+                                    key, exportable, donate_args, run_log)
+        except Exception as e:  # noqa: BLE001 - never break a run
+            # One log line, one failed attempt: the program is unexportable
+            # or otherwise unbuildable in this environment, so stop paying
+            # the attempt (the plain jit path serves every later call).
+            self._failed.add(key)
+            log(f"program store: building {label!r} failed "
+                f"({type(e).__name__}: {e}); falling back to plain jit")
+            return None
+        self._programs[key] = program
+        return program
+
+    def _acquire(self, label, fn, args, kwargs, signature, key,
+                 exportable, donate_args, run_log) -> Program:
+        from jax import export as jax_export
+
+        flat, treedef, arr_idx, aux, key_impls = _split_leaves(args, kwargs)
+        specs = _leaf_specs(flat, arr_idx, key_impls)
+        common = dict(label=label, key=key, signature=signature,
+                      _treedef=treedef, _arr_idx=arr_idx,
+                      _key_impls=key_impls)
+
+        loaded = self._load_serialized(key) if exportable else None
+        before = compile_counts()
+        if loaded is not None:
+            blob, meta = loaded
+            t0 = time.perf_counter()
+            exported = jax_export.deserialize(blob)
+            lower_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            executable = jax.jit(exported.call).lower(*specs).compile()
+            compile_s = time.perf_counter() - t0
+            program = Program(
+                source="store", memory_fields=meta.get("memory_fields"),
+                lower_s=round(lower_s, 6), compile_s=round(compile_s, 6),
+                executable=executable, **common)
+            self._event(program, run_log, lower_s=lower_s,
+                        compile_s=compile_s,
+                        deltas=_count_deltas(before, compile_counts()))
+            return program
+
+        wrapper = _make_wrapper(fn, treedef, len(flat), arr_idx, aux,
+                                key_impls)
+        donate = _donated_leaf_positions(args, kwargs, tuple(donate_args),
+                                         arr_idx)
+        wrapped = jax.jit(wrapper, donate_argnums=donate or ())
+        t0 = time.perf_counter()
+        blob = None
+        if exportable and not donate:
+            try:
+                # Round-trip through serialize/deserialize BEFORE
+                # compiling, so this process and every later store-hit
+                # process compile the byte-identical module — that
+                # identity is what turns the warm process's backend
+                # compile into a guaranteed persistent-cache hit.
+                blob = jax_export.export(wrapped)(*specs).serialize()
+                to_compile = jax.jit(jax_export.deserialize(blob).call)
+            except Exception:  # noqa: BLE001 - unexportable: AOT-share only
+                blob = None
+                to_compile = wrapped
+        else:
+            # Donating programs are AOT-shared in-process (and their
+            # backend compile still lands in the persistent XLA cache)
+            # but not serialized: jax.export drops donation, and a
+            # store-loaded twin would silently double the program's HBM
+            # footprint.
+            to_compile = wrapped
+        lowered = to_compile.lower(*specs)
+        lower_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        executable = lowered.compile()
+        compile_s = time.perf_counter() - t0
+        memory_fields = None
+        try:
+            stats = executable.memory_analysis()
+            if stats is not None:
+                memory_fields = memory_analysis_fields(stats)
+        except Exception:  # noqa: BLE001 - accounting is best-effort
+            pass
+        program = Program(
+            source="jit", memory_fields=memory_fields,
+            lower_s=round(lower_s, 6), compile_s=round(compile_s, 6),
+            executable=executable, **common)
+        if blob is not None:
+            self._persist(key, blob, {
+                "label": label, "key": key, "signature": signature,
+                "jax": jax.__version__,
+                "backend": backend_fingerprint(),
+                "source_version": _source_version(),
+                "memory_fields": memory_fields,
+                "lower_s": program.lower_s,
+                "compile_s": program.compile_s,
+                "created_ts": round(time.time(), 3),
+            })
+        self._event(program, run_log, lower_s=lower_s, compile_s=compile_s,
+                    deltas=_count_deltas(before, compile_counts()))
+        return program
+
+    def _event(self, program: Program, run_log, *, lower_s: float,
+               compile_s: float, deltas: Dict[str, int]) -> None:
+        fields = {
+            "label": program.label,
+            "source": program.source,
+            "hit": program.source != "jit",
+            "lower_s": round(lower_s, 6),
+            "compile_s": round(compile_s, 6),
+            "backend_compiles": deltas.get("backend_compiles", 0),
+            "persistent_cache_hits": deltas.get("persistent_cache_hits", 0),
+            "persistent_cache_misses": deltas.get(
+                "persistent_cache_misses", 0),
+            "key": program.key[:16],
+        }
+        self.history.append(dict(fields))
+        if run_log is None:
+            run_log = current_run()
+        if run_log is not None and not getattr(run_log, "disabled", False):
+            try:
+                run_log.event("compile_event", **fields)
+            except Exception:  # noqa: BLE001 - telemetry must never break
+                pass
+
+
+def _count_deltas(before: Dict[str, int], after: Dict[str, int]):
+    return {k: after.get(k, 0) - before.get(k, 0) for k in after}
+
+
+def get_program(label: str, fn: Callable, *args,
+                exportable: bool = True,
+                donate_args: Tuple[int, ...] = (),
+                run_log=None, **kwargs) -> Optional[Program]:
+    """Acquire ``label``'s compiled program from the active store, or
+    None when no store is active (callers then dispatch the plain jitted
+    ``fn`` — the pre-subsystem behavior, byte for byte)."""
+    store = active_store()
+    if store is None:
+        return None
+    return store.get(label, fn, tuple(args), dict(kwargs),
+                     exportable=exportable, donate_args=donate_args,
+                     run_log=run_log)

@@ -1,0 +1,198 @@
+"""The named hot-path program zoo behind ``apnea-uq warm-cache``.
+
+``warm_cache`` precompiles, prices, and (where exportable) persists every
+program a given config will dispatch — the four predict families, the
+deterministic sanity/eval predictor, ``train_epoch``/``val_loss``, and
+the lockstep ``ensemble_epoch`` — so a later production eval/train
+process starts hot: program-store hits skip trace+lower, and every
+backend compile is a persistent-XLA-cache disk hit.
+
+Nothing here re-derives argument shapes by hand: the warm paths are the
+*real* entry points in their no-dispatch modes (``record_memory_only=True``
+on the predictors, ``compile_only=True`` on the trainers), so the warmed
+program signatures are the executed ones by construction — the property
+the zoo-coverage test (tests/test_compilecache.py) pins from the other
+side by asserting every memory-priced label in the drivers has a zoo
+entry.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+# One entry per warmable stage group; the label sets double as the
+# store-vs-pricing-table drift pin: every ``*_fused``/memory-priced
+# label the drivers use MUST appear here (enforced by
+# tests/test_compilecache.py against the driver sources).
+WARM_GROUPS: Tuple[str, ...] = (
+    "eval-mcd", "eval-de", "train", "train-ensemble",
+)
+
+GROUP_LABELS: Dict[str, Tuple[str, ...]] = {
+    "eval-mcd": ("mcd_predict", "mcd_predict_fused",
+                 "mcd_chunk_predict", "mcd_chunk_predict_fused",
+                 "predict_eval"),
+    "eval-de": ("de_predict", "de_predict_fused",
+                "de_chunk_predict", "de_chunk_predict_fused"),
+    "train": ("train_epoch", "val_loss"),
+    "train-ensemble": ("ensemble_epoch",),
+}
+
+
+def _test_set_shapes(prepared) -> List[Tuple[int, ...]]:
+    shapes = [tuple(prepared.x_test.shape)]
+    if prepared.x_test_rus is not None:
+        shapes.append(tuple(prepared.x_test_rus.shape))
+    return shapes
+
+
+def resolve_de_members(num_members: int, config,
+                       ckpt_root: Optional[str]) -> int:
+    """The member count a later ``eval-de`` will actually run: an
+    explicit ``num_members`` wins; otherwise the checkpointed member
+    count when an ensemble store exists (eval-de's own ``--num-members
+    0`` resolution — a store grown by promoted padded slots, or by a
+    config edited after training, would otherwise make every warmed de_*
+    signature miss), else the configured ensemble size."""
+    if num_members > 0:
+        return num_members
+    if ckpt_root:
+        try:
+            from apnea_uq_tpu.training import EnsembleCheckpointStore
+
+            seeds = EnsembleCheckpointStore(
+                os.path.join(ckpt_root, "ensemble")).existing_seeds()
+            if seeds:
+                return len(seeds)
+        except Exception:  # noqa: BLE001 - no/unreadable store: config wins
+            pass
+    return config.ensemble.num_members
+
+
+def warm_cache(
+    registry,
+    config,
+    *,
+    num_members: int = 0,
+    groups: Tuple[str, ...] = WARM_GROUPS,
+    ckpt_root: Optional[str] = None,
+    run_log=None,
+) -> List[Dict[str, Any]]:
+    """Precompile the program zoo ``config`` selects, against the
+    registry's prepared data shapes.  ``num_members`` (<=0 → every
+    checkpointed member under ``ckpt_root`` when one exists, else the
+    configured ensemble size; see :func:`resolve_de_members`) must match
+    the ``--num-members`` a later ``eval-de`` will run with, or that
+    eval's member axis — and thus its program signature — will differ.
+    Returns the compile_event field dicts of every acquisition performed
+    (source ``jit`` = compiled and banked, ``store``/``cache`` = already
+    warm).  Streaming trainer configs have no single epoch program to
+    warm (their per-step programs are not memory-priced); those groups
+    log an explicit skip instead of silently warming nothing."""
+    import jax
+    import jax.numpy as jnp
+
+    from apnea_uq_tpu.compilecache.store import active_store
+    from apnea_uq_tpu.data.prepare import load_prepared
+    from apnea_uq_tpu.telemetry import log
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.parallel import fit_ensemble
+    from apnea_uq_tpu.parallel.mesh import make_mesh, make_mesh_from_config
+    from apnea_uq_tpu.training import create_train_state, fit
+    from apnea_uq_tpu.training.trainer import predict_proba_batched
+    from apnea_uq_tpu.uq.predict import (
+        ensemble_predict,
+        ensemble_predict_streaming,
+        mc_dropout_predict,
+        mc_dropout_predict_streaming,
+        stack_member_variables,
+    )
+    from apnea_uq_tpu.utils import prng
+
+    unknown = set(groups) - set(WARM_GROUPS)
+    if unknown:
+        raise ValueError(
+            f"unknown warm-cache group(s) {sorted(unknown)}; "
+            f"valid: {list(WARM_GROUPS)}"
+        )
+    store = active_store()
+    history_base = len(store.history) if store is not None else 0
+
+    need_train = bool({"train", "train-ensemble"} & set(groups))
+    prepared = load_prepared(registry, include_train=need_train)
+    model = AlarconCNN1D(config.model)
+    # Fresh-initialized variables are aval-identical to any checkpoint of
+    # this model config — values never matter to compilation.
+    variables = init_variables(model, jax.random.key(0))
+    uq = config.uq
+    stat_spec = ("nats", uq.entropy_eps) if uq.fused_reduction else None
+    test_shapes = _test_set_shapes(prepared)
+
+    if "eval-mcd" in groups:
+        mesh = make_mesh_from_config(config.mesh, num_members=uq.mc_passes)
+        predict = (mc_dropout_predict_streaming if uq.mcd_streaming
+                   else mc_dropout_predict)
+        key = prng.stochastic_key(config.train.seed)
+        for i, shape in enumerate(test_shapes):
+            x_aval = jax.ShapeDtypeStruct(shape, jnp.float32)
+            predict(
+                model, variables, x_aval,
+                n_passes=uq.mc_passes, mode=uq.mcd_mode,
+                batch_size=uq.mcd_batch_size, key=key, mesh=mesh,
+                run_log=run_log, record_memory_only=True, stats=stat_spec,
+            )
+            if i == 0:
+                # The drivers' deterministic sanity probe runs on the
+                # first test set only (run_mcd_analysis sanity_check).
+                predict_proba_batched(
+                    model, variables, x_aval,
+                    batch_size=uq.inference_batch_size, mesh=mesh,
+                    record_memory_only=True,
+                )
+
+    if "eval-de" in groups:
+        n_members = resolve_de_members(num_members, config, ckpt_root)
+        members = stack_member_variables([variables] * n_members)
+        mesh = make_mesh_from_config(config.mesh, num_members=n_members)
+        predict = (ensemble_predict_streaming if uq.de_streaming
+                   else ensemble_predict)
+        for shape in test_shapes:
+            x_aval = jax.ShapeDtypeStruct(shape, jnp.float32)
+            predict(
+                model, members, x_aval,
+                batch_size=uq.inference_batch_size, mesh=mesh,
+                run_log=run_log, record_memory_only=True, stats=stat_spec,
+            )
+
+    if "train" in groups:
+        if config.train.streaming:
+            log("warm-cache: train group SKIPPED — TrainConfig.streaming "
+                "dispatches per-step programs with no single epoch "
+                "program to warm")
+        else:
+            state = create_train_state(
+                model, jax.random.key(config.train.seed),
+                learning_rate=config.train.learning_rate,
+            )
+            fit(
+                model, state, prepared.x_train, prepared.y_train,
+                config.train, mesh=make_mesh(num_members=1),
+                run_log=run_log, compile_only=True,
+            )
+
+    if "train-ensemble" in groups:
+        if config.ensemble.streaming:
+            log("warm-cache: train-ensemble group SKIPPED — "
+                "EnsembleConfig.streaming dispatches per-step programs "
+                "with no single epoch program to warm")
+        else:
+            fit_ensemble(
+                model, prepared.x_train, prepared.y_train, config.ensemble,
+                mesh=make_mesh_from_config(
+                    config.mesh, num_members=config.ensemble.num_members),
+                run_log=run_log, compile_only=True,
+            )
+
+    return (list(store.history[history_base:]) if store is not None
+            else [])

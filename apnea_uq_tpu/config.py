@@ -224,6 +224,38 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class CompileCacheConfig:
+    """Compile-cost subsystem (apnea_uq_tpu/compilecache): pay for XLA
+    compilation once per (program, shapes, topology, code version), not
+    once per process.
+
+    ``cache_dir`` points JAX's persistent compilation cache at a
+    directory; "" resolves to ``APNEA_UQ_XLA_CACHE_DIR`` or
+    ``<registry>/xla-cache``, and defers to an already-configured cache
+    (``JAX_COMPILATION_CACHE_DIR``) when one is set.  The min-entry-size
+    / min-compile-time knobs mirror JAX's ``jax_persistent_cache_*``
+    thresholds; both default to 0 so every hot-path program is cached —
+    raise them on shared caches where tiny entries are churn.
+    ``program_store`` additionally AOT-serializes the *named* hot-path
+    programs (``jax.export``) under ``store_dir`` ("" →
+    ``APNEA_UQ_PROGRAM_STORE_DIR`` or ``<registry>/program-store``),
+    keyed by (label, aval signature, jax/jaxlib version,
+    backend+topology fingerprint, package source hash), so a warmed
+    second process skips trace+lower too — ``apnea-uq warm-cache``
+    precompiles the zoo.  ``enabled=False`` (or the
+    ``APNEA_UQ_COMPILE_CACHE=0`` env kill switch) turns the whole
+    subsystem off.
+    """
+
+    enabled: bool = True
+    cache_dir: str = ""
+    min_entry_size_bytes: int = 0
+    min_compile_time_secs: float = 0.0
+    program_store: bool = True
+    store_dir: str = ""
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     """Top-level bundle covering the whole pipeline."""
 
@@ -234,6 +266,8 @@ class ExperimentConfig:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     prepare: PrepareConfig = field(default_factory=PrepareConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    compilecache: CompileCacheConfig = field(
+        default_factory=CompileCacheConfig)
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -304,6 +338,7 @@ _NESTED = {
     "ingest": IngestConfig,
     "prepare": PrepareConfig,
     "mesh": MeshConfig,
+    "compilecache": CompileCacheConfig,
 }
 
 

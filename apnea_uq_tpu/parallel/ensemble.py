@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from apnea_uq_tpu.compilecache import store as program_store
 from apnea_uq_tpu.config import EnsembleConfig
 from apnea_uq_tpu.models.cnn1d import (
     AlarconCNN1D, apply_model, init_variables, predict_proba,
@@ -631,6 +632,7 @@ def fit_ensemble(
     log_fn=None,
     run_log=None,
     profiler=None,
+    compile_only: bool = False,
 ) -> EnsembleFitResult:
     """Train all N members concurrently over the mesh's ensemble axis,
     each member's batches data-parallel over the mesh's ``data`` axis.
@@ -685,6 +687,11 @@ def fit_ensemble(
     ``profiler`` (a :class:`apnea_uq_tpu.telemetry.profiler.TraceSession`)
     is stepped once per lockstep epoch, bounding a ``--profile`` capture
     to the session's warmup/step budget.
+
+    ``compile_only=True`` (the ``apnea-uq warm-cache`` stage) runs the
+    full setup, acquires/prices the exact lockstep epoch program via the
+    compile-cost subsystem — seeding the persistent XLA cache for the
+    next process — and returns None without training an epoch.
     """
     if streaming is None:
         streaming = config.streaming
@@ -730,23 +737,43 @@ def fit_ensemble(
     } if track else {}
     lockstep_epochs = 0
     step_metrics = StepMetrics(run_log) if run_log is not None else None
+    epoch_program = None
     with mesh:
         for epoch in range(config.num_epochs):
             epoch_key = jax.random.fold_in(shuffle_root, epoch)
             lockstep_epochs += 1
 
-            if run_log is not None and not streaming and epoch == 0:
-                # One-time compiled-HBM accounting of the exact lockstep
-                # program (deduped per signature in telemetry.memory):
-                # the member-stacked params/opt-state plus every slot's
-                # activations, priced before epoch 1 dispatches.
-                telemetry_memory.record_jit_memory(
-                    run_log, "ensemble_epoch", _ensemble_epoch,
-                    model, tx, state, book, x, y, x_val, y_val,
-                    epoch_key, member_ids, config.batch_size,
-                    config.early_stopping_patience, data_sharding,
-                    track,
-                )
+            if not streaming and epoch == 0:
+                # Acquire the exact lockstep program through the
+                # compile-cost subsystem (one lowering shared between the
+                # HBM pricing and every epoch's dispatch) and price it.
+                # exportable=False: jax.export drops buffer donation, and
+                # a store-loaded twin of this donating program would
+                # silently double the stacked-state HBM footprint — so
+                # the epoch is AOT-shared in-process (its backend compile
+                # still lands in the persistent XLA cache for the next
+                # process) but never serialized.
+                epoch_args = (model, tx, state, book, x, y, x_val, y_val,
+                              epoch_key, member_ids, config.batch_size,
+                              config.early_stopping_patience, data_sharding,
+                              track)
+                epoch_program = program_store.get_program(
+                    "ensemble_epoch", _ensemble_epoch, *epoch_args,
+                    exportable=False, donate_args=(2, 3), run_log=run_log)
+                if run_log is not None:
+                    # One-time compiled-HBM accounting of the exact
+                    # lockstep program (deduped per signature in
+                    # telemetry.memory): the member-stacked params/
+                    # opt-state plus every slot's activations, priced
+                    # before epoch 1 dispatches.
+                    telemetry_memory.record_jit_memory(
+                        run_log, "ensemble_epoch", _ensemble_epoch,
+                        *epoch_args, program=epoch_program,
+                    )
+            if compile_only:
+                # warm-cache: the lockstep program is built and priced;
+                # no epoch dispatches, nothing trains.
+                return None
 
             def run_lockstep_epoch():
                 if streaming:
@@ -755,6 +782,13 @@ def fit_ensemble(
                         epoch_key, member_ids, config.batch_size,
                         config.early_stopping_patience, mesh, data_sharding,
                         prefetch, track_metrics=track,
+                    )
+                if epoch_program is not None:
+                    return epoch_program(
+                        model, tx, state, book, x, y, x_val, y_val,
+                        epoch_key, member_ids, config.batch_size,
+                        config.early_stopping_patience, data_sharding,
+                        track,
                     )
                 return _ensemble_epoch(
                     model, tx, state, book, x, y, x_val, y_val, epoch_key,

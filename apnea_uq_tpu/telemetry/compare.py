@@ -142,9 +142,16 @@ def _metrics_from_bench_doc(doc: Dict[str, Any]) -> Dict[str, Metric]:
 
 def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
     """Comparable scalars of one run's event log: bench metric mirrors,
-    eval throughput, and the compiled-HBM peaks (so a footprint
-    regression gates like a speed regression)."""
+    eval throughput, the compiled-HBM peaks (so a footprint regression
+    gates like a speed regression), and the compile-cost roll-up —
+    ``compile.total_s`` (seconds spent acquiring programs,
+    lower-is-better) and ``compile.hit_ratio`` (store/cache hits over
+    acquisitions, higher-is-better) — so a cold-start regression (a
+    label falling out of the program store, a cache key churn) gates
+    like any other."""
     out: Dict[str, Metric] = {}
+    compile_n = compile_hits = 0
+    compile_total = 0.0
     for e in events:
         kind = e.get("kind")
         if kind == "bench_metric" and e.get("value") is not None:
@@ -178,6 +185,17 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
             name = f"memory.{e.get('label', '?')}.peak_bytes"
             out[name] = Metric(name, float(e["peak_bytes"]), "bytes",
                                False)
+        elif kind == "compile_event":
+            compile_n += 1
+            compile_hits += 1 if e.get("hit") else 0
+            compile_total += ((e.get("lower_s") or 0.0)
+                              + (e.get("compile_s") or 0.0))
+    if compile_n:
+        out["compile.total_s"] = Metric(
+            "compile.total_s", round(compile_total, 6), "seconds", False)
+        out["compile.hit_ratio"] = Metric(
+            "compile.hit_ratio", round(compile_hits / compile_n, 4),
+            "ratio", True)
     return out
 
 

@@ -17,11 +17,18 @@ table and ``telemetry compare`` can gate on footprint regressions:
   pprof dump saved under ``<run_dir>/memory/``, recorded as a
   ``memory_snapshot`` event.
 
-Cost note: ``record_jit_memory`` compiles the program a second time
-(AOT ``lower().compile()`` does not share the jit call cache), so call
-sites invoke it once per program signature — a per-run-log memo
-enforces that even when a caller (e.g. bench's repeated ``fit_ensemble``
-reps against one run log) cannot.  Per-RUN, not per-process: a second
+Cost note: with a driver-supplied ``program`` (the compile-cost
+subsystem's :class:`~apnea_uq_tpu.compilecache.Program`, carrying the
+executable and the stats priced when it was first compiled — persisted
+alongside the serialized program, so a ProgramStore hit skips the
+``memory_analysis()`` recompute entirely) the accounting costs nothing:
+one lowering serves pricing and execution both.  WITHOUT one — library
+callers outside any active store — ``record_jit_memory`` falls back to
+compiling the program a second time (AOT ``lower().compile()`` does not
+share the jit call cache), so call sites invoke it once per program
+signature — a per-run-log memo enforces that even when a caller (e.g.
+bench's repeated ``fit_ensemble`` reps against one run log) cannot.
+Per-RUN, not per-process: a second
 run in the same process (a notebook driver, back-to-back CLI stages)
 must get its own ``memory_profile`` events, or its HBM table comes up
 empty and its footprint metrics silently drop out of the compare gate.
@@ -120,13 +127,21 @@ def _abstract_signature(args: tuple, kwargs: dict) -> str:
 
 
 def record_jit_memory(run_log, label: str, fn, *args,
-                      **kwargs) -> Optional[Dict[str, Any]]:
+                      program=None, **kwargs) -> Optional[Dict[str, Any]]:
     """Lower+compile ``fn(*args, **kwargs)`` (a ``jax.jit``-wrapped
     callable, invoked exactly as the hot path is about to) and append a
     ``memory_profile`` event with its compiled memory analysis plus the
     device's HBM limit and headroom.  Deduped per run log per (label,
     argument signature); best-effort — returns the event record or None,
-    never raises.  ``APNEA_UQ_MEMORY_PROFILE=0`` disables the accounting
+    never raises.
+
+    ``program`` (a :class:`~apnea_uq_tpu.compilecache.Program` the
+    caller acquired for this exact call) supplies the memory fields
+    priced when the executable was first compiled — persisted alongside
+    the serialized program, so even a ProgramStore hit skips the
+    ``memory_analysis()`` recompute — and NO second AOT compile happens
+    here.  Without one, the historical double-compile fallback runs;
+    ``APNEA_UQ_MEMORY_PROFILE=0`` disables the accounting
     entirely — the opt-out for runs where even one extra AOT compile of
     the heaviest program (absorbed as a disk hit under a warm persistent
     compilation cache, but a real compile without one) is unwelcome."""
@@ -145,10 +160,19 @@ def record_jit_memory(run_log, label: str, fn, *args,
         # retrying every call would re-pay the full AOT compile — inside
         # the timed windows the drivers' pre-pass exists to protect.
         memo.add(key)
-        stats = fn.lower(*args, **kwargs).compile().memory_analysis()
-        if stats is None:
-            return None
-        fields = memory_analysis_fields(stats)
+        if program is not None:
+            # One-lowering sharing (compilecache.get_program): the fields
+            # were priced when the executable was built — or read back
+            # from the store's metadata on a hit — so the historical
+            # second AOT compile below never runs.
+            if program.memory_fields is None:
+                return None
+            fields = dict(program.memory_fields)
+        else:
+            stats = fn.lower(*args, **kwargs).compile().memory_analysis()
+            if stats is None:
+                return None
+            fields = memory_analysis_fields(stats)
         device = jax.devices()[0]
         limit = device_hbm_limit(device)
         return run_log.event(

@@ -29,7 +29,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
-_COUNTS: Dict[str, int] = {"retraces": 0, "backend_compiles": 0}
+_COUNTS: Dict[str, int] = {
+    "retraces": 0, "backend_compiles": 0,
+    "persistent_cache_hits": 0, "persistent_cache_misses": 0,
+}
 _INSTALLED = False
 
 
@@ -38,6 +41,18 @@ def _on_event_duration(name: str, secs: float, **kwargs: Any) -> None:
         _COUNTS["retraces"] += 1
     elif name.endswith("backend_compile_duration"):
         _COUNTS["backend_compiles"] += 1
+
+
+def _on_event(name: str, **kwargs: Any) -> None:
+    # Persistent-compilation-cache outcomes: `backend_compiles` counts a
+    # disk HIT too (jax records the duration event around the whole
+    # compile-or-load), so "fresh XLA compile" questions — the
+    # compile-cost subsystem's zero-recompile claim — key on cache_misses
+    # when a cache dir is configured.
+    if name.endswith("compilation_cache/cache_hits"):
+        _COUNTS["persistent_cache_hits"] += 1
+    elif name.endswith("compilation_cache/cache_misses"):
+        _COUNTS["persistent_cache_misses"] += 1
 
 
 def install_compile_listener() -> bool:
@@ -52,12 +67,17 @@ def install_compile_listener() -> bool:
         )
     except Exception:  # noqa: BLE001 - older/newer jax without the hook
         return False
+    try:
+        jax.monitoring.register_event_listener(_on_event)
+    except Exception:  # noqa: BLE001 - cache counters stay at zero
+        pass
     _INSTALLED = True
     return True
 
 
 def compile_counts() -> Dict[str, int]:
-    """Snapshot of cumulative {retraces, backend_compiles} since install."""
+    """Snapshot of cumulative {retraces, backend_compiles,
+    persistent_cache_hits, persistent_cache_misses} since install."""
     install_compile_listener()
     return dict(_COUNTS)
 

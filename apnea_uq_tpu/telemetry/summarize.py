@@ -151,6 +151,37 @@ def _render_profiles(profs: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _compile_aggregate(comps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Roll-up of a run's compile_event stream: acquisition count, hit
+    ratio (store/cache vs fresh jit compiles), and the total
+    lower+compile seconds the run spent — the two numbers `telemetry
+    compare` gates cold-start regressions on."""
+    hits = sum(1 for e in comps if e.get("hit"))
+    total = sum((e.get("lower_s") or 0.0) + (e.get("compile_s") or 0.0)
+                for e in comps)
+    return {
+        "count": len(comps),
+        "hits": hits,
+        "hit_ratio": round(hits / len(comps), 4) if comps else None,
+        "total_s": round(total, 6),
+    }
+
+
+def _render_compile(comps: List[Dict[str, Any]]) -> List[str]:
+    agg = _compile_aggregate(comps)
+    lines = [
+        f"compile: {agg['count']} acquisition(s), hit ratio "
+        f"{_fmt(agg['hit_ratio'], 2)}, total {agg['total_s']:.3f}s"
+    ]
+    for e in comps:
+        lines.append(
+            f"  {e.get('label', '?')}: {e.get('source', '?')}"
+            f" lower {_fmt(e.get('lower_s'), 3)}s"
+            f" compile {_fmt(e.get('compile_s'), 3)}s"
+        )
+    return lines
+
+
 # The field projections the renderer's capture sections AND the --json
 # document share — one list per event kind, so a field added to one
 # output cannot silently miss the other.
@@ -163,6 +194,10 @@ _MEMORY_SNAPSHOT_FIELDS = (
     "profile_path", "profile_bytes")
 _PROFILE_FIELDS = (
     "label", "trace_dir", "mode", "steps_profiled", "warmup_steps")
+_COMPILE_EVENT_FIELDS = (
+    "label", "source", "hit", "lower_s", "compile_s",
+    "backend_compiles", "persistent_cache_hits",
+    "persistent_cache_misses")
 
 
 def _section(events: List[Dict[str, Any]], kind: str,
@@ -271,6 +306,11 @@ def summarize_events(run_dir: str,
         lines.append("")
         lines.extend(_render_profiles(profs))
 
+    comps = _section(events, "compile_event", _COMPILE_EVENT_FIELDS)
+    if comps:
+        lines.append("")
+        lines.extend(_render_compile(comps))
+
     errors = [e for e in events if e.get("kind") == "error"]
     lines.append("")
     if errors:
@@ -323,6 +363,7 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
     def section(kind: str, fields: tuple) -> List[Dict[str, Any]]:
         return _section(events, kind, fields)
 
+    compile_events = section("compile_event", _COMPILE_EVENT_FIELDS)
     return {
         "run": os.path.basename(os.path.normpath(run_dir)),
         "started_ts": (started or {}).get("ts"),
@@ -353,5 +394,7 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
         "memory_snapshots": section("memory_snapshot",
                                     _MEMORY_SNAPSHOT_FIELDS),
         "profiles": section("profile_captured", _PROFILE_FIELDS),
+        "compile_events": compile_events,
+        "compile": _compile_aggregate(compile_events),
         "errors": section("error", ("where", "error")),
     }

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from apnea_uq_tpu.compilecache import store as program_store
 from apnea_uq_tpu.config import TrainConfig
 from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
 from apnea_uq_tpu.ops import streaming_auc
@@ -221,19 +222,34 @@ def _predict_jit(model, variables, x, batch_size, data_sharding=None):
 
 
 def predict_proba_batched(model, variables, x, *, batch_size: int = 8192,
-                          mesh=None):
+                          mesh=None, record_memory_only: bool = False):
     """Deterministic (eval-mode) probabilities, chunked over windows;
-    with ``mesh``, each chunk shards over its ``data`` axis."""
+    with ``mesh``, each chunk shards over its ``data`` axis.  The program
+    is acquired through the compile-cost subsystem (label
+    ``predict_eval``) when a store is active, so the eval drivers'
+    sanity probe starts hot in a warmed process.
+    ``record_memory_only=True`` (warm-cache) acquires/prices from an
+    abstract window set and dispatches nothing."""
     data_sharding = None
     if mesh is not None:
         from apnea_uq_tpu.parallel import mesh as mesh_lib  # cycle-breaker
         data_sharding = mesh_lib.data_sharding(mesh)
         repl = mesh_lib.replicated(mesh)
-        x = jax.device_put(jnp.asarray(x, jnp.float32), repl)
+        if record_memory_only:
+            x = jax.ShapeDtypeStruct(tuple(np.shape(x)), jnp.float32,
+                                     sharding=repl)
+        else:
+            x = jax.device_put(jnp.asarray(x, jnp.float32), repl)
         variables = jax.tree.map(lambda a: jax.device_put(a, repl), variables)
-    return _predict_jit(
-        model, variables, jnp.asarray(x, jnp.float32), batch_size, data_sharding
-    )
+    elif record_memory_only:
+        x = jax.ShapeDtypeStruct(tuple(np.shape(x)), jnp.float32)
+    else:
+        x = jnp.asarray(x, jnp.float32)
+    args = (model, variables, x, batch_size, data_sharding)
+    program = program_store.get_program("predict_eval", _predict_jit, *args)
+    if record_memory_only:
+        return None
+    return program(*args) if program is not None else _predict_jit(*args)
 
 
 @partial(jax.jit, static_argnames=("model", "tx", "data_sharding",
@@ -375,8 +391,16 @@ def fit(
     log_fn: Optional[Callable[[str], None]] = None,
     run_log=None,
     profiler=None,
+    compile_only: bool = False,
 ) -> FitResult:
     """Train with validation-split early stopping; returns best-weight state.
+
+    ``compile_only=True`` (the ``apnea-uq warm-cache`` stage) runs the
+    full setup and acquires/prices the epoch + validation programs via
+    the compile-cost subsystem — exactly the programs a real fit at this
+    config would dispatch, so the store/persistent-cache entries it
+    leaves behind are guaranteed hits — then returns None without
+    training an epoch.
 
     Pass ``mesh`` to data-parallelize the baseline trainer: every batch is
     sharded over the mesh's ``data_axis`` and XLA all-reduces the gradients
@@ -453,24 +477,47 @@ def fit(
         batch_sharding = data_sharding  # place streamed batches pre-sharded
 
     step_metrics = StepMetrics(run_log) if run_log is not None else None
+    train_program = val_program = None
 
     for epoch in range(config.num_epochs):
         epoch_key = jax.random.fold_in(rng, epoch)
 
-        if run_log is not None and not streaming and epoch == 0:
-            # One-time compiled-HBM accounting of the exact programs this
-            # fit dispatches (deduped per signature in telemetry.memory).
-            telemetry_memory.record_jit_memory(
-                run_log, "train_epoch", _epoch_jit,
-                model, tx, state, x, y, epoch_key,
-                config.batch_size, config.shuffle, data_sharding, track,
-            )
-            if x_val is not None:
+        if not streaming and epoch == 0:
+            # Acquire the exact programs this fit dispatches through the
+            # compile-cost subsystem (one lowering shared between the
+            # HBM pricing below and every epoch's execution; None when
+            # no store is active) and price them once per signature.
+            train_args = (model, tx, state, x, y, epoch_key,
+                          config.batch_size, config.shuffle, data_sharding,
+                          track)
+            # exportable=False: the epoch's output carries TrainState /
+            # optax pytree nodes jax.export cannot serialize, so the
+            # program is AOT-shared in-process (pricing + every epoch's
+            # dispatch from ONE lowering) and its backend compile lands
+            # in the persistent XLA cache for the next process — the
+            # same treatment as the donating ensemble epoch.
+            train_program = program_store.get_program(
+                "train_epoch", _epoch_jit, *train_args,
+                exportable=False, run_log=run_log)
+            if run_log is not None:
                 telemetry_memory.record_jit_memory(
-                    run_log, "val_loss", _eval_loss_jit,
-                    model, state.variables(), x_val, y_val,
-                    config.batch_size, data_sharding, track,
+                    run_log, "train_epoch", _epoch_jit, *train_args,
+                    program=train_program,
                 )
+            if x_val is not None:
+                val_args = (model, state.variables(), x_val, y_val,
+                            config.batch_size, data_sharding, track)
+                val_program = program_store.get_program(
+                    "val_loss", _eval_loss_jit, *val_args, run_log=run_log)
+                if run_log is not None:
+                    telemetry_memory.record_jit_memory(
+                        run_log, "val_loss", _eval_loss_jit, *val_args,
+                        program=val_program,
+                    )
+        if compile_only:
+            # warm-cache: the programs above are built, priced, and (for
+            # the exportable ones) persisted; nothing dispatches.
+            return None
 
         def run_epoch():
             if streaming:
@@ -478,6 +525,11 @@ def fit(
                     model, tx, state, x, y, epoch_key, config.batch_size,
                     config.shuffle, data_sharding, batch_sharding, prefetch,
                     track_metrics=track,
+                )
+            if train_program is not None:
+                return train_program(
+                    model, tx, state, x, y, epoch_key, config.batch_size,
+                    config.shuffle, data_sharding, track,
                 )
             return _epoch_jit(
                 model, tx, state, x, y, epoch_key, config.batch_size,
@@ -533,6 +585,11 @@ def fit(
                         model, state.variables(), x_val, y_val,
                         config.batch_size, data_sharding, batch_sharding,
                         prefetch, track_metrics=track,
+                    )
+                if val_program is not None:
+                    return val_program(
+                        model, state.variables(), x_val, y_val,
+                        config.batch_size, data_sharding, track,
                     )
                 return _eval_loss_jit(
                     model, state.variables(), x_val, y_val,

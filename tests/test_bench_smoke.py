@@ -154,6 +154,16 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     # x windows x 4 bytes (at the smoke's BENCH_PASSES=4 they coincide).
     assert fused["d2h_bytes_full"] == 4 * 256 * 4
     assert fused["d2h_bytes_fused"] == 4 * 256 * 4
+    # Compile-cost block (ISSUE 7): two real probe subprocesses against
+    # one fresh cache/store pair — the cold run compiles fresh, the warm
+    # run loads the stored program with ZERO fresh XLA compiles.
+    compile_ctx = ctx["compile"]
+    assert "error" not in compile_ctx, compile_ctx
+    assert compile_ctx["cold"]["source"] == "jit"
+    assert compile_ctx["cold"]["total_s"] > 0
+    assert compile_ctx["warm"]["source"] == "store"
+    assert compile_ctx["warm"]["persistent_cache_misses"] == 0
+    assert compile_ctx["warm"]["total_s"] > 0
 
     # The printed line was assembled from the on-disk progress capture:
     # the two artifacts are the same result by construction.
