@@ -1,0 +1,31 @@
+"""IR-level program audit (ISSUE 8 tentpole).
+
+The AST lint (:mod:`apnea_uq_tpu.lint`) catches hazards visible in
+Python source; the promises this codebase actually makes — f32
+accumulation under bf16 compute (PARITY.md), zero cross-member
+collectives in the shard_map ensemble paths, donation on the ensemble
+epoch, weights passed as arguments rather than baked constants — live in
+the *lowered* program.  This package lowers every compile-cache zoo
+label on CPU (no dispatch) through the same no-dispatch entry points
+``warm-cache`` uses, and runs a second rule family over the jaxpr, the
+StableHLO text, and the compiled executable's memory/cost analysis:
+``apnea-uq audit``.
+
+Import discipline mirrors the lint package: :mod:`rules` and
+:mod:`manifest` are jax-free (the rule logic and the manifest diff run
+anywhere), only :mod:`capture` / :mod:`programs` import jax — and the
+CLI imports those lazily, so ``apnea-uq --help`` stays instant.
+"""
+
+from apnea_uq_tpu.audit.manifest import (  # noqa: F401
+    DEFAULT_MANIFEST_PATH,
+    load_manifest,
+    manifest_row,
+    save_manifest,
+    zoo_label_lines,
+)
+from apnea_uq_tpu.audit.rules import (  # noqa: F401
+    PROGRAM_RULES,
+    AuditContext,
+    run_program_rules,
+)

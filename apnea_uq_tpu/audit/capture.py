@@ -1,0 +1,285 @@
+"""Lower one named program and distill the facts the program rules need.
+
+The capture re-uses the compile-cost subsystem's own re-expression
+machinery (:mod:`apnea_uq_tpu.compilecache.store`): a program is the
+jitted wrapper over its array leaves — exactly what the store would
+compile, persist, and dispatch — traced and lowered **on CPU, with no
+dispatch**.  From one acquisition three views are distilled into a
+plain-data :class:`ProgramAudit`:
+
+- the **jaxpr** (recursively, through scan/pjit/shard_map sub-jaxprs):
+  explicit collective primitives with their mesh axis names, host
+  callback primitives, and the closed-over constants (a weight pytree
+  traced as a literal shows up here — HBM duplication plus a cache key
+  per value);
+- the **StableHLO text**: f64 tensor types anywhere, and bf16-
+  accumulated reductions (the PARITY.md promise is f32 accumulation
+  even under ``compute_dtype='bfloat16'``);
+- the **compiled executable**: ``input_output_alias`` (did declared
+  donation survive to aliasing? ``jax.export`` is known to drop it —
+  PR 6), ``memory_analysis()`` and ``cost_analysis()`` (FLOPs, bytes
+  accessed, arithmetic intensity — the ``program_audit`` telemetry
+  payload).
+
+Everything downstream (:mod:`apnea_uq_tpu.audit.rules`) consumes only
+the dataclass, so the rules stay jax-free and tests can inject
+violations by capturing deliberately-broken synthetic programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from apnea_uq_tpu.compilecache import store as store_mod
+
+# jaxpr primitives that communicate across mesh axes.  A refactor that
+# introduces one of these inside a shard_map body is exactly what the
+# collective-budget rule exists to catch.  `pbroadcast` is deliberately
+# absent: shard_map's replication-typing machinery inserts it freely and
+# it lowers to identity — no wire traffic, not a budget item.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "pmax", "pmin", "ppermute", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter", "collective_permute",
+})
+
+# shard_map's replication-rewrite renames psum to psum2 inside its
+# bodies; budget keys use the canonical spelling so a manifest row
+# survives jax refactors of that machinery.
+_PRIM_CANONICAL = {"psum2": "psum"}
+
+# jaxpr primitives that call back into the host mid-program: a
+# guaranteed device->host sync inside what should be a pure device step.
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "host_callback",
+    "outside_call",
+})
+
+# StableHLO collective ops, counted textually as a second, lowering-side
+# view of the same budget (explicit collectives only; XLA's SPMD
+# partitioner inserts resharding later, during backend compilation).
+HLO_COLLECTIVE_OPS = (
+    "stablehlo.all_reduce", "stablehlo.all_gather", "stablehlo.all_to_all",
+    "stablehlo.collective_permute", "stablehlo.reduce_scatter",
+    "stablehlo.collective_broadcast",
+)
+
+# Constant leaves smaller than this are recorded nowhere: eps scalars,
+# iota index vectors and BN shape constants are normal.  The rule-level
+# threshold (AuditContext.const_threshold) sits above this floor.
+_CONST_RECORD_FLOOR_BYTES = 1024
+
+# Any tensor whose element type is f64: `tensor<f64>`, `tensor<8xf64>`,
+# `tensor<4xcomplex<f64>>`.  NOTE `\bf64\b` would miss the shaped forms
+# ('x' and 'f' are both word characters, so there is no boundary in
+# "8xf64") — the suffix match is the reliable spelling.
+_F64_RE = re.compile(r"tensor<[^>]*f64>")
+# `stablehlo.reduce(...) applies stablehlo.add ... tensor<...bf16>`:
+# a sum whose accumulator carries bf16 — 8 mantissa bits — through the
+# reduction tree.
+_BF16_REDUCE_RE = re.compile(r"stablehlo\.reduce\b[^\n]*bf16")
+
+
+@dataclasses.dataclass
+class ProgramAudit:
+    """Plain-data audit facts of one lowered program (jax-free to read)."""
+
+    label: str
+    group: str
+    # "psum[data]" -> count: explicit collectives in the jaxpr, keyed by
+    # primitive and sorted mesh axis names.
+    collectives: Dict[str, int]
+    # "stablehlo.all_reduce" -> count in the lowered module text.
+    hlo_collectives: Dict[str, int]
+    f64_ops: int
+    bf16_accum_reduces: int
+    # Closed-over constants >= the record floor: {shape, dtype, bytes}.
+    consts: List[Dict[str, Any]]
+    donated_args: int           # wrapper params declared donated
+    aliased_outputs: int        # input-output aliases in the executable
+    host_callbacks: List[str]
+    flops: Optional[float]
+    bytes_accessed: Optional[float]
+    arithmetic_intensity: Optional[float]
+    memory_fields: Optional[Dict[str, int]]
+    platform: str
+    num_devices: int
+
+    @property
+    def const_bytes(self) -> int:
+        return sum(int(c["bytes"]) for c in self.consts)
+
+
+def _iter_jaxprs(jaxpr) -> Any:
+    """``jaxpr`` and every sub-jaxpr reachable through eqn params
+    (scan/while bodies, pjit/closed_call/shard_map inner jaxprs, cond
+    branches), depth-first."""
+    stack = [jaxpr]
+    seen = set()
+    while stack:
+        cur = stack.pop()
+        if hasattr(cur, "jaxpr"):       # ClosedJaxpr -> Jaxpr
+            cur = cur.jaxpr
+        if not hasattr(cur, "eqns") or id(cur) in seen:
+            continue
+        seen.add(id(cur))
+        yield cur
+        for eqn in cur.eqns:
+            for value in eqn.params.values():
+                for item in (value if isinstance(value, (tuple, list))
+                             else (value,)):
+                    if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                        stack.append(item)
+
+
+def _axis_names(params: Dict[str, Any]) -> Tuple[str, ...]:
+    """The mesh axis names a collective eqn communicates over."""
+    axes = params.get("axes", params.get("axis_name", ()))
+    if isinstance(axes, (str, int)):
+        axes = (axes,)
+    return tuple(sorted(str(a) for a in axes))
+
+
+def _scan_jaxpr(closed) -> Tuple[Dict[str, int], List[str]]:
+    collectives: Dict[str, int] = {}
+    callbacks: List[str] = []
+    for jaxpr in _iter_jaxprs(closed):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                canonical = _PRIM_CANONICAL.get(name, name)
+                key = f"{canonical}[{','.join(_axis_names(eqn.params))}]"
+                collectives[key] = collectives.get(key, 0) + 1
+            elif name in CALLBACK_PRIMS or "callback" in name:
+                callbacks.append(name)
+    return dict(sorted(collectives.items())), sorted(callbacks)
+
+
+def _const_records(closed) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for const in jax.tree_util.tree_leaves(getattr(closed, "consts", [])):
+        nbytes = int(getattr(const, "nbytes", 0) or 0)
+        if nbytes >= _CONST_RECORD_FLOOR_BYTES:
+            out.append({
+                "shape": list(getattr(const, "shape", ())),
+                "dtype": str(getattr(const, "dtype", "?")),
+                "bytes": nbytes,
+            })
+    out.sort(key=lambda c: (-c["bytes"], c["dtype"], c["shape"]))
+    return out
+
+
+def _cost_fields(compiled) -> Tuple[Optional[float], Optional[float]]:
+    """(flops, bytes accessed) from ``cost_analysis()`` — which returns a
+    dict on some jax versions and a one-per-device list on others."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 - accounting is best-effort
+        return None, None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return None, None
+    flops = cost.get("flops")
+    nbytes = cost.get("bytes accessed")
+    return (float(flops) if flops is not None else None,
+            float(nbytes) if nbytes is not None else None)
+
+
+def _alias_count(compiled) -> int:
+    """Input-output aliases the backend actually honored, read from the
+    compiled module header's ``input_output_alias={ {0}: (0, {},
+    may-alias) ... }`` attribute — the ground truth ``donate_argnums``
+    must survive to (CPU honors donation, so the audit sees it)."""
+    try:
+        text = compiled.as_text()
+    except Exception:  # noqa: BLE001 - backend without as_text
+        return 0
+    return text.count("may-alias") + text.count("must-alias")
+
+
+def capture_program(label: str, fn, args: tuple, kwargs: dict, *,
+                    group: str = "", donate_args: Tuple[int, ...] = (),
+                    ) -> ProgramAudit:
+    """Trace + lower + compile ``fn(*args, **kwargs)`` exactly as the
+    program store would (same wrapper, same leaf specs, same donation
+    re-threading) and distill the audit facts.  Nothing dispatches."""
+    flat, treedef, arr_idx, aux, key_impls = store_mod._split_leaves(
+        args, kwargs)
+    specs = store_mod._leaf_specs(flat, arr_idx, key_impls)
+    wrapper = store_mod._make_wrapper(fn, treedef, len(flat), arr_idx, aux,
+                                      key_impls)
+    donate = store_mod._donated_leaf_positions(
+        args, kwargs, tuple(donate_args), arr_idx)
+    jitted = jax.jit(wrapper, donate_argnums=donate or ())
+    traced = jitted.trace(*specs)
+    closed = traced.jaxpr
+    collectives, callbacks = _scan_jaxpr(closed)
+    consts = _const_records(closed)
+    lowered = traced.lower()
+    hlo = lowered.as_text()
+    hlo_collectives = {
+        op: hlo.count(op) for op in HLO_COLLECTIVE_OPS if op in hlo
+    }
+    compiled = lowered.compile()
+    flops, bytes_accessed = _cost_fields(compiled)
+    intensity = (flops / bytes_accessed
+                 if flops is not None and bytes_accessed else None)
+    memory_fields = None
+    try:
+        stats = compiled.memory_analysis()
+        if stats is not None:
+            from apnea_uq_tpu.telemetry.memory import memory_analysis_fields
+
+            memory_fields = memory_analysis_fields(stats)
+    except Exception:  # noqa: BLE001 - accounting is best-effort
+        pass
+    try:
+        devices = jax.devices()
+        platform, num_devices = devices[0].platform, len(devices)
+    except Exception:  # noqa: BLE001 - no backend: facts still form
+        platform, num_devices = "unknown", 0
+    return ProgramAudit(
+        label=label, group=group,
+        collectives=collectives, hlo_collectives=hlo_collectives,
+        f64_ops=len(_F64_RE.findall(hlo)),
+        bf16_accum_reduces=len(_BF16_REDUCE_RE.findall(hlo)),
+        consts=consts,
+        donated_args=len(donate), aliased_outputs=_alias_count(compiled),
+        host_callbacks=callbacks,
+        flops=flops, bytes_accessed=bytes_accessed,
+        arithmetic_intensity=intensity, memory_fields=memory_fields,
+        platform=platform, num_devices=num_devices,
+    )
+
+
+class CaptureStore(store_mod.ProgramStore):
+    """A program store whose acquisitions are audits, not executables.
+
+    Activated around the zoo's no-dispatch entry points
+    (``record_memory_only=True`` predictors, ``compile_only=True``
+    trainers), every ``get_program`` call lands here: the program is
+    captured (traced + lowered + compiled on CPU, nothing dispatched,
+    nothing persisted) and ``None`` is returned so the caller's
+    plain-jit fallback path stays untouched — which the no-dispatch
+    modes never reach anyway."""
+
+    def __init__(self):
+        super().__init__(None)
+        self.group = ""
+        self.captures: Dict[str, ProgramAudit] = {}
+        self.failures: Dict[str, str] = {}
+
+    def get(self, label, fn, args, kwargs, *, exportable=True,
+            donate_args=(), run_log=None):
+        if label not in self.captures and label not in self.failures:
+            try:
+                self.captures[label] = capture_program(
+                    label, fn, args, dict(kwargs), group=self.group,
+                    donate_args=tuple(donate_args))
+            except Exception as e:  # noqa: BLE001 - surfaced as exit 2
+                self.failures[label] = f"{type(e).__name__}: {e}"
+        return None

@@ -1,0 +1,224 @@
+"""The ``apnea-uq audit`` subcommand.
+
+``apnea-uq audit [--programs GROUPS] [--json | --format gha]
+[--update-manifest] [--rule NAME ...]`` — lower every compile-cache zoo
+label on CPU through the same no-dispatch entry points ``warm-cache``
+uses (nothing dispatches), run the program-rule family over the lowered
+IR, and diff the structural facts against the checked-in manifest.
+Exits 0 when clean, 1 on unsuppressed violations, 2 on usage errors —
+the same contract as ``apnea-uq lint``, whose suppression mechanism
+(``# apnea-lint: disable=<rule> -- <why>`` at the zoo-registration
+site in ``compilecache/zoo.py``) findings here reuse.
+
+With ``--run-dir`` the per-program FLOPs/bytes/arithmetic-intensity are
+persisted as ``program_audit`` telemetry events, rendered by
+``telemetry summarize`` and gateable by ``telemetry compare``
+(``audit.<label>.flops`` / ``.bytes_accessed``, lower-is-better).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, Optional
+
+from apnea_uq_tpu.telemetry import log
+
+
+def audit_program_data(program) -> Dict[str, Any]:
+    """The per-program payload of ``audit --json`` AND the
+    ``program_audit`` telemetry event — one projection, so the two
+    machine-readable views cannot drift."""
+    memory = program.memory_fields or {}
+    return {
+        "label": program.label,
+        "group": program.group,
+        "flops": program.flops,
+        "bytes_accessed": program.bytes_accessed,
+        "arithmetic_intensity": program.arithmetic_intensity,
+        "collectives": sum(program.collectives.values()),
+        "donated_args": program.donated_args,
+        "aliased_outputs": program.aliased_outputs,
+        "const_bytes": program.const_bytes,
+        "peak_bytes": memory.get("peak_bytes"),
+    }
+
+
+def _emit_events(run_log, captures) -> None:
+    for label in sorted(captures):
+        d = audit_program_data(captures[label])
+        run_log.event(
+            "program_audit",
+            label=d["label"], group=d["group"], flops=d["flops"],
+            bytes_accessed=d["bytes_accessed"],
+            arithmetic_intensity=d["arithmetic_intensity"],
+            collectives=d["collectives"],
+            donated_args=d["donated_args"],
+            aliased_outputs=d["aliased_outputs"],
+            const_bytes=d["const_bytes"], peak_bytes=d["peak_bytes"],
+        )
+
+
+def cmd_audit(args, config) -> int:
+    from apnea_uq_tpu.audit.manifest import (
+        load_manifest, merge_rows, write_manifest, zoo_label_lines,
+    )
+    from apnea_uq_tpu.audit.rules import (
+        PROGRAM_RULES, AuditContext, run_program_rules,
+    )
+    from apnea_uq_tpu.compilecache.zoo import WARM_GROUPS
+    from apnea_uq_tpu.lint.engine import (
+        LintResult, apply_suppressions, default_repo_root, load_files,
+    )
+    from apnea_uq_tpu.lint.report import emit_result, resolve_format
+    from apnea_uq_tpu.telemetry.logging_shim import narration_to_stderr
+
+    fmt = resolve_format(args)
+
+    def narrate(message: str) -> None:
+        # In --json mode stdout is a machine interface (one JSON
+        # document); progress/skip/manifest lines go to stderr so
+        # `audit --json | jq .` parses without stripping.
+        if fmt == "json":
+            with narration_to_stderr():
+                log(message)
+        else:
+            log(message)
+
+    groups = tuple(g.strip() for g in args.programs.split(",") if g.strip())
+    bad = set(groups) - set(WARM_GROUPS)
+    if bad or not groups:
+        # Usage errors exit 2, like lint: CI gating on the exit code
+        # must never mistake a typo for a clean or dirty zoo.
+        log(f"audit: unknown --programs group(s) "
+            f"{sorted(bad) or '(none given)'}; "
+            f"valid: {','.join(WARM_GROUPS)}")
+        raise SystemExit(2)
+
+    # The audit is lowering-only: it never needs an accelerator, and a
+    # manifest is only comparable when generated on the same platform
+    # rules — so pin CPU before the first jax import (an already-imported
+    # jax, e.g. under the test rig's virtual CPU mesh, is left alone).
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import contextlib
+
+    run_log = None
+    with contextlib.ExitStack() as stack:
+        if getattr(args, "run_dir", None):
+            from apnea_uq_tpu.telemetry import start_run
+
+            run_log = stack.enter_context(
+                start_run(args.run_dir, stage="audit", config=config,
+                          argv=sys.argv[1:]))
+            narrate(f"telemetry -> {args.run_dir}")
+
+        from apnea_uq_tpu.audit.programs import capture_zoo
+
+        captures, skipped, failures = capture_zoo(config, groups=groups)
+        for label, reason in skipped:
+            narrate(f"audit: {label} SKIPPED — {reason}")
+        if failures:
+            for label, error in sorted(failures.items()):
+                log(f"audit: capturing {label} FAILED — {error}")
+            raise SystemExit(2)
+
+        manifest_path = args.manifest
+        manifest = load_manifest(manifest_path)
+        if args.update_manifest:
+            # The merged rows drive the rules NOW; the file is written
+            # only after the rules pass, so a failed update (e.g. an
+            # unblessable cross-member collective) never mutates the
+            # golden manifest.
+            manifest = merge_rows(captures, prior=manifest)
+        elif manifest is None:
+            log(f"audit: no manifest at {manifest_path!r} — run "
+                f"`apnea-uq audit --update-manifest` once to record the "
+                f"golden per-label budgets")
+            raise SystemExit(2)
+
+        zoo_abs, label_lines = zoo_label_lines()
+        repo_root = default_repo_root([zoo_abs])
+        zoo_sf = load_files([zoo_abs], repo_root)[0]
+        context = AuditContext(
+            programs=captures, manifest=manifest, zoo_path=zoo_sf.path,
+            label_lines=label_lines,
+        )
+        try:
+            findings = run_program_rules(context, rules=args.rule or None)
+        except ValueError as e:
+            log(f"apnea-uq audit: {e}")
+            raise SystemExit(2)
+        findings = [apply_suppressions(f, zoo_sf) for f in findings]
+        result = LintResult(
+            findings=findings, files_scanned=len(captures),
+            rules_run=tuple(dict.fromkeys(args.rule)
+                            or sorted(PROGRAM_RULES)),
+            scanned_paths=tuple(sorted(captures)),
+        )
+        if run_log is not None:
+            _emit_events(run_log, captures)
+
+        if args.update_manifest:
+            if result.unsuppressed:
+                narrate(f"audit: manifest NOT updated — unsuppressed "
+                        f"finding(s) remain; fix (or suppress) them, "
+                        f"then re-run --update-manifest")
+            else:
+                write_manifest(manifest_path, manifest)
+                narrate(f"manifest -> {manifest_path} "
+                        f"({len(captures)} row(s) updated)")
+
+        emit_result(result, fmt, subject="program(s)", json_extra={
+            "programs": {
+                label: audit_program_data(captures[label])
+                for label in sorted(captures)
+            },
+        })
+        return 1 if result.unsuppressed else 0
+
+
+def register(sub, add_config_arg, load_config_fn) -> None:
+    """Attach the ``audit`` subcommand to the CLI's subparser registry
+    (same lazy-config wiring as the pipeline stages)."""
+    p = sub.add_parser(
+        "audit",
+        help="IR-level program audit: lower the compile-cache zoo on CPU "
+             "(no dispatch) and statically verify dtypes, collectives, "
+             "donation, constant capture, and host callbacks against the "
+             "checked-in manifest.")
+    from apnea_uq_tpu.compilecache.zoo import WARM_GROUPS  # jax-free
+
+    add_config_arg(p)
+    p.add_argument("--programs", default=",".join(WARM_GROUPS),
+                   help=f"Comma-separated zoo groups to audit "
+                        f"({','.join(WARM_GROUPS)}; default all).")
+    p.add_argument("--json", action="store_true",
+                   help="Emit findings + per-program cost facts "
+                        "machine-readable (full audit trail).")
+    p.add_argument("--format", choices=("text", "json", "gha"),
+                   default="text",
+                   help="Output format; `gha` emits GitHub Actions "
+                        "::error/::warning annotation lines (shared "
+                        "with `apnea-uq lint --format gha`).")
+    p.add_argument("--rule", action="append", default=[], metavar="NAME",
+                   help="Run only this program rule (repeatable); "
+                        "default: all — see docs/LINT.md.")
+    p.add_argument("--update-manifest", action="store_true",
+                   help="Regenerate the audited labels' manifest rows "
+                        "(rows of groups not audited are preserved). "
+                        "Cross-member collectives still fail: no "
+                        "manifest can bless them.")
+    from apnea_uq_tpu.audit.manifest import DEFAULT_MANIFEST_PATH
+
+    p.add_argument("--manifest", default=DEFAULT_MANIFEST_PATH,
+                   help="Manifest path (default: the in-package golden "
+                        "apnea_uq_tpu/audit/manifest.json).")
+    p.add_argument("--run-dir", default=None,
+                   help="Telemetry run directory: persists one "
+                        "program_audit event per label "
+                        "(FLOPs/bytes/arithmetic intensity), rendered "
+                        "by `telemetry summarize` and gateable by "
+                        "`telemetry compare`.")
+    p.set_defaults(fn=lambda args: cmd_audit(args, load_config_fn(args)))

@@ -1,0 +1,129 @@
+"""The golden per-label program manifest, checked into the repo.
+
+One JSON row per zoo label records the structural facts of its lowered
+program — the explicit collective budget and the donation declaration/
+aliasing — so CI fails the moment a refactor introduces a stray
+collective or drops donation, against a file a reviewer can read in the
+diff.  The rows are *structural* (no FLOPs, no bytes — those are
+shape-dependent and flow to telemetry instead), so the same manifest
+holds across model sizes, topologies, and the canonical audit shapes.
+
+``apnea-uq audit --update-manifest`` regenerates the rows for the
+audited groups, merge-preserving rows of groups not audited in that
+invocation.  This module is jax-free.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+MANIFEST_VERSION = 1
+DEFAULT_MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "manifest.json")
+
+
+def manifest_row(program) -> Dict[str, Any]:
+    """The checked-in row for one captured program.  Structural facts
+    only: donation is recorded as booleans, not leaf counts (a config
+    with more layers donates more leaves without changing the contract),
+    and FLOPs/bytes stay out entirely (shape-dependent — they flow to
+    ``program_audit`` telemetry instead)."""
+    return {
+        "group": program.group,
+        "collectives": dict(sorted(program.collectives.items())),
+        "donates": bool(program.donated_args),
+        "aliased": bool(program.aliased_outputs),
+    }
+
+
+def load_manifest(path: str = DEFAULT_MANIFEST_PATH,
+                  ) -> Optional[Dict[str, Dict[str, Any]]]:
+    """label -> row, or None when no manifest exists yet."""
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "programs" not in doc:
+        raise ValueError(
+            f"{path!r} is not an audit manifest (no 'programs' key)")
+    return dict(doc["programs"])
+
+
+def merge_rows(programs: Dict[str, Any],
+               prior: Optional[Dict[str, Dict[str, Any]]] = None,
+               ) -> Dict[str, Dict[str, Any]]:
+    """The would-be manifest after an update: rows for ``programs``,
+    ``prior`` rows preserved for zoo labels not captured this run (a
+    `--programs eval-mcd` update must not drop the trainer rows), and
+    rows whose label left the zoo entirely PRUNED — `--update-manifest`
+    is the documented remediation for the stale-row drift pin, so it
+    must actually remove them.  Pure merge; :func:`write_manifest`
+    persists (the CLI defers that until the rules pass, so a failed
+    update never mutates the golden file)."""
+    from apnea_uq_tpu.compilecache.zoo import GROUP_LABELS  # jax-free
+
+    zoo_labels = {lb for labels in GROUP_LABELS.values() for lb in labels}
+    rows: Dict[str, Dict[str, Any]] = {
+        label: row for label, row in (prior or {}).items()
+        if label in zoo_labels
+    }
+    for label, program in programs.items():
+        rows[label] = manifest_row(program)
+    return rows
+
+
+def write_manifest(path: str, rows: Dict[str, Dict[str, Any]]) -> None:
+    doc = {
+        "version": MANIFEST_VERSION,
+        "programs": {label: rows[label] for label in sorted(rows)},
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def save_manifest(path: str, programs: Dict[str, Any],
+                  prior: Optional[Dict[str, Dict[str, Any]]] = None,
+                  ) -> Dict[str, Dict[str, Any]]:
+    """:func:`merge_rows` + :func:`write_manifest` in one step."""
+    rows = merge_rows(programs, prior)
+    write_manifest(path, rows)
+    return rows
+
+
+def zoo_label_lines() -> Tuple[str, Dict[str, int]]:
+    """(absolute zoo.py path, label -> line of its string literal inside
+    the ``GROUP_LABELS`` display) — the zoo-registration anchor every
+    program finding points at, resolved by parsing the source (never by
+    importing the jax-loaded zoo module)."""
+    import apnea_uq_tpu
+
+    zoo_path = os.path.join(
+        os.path.dirname(os.path.abspath(apnea_uq_tpu.__file__)),
+        "compilecache", "zoo.py")
+    with open(zoo_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=zoo_path)
+    lines: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        if not any(isinstance(t, ast.Name) and t.id == "GROUP_LABELS"
+                   for t in targets):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Dict):
+            continue
+        for group_value in value.values:
+            for sub in ast.walk(group_value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str):
+                    lines.setdefault(sub.value, sub.lineno)
+    return zoo_path, lines

@@ -1,0 +1,176 @@
+"""Drive every compile-cache zoo label through its real entry point and
+capture the lowered IR.
+
+``warm-cache`` precompiles the variant a config will actually dispatch
+(fused OR full, streamed OR in-HBM); the audit's job is the opposite —
+statically verify **every** program named in
+:data:`apnea_uq_tpu.compilecache.zoo.GROUP_LABELS`, because the variant
+a production config skips today is the one a refactor breaks unnoticed.
+So this module calls the same no-dispatch entry points warm-cache uses
+(``record_memory_only=True`` predictors, ``compile_only=True``
+trainers), but sweeps both stats modes and both streaming modes, against
+small synthetic shapes — the audited invariants (collectives, donation,
+dtypes, constants, callbacks) are structural, not shape-dependent, so
+canonical tiny shapes keep a full-zoo audit a CPU-seconds affair.
+
+The capture rides the active-program-store seam: a
+:class:`~apnea_uq_tpu.audit.capture.CaptureStore` is pushed for the
+duration, so every ``get_program`` acquisition in the drivers lands as a
+:class:`~apnea_uq_tpu.audit.capture.ProgramAudit` and nothing compiles
+twice, persists, or dispatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+from apnea_uq_tpu.compilecache.zoo import GROUP_LABELS, WARM_GROUPS
+
+# Canonical audit shapes: small enough that the full zoo lowers in
+# seconds on CPU, large enough that chunking/padding paths are real.
+AUDIT_WINDOWS = 64
+AUDIT_WINDOW_SHAPE = (60, 4)
+AUDIT_BATCH = 32
+AUDIT_PASSES = 4
+AUDIT_MEMBERS = 4
+AUDIT_TRAIN_BATCH = 16
+
+
+def capture_zoo(config, *, groups: Tuple[str, ...] = WARM_GROUPS,
+                ) -> Tuple[Dict[str, object], List[Tuple[str, str]],
+                           Dict[str, str]]:
+    """Lower every label of the selected ``groups`` on the current
+    (CPU) backend.  Returns ``(captures, skipped, failures)``:
+    label -> :class:`ProgramAudit`, ``(label, reason)`` for programs the
+    config makes uncapturable (streaming trainers have no single epoch
+    program — the same skip warm-cache logs), and label -> error for
+    captures that failed outright."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from apnea_uq_tpu.audit.capture import CaptureStore
+    from apnea_uq_tpu.compilecache.store import use_store
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.parallel import fit_ensemble
+    from apnea_uq_tpu.parallel.mesh import make_mesh, make_mesh_from_config
+    from apnea_uq_tpu.training import create_train_state, fit
+    from apnea_uq_tpu.training.trainer import predict_proba_batched
+    from apnea_uq_tpu.uq.predict import (
+        ensemble_predict,
+        ensemble_predict_streaming,
+        mc_dropout_predict,
+        mc_dropout_predict_streaming,
+        stack_member_variables,
+    )
+    from apnea_uq_tpu.utils import prng
+
+    unknown = set(groups) - set(WARM_GROUPS)
+    if unknown:
+        raise ValueError(
+            f"unknown audit group(s) {sorted(unknown)}; "
+            f"valid: {list(WARM_GROUPS)}"
+        )
+    store = CaptureStore()
+    skipped: List[Tuple[str, str]] = []
+
+    model = AlarconCNN1D(config.model)
+    variables = init_variables(model, jax.random.key(0))
+    uq = config.uq
+    stat_spec = ("nats", float(uq.entropy_eps))
+    x_aval = jax.ShapeDtypeStruct((AUDIT_WINDOWS,) + AUDIT_WINDOW_SHAPE,
+                                  jnp.float32)
+
+    with use_store(store):
+        if "eval-mcd" in groups:
+            store.group = "eval-mcd"
+            mesh = make_mesh_from_config(config.mesh,
+                                         num_members=AUDIT_PASSES)
+            key = prng.stochastic_key(config.train.seed)
+            for stats in (None, stat_spec):   # full AND fused variants
+                common = dict(n_passes=AUDIT_PASSES, mode=uq.mcd_mode,
+                              batch_size=AUDIT_BATCH, key=key, mesh=mesh,
+                              record_memory_only=True, stats=stats)
+                mc_dropout_predict(model, variables, x_aval, **common)
+                mc_dropout_predict_streaming(model, variables, x_aval,
+                                             **common)
+            predict_proba_batched(
+                model, variables, x_aval, batch_size=AUDIT_BATCH,
+                mesh=mesh, record_memory_only=True,
+            )
+
+        if "eval-de" in groups:
+            store.group = "eval-de"
+            members = stack_member_variables([variables] * AUDIT_MEMBERS)
+            mesh = make_mesh_from_config(config.mesh,
+                                         num_members=AUDIT_MEMBERS)
+            for stats in (None, stat_spec):
+                common = dict(batch_size=AUDIT_BATCH, mesh=mesh,
+                              record_memory_only=True, stats=stats)
+                ensemble_predict(model, members, x_aval, **common)
+                ensemble_predict_streaming(model, members, x_aval, **common)
+
+        need_train_data = bool({"train", "train-ensemble"} & set(groups))
+        if need_train_data:
+            rng = np.random.default_rng(0)
+            x_train = rng.normal(
+                size=(AUDIT_WINDOWS,) + AUDIT_WINDOW_SHAPE
+            ).astype(np.float32)
+            y_train = (np.arange(AUDIT_WINDOWS) % 2).astype(np.int8)
+
+        if "train" in groups:
+            store.group = "train"
+            if config.train.streaming:
+                skipped.extend(
+                    (label, "TrainConfig.streaming dispatches per-step "
+                            "programs with no single epoch program to "
+                            "audit")
+                    for label in GROUP_LABELS["train"]
+                )
+            else:
+                tcfg = dataclasses.replace(config.train,
+                                           batch_size=AUDIT_TRAIN_BATCH)
+                state = create_train_state(
+                    model, jax.random.key(tcfg.seed),
+                    learning_rate=tcfg.learning_rate,
+                )
+                fit(model, state, x_train, y_train, tcfg,
+                    mesh=make_mesh(num_members=1), compile_only=True)
+
+        if "train-ensemble" in groups:
+            store.group = "train-ensemble"
+            if config.ensemble.streaming:
+                skipped.extend(
+                    (label, "EnsembleConfig.streaming dispatches per-step "
+                            "programs with no single epoch program to "
+                            "audit")
+                    for label in GROUP_LABELS["train-ensemble"]
+                )
+            else:
+                ecfg = dataclasses.replace(
+                    config.ensemble, num_members=AUDIT_MEMBERS,
+                    batch_size=AUDIT_TRAIN_BATCH,
+                )
+                fit_ensemble(
+                    model, x_train, y_train, ecfg,
+                    mesh=make_mesh_from_config(
+                        config.mesh, num_members=AUDIT_MEMBERS),
+                    compile_only=True,
+                )
+
+    # Any selected-group label that neither captured, skipped, nor failed
+    # means an entry-point drift (a driver stopped acquiring through the
+    # store) — surface it as a capture failure, not silence.
+    expected = {
+        label for g in groups for label in GROUP_LABELS[g]
+    }
+    accounted = (set(store.captures) | set(store.failures)
+                 | {label for label, _ in skipped})
+    for label in sorted(expected - accounted):
+        store.failures[label] = (
+            "entry point never acquired this label through the program "
+            "store — zoo/driver drift"
+        )
+    return store.captures, skipped, store.failures

@@ -1,0 +1,263 @@
+"""The program-rule family: static verification of lowered programs.
+
+Second rule family for the lint engine — same :class:`Finding` type,
+same severities, same suppression mechanism — but the subject is a
+lowered program (:class:`~apnea_uq_tpu.audit.capture.ProgramAudit`
+facts), not an AST.  Findings anchor at the program's **zoo-registration
+site** (the label string in ``compilecache/zoo.py``'s ``GROUP_LABELS``),
+which gives every violation a pointable file:line and lets the existing
+``# apnea-lint: disable=<rule> -- <why>`` comments suppress per label.
+
+This module is deliberately jax-free, like the AST rules: it consumes
+plain capture data, so the rule logic runs (and is tested) anywhere.
+
+Rules:
+
+- ``program-dtype-drift`` — any f64 tensor type in the lowered module
+  (a silent x64 leak doubles bytes and halves MXU throughput), and, in
+  the ``_fused`` statistics programs, any reduction that accumulates in
+  bf16 (PARITY.md promises f32 accumulation even under
+  ``compute_dtype='bfloat16'``).
+- ``program-collective-budget`` — the program's explicit collectives
+  (jaxpr primitives, keyed by mesh axes) must match the checked-in
+  manifest row, and collectives over the ``ensemble`` axis are
+  *unconditionally* violations: members are independent by design, so a
+  cross-member collective is a correctness/perf bug no manifest update
+  can bless.
+- ``program-donation-effectiveness`` — declared ``donate_argnums`` must
+  survive to input-output aliasing in the compiled executable
+  (``jax.export`` round-trips drop donation — PR 6), and a label whose
+  manifest row records donation must still declare it.
+- ``program-constant-capture`` — closed-over constants above the size
+  threshold: a weight pytree traced as a literal duplicates HBM per
+  program and explodes the compile-cache key space per value.
+- ``program-host-callback`` — host callback primitives inside a jitted
+  hot-path program serialize the device stream mid-step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from apnea_uq_tpu.lint.engine import SEVERITIES, Finding, Rule
+
+# The mesh axis ensemble members shard over; collectives over it are
+# cross-member by definition.  Mirrors parallel.mesh.AXIS_ENSEMBLE
+# (pinned by a test) without importing the jax-loaded module here.
+ENSEMBLE_AXIS = "ensemble"
+
+# Constant leaves at or above this count as captured weights.  The
+# largest legitimate closed-over constants (iota tables, BN shape
+# vectors) stay well under it; even a tiny model's stacked kernels
+# exceed it.
+DEFAULT_CONST_THRESHOLD_BYTES = 64 * 1024
+
+PROGRAM_RULES: Dict[str, Rule] = {}
+
+
+def register_program_rule(name: str, severity: str, summary: str):
+    """Decorator twin of :func:`apnea_uq_tpu.lint.engine.register_rule`
+    for rules that check lowered programs instead of ASTs."""
+    if severity not in SEVERITIES:
+        raise ValueError(
+            f"severity must be one of {SEVERITIES}, got {severity!r}")
+
+    def wrap(fn: Callable[["AuditContext"], Iterable[Finding]]):
+        PROGRAM_RULES[name] = Rule(name=name, severity=severity,
+                                   summary=summary, check=fn)
+        return fn
+
+    return wrap
+
+
+@dataclasses.dataclass
+class AuditContext:
+    """Everything a program rule sees: the captured programs, the golden
+    manifest rows (None = no manifest yet), and the zoo-registration
+    anchor (display path + label -> line) findings point at."""
+
+    programs: Dict[str, Any]            # label -> ProgramAudit facts
+    manifest: Optional[Dict[str, Dict[str, Any]]]
+    zoo_path: str                       # repo-root-relative display path
+    label_lines: Dict[str, int]
+    const_threshold: int = DEFAULT_CONST_THRESHOLD_BYTES
+    ensemble_axis: str = ENSEMBLE_AXIS
+
+    def line_for(self, label: str) -> int:
+        return self.label_lines.get(label, 1)
+
+    def finding(self, rule: str, label: str, message: str) -> Finding:
+        return Finding(
+            rule=rule, severity=PROGRAM_RULES[rule].severity,
+            path=self.zoo_path, line=self.line_for(label),
+            message=f"{label}: {message}",
+        )
+
+
+def _collective_axes(key: str) -> Tuple[str, ...]:
+    if "[" not in key:
+        return ()
+    inner = key[key.index("[") + 1:].rstrip("]")
+    return tuple(a for a in inner.split(",") if a)
+
+
+@register_program_rule(
+    "program-dtype-drift", "error",
+    "f64 ops anywhere in a lowered hot-path program, or bf16-accumulated "
+    "reductions in the _fused statistics programs (PARITY.md promises "
+    "f32 accumulation even under compute_dtype='bfloat16')",
+)
+def check_dtype_drift(context: AuditContext) -> Iterable[Finding]:
+    for label, p in sorted(context.programs.items()):
+        if p.f64_ops:
+            yield context.finding(
+                "program-dtype-drift", label,
+                f"lowered module contains {p.f64_ops} f64 tensor type(s) "
+                f"— an x64 leak doubles memory traffic and falls off the "
+                f"bf16/f32 matmul units",
+            )
+        if label.endswith("_fused") and p.bf16_accum_reduces:
+            yield context.finding(
+                "program-dtype-drift", label,
+                f"{p.bf16_accum_reduces} reduction(s) accumulate in bf16 "
+                f"— the fused sufficient-statistics reductions must "
+                f"accumulate in f32 (PARITY.md; pass dtype=jnp.float32 "
+                f"to the reducing op)",
+            )
+
+
+@register_program_rule(
+    "program-collective-budget", "error",
+    "explicit collectives in a lowered program must match the checked-in "
+    "manifest row, and cross-member (ensemble-axis) collectives are "
+    "unconditional violations — ensemble members are independent",
+)
+def check_collective_budget(context: AuditContext) -> Iterable[Finding]:
+    for label, p in sorted(context.programs.items()):
+        cross = {
+            key: n for key, n in p.collectives.items()
+            if context.ensemble_axis in _collective_axes(key)
+        }
+        if cross:
+            yield context.finding(
+                "program-collective-budget", label,
+                f"cross-member collective(s) {cross} — members are "
+                f"independent by design; communication over the "
+                f"'{context.ensemble_axis}' axis serializes them "
+                f"(no manifest update can bless this)",
+            )
+        if context.manifest is None:
+            continue
+        row = context.manifest.get(label)
+        if row is None:
+            yield context.finding(
+                "program-collective-budget", label,
+                "no manifest row for this zoo label — run "
+                "`apnea-uq audit --update-manifest` to record its "
+                "collective budget",
+            )
+        elif dict(row.get("collectives", {})) != dict(p.collectives):
+            yield context.finding(
+                "program-collective-budget", label,
+                f"collective budget drift: program lowers with "
+                f"{p.collectives or 'no collectives'} but the manifest "
+                f"records {row.get('collectives') or 'none'} — an "
+                f"intended change needs `--update-manifest`",
+            )
+
+
+@register_program_rule(
+    "program-donation-effectiveness", "error",
+    "declared donate_argnums must survive to input-output aliasing in "
+    "the compiled executable (jax.export drops donation), and a label "
+    "whose manifest row records donation must still declare it",
+)
+def check_donation(context: AuditContext) -> Iterable[Finding]:
+    for label, p in sorted(context.programs.items()):
+        if p.donated_args and not p.aliased_outputs:
+            yield context.finding(
+                "program-donation-effectiveness", label,
+                f"{p.donated_args} argument(s) declared donated but the "
+                f"compiled executable aliases no input to an output — "
+                f"donation was dropped (a jax.export round-trip, or "
+                f"shape/dtype-incompatible donated buffers), so the "
+                f"program's HBM footprint silently doubles",
+            )
+        row = (context.manifest or {}).get(label)
+        if row and row.get("donates") and not p.donated_args:
+            yield context.finding(
+                "program-donation-effectiveness", label,
+                "manifest records this program as donating but it now "
+                "declares no donated arguments — a refactor removed "
+                "donate_argnums (an intended change needs "
+                "`--update-manifest`)",
+            )
+
+
+@register_program_rule(
+    "program-constant-capture", "error",
+    "closed-over constants above the size threshold: weights traced as "
+    "literals duplicate HBM per program and key the compile cache per "
+    "value",
+)
+def check_constant_capture(context: AuditContext) -> Iterable[Finding]:
+    for label, p in sorted(context.programs.items()):
+        big = [c for c in p.consts
+               if c["bytes"] >= context.const_threshold]
+        if not big:
+            continue
+        total = sum(c["bytes"] for c in big)
+        worst = ", ".join(
+            f"{tuple(c['shape'])}:{c['dtype']}={c['bytes']}B"
+            for c in big[:3]
+        )
+        yield context.finding(
+            "program-constant-capture", label,
+            f"{len(big)} constant(s) totalling {total} bytes baked into "
+            f"the program ({worst}{', ...' if len(big) > 3 else ''}) — "
+            f"pass arrays as arguments instead of closing over values, "
+            f"or every new checkpoint recompiles and re-stores the "
+            f"program",
+        )
+
+
+@register_program_rule(
+    "program-host-callback", "error",
+    "host callback primitives inside a jitted hot-path program serialize "
+    "the device stream mid-step",
+)
+def check_host_callback(context: AuditContext) -> Iterable[Finding]:
+    for label, p in sorted(context.programs.items()):
+        if p.host_callbacks:
+            yield context.finding(
+                "program-host-callback", label,
+                f"host callback(s) {sorted(set(p.host_callbacks))} in "
+                f"the jaxpr — each one round-trips to Python mid-program "
+                f"and stalls the device pipeline",
+            )
+
+
+def run_program_rules(
+    context: AuditContext,
+    *,
+    rules: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run the (selected) program rules over ``context``; findings come
+    back sorted (path, line, rule, message) — suppressions are the
+    caller's job (they need the zoo source file)."""
+    if rules is None:
+        selected = tuple(sorted(PROGRAM_RULES))
+    else:
+        selected = tuple(dict.fromkeys(rules))
+    unknown = [r for r in selected if r not in PROGRAM_RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown program rule(s) {unknown}; "
+            f"available: {sorted(PROGRAM_RULES)}"
+        )
+    findings: List[Finding] = []
+    for name in selected:
+        findings.extend(PROGRAM_RULES[name].check(context))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings

@@ -874,10 +874,13 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--registry", required=True)
     p.add_argument("--ckpt-dir", default=None)
     _add_run_dir_arg(p)
-    p.add_argument("--programs", default=",".join(
-        ("eval-mcd", "eval-de", "train", "train-ensemble")),
-        help="Comma-separated stage groups to warm "
-             "(eval-mcd,eval-de,train,train-ensemble; default all).")
+    # Derived from the zoo (jax-free import) so a new warm group lands
+    # in the default scope of BOTH warm-cache and audit automatically.
+    from apnea_uq_tpu.compilecache.zoo import WARM_GROUPS
+
+    p.add_argument("--programs", default=",".join(WARM_GROUPS),
+                   help=f"Comma-separated stage groups to warm "
+                        f"({','.join(WARM_GROUPS)}; default all).")
     p.add_argument("--num-members", type=int, default=0,
                    help="Ensemble members the later eval-de will run "
                         "with (must match its --num-members; default 0 "
@@ -1046,6 +1049,15 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     from apnea_uq_tpu.lint import cli as lint_cli
 
     lint_cli.register(sub)
+
+    # `audit` is the lint's IR-level sibling (apnea_uq_tpu/audit/):
+    # lowers the compile-cache zoo on CPU — no dispatch, no registry —
+    # and verifies dtypes/collectives/donation/constants against the
+    # checked-in manifest.  Takes --config (the zoo is config-selected);
+    # jax imports stay inside the handler.
+    from apnea_uq_tpu.audit import cli as audit_cli
+
+    audit_cli.register(sub, add_config_arg, load_config_fn)
 
     p = add("demo", cmd_demo,
             "Zero-data synthetic smoke demo of the UQ engine.")

@@ -25,6 +25,13 @@ from typing import Any, Dict, List, Optional, Tuple
 # store-vs-pricing-table drift pin: every ``*_fused``/memory-priced
 # label the drivers use MUST appear here (enforced by
 # tests/test_compilecache.py against the driver sources).
+#
+# GROUP_LABELS is ALSO the IR audit's registration site (ISSUE 8,
+# apnea_uq_tpu/audit/): `apnea-uq audit` lowers every label below on CPU
+# and anchors its findings at the label's line here, every label must
+# have a row in audit/manifest.json (same drift pin enforces it), and a
+# per-label exemption is an inline `# apnea-lint: disable=<program-rule>
+# -- <why>` comment next to the label string.
 WARM_GROUPS: Tuple[str, ...] = (
     "eval-mcd", "eval-de", "train", "train-ensemble",
 )

@@ -32,7 +32,7 @@ def default_paths() -> List[str]:
 
 def cmd_lint(args) -> int:
     from apnea_uq_tpu.lint.engine import run_lint
-    from apnea_uq_tpu.lint.report import render_json, render_text
+    from apnea_uq_tpu.lint.report import emit_result, resolve_format
 
     paths = args.paths or default_paths()
     try:
@@ -43,7 +43,7 @@ def cmd_lint(args) -> int:
         # dirty tree.
         log(f"apnea-uq lint: {e}")
         raise SystemExit(2)
-    log(render_json(result) if args.json else render_text(result))
+    emit_result(result, resolve_format(args))
     return 1 if result.unsuppressed else 0
 
 
@@ -57,9 +57,9 @@ def register(sub) -> None:
     p.add_argument("paths", nargs="*", default=None,
                    help="Files/directories to lint; default: the "
                         "apnea_uq_tpu package plus bench.py beside it.")
-    p.add_argument("--json", action="store_true",
-                   help="Emit findings machine-readable (full audit "
-                        "trail, suppressed findings included).")
+    from apnea_uq_tpu.lint.report import add_format_args
+
+    add_format_args(p)
     p.add_argument("--rule", action="append", default=[],
                    metavar="NAME",
                    help="Run only this rule (repeatable); default: all "

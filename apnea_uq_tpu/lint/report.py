@@ -1,8 +1,14 @@
-"""Text and JSON reporters for lint results.
+"""Text, JSON, and GitHub-annotation reporters for lint/audit results.
 
-Both render the same resolved findings; ``--json`` is the machine side
-(stable field set, sorted — the golden test pins it) and the text side
-is the human one, grouped per file with a one-line summary.
+All three render the same resolved findings: ``--json`` is the machine
+side (stable field set, (path, line, rule) sort order, per-rule count
+summary — the golden test pins it, so CI diffs are deterministic),
+``--format gha`` emits GitHub Actions ``::error``/``::warning``
+workflow-command lines (one per unsuppressed finding, so violations
+annotate the PR diff inline), and the text side is the human one with a
+one-line summary.  The audit subcommand shares every reporter — its
+findings are the same :class:`Finding` type anchored at the
+zoo-registration site.
 """
 
 from __future__ import annotations
@@ -15,7 +21,10 @@ from apnea_uq_tpu.lint.engine import LintResult
 
 def result_data(result: LintResult) -> Dict[str, Any]:
     """The ``--json`` document: every finding (suppressed included, so
-    the suppression audit trail is machine-readable) plus the summary."""
+    the suppression audit trail is machine-readable) plus the summary —
+    findings in (path, line, rule) order and a ``by_rule`` count block
+    covering every rule that ran (zero counts included), so two runs
+    over the same tree always diff clean."""
     findings: List[Dict[str, Any]] = [
         {
             "rule": f.rule,
@@ -26,8 +35,18 @@ def result_data(result: LintResult) -> Dict[str, Any]:
             "suppressed": f.suppressed,
             "justification": f.justification,
         }
-        for f in result.findings
+        for f in sorted(result.findings,
+                        key=lambda f: (f.path, f.line, f.rule, f.message))
     ]
+    by_rule = {
+        rule: {"findings": 0, "suppressed": 0, "unsuppressed": 0}
+        for rule in sorted(result.rules_run)
+    }
+    for f in result.findings:
+        row = by_rule.setdefault(
+            f.rule, {"findings": 0, "suppressed": 0, "unsuppressed": 0})
+        row["findings"] += 1
+        row["suppressed" if f.suppressed else "unsuppressed"] += 1
     return {
         "findings": findings,
         "summary": {
@@ -36,6 +55,7 @@ def result_data(result: LintResult) -> Dict[str, Any]:
             "findings": len(result.findings),
             "suppressed": sum(1 for f in result.findings if f.suppressed),
             "unsuppressed": len(result.unsuppressed),
+            "by_rule": dict(sorted(by_rule.items())),
         },
     }
 
@@ -44,13 +64,91 @@ def render_json(result: LintResult) -> str:
     return json.dumps(result_data(result), indent=2, sort_keys=False)
 
 
-def render_text(result: LintResult) -> str:
+def render_text(result: LintResult, *, subject: str = "file(s)") -> str:
     lines: List[str] = []
     for f in result.findings:
         lines.append(f.render())
     n_sup = sum(1 for f in result.findings if f.suppressed)
     lines.append(
-        f"{result.files_scanned} file(s), {len(result.rules_run)} rule(s): "
-        f"{len(result.unsuppressed)} finding(s), {n_sup} suppressed"
+        f"{result.files_scanned} {subject}, {len(result.rules_run)} "
+        f"rule(s): {len(result.unsuppressed)} finding(s), "
+        f"{n_sup} suppressed"
     )
     return "\n".join(lines)
+
+
+def _gha_escape(value: str, *, prop: bool = False) -> str:
+    """GitHub workflow-command escaping: data %-escapes newlines;
+    property values additionally escape ``:`` and ``,``."""
+    value = (value.replace("%", "%25")
+             .replace("\r", "%0D").replace("\n", "%0A"))
+    if prop:
+        value = value.replace(":", "%3A").replace(",", "%2C")
+    return value
+
+
+def render_gha(result: LintResult) -> str:
+    """One ``::error``/``::warning`` annotation line per *unsuppressed*
+    finding (suppressed findings are resolved exemptions — annotating
+    them would bury real violations in a PR's checks tab).  Empty string
+    when the run is clean."""
+    lines: List[str] = []
+    for f in result.findings:
+        if f.suppressed:
+            continue
+        command = "error" if f.severity == "error" else "warning"
+        path = _gha_escape(f.path.replace("\\", "/"), prop=True)
+        title = _gha_escape(f.rule, prop=True)
+        lines.append(
+            f"::{command} file={path},line={f.line},title={title}"
+            f"::{_gha_escape(f.message)}"
+        )
+    return "\n".join(lines)
+
+
+# -------------------------------------------- shared CLI output contract --
+
+def add_format_args(parser) -> None:
+    """The output-format options both gates (``lint`` and ``audit``)
+    share — one definition, so the two CLIs cannot drift."""
+    parser.add_argument("--json", action="store_true",
+                        help="Emit findings machine-readable (full audit "
+                             "trail, suppressed findings included).")
+    parser.add_argument("--format", choices=("text", "json", "gha"),
+                        default="text",
+                        help="Output format; `gha` emits GitHub Actions "
+                             "::error/::warning annotation lines for "
+                             "inline PR review (shared by `apnea-uq "
+                             "lint` and `apnea-uq audit`).")
+
+
+def resolve_format(args) -> str:
+    """The effective format of a parsed gate invocation: an explicit
+    ``--format gha`` wins, then ``--json``/``--format json``, else text."""
+    if args.format == "gha":
+        return "gha"
+    if args.json or args.format == "json":
+        return "json"
+    return "text"
+
+
+def emit_result(result: LintResult, fmt: str, *, subject: str = "file(s)",
+                json_extra=None) -> None:
+    """Render ``result`` in ``fmt`` through ``telemetry.log`` — the one
+    dispatch both gates use.  ``json_extra`` merges extra top-level keys
+    into the ``--json`` document (the audit's per-program cost facts);
+    gha emits nothing at all on a clean tree (GitHub renders every
+    stdout line that parses as a command — silence is green)."""
+    from apnea_uq_tpu.telemetry import log
+
+    if fmt == "json":
+        doc = result_data(result)
+        if json_extra:
+            doc.update(json_extra)
+        log(json.dumps(doc, indent=2, sort_keys=False))
+    elif fmt == "gha":
+        rendered = render_gha(result)
+        if rendered:
+            log(rendered)
+    else:
+        log(render_text(result, subject=subject))

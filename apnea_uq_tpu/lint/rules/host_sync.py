@@ -4,8 +4,10 @@ telemetry layer's honest-timing windows.
 ``StepMetrics.measure`` times a thunk twice — dispatch (call return) and
 device (``block_until_ready`` on the result) — and that decomposition is
 the whole point of the telemetry layer: the gap is what async dispatch
-hides.  A host sync *inside* the thunk (``.item()``, ``float()``/``int()``
-on a device array, ``np.asarray``, ``jax.device_get``, an inner
+hides.  A host sync *inside* the thunk (``.item()``/``.tolist()``,
+``float()``/``int()`` on a device array, ``np.asarray``/``np.array`` —
+however the import is spelled, ``from numpy import asarray`` included —
+``jax.device_get`` and its from-import aliases, an inner
 ``block_until_ready``, the repo's ``host_values`` helper) serializes the
 device work mid-window, double-counts it into dispatch time, and makes
 ``dispatch_s`` vs ``device_s`` lie.  The same applies to
@@ -32,7 +34,15 @@ _FOLLOW_DEPTH = 2
 
 # Numpy module spellings that force a device->host copy via asarray/array.
 _NUMPY_MODULES = {"numpy"}
-_JAX_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+# Canonical (post-alias-resolution) names that sync regardless of how the
+# import was spelled — `jr = jax`, `from numpy import asarray as aa`,
+# `from jax import device_get as dg` all resolve here through
+# canonical_call, covering the aliased-import escapes the
+# module-attribute check above cannot see.
+_CANONICAL_SYNC_CALLS = {
+    "numpy.asarray", "numpy.array", "jax.device_get",
+    "jax.block_until_ready",
+}
 _HOST_VALUE_HELPERS = {"host_values", "_host_values", "_host_predictions"}
 
 
@@ -45,8 +55,9 @@ def _sync_reason(call: ast.Call, aliases: Dict[str, str],
     """Why this call is a host sync, or None."""
     func = call.func
     if isinstance(func, ast.Attribute):
-        if func.attr == "item" and not call.args and not call.keywords:
-            return ".item() forces a device->host transfer"
+        if (func.attr in ("item", "tolist") and not call.args
+                and not call.keywords):
+            return f".{func.attr}() forces a device->host transfer"
         if func.attr == "block_until_ready":
             return ".block_until_ready() serializes the dispatch stream"
         if (isinstance(func.value, ast.Name) and func.value.id in np_names
@@ -54,7 +65,9 @@ def _sync_reason(call: ast.Call, aliases: Dict[str, str],
             return (f"{func.value.id}.{func.attr}(...) copies the device "
                     f"array to host")
     name = astwalk.canonical_call(call, aliases)
-    if name in _JAX_SYNC_CALLS:
+    if name in _CANONICAL_SYNC_CALLS:
+        if name.startswith("numpy."):
+            return f"{name}(...) copies the device array to host"
         return f"{name}(...) blocks on device work"
     if name in _HOST_VALUE_HELPERS or (
             name is not None and name.split(".")[-1] in _HOST_VALUE_HELPERS):
@@ -171,10 +184,11 @@ def _is_timing_timer(call: ast.Call, aliases) -> bool:
 
 @register_rule(
     "host-sync-in-timed-region", "warning",
-    "a host sync (.item(), float()/int() on arrays, np.asarray, "
-    "device_get, block_until_ready, host_values) inside a StepMetrics "
-    "window or Timer(block=True) body corrupts the dispatch-vs-device "
-    "timing the telemetry layer exists to measure",
+    "a host sync (.item()/.tolist(), float()/int() on arrays, "
+    "np.asarray/np.array (aliased from-imports included), device_get, "
+    "block_until_ready, host_values) inside a StepMetrics window or "
+    "Timer(block=True) body corrupts the dispatch-vs-device timing the "
+    "telemetry layer exists to measure",
 )
 def check(context: LintContext) -> Iterator[Finding]:
     for sf in context.files:

@@ -90,7 +90,8 @@ def unit_direction(unit: Optional[str]) -> bool:
     u = (unit or "").lower()
     if "/sec" in u or "/s" in u or u in ("ratio", "speedup", "x"):
         return True
-    if u in ("seconds", "s", "ms", "milliseconds") or "byte" in u:
+    if (u in ("seconds", "s", "ms", "milliseconds", "flops", "flop")
+            or "byte" in u):
         return False
     return True
 
@@ -185,6 +186,19 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
             name = f"memory.{e.get('label', '?')}.peak_bytes"
             out[name] = Metric(name, float(e["peak_bytes"]), "bytes",
                                False)
+        elif kind == "program_audit":
+            # The IR-level cost of one zoo program (`apnea-uq audit
+            # --run-dir`): FLOPs and bytes accessed, both lower-is-better
+            # per label — a refactor that inflates a hot-path program's
+            # compute or traffic gates like any other regression.
+            if e.get("flops") is not None:
+                name = f"audit.{e.get('label', '?')}.flops"
+                out[name] = Metric(name, float(e["flops"]), "flops",
+                                   False)
+            if e.get("bytes_accessed") is not None:
+                name = f"audit.{e.get('label', '?')}.bytes_accessed"
+                out[name] = Metric(name, float(e["bytes_accessed"]),
+                                   "bytes", False)
         elif kind == "compile_event":
             compile_n += 1
             compile_hits += 1 if e.get("hit") else 0
@@ -218,8 +232,8 @@ def load_metrics(path: str) -> Dict[str, Metric]:
             # metrics check downstream).
             raise NoComparableMetrics(
                 f"no comparable metrics in source {path!r}: the run's "
-                f"events carry no bench/eval throughput, d2h, or "
-                f"memory-peak metrics"
+                f"events carry no bench/eval throughput, d2h, "
+                f"memory-peak, compile-cost, or program-audit metrics"
             )
         return metrics
     with open(path) as f:

@@ -151,6 +151,32 @@ def _render_profiles(profs: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _render_program_audits(audits: List[Dict[str, Any]]) -> List[str]:
+    """The per-program cost table from ``program_audit`` events
+    (``apnea-uq audit --run-dir``: lowered-IR FLOPs, bytes accessed,
+    arithmetic intensity, and the structural facts)."""
+    header = ("program", "gflops", "mb_accessed", "flops/byte",
+              "colls", "donated")
+    name_w = max([len(header[0])]
+                 + [len(str(e.get("label", "?"))) for e in audits])
+    fmt = (f"{{:<{name_w}}}  {{:>10}}  {{:>11}}  {{:>10}}  {{:>5}}  "
+           f"{{:>7}}")
+    lines = ["program audit (lowered-IR cost):", fmt.format(*header)]
+    for e in audits:
+        flops = e.get("flops")
+        colls = e.get("collectives")
+        donated = e.get("donated_args")
+        lines.append(fmt.format(
+            e.get("label", "?"),
+            _fmt(flops / 1e9 if flops is not None else None, 3),
+            _mb(e.get("bytes_accessed")),
+            _fmt(e.get("arithmetic_intensity"), 2),
+            "-" if colls is None else colls,
+            "-" if donated is None else donated,
+        ))
+    return lines
+
+
 def _compile_aggregate(comps: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Roll-up of a run's compile_event stream: acquisition count, hit
     ratio (store/cache vs fresh jit compiles), and the total
@@ -198,6 +224,10 @@ _COMPILE_EVENT_FIELDS = (
     "label", "source", "hit", "lower_s", "compile_s",
     "backend_compiles", "persistent_cache_hits",
     "persistent_cache_misses")
+_PROGRAM_AUDIT_FIELDS = (
+    "label", "group", "flops", "bytes_accessed",
+    "arithmetic_intensity", "collectives", "donated_args",
+    "aliased_outputs", "const_bytes", "peak_bytes")
 
 
 def _section(events: List[Dict[str, Any]], kind: str,
@@ -311,6 +341,11 @@ def summarize_events(run_dir: str,
         lines.append("")
         lines.extend(_render_compile(comps))
 
+    audits = _section(events, "program_audit", _PROGRAM_AUDIT_FIELDS)
+    if audits:
+        lines.append("")
+        lines.extend(_render_program_audits(audits))
+
     errors = [e for e in events if e.get("kind") == "error"]
     lines.append("")
     if errors:
@@ -394,6 +429,7 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
         "memory_snapshots": section("memory_snapshot",
                                     _MEMORY_SNAPSHOT_FIELDS),
         "profiles": section("profile_captured", _PROFILE_FIELDS),
+        "program_audits": section("program_audit", _PROGRAM_AUDIT_FIELDS),
         "compile_events": compile_events,
         "compile": _compile_aggregate(compile_events),
         "errors": section("error", ("where", "error")),

@@ -40,6 +40,10 @@ full (T, M) probability round-trip, end-to-end incl. host fetch),
 BENCH_SKIP_COMPILE=1 to skip the compile context (cold-vs-warm process
 start of the MCD hot path through the persistent compile cache + AOT
 program store, measured as two probe subprocesses),
+BENCH_SKIP_AUDIT=1 to skip the program-audit context (the IR-level
+`apnea-uq audit` over the inference zoo as a CPU subprocess — lowering
+only, no device time; records per-program FLOPs/arithmetic intensity
+and whether the lowered-IR promises still hold),
 BENCH_DE_CHUNK for its DE chunk size,
 BENCH_WASTE_EPOCHS for the early-stop-waste context's epoch cap (0
 skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
@@ -645,6 +649,44 @@ def bench_compile_startup(n_windows: int, n_passes: int, chunk: int) -> dict:
     return out
 
 
+def bench_program_audit() -> dict:
+    """IR-level audit of the inference zoo (`apnea-uq audit`, ISSUE 8)
+    as a CPU subprocess: the bench capture's context records whether the
+    lowered programs still honor the structural promises (no f64, no
+    cross-member collectives, donation intact, no baked weights, no host
+    callbacks) and each program's FLOPs/arithmetic intensity — so a
+    round's headline throughput is read next to the IR it was achieved
+    with.  Always CPU (lowering only, nothing dispatches), so the block
+    costs no device time."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "apnea_uq_tpu.cli.main", "audit", "--json",
+         "--programs", "eval-mcd,eval-de"],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode not in (0, 1) or "{" not in proc.stdout:
+        raise RuntimeError(
+            f"audit subprocess failed rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-500:]}"
+        )
+    doc = json.loads(proc.stdout[proc.stdout.index("{"):])
+    return {
+        "clean": proc.returncode == 0,
+        "unsuppressed": doc["summary"]["unsuppressed"],
+        "programs": {
+            label: {
+                "flops": facts["flops"],
+                "arithmetic_intensity": facts["arithmetic_intensity"],
+            }
+            for label, facts in sorted(doc["programs"].items())
+        },
+    }
+
+
 def bench_mcd() -> dict:
     from apnea_uq_tpu.config import ModelConfig
     from apnea_uq_tpu.models import AlarconCNN1D, apply_model, init_variables, predict_proba
@@ -809,6 +851,14 @@ def bench_mcd() -> dict:
         lambda: bench_compile_startup(n_windows, n_passes, chunk),
         skip=bool(os.environ.get("BENCH_SKIP_COMPILE")),
     )
+    # Static IR audit of the inference zoo (CPU subprocess, no device
+    # time): the capture records whether the programs behind this
+    # round's numbers still honor the lowered-IR promises.
+    result["context"]["program_audit"] = _guarded(
+        bench_program_audit,
+        skip=bool(os.environ.get("BENCH_SKIP_AUDIT")),
+    )
+    _progress_record("primary", result)
     return result
 
 

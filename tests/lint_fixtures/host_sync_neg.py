@@ -36,3 +36,17 @@ def threading_timer_is_not_ours(secs, fire):
     timer = threading.Timer(secs, fire)
     timer.start()
     return timer
+
+
+def tolist_after_window(run_log, x):
+    metrics = StepMetrics(run_log)
+    out = metrics.measure("good", lambda: jax.numpy.cumsum(x))
+    return out.tolist()                 # after measure returned: fine
+
+
+def aliased_import_outside_window(run_log, x):
+    from jax import device_get as dg
+
+    metrics = StepMetrics(run_log)
+    probs = metrics.measure("good", lambda: jax.numpy.tanh(x))
+    return dg(probs)                    # sync AFTER the window: fine

@@ -42,3 +42,23 @@ def timer_block_body(x):
         y = t.wrap(jax.numpy.sum(x))
         z = float(y)                    # FINDING: blocks inside the body
     return z
+
+
+def tolist_in_thunk(run_log, x):
+    metrics = StepMetrics(run_log)
+    # FINDING: .tolist() is a device->host transfer like .item()
+    return metrics.measure("bad", lambda: jax.numpy.cumsum(x).tolist())
+
+
+def aliased_from_imports(run_log, x):
+    from jax import device_get as dg
+    from numpy import asarray as host_copy
+
+    metrics = StepMetrics(run_log)
+
+    def thunk():
+        y = jax.numpy.tanh(x)
+        a = dg(y)                       # FINDING: aliased jax.device_get
+        return host_copy(a)             # FINDING: aliased numpy.asarray
+
+    return metrics.measure("bad", thunk)

@@ -1,0 +1,666 @@
+"""IR-level program audit (ISSUE 8): zoo capture, program rules against
+injected violations, manifest round-trip, suppressions at the
+zoo-registration site, the `apnea-uq audit` CLI contract, and the
+program_audit telemetry read side (summarize + compare).
+
+The acceptance test lowers the FULL zoo on CPU through the real CLI
+(no dispatch) and must pass clean against the checked-in manifest; each
+violation class — f64 leak, stray cross-member collective, dropped
+donation, baked constant, host callback — is injected as a real lowered
+synthetic program and must exit 1 with a pointable zoo.py location.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apnea_uq_tpu.audit.capture import CaptureStore, capture_program  # noqa: E402
+from apnea_uq_tpu.audit.manifest import (  # noqa: E402
+    DEFAULT_MANIFEST_PATH,
+    load_manifest,
+    manifest_row,
+    save_manifest,
+    zoo_label_lines,
+)
+from apnea_uq_tpu.audit.rules import (  # noqa: E402
+    ENSEMBLE_AXIS,
+    PROGRAM_RULES,
+    AuditContext,
+    run_program_rules,
+)
+from apnea_uq_tpu.compilecache.zoo import GROUP_LABELS  # noqa: E402
+from apnea_uq_tpu.config import ExperimentConfig, ModelConfig, save_config  # noqa: E402
+from apnea_uq_tpu.lint.engine import apply_suppressions, load_files  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALL_LABELS = sorted({lb for labels in GROUP_LABELS.values() for lb in labels})
+
+
+@pytest.fixture(scope="module")
+def tiny_config_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("audit_cfg") / "config.json")
+    save_config(ExperimentConfig(model=ModelConfig(
+        features=(8, 12, 8), kernel_sizes=(5, 3, 3),
+        dropout_rates=(0.3, 0.4, 0.5))), path)
+    return path
+
+
+# --------------------------------------------------- synthetic captures --
+
+def _capture(label, fn, args, donate_args=(), group="eval-mcd"):
+    return capture_program(label, fn, tuple(args), {}, group=group,
+                           donate_args=donate_args)
+
+
+def _context(captures, manifest=None, **kwargs):
+    zoo_abs, label_lines = zoo_label_lines()
+    rel = os.path.relpath(zoo_abs, REPO).replace(os.sep, "/")
+    return AuditContext(programs=captures, manifest=manifest,
+                        zoo_path=rel, label_lines=label_lines, **kwargs)
+
+
+def _clean_capture(label="mcd_predict", group="eval-mcd"):
+    return _capture(label, lambda x: jnp.tanh(x) * 2,
+                    (jnp.zeros((8,), jnp.float32),), group=group)
+
+
+def _f64_capture(label="mcd_predict"):
+    from jax.experimental import enable_x64
+
+    # Shaped-only f64 (no scalar reduction): the lowered module spells
+    # it `tensor<8xf64>`, which a naive \bf64\b regex would MISS ('x'
+    # and 'f' are word characters) — this fixture pins the suffix match.
+    with enable_x64():
+        return _capture(label,
+                        lambda x: x.astype(jnp.float64) * 2.0,
+                        (jnp.zeros((8,), jnp.float32),))
+
+
+def _baked_constant_capture(label="predict_eval"):
+    weights = jnp.asarray(
+        np.random.default_rng(0).normal(size=(130, 200)).astype(np.float32))
+
+    def fn(x):
+        return x @ weights  # closes over 104 KB of weights -> jaxpr const
+
+    return _capture(label, fn, (jnp.zeros((4, 130), jnp.float32),))
+
+
+def _dropped_donation_capture(label="ensemble_epoch"):
+    """Donation declared on an argument no output can alias (different
+    shape): the compiled executable ends up with zero input-output
+    aliases — the observable signature of an export-dropped donation."""
+    def fn(state, x):
+        return x * 2.0
+
+    return _capture(label, fn, (jnp.zeros((16,), jnp.float32),
+                                jnp.zeros((4,), jnp.float32)),
+                    donate_args=(0,), group="train-ensemble")
+
+
+def _export_round_trip_capture(label="ensemble_epoch"):
+    """The literal PR-6 failure: a donating program serialized through
+    jax.export comes back with donation GONE — the loaded twin declares
+    nothing, and only the manifest row remembers it ever donated."""
+    from jax import export as jax_export
+
+    def fn(state):
+        return state + 1.0
+
+    spec = jax.ShapeDtypeStruct((8,), jnp.float32)
+    exported = jax_export.export(jax.jit(fn, donate_argnums=(0,)))(spec)
+    loaded = jax_export.deserialize(exported.serialize())
+    return _capture(label, loaded.call, (jnp.zeros((8,), jnp.float32),),
+                    donate_args=(), group="train-ensemble")
+
+
+def _cross_member_collective_capture(label="de_predict"):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(devs.size // 2, 2), (ENSEMBLE_AXIS, "data"))
+
+    def body(x):
+        return jax.lax.psum(x, ENSEMBLE_AXIS)
+
+    def fn(x):
+        return _shard_map(body, mesh=mesh, in_specs=P(ENSEMBLE_AXIS),
+                          out_specs=P())(x)
+
+    return _capture(label, fn,
+                    (jnp.zeros((devs.size // 2 * 4,), jnp.float32),),
+                    group="eval-de")
+
+
+def _data_collective_capture(label="train_epoch"):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(1, devs.size), (ENSEMBLE_AXIS, "data"))
+
+    def body(x):
+        return jax.lax.psum(x, "data")
+
+    def fn(x):
+        return _shard_map(body, mesh=mesh, in_specs=P("data"),
+                          out_specs=P())(x)
+
+    return _capture(label, fn, (jnp.zeros((devs.size * 2,), jnp.float32),),
+                    group="train")
+
+
+def _host_callback_capture(label="val_loss"):
+    def fn(x):
+        y = jax.pure_callback(
+            lambda a: np.asarray(a) * 2.0,
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y + 1.0
+
+    return _capture(label, fn, (jnp.zeros((8,), jnp.float32),),
+                    group="train")
+
+
+def _bf16_reduce_capture(label="mcd_predict_fused"):
+    def fn(x):
+        # A genuinely bf16-accumulated reduction (jnp.sum upcasts bf16
+        # accumulators to f32 even under dtype=bfloat16 — that upcast is
+        # exactly the promised behavior, so lax.reduce is the injection).
+        xb = x.astype(jnp.bfloat16)
+        return jax.lax.reduce(xb, jnp.bfloat16(0), jax.lax.add, (0,))
+
+    return _capture(label, fn, (jnp.zeros((64,), jnp.float32),))
+
+
+# -------------------------------------------------------- rule behavior --
+
+def test_clean_capture_passes_all_rules():
+    cap = _clean_capture()
+    findings = run_program_rules(_context(
+        {"mcd_predict": cap},
+        manifest={"mcd_predict": manifest_row(cap)}))
+    assert findings == []
+
+
+def test_f64_leak_flagged_with_pointable_location():
+    ctx = _context({"mcd_predict": _f64_capture()}, manifest={})
+    findings = run_program_rules(ctx, rules=["program-dtype-drift"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert "f64" in f.message and f.message.startswith("mcd_predict:")
+    # Pointable location: the label's registration line in zoo.py.
+    assert f.path.endswith("compilecache/zoo.py")
+    assert f.line == ctx.label_lines["mcd_predict"] > 1
+
+
+def test_bf16_accumulation_flagged_only_for_fused_labels():
+    cap = _bf16_reduce_capture()
+    assert cap.bf16_accum_reduces >= 1
+    fused = run_program_rules(
+        _context({"mcd_predict_fused": cap}, manifest={}),
+        rules=["program-dtype-drift"])
+    assert len(fused) == 1 and "bf16" in fused[0].message
+    # The same lowering under a non-stats label is legal bf16 compute.
+    relabeled = dataclasses.replace(cap, label="mcd_predict")
+    plain = run_program_rules(
+        _context({"mcd_predict": relabeled}, manifest={}),
+        rules=["program-dtype-drift"])
+    assert plain == []
+
+
+def test_cross_member_collective_is_unconditional_violation():
+    cap = _cross_member_collective_capture()
+    assert any(ENSEMBLE_AXIS in key for key in cap.collectives)
+    # Even a manifest that records the collective cannot bless it.
+    blessing = {"de_predict": manifest_row(cap)}
+    findings = run_program_rules(
+        _context({"de_predict": cap}, manifest=blessing),
+        rules=["program-collective-budget"])
+    assert len(findings) == 1
+    assert "cross-member" in findings[0].message
+
+
+def test_collective_budget_diffs_against_manifest():
+    cap = _data_collective_capture()
+    assert cap.collectives == {"psum[data]": 1}
+    # Matching row: clean.  Empty-budget row: drift.  Missing row: flagged.
+    ok = run_program_rules(
+        _context({"train_epoch": cap},
+                 manifest={"train_epoch": manifest_row(cap)}),
+        rules=["program-collective-budget"])
+    assert ok == []
+    drift = run_program_rules(
+        _context({"train_epoch": cap},
+                 manifest={"train_epoch": {"collectives": {}}}),
+        rules=["program-collective-budget"])
+    assert len(drift) == 1 and "drift" in drift[0].message
+    missing = run_program_rules(
+        _context({"train_epoch": cap}, manifest={}),
+        rules=["program-collective-budget"])
+    assert len(missing) == 1 and "no manifest row" in missing[0].message
+
+
+def test_dropped_donation_flagged():
+    cap = _dropped_donation_capture()
+    assert cap.donated_args == 1 and cap.aliased_outputs == 0
+    findings = run_program_rules(
+        _context({"ensemble_epoch": cap}, manifest={}),
+        rules=["program-donation-effectiveness"])
+    assert len(findings) == 1
+    assert "donation was dropped" in findings[0].message
+
+
+def test_export_round_trip_loses_donation_and_manifest_catches_it():
+    cap = _export_round_trip_capture()
+    # jax.export dropped the declaration: the loaded twin donates nothing.
+    assert cap.donated_args == 0
+    manifest = {"ensemble_epoch": {"collectives": {}, "donates": True,
+                                   "aliased": True}}
+    findings = run_program_rules(
+        _context({"ensemble_epoch": cap}, manifest=manifest),
+        rules=["program-donation-effectiveness"])
+    assert len(findings) == 1
+    assert "manifest records this program as donating" in findings[0].message
+
+
+def test_donation_survives_when_shapes_alias():
+    def fn(state, x):
+        return state + x
+
+    cap = _capture("ensemble_epoch", fn,
+                   (jnp.zeros((16,), jnp.float32),
+                    jnp.zeros((16,), jnp.float32)),
+                   donate_args=(0,), group="train-ensemble")
+    assert cap.donated_args == 1 and cap.aliased_outputs >= 1
+    findings = run_program_rules(
+        _context({"ensemble_epoch": cap},
+                 manifest={"ensemble_epoch": manifest_row(cap)}),
+        rules=["program-donation-effectiveness"])
+    assert findings == []
+
+
+def test_baked_constant_flagged_and_threshold_respected():
+    cap = _baked_constant_capture()
+    assert cap.const_bytes >= 100_000
+    findings = run_program_rules(
+        _context({"predict_eval": cap}, manifest={}),
+        rules=["program-constant-capture"])
+    assert len(findings) == 1
+    assert "baked into the program" in findings[0].message
+    # A looser threshold lets the same capture pass.
+    loose = run_program_rules(
+        _context({"predict_eval": cap}, manifest={},
+                 const_threshold=1 << 20),
+        rules=["program-constant-capture"])
+    assert loose == []
+
+
+def test_host_callback_flagged():
+    cap = _host_callback_capture()
+    assert cap.host_callbacks
+    findings = run_program_rules(
+        _context({"val_loss": cap}, manifest={}),
+        rules=["program-host-callback"])
+    assert len(findings) == 1
+    assert "host callback" in findings[0].message
+
+
+def test_ensemble_axis_matches_mesh_constant():
+    from apnea_uq_tpu.parallel import mesh as mesh_lib
+
+    assert ENSEMBLE_AXIS == mesh_lib.AXIS_ENSEMBLE
+
+
+def test_program_rules_registry():
+    assert set(PROGRAM_RULES) == {
+        "program-dtype-drift", "program-collective-budget",
+        "program-donation-effectiveness", "program-constant-capture",
+        "program-host-callback",
+    }
+    for rule in PROGRAM_RULES.values():
+        assert rule.severity in ("error", "warning") and rule.summary
+    with pytest.raises(ValueError, match="unknown program rule"):
+        run_program_rules(_context({}, manifest={}), rules=["no-such"])
+
+
+# ------------------------------------------- suppression at the zoo site --
+
+_SUPPRESSED_ZOO = '''\
+GROUP_LABELS = {
+    "train": (
+        # apnea-lint: disable=program-host-callback -- fixture: blessed
+        "val_loss",
+    ),
+    "eval-mcd": (
+        # apnea-lint: disable=program-dtype-drift
+        "mcd_predict",
+    ),
+}
+'''
+
+
+def test_suppression_at_registration_site_requires_justification(tmp_path):
+    zoo_file = tmp_path / "zoo.py"
+    zoo_file.write_text(_SUPPRESSED_ZOO, encoding="utf-8")
+    sf = load_files([str(zoo_file)], str(tmp_path))[0]
+    context = AuditContext(
+        programs={"val_loss": _host_callback_capture(),
+                  "mcd_predict": _f64_capture()},
+        manifest=None, zoo_path=sf.path,
+        label_lines={"val_loss": 4, "mcd_predict": 8},
+    )
+    findings = [
+        apply_suppressions(f, sf)
+        for f in run_program_rules(
+            context, rules=["program-host-callback",
+                            "program-dtype-drift"])
+    ]
+    suppressed = [f for f in findings if f.suppressed]
+    standing = [f for f in findings if not f.suppressed]
+    # Justified comment suppresses the host-callback finding...
+    assert len(suppressed) == 1
+    assert suppressed[0].rule == "program-host-callback"
+    assert suppressed[0].justification == "fixture: blessed"
+    # ...the justification-less disable leaves the f64 finding standing.
+    assert len(standing) == 1
+    assert standing[0].rule == "program-dtype-drift"
+    assert "lacks a justification" in standing[0].message
+
+
+# --------------------------------------------------- manifest round-trip --
+
+def test_manifest_save_merges_prior_rows_and_prunes_stale(tmp_path):
+    path = str(tmp_path / "manifest.json")
+    cap = _clean_capture()
+    save_manifest(path, {"mcd_predict": cap})
+    other = _data_collective_capture()
+    merged = save_manifest(path, {"train_epoch": other},
+                           prior=load_manifest(path))
+    assert set(merged) == {"mcd_predict", "train_epoch"}
+    reloaded = load_manifest(path)
+    assert reloaded["mcd_predict"] == manifest_row(cap)
+    assert reloaded["train_epoch"]["collectives"] == {"psum[data]": 1}
+    # A prior row whose label left the zoo is PRUNED on update — the
+    # drift pin's printed remediation (`--update-manifest`) must
+    # actually remove stale rows, not preserve them forever.
+    stale = dict(reloaded)
+    stale["a_label_removed_from_the_zoo"] = {"group": "train",
+                                             "collectives": {},
+                                             "donates": False,
+                                             "aliased": False}
+    merged = save_manifest(path, {"train_epoch": other}, prior=stale)
+    assert "a_label_removed_from_the_zoo" not in merged
+    assert set(load_manifest(path)) == {"mcd_predict", "train_epoch"}
+
+
+def test_cli_programs_default_tracks_warm_groups():
+    """The CLI defaults of BOTH audit and warm-cache derive from
+    zoo.WARM_GROUPS: a fifth group cannot be valid-but-silently-absent
+    from the default scope."""
+    from apnea_uq_tpu.cli.main import build_parser
+    from apnea_uq_tpu.compilecache.zoo import WARM_GROUPS
+
+    subs = next(a.choices for a in build_parser()._actions
+                if hasattr(a, "choices") and isinstance(a.choices, dict))
+    for name in ("audit", "warm-cache"):
+        default = next(a.default for a in subs[name]._actions
+                       if "--programs" in a.option_strings)
+        assert default == ",".join(WARM_GROUPS), name
+
+
+def test_checked_in_manifest_covers_every_zoo_label():
+    manifest = load_manifest(DEFAULT_MANIFEST_PATH)
+    assert manifest is not None
+    assert set(manifest) == set(ALL_LABELS)
+    for label, row in manifest.items():
+        assert set(row) == {"group", "collectives", "donates", "aliased"}
+    # The repo-wide promises, as checked-in facts: no explicit
+    # collectives anywhere in the zoo, and the lockstep ensemble epoch
+    # both declares donation and keeps it through compilation.
+    assert all(row["collectives"] == {} for row in manifest.values())
+    assert manifest["ensemble_epoch"]["donates"]
+    assert manifest["ensemble_epoch"]["aliased"]
+
+
+# ------------------------------------------------------- the CLI contract --
+
+def _patch_zoo(monkeypatch, captures):
+    monkeypatch.setattr(
+        "apnea_uq_tpu.audit.programs.capture_zoo",
+        lambda config, groups: (captures, [], {}))
+
+
+def test_cli_injected_violations_exit_1(monkeypatch, capsys,
+                                        tiny_config_path):
+    """Every injected violation class fails the real CLI with exit 1 and
+    a zoo.py-anchored location (the acceptance criterion)."""
+    from apnea_uq_tpu.cli.main import main
+
+    zoo_abs, label_lines = zoo_label_lines()
+    injections = {
+        "f64 leak": ("mcd_predict", _f64_capture()),
+        "stray collective": ("de_predict",
+                             _cross_member_collective_capture()),
+        "dropped donation": ("ensemble_epoch",
+                             _dropped_donation_capture()),
+        "baked constant": ("predict_eval", _baked_constant_capture()),
+        "host callback": ("val_loss", _host_callback_capture()),
+    }
+    for name, (label, cap) in injections.items():
+        _patch_zoo(monkeypatch, {label: cap})
+        rc = main(["audit", "--config", tiny_config_path])
+        out = capsys.readouterr().out
+        assert rc == 1, f"{name} did not fail the audit:\n{out}"
+        anchor = f"compilecache/zoo.py:{label_lines[label]}:"
+        assert anchor in out, (
+            f"{name} finding lacks the pointable location {anchor}:\n{out}"
+        )
+
+
+def test_cli_gha_format_for_injection(monkeypatch, capsys,
+                                      tiny_config_path):
+    from apnea_uq_tpu.cli.main import main
+
+    _patch_zoo(monkeypatch, {"val_loss": _host_callback_capture()})
+    rc = main(["audit", "--config", tiny_config_path, "--format", "gha"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    line = next(ln for ln in out.splitlines() if ln.startswith("::error"))
+    assert "title=program-host-callback" in line
+    assert "file=apnea_uq_tpu/compilecache/zoo.py" in line
+
+
+def test_cli_update_manifest_round_trip(monkeypatch, capsys, tmp_path,
+                                        tiny_config_path):
+    """A legit budget change fails against the stale manifest, passes
+    after --update-manifest, and the update persists; a cross-member
+    collective stays fatal even through --update-manifest."""
+    from apnea_uq_tpu.cli.main import main
+
+    path = str(tmp_path / "manifest.json")
+    cap = _data_collective_capture()          # psum[data] on train_epoch
+    _patch_zoo(monkeypatch, {"train_epoch": cap})
+    # No manifest yet: usage error, with guidance.
+    with pytest.raises(SystemExit) as exc:
+        main(["audit", "--config", tiny_config_path, "--manifest", path])
+    assert exc.value.code == 2
+    assert "--update-manifest" in capsys.readouterr().out
+    # Record the budget, then audit clean against it.
+    rc = main(["audit", "--config", tiny_config_path, "--manifest", path,
+               "--update-manifest"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["audit", "--config", tiny_config_path, "--manifest", path])
+    assert rc == 0
+    capsys.readouterr()
+    assert load_manifest(path)["train_epoch"]["collectives"] == {
+        "psum[data]": 1}
+    # Drift: the program changes (loses its collective) -> exit 1.
+    _patch_zoo(monkeypatch, {"train_epoch": _clean_capture(
+        label="train_epoch", group="train")})
+    rc = main(["audit", "--config", tiny_config_path, "--manifest", path])
+    assert rc == 1
+    assert "drift" in capsys.readouterr().out
+    # Cross-member collectives cannot be blessed by updating — and the
+    # failed update must NOT mutate the golden file (a committed
+    # polluted manifest would fail CI on a later-corrected tree).
+    before = load_manifest(path)
+    _patch_zoo(monkeypatch,
+               {"de_predict": _cross_member_collective_capture()})
+    rc = main(["audit", "--config", tiny_config_path, "--manifest", path,
+               "--update-manifest"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "cross-member" in out and "NOT updated" in out
+    assert load_manifest(path) == before
+
+
+def test_cli_usage_errors_exit_2(capsys, tiny_config_path):
+    from apnea_uq_tpu.cli.main import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["audit", "--config", tiny_config_path,
+              "--programs", "no-such-group"])
+    assert exc.value.code == 2
+    assert "unknown --programs" in capsys.readouterr().out
+
+
+def test_capture_failure_exits_2(monkeypatch, capsys, tiny_config_path):
+    from apnea_uq_tpu.cli.main import main
+
+    monkeypatch.setattr(
+        "apnea_uq_tpu.audit.programs.capture_zoo",
+        lambda config, groups: ({}, [], {"mcd_predict": "boom"}))
+    with pytest.raises(SystemExit) as exc:
+        main(["audit", "--config", tiny_config_path])
+    assert exc.value.code == 2
+    assert "FAILED" in capsys.readouterr().out
+
+
+# ------------------------------- the acceptance run: full zoo, real CLI --
+
+@pytest.fixture(scope="module")
+def full_zoo_audit(tiny_config_path, tmp_path_factory):
+    """ONE full-zoo audit through the real CLI (all 12 labels lowered on
+    the virtual-CPU mesh, nothing dispatched), shared by the acceptance
+    assertions below.  stdout is captured via the telemetry log handler
+    seam so a module fixture can hold it."""
+    import contextlib
+    import io
+
+    from apnea_uq_tpu.cli.main import main
+
+    run_dir = str(tmp_path_factory.mktemp("audit_run") / "run")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["audit", "--config", tiny_config_path, "--json",
+                   "--run-dir", run_dir])
+    return rc, buf.getvalue(), run_dir
+
+
+def test_full_zoo_audit_passes_clean(full_zoo_audit):
+    rc, out, _run_dir = full_zoo_audit
+    assert rc == 0, f"audit over the full zoo is dirty:\n{out}"
+    # --json stdout is pure JSON: narration (telemetry dir, skips) goes
+    # to stderr, so `audit --json | jq .` parses without stripping.
+    assert out.lstrip().startswith("{"), out[:200]
+    doc = json.loads(out[out.index("{"):])
+    assert doc["summary"]["unsuppressed"] == 0
+    assert sorted(doc["programs"]) == ALL_LABELS
+    for label, facts in doc["programs"].items():
+        assert facts["flops"] is not None and facts["flops"] > 0
+        assert facts["bytes_accessed"] and facts["bytes_accessed"] > 0
+        assert facts["arithmetic_intensity"] > 0
+        assert facts["collectives"] == 0
+    assert doc["programs"]["ensemble_epoch"]["donated_args"] > 0
+    assert doc["programs"]["ensemble_epoch"]["aliased_outputs"] > 0
+
+
+def test_program_audit_events_and_summarize(full_zoo_audit):
+    from apnea_uq_tpu.telemetry import summarize_data, summarize_run
+    from apnea_uq_tpu.telemetry.runlog import read_events
+
+    _rc, _out, run_dir = full_zoo_audit
+    events = [e for e in read_events(run_dir)
+              if e.get("kind") == "program_audit"]
+    assert sorted(e["label"] for e in events) == ALL_LABELS
+    rendered = summarize_run(run_dir)
+    assert "program audit (lowered-IR cost)" in rendered
+    assert "ensemble_epoch" in rendered
+    data = summarize_data(run_dir)
+    assert sorted(p["label"] for p in data["program_audits"]) == ALL_LABELS
+    row = next(p for p in data["program_audits"]
+               if p["label"] == "ensemble_epoch")
+    assert row["donated_args"] > 0 and row["flops"] > 0
+
+
+def test_compare_gates_audit_flops_lower_better(full_zoo_audit, tmp_path):
+    """program_audit flops/bytes are comparable metrics with
+    lower-is-better direction: an inflated candidate regresses, a
+    cheaper one improves."""
+    from apnea_uq_tpu.telemetry import compare as compare_mod
+
+    _rc, _out, run_dir = full_zoo_audit
+    worse = tmp_path / "worse_run"
+    worse.mkdir()
+    with open(os.path.join(run_dir, "events.jsonl")) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    for e in lines:
+        if e.get("kind") == "program_audit":
+            e["flops"] = e["flops"] * 1.5
+            e["bytes_accessed"] = e["bytes_accessed"] * 1.2
+    with open(worse / "events.jsonl", "w") as f:
+        for e in lines:
+            f.write(json.dumps(e) + "\n")
+    comparison = compare_mod.compare_paths(run_dir, str(worse))
+    regressed = {d.name for d in comparison.regressions}
+    assert "audit.ensemble_epoch.flops" in regressed
+    assert "audit.mcd_predict.bytes_accessed" in regressed
+    # The reverse direction improves rather than regresses.
+    back = compare_mod.compare_paths(str(worse), run_dir)
+    assert not [d for d in back.regressions
+                if d.name.startswith("audit.")]
+    flop_delta = next(d for d in back.deltas
+                      if d.name == "audit.mcd_predict.flops")
+    assert flop_delta.improved and not flop_delta.higher_better
+
+
+def test_zoo_capture_respects_group_filter(tiny_config_path):
+    from apnea_uq_tpu.audit.programs import capture_zoo
+    from apnea_uq_tpu.config import load_config
+
+    config = load_config(tiny_config_path)
+    captures, skipped, failures = capture_zoo(config, groups=("train",))
+    assert not failures and not skipped
+    assert sorted(captures) == sorted(GROUP_LABELS["train"])
+    assert all(p.group == "train" for p in captures.values())
+    with pytest.raises(ValueError, match="unknown audit group"):
+        capture_zoo(config, groups=("nope",))
+
+
+def test_streaming_config_skips_trainer_labels(tiny_config_path):
+    from apnea_uq_tpu.audit.programs import capture_zoo
+    from apnea_uq_tpu.config import load_config
+
+    config = load_config(tiny_config_path)
+    config = dataclasses.replace(
+        config, train=dataclasses.replace(config.train, streaming=True))
+    captures, skipped, failures = capture_zoo(config, groups=("train",))
+    assert not failures and not captures
+    assert sorted(label for label, _ in skipped) == sorted(
+        GROUP_LABELS["train"])

@@ -164,6 +164,14 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert compile_ctx["warm"]["source"] == "store"
     assert compile_ctx["warm"]["persistent_cache_misses"] == 0
     assert compile_ctx["warm"]["total_s"] > 0
+    # IR-audit block (ISSUE 8): the `apnea-uq audit` subprocess lowered
+    # the inference zoo on CPU and found it clean against the checked-in
+    # manifest, with per-program cost facts attached to the capture.
+    audit_ctx = ctx["program_audit"]
+    assert "error" not in audit_ctx, audit_ctx
+    assert audit_ctx["clean"] is True and audit_ctx["unsuppressed"] == 0
+    for label in ("mcd_predict_fused", "de_predict_fused", "predict_eval"):
+        assert audit_ctx["programs"][label]["flops"] > 0, (label, audit_ctx)
 
     # The printed line was assembled from the on-disk progress capture:
     # the two artifacts are the same result by construction.

@@ -371,6 +371,38 @@ def test_every_priced_label_has_a_warm_cache_zoo_entry():
         f"zoo advertises labels no driver uses: {sorted(phantom)}"
     )
     assert set(zoo.GROUP_LABELS) == set(zoo.WARM_GROUPS)
+    # ... and every zoo label must have a row in the audit's golden
+    # manifest (ISSUE 8 satellite): a new program that never runs
+    # `apnea-uq audit --update-manifest` would otherwise dodge the
+    # IR-level audit entirely — the collective-budget rule flags a
+    # missing row at audit time, and this pin flags it at test time.
+    from apnea_uq_tpu.audit.manifest import (
+        DEFAULT_MANIFEST_PATH, load_manifest, zoo_label_lines,
+    )
+
+    manifest = load_manifest()
+    assert manifest is not None, (
+        f"audit manifest missing at {DEFAULT_MANIFEST_PATH} — run "
+        f"`apnea-uq audit --update-manifest`"
+    )
+    unaudited = zoo_labels - set(manifest)
+    assert not unaudited, (
+        f"zoo labels with no audit-manifest row: {sorted(unaudited)} — "
+        f"run `apnea-uq audit --update-manifest` and commit the diff"
+    )
+    stale = set(manifest) - zoo_labels
+    assert not stale, (
+        f"audit manifest carries rows for labels no longer in the zoo: "
+        f"{sorted(stale)} — run `apnea-uq audit --update-manifest`"
+    )
+    # And the registration-site anchor must resolve for every label, or
+    # audit findings would lose their pointable file:line.
+    _zoo_path, label_lines = zoo_label_lines()
+    unanchored = zoo_labels - set(label_lines)
+    assert not unanchored, (
+        f"zoo labels not anchored in GROUP_LABELS source: "
+        f"{sorted(unanchored)}"
+    )
 
 
 # ---------------------------------------------------------------------------
