@@ -111,10 +111,18 @@ def _baseline_template(config):
 
 
 def _load_test_sets(registry, *, include_train: bool = False):
-    """{label: (x, y, patient_ids|None)} for the unbalanced + RUS sets."""
+    """{label: (x, y, patient_ids|None)} for the unbalanced + RUS sets.
+
+    Loaded with ``mmap=True``: ``array_store`` artifacts come back as
+    memmap-backed lazy arrays (zero copy, zero load time — streamed
+    consumers slice batches off the mapping, in-HBM consumers
+    materialize on device transfer), ``.npz`` artifacts load as before.
+    Call inside the stage's run-log scope so the ``data_load`` telemetry
+    events land in the run's events.jsonl."""
     from apnea_uq_tpu.data.prepare import load_prepared
 
-    prepared = load_prepared(registry, include_train=include_train)
+    prepared = load_prepared(registry, include_train=include_train,
+                             mmap=True)
     sets = {
         "Unbalanced": (prepared.x_test, prepared.y_test, prepared.patient_ids_test)
     }
@@ -128,40 +136,117 @@ def _load_test_sets(registry, *, include_train: bool = False):
 def cmd_ingest(args, config) -> int:
     from apnea_uq_tpu.data import ingest_directory
     from apnea_uq_tpu.data import registry as reg
+    from apnea_uq_tpu.data.ingest import ingest_directory_to_store
 
-    windows, reports = ingest_directory(
-        args.edf_dir, args.xml_dir, config.ingest,
-        num_files=args.num_files, workers=args.workers,
-    )
-    excluded = [r for r in reports if r.excluded]
-    log(f"processed {len(reports)} recordings, excluded {len(excluded)}")
-    for r in excluded:
-        log(f"  excluded {r.patient_id}: {r.excluded}")
-    if windows is None:
-        log("no windows produced")
-        return 1
     registry = _registry(args)
-    registry.save_arrays(reg.WINDOWS, windows.to_arrays(), config=config.ingest)
-    log(f"saved {len(windows)} windows -> {registry.root}")
+    with _run(args, "ingest", config) as run_log:
+        if args.store:
+            # Out-of-core ingest: one committed shard per recording, peak
+            # host memory O(one recording), resumable after kill -9
+            # (ingest_progress.json; --fresh discards prior progress).
+            store_dir = registry.path_for(reg.WINDOWS, ".store")
+            with run_log.stage("ingest"):
+                store, reports = ingest_directory_to_store(
+                    args.edf_dir, args.xml_dir, store_dir, config.ingest,
+                    num_files=args.num_files, workers=args.workers,
+                    mode=args.mode, resume=not args.fresh, run_log=run_log,
+                )
+            windows_len = store.rows if store is not None else 0
+        else:
+            with run_log.stage("ingest"):
+                windows, reports = ingest_directory(
+                    args.edf_dir, args.xml_dir, config.ingest,
+                    num_files=args.num_files, workers=args.workers,
+                    mode=args.mode,
+                )
+            windows_len = 0 if windows is None else len(windows)
+        excluded = [r for r in reports if r.excluded]
+        errored = [r for r in reports if r.error]
+        log(f"processed {len(reports)} recordings, excluded "
+            f"{len(excluded)}, errored {len(errored)}")
+        for r in excluded:
+            log(f"  excluded {r.patient_id}: {r.excluded}")
+        for r in errored:
+            log(f"  errored {r.patient_id}: {r.error}")
+        if windows_len == 0:
+            log("no windows produced")
+            return 1
+        if args.store:
+            registry.adopt_array_store(reg.WINDOWS, config=config.ingest)
+        else:
+            registry.save_arrays(reg.WINDOWS, windows.to_arrays(),
+                                 config=config.ingest)
+        log(f"saved {windows_len} windows -> {registry.root}")
     return 0
 
 
 def cmd_prepare(args, config) -> int:
     from apnea_uq_tpu.data import WindowSet, windows_from_reference_csv
     from apnea_uq_tpu.data import registry as reg
-    from apnea_uq_tpu.data.prepare import prepare_datasets, save_prepared
+    from apnea_uq_tpu.data.prepare import (
+        load_prepared, prepare_datasets, prepare_from_store, save_prepared,
+    )
 
     registry = _registry(args)
-    if args.from_csv:
-        windows = windows_from_reference_csv(args.from_csv)
-    else:
-        windows = WindowSet.from_arrays(registry.load_arrays(reg.WINDOWS))
-    prepared = prepare_datasets(windows, config.prepare)
-    save_prepared(prepared, registry, config.prepare)
-    log(
-        f"train {prepared.x_train.shape}, test {prepared.x_test.shape}, "
-        f"rus {None if prepared.x_test_rus is None else prepared.x_test_rus.shape}"
-    )
+    with _run(args, "prepare", config) as run_log:
+        entry = registry.describe(reg.WINDOWS)
+        if (args.store and not args.from_csv and entry is not None
+                and entry.get("kind") == "array_store"):
+            # Fully out-of-core: windows stream from the sharded store,
+            # prepared artifacts stream into sharded stores — host memory
+            # stays O(block), never O(dataset).
+            with run_log.stage("prepare"):
+                prepare_from_store(
+                    registry.open_array_store(reg.WINDOWS), registry,
+                    config.prepare,
+                )
+            prepared = load_prepared(registry, mmap=True)
+        else:
+            if args.from_csv:
+                windows = windows_from_reference_csv(args.from_csv)
+            elif entry is not None and entry.get("kind") == "array_store":
+                # Store-kind windows without --store: in-core prepare
+                # over the materialized store (channels come from the
+                # store's manifest, not a row field).
+                from apnea_uq_tpu.data.ingest import windows_from_store
+
+                windows = windows_from_store(
+                    registry.open_array_store(reg.WINDOWS))
+            else:
+                windows = WindowSet.from_arrays(
+                    registry.load_arrays(reg.WINDOWS)
+                )
+            with run_log.stage("prepare"):
+                prepared = prepare_datasets(windows, config.prepare)
+                save_prepared(prepared, registry, config.prepare,
+                              store=args.store)
+        log(
+            f"train {prepared.x_train.shape}, test {prepared.x_test.shape}, "
+            f"rus {None if prepared.x_test_rus is None else prepared.x_test_rus.shape}"
+        )
+    return 0
+
+
+def cmd_migrate(args, config) -> int:
+    """Convert monolithic ``.npz`` array artifacts to the sharded memmap
+    ``array_store`` kind in place (same keys, verified content) so every
+    later stage start memory-maps instead of decompressing the whole
+    dataset.  Old registries stay readable without migrating — this is
+    the one-command upgrade."""
+    from apnea_uq_tpu.data.registry import migrate_to_store
+
+    registry = _registry(args)
+    keys = args.keys or [
+        k for k, e in registry.manifest()["artifacts"].items()
+        if e.get("kind") == "arrays"
+    ]
+    if not keys:
+        log("nothing to migrate: no .npz array artifacts in the registry")
+        return 0
+    for key in keys:
+        path = migrate_to_store(registry, key,
+                                rows_per_shard=args.rows_per_shard)
+        log(f"migrated {key} -> {path}")
     return 0
 
 
@@ -174,7 +259,6 @@ def cmd_train(args, config) -> int:
     )
 
     registry = _registry(args)
-    prepared, sets = _load_test_sets(registry, include_train=True)
     model = _model(config)
     state = create_train_state(
         model, jax.random.key(config.train.seed),
@@ -184,6 +268,9 @@ def cmd_train(args, config) -> int:
     from apnea_uq_tpu.telemetry.profiler import maybe_profile
 
     with _compile_env(args, config), _run(args, "train", config) as run_log:
+        # Loaded inside the run scope so the artifact's data_load event
+        # (cold stage-start cost: load_s / rss_bytes) lands in this run.
+        prepared, sets = _load_test_sets(registry, include_train=True)
         with run_log.stage("fit", snapshot_memory=True), \
                 maybe_profile(run_log, args.profile, label="train") as prof:
             result = fit(
@@ -216,7 +303,6 @@ def cmd_train_ensemble(args, config) -> int:
     )
 
     registry = _registry(args)
-    prepared, _ = _load_test_sets(registry, include_train=True)
     model = _model(config)
     store = EnsembleCheckpointStore(os.path.join(_ckpt_root(args), "ensemble"))
 
@@ -240,6 +326,7 @@ def cmd_train_ensemble(args, config) -> int:
 
     with _compile_env(args, config), \
             _run(args, "train-ensemble", config) as run_log:
+        prepared, _ = _load_test_sets(registry, include_train=True)
         with run_log.stage("fit_ensemble", snapshot_memory=True), \
                 maybe_profile(run_log, args.profile,
                               label="train-ensemble") as prof:
@@ -437,10 +524,10 @@ def cmd_eval_mcd(args, config) -> int:
     registry = _registry(args)
     model, template = _baseline_template(config)
     state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
-    _prepared, sets = _load_test_sets(registry)
     uq_config = _eval_uq_config(args, config)
     with _compile_env(args, config), \
             _run(args, "eval-mcd", config) as run_log:
+        _prepared, sets = _load_test_sets(registry)
         for i, (label, (x, y, ids)) in enumerate(sets.items()):
             # Trace only the device-heavy evaluation; plots/registry writes
             # would otherwise dominate the XProf host timeline.  The
@@ -480,10 +567,10 @@ def cmd_eval_de(args, config) -> int:
     registry = _registry(args)
     model, member_variables = _restore_members(args, config, args.num_members)
     n_members = len(member_variables)  # resolved count (0 -> all existing)
-    _prepared, sets = _load_test_sets(registry)
     uq_config = _eval_uq_config(args, config)
     with _compile_env(args, config), \
             _run(args, "eval-de", config) as run_log:
+        _prepared, sets = _load_test_sets(registry)
         for label, (x, y, ids) in sets.items():
             with run_log.stage(f"CNN_DE_{label}", snapshot_memory=True), \
                     profile_trace(getattr(args, "profile_dir", None)):
@@ -847,6 +934,20 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--registry", required=True)
     p.add_argument("--num-files", type=int, default=None)
     p.add_argument("--workers", type=int, default=0)
+    p.add_argument("--mode", choices=("thread", "process"), default="thread",
+                   help="Worker pool flavor for --workers > 0: 'thread' "
+                        "(GIL-releasing NumPy decode) or 'process' "
+                        "(fully parallel CPU-bound decode+resample). "
+                        "Results keep job order either way.")
+    p.add_argument("--store", action="store_true",
+                   help="Stream recordings straight into a sharded memmap "
+                        "store (array_store kind; data/store.py): peak "
+                        "host memory O(one recording), resumable after "
+                        "kill -9 via the per-recording progress manifest.")
+    p.add_argument("--fresh", action="store_true",
+                   help="With --store: discard any previous ingest "
+                        "progress and shards instead of resuming.")
+    _add_run_dir_arg(p)
 
     p = add("prepare", cmd_prepare,
             "Windows -> split/standardized/balanced train+test arrays.")
@@ -854,6 +955,21 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--from-csv", default=None,
                    help="Ingest from a reference-format flattened CSV instead "
                         "of the registry windows artifact.")
+    p.add_argument("--store", action="store_true",
+                   help="Write the prepared artifacts as sharded memmap "
+                        "stores; with a store-kind windows artifact the "
+                        "whole prepare runs out-of-core (O(block) host "
+                        "memory).")
+    _add_run_dir_arg(p)
+
+    p = add("migrate", cmd_migrate,
+            "Convert .npz array artifacts to sharded memmap stores "
+            "(zero-copy loads) in place.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--keys", nargs="*", default=None,
+                   help="Artifact keys to convert (default: every .npz "
+                        "array artifact in the registry).")
+    p.add_argument("--rows-per-shard", type=int, default=65536)
 
     p = add("train", cmd_train, "Train the baseline 1D-CNN.")
     p.add_argument("--registry", required=True)

@@ -287,12 +287,20 @@ def ingest_recording(
             ),
         )
 
-    # FFT resample every channel to the target rate (:158-164).
+    # FFT resample every channel to the target rate (:158-164).  The
+    # result is pinned to float32 at the call site: the FFT itself runs
+    # in double precision (numpy has no single-precision FFT) but only
+    # as per-channel scratch — letting a float64 channel survive to the
+    # stack below would double the per-recording window memory and leak
+    # float64 into the L1 artifact (dtype hygiene pinned by
+    # tests/test_data_ingest.py::TestIngestRecording::test_float32_end_to_end).
     resampled = {}
     for ch in channels:
         sig = signals[ch]
         target_len = int(len(sig) * (config.target_rate_hz / rates[ch]))
-        resampled[ch] = fft_resample(sig, target_len)
+        resampled[ch] = fft_resample(sig, target_len).astype(
+            np.float32, copy=False
+        )
 
     # Cut full windows at stride (window - overlap); trailing partial
     # window dropped (:208-220; overlap_size honored as at :194,211).
@@ -347,23 +355,40 @@ def _nsrr_pair(edf_file: str) -> Tuple[str, str]:
     return nsrr_id, f"shhs2-{nsrr_id}-nsrr.xml"
 
 
-def ingest_directory(
+def _error_detail(exc: Exception, tail_lines: int = 6) -> str:
+    """``Type: message`` plus the traceback tail — a bare ``str(e)``
+    (often just a filename, or empty) made three a.m. ingest triage
+    impossible; the tail names the failing frame without shipping the
+    whole stack into every report."""
+    import traceback
+
+    tail = traceback.format_exc().strip().splitlines()[-tail_lines:]
+    return f"{type(exc).__name__}: {exc}\n" + "\n".join(tail)
+
+
+def _run_ingest_job(
+    job: Tuple[str, str, str], config: IngestConfig
+) -> Tuple[Optional[WindowSet], IngestReport]:
+    """One job with per-file containment (:316-318).  Module-level so the
+    process-pool mode can pickle it."""
+    edf_path, xml_path, patient_id = job
+    try:
+        return ingest_recording(edf_path, xml_path, patient_id, config)
+    except Exception as e:
+        return None, IngestReport(patient_id, edf_path,
+                                  error=_error_detail(e))
+
+
+def list_ingest_jobs(
     edf_folder: str,
     xml_folder: str,
-    config: IngestConfig = IngestConfig(),
     *,
     num_files: Optional[int] = None,
-    workers: int = 0,
-) -> Tuple[Optional[WindowSet], List[IngestReport]]:
-    """All EDF/XML pairs under two folders -> one combined WindowSet
-    (process_all_files, preprocess_shhs_raw.py:290-326).
-
-    ``num_files`` limits the number of processed recordings (the
-    reference's --num_files dry-run flag, :19-26).  ``workers`` > 0
-    decodes recordings in a thread pool (EDF decode and FFT resample are
-    NumPy/SciPy calls that release the GIL); 0 keeps the reference's
-    sequential order.
-    """
+) -> List[Tuple[str, str, str]]:
+    """Deterministic (edf_path, xml_path, patient_id) job list: sorted by
+    EDF file name, capped at ``num_files`` — shared by the in-memory and
+    store ingest paths so both process the same recordings in the same
+    order."""
     jobs = []
     for edf_file in sorted(os.listdir(edf_folder)):
         if num_files is not None and len(jobs) >= num_files:
@@ -378,27 +403,341 @@ def ingest_directory(
         if not os.path.exists(xml_path):
             continue
         jobs.append((os.path.join(edf_folder, edf_file), xml_path, patient_id))
+    return jobs
 
-    def run(job) -> Tuple[Optional[WindowSet], IngestReport]:
-        edf_path, xml_path, patient_id = job
-        try:
-            return ingest_recording(edf_path, xml_path, patient_id, config)
-        except Exception as e:  # per-file containment (:316-318)
-            return None, IngestReport(patient_id, edf_path, error=str(e))
 
-    if workers > 0:
+def _job_results(jobs, config: IngestConfig, workers: int, mode: str):
+    """Iterate (window_set, report) per job, IN JOB ORDER regardless of
+    worker scheduling, so every ingest mode produces identical report
+    lists and shard sequences.
+
+    ``mode='thread'`` suits the GIL-releasing NumPy decode path;
+    ``mode='process'`` side-steps the GIL entirely for the CPU-bound
+    EDF-decode + FFT-resample pipeline (jobs and the frozen config
+    pickle).  Process workers use the ``spawn`` start method: this
+    module transitively imports jax (a multithreaded runtime), and
+    fork()ing a threaded parent can deadlock a worker on an inherited
+    lock.  Submission is a bounded sliding window — ``Executor.map``
+    would submit everything up front and buffer every completed result
+    the consumer hasn't reached — so at most ``workers + 1`` decoded
+    recordings exist ahead of the consumer and the store ingest's
+    O(one recording) memory bound survives a slow shard writer."""
+    if workers <= 0:
+        for job in jobs:
+            yield _run_ingest_job(job, config)
+        return
+    if mode == "thread":
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            results = list(pool.map(run, jobs))
-    else:
-        results = [run(job) for job in jobs]
+        pool = ThreadPoolExecutor(max_workers=workers)
+    elif mode == "process":
+        import multiprocessing
+        from concurrent.futures import ProcessPoolExecutor
 
+        pool = ProcessPoolExecutor(
+            max_workers=workers,
+            mp_context=multiprocessing.get_context("spawn"),
+        )
+    else:
+        raise ValueError(f"mode must be 'thread' or 'process', got {mode!r}")
+    import collections
+
+    with pool:
+        it = iter(jobs)
+        pending: collections.deque = collections.deque()
+
+        def submit_next() -> None:
+            job = next(it, None)
+            if job is not None:
+                pending.append(pool.submit(_run_ingest_job, job, config))
+
+        for _ in range(workers + 1):
+            submit_next()
+        while pending:
+            result = pending.popleft().result()
+            submit_next()
+            yield result
+
+
+def ingest_directory(
+    edf_folder: str,
+    xml_folder: str,
+    config: IngestConfig = IngestConfig(),
+    *,
+    num_files: Optional[int] = None,
+    workers: int = 0,
+    mode: str = "thread",
+) -> Tuple[Optional[WindowSet], List[IngestReport]]:
+    """All EDF/XML pairs under two folders -> one combined WindowSet
+    (process_all_files, preprocess_shhs_raw.py:290-326).
+
+    ``num_files`` limits the number of processed recordings (the
+    reference's --num_files dry-run flag, :19-26).  ``workers`` > 0
+    decodes recordings in a pool — ``mode='thread'`` (EDF decode and FFT
+    resample are NumPy calls that release the GIL) or ``mode='process'``
+    (fully GIL-free; CPU-bound decode parallelizes across cores); 0
+    keeps the reference's sequential order.  Results are consumed in job
+    order in every mode.
+
+    This path materializes the combined set in host RAM — O(dataset).
+    For SHHS2-scale ingests use :func:`ingest_directory_to_store`, which
+    streams each recording straight into a sharded memmap store and
+    keeps peak host memory at O(one recording).
+    """
+    jobs = list_ingest_jobs(edf_folder, xml_folder, num_files=num_files)
+    results = list(_job_results(jobs, config, workers, mode))
     reports = [r for _, r in results]
     sets = [ws for ws, _ in results if ws is not None]
     if not sets:
         return None, reports
     return WindowSet.concat_all(sets), reports
+
+
+def windows_from_store(store, *, mmap: bool = False) -> WindowSet:
+    """A :class:`WindowSet` from a sharded windows store (either shape:
+    the streaming ingest's layout with channels in manifest ``meta``, or
+    a migrated ``.npz`` bundle carrying ``channels`` as an extra array).
+    ``mmap=True`` keeps ``x`` lazy; labels/ids/starts materialize (they
+    are O(rows) scalars the in-core consumers index freely)."""
+    channels = store.extra_arrays.get("channels")
+    if channels is not None:
+        channels = tuple(np.asarray(channels["values"]).astype(str))
+    else:
+        channels = tuple(str(c) for c in store.meta.get("channels", ()))
+    if not channels:
+        raise ValueError(
+            f"store at {store.directory} carries no channel names "
+            f"(neither a 'channels' extra array nor manifest meta)"
+        )
+    n = store.rows
+    start = (store.read("start_time_s", mmap=False)
+             if "start_time_s" in store.fields
+             else np.zeros(n, np.int32))
+    return WindowSet(
+        x=store.read("x", mmap=mmap),
+        y=np.asarray(store.read("y", mmap=False)),
+        patient_ids=np.asarray(
+            store.read("patient_ids", mmap=False)).astype(str),
+        start_time_s=np.asarray(start),
+        channels=channels,
+    )
+
+
+# -- out-of-core ingest: recordings -> sharded memmap store ---------------
+
+INGEST_PROGRESS_NAME = "ingest_progress.json"
+
+# Fixed-width patient-id dtype so every shard shares one schema (per-
+# recording ``np.full(n, str(id))`` infers a width from that id alone).
+_PATIENT_ID_DTYPE = "U32"
+
+
+def _progress_path(store_dir: str) -> str:
+    return os.path.join(store_dir, INGEST_PROGRESS_NAME)
+
+
+def read_ingest_progress(store_dir: str) -> Dict[str, Dict]:
+    """{patient_id: completion record} of a (possibly interrupted) store
+    ingest; tolerates a missing/corrupt file (fresh start)."""
+    import json
+
+    path = _progress_path(store_dir)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return json.load(f).get("completed", {})
+    except (OSError, ValueError):
+        return {}
+
+
+def _write_ingest_progress(store_dir: str, completed: Dict[str, Dict]) -> None:
+    from apnea_uq_tpu.data.store import atomic_write_json
+
+    atomic_write_json(_progress_path(store_dir),
+                       {"version": 1, "completed": completed})
+
+
+def ingest_directory_to_store(
+    edf_folder: str,
+    xml_folder: str,
+    store_dir: str,
+    config: IngestConfig = IngestConfig(),
+    *,
+    num_files: Optional[int] = None,
+    workers: int = 0,
+    mode: str = "thread",
+    resume: bool = True,
+    run_log=None,
+):
+    """Stream every EDF/XML pair straight into a sharded memmap store
+    (data/store.py): one shard per included recording, written and
+    committed the moment the recording decodes, so peak host memory is
+    O(one recording) — not O(dataset) like :func:`ingest_directory` —
+    and CPU-bound decode+resample parallelizes across cores in
+    ``mode='process'`` (at most ``workers`` recordings buffer ahead of
+    the shard writer).
+
+    Resumable by construction: a per-recording progress manifest
+    (``ingest_progress.json``, atomic-replace) records each completed
+    recording next to the store's own shard manifest.  A ``kill -9``
+    mid-recording loses at most the shard in flight (the store writer
+    deletes uncommitted files on reopen — no torn shard survives), and a
+    rerun with ``resume=True`` (default) skips completed recordings and
+    retries only errored ones.
+
+    Returns ``(ArrayStore | None, reports)`` — the store holds fields
+    ``x``/``y``/``patient_ids``/``start_time_s`` with the channel tuple
+    in its manifest ``meta``; reports cover every job including resumed
+    ones.  Progress is mirrored as ``ingest_progress`` telemetry events
+    on ``run_log`` (default: the active run, if any).
+    """
+    import time
+
+    from apnea_uq_tpu.data.store import ArrayStore, StoreWriter, peak_rss_bytes
+
+    if run_log is None:
+        from apnea_uq_tpu.telemetry.runlog import current_run
+
+        run_log = current_run()
+
+    jobs = list_ingest_jobs(edf_folder, xml_folder, num_files=num_files)
+    if not resume:
+        # Clear progress BEFORE resetting the store: a kill between the
+        # two leaves empty progress + old shards, which the reconcile
+        # below re-adopts from the store manifest — never the reverse
+        # gap (reset store + stale progress), where a later resumed run
+        # would skip recordings whose shards are gone.
+        os.makedirs(store_dir, exist_ok=True)
+        _write_ingest_progress(store_dir, {})
+    writer = StoreWriter(
+        store_dir, resume=resume,
+        meta={"channels": list(config.channels),
+              "window_size_s": config.window_size_s},
+    )
+    completed = read_ingest_progress(store_dir) if resume else {}
+    # Reconcile progress against the store's own shard manifest, both
+    # directions:
+    # 1. Drop stale records whose shard no longer exists (or holds a
+    #    different patient) — trusting them would silently skip a
+    #    recording whose data is gone; the rerun re-ingests it instead.
+    shard_patient = {
+        i: rng[0] for i, rng in enumerate(writer.patient_ranges())
+        if rng is not None
+    }
+    for pid, rec in list(completed.items()):
+        si = rec.get("shard")
+        if si is not None and shard_patient.get(si) != pid:
+            del completed[pid]
+    # 2. Adopt committed shards the progress file doesn't know about (a
+    #    kill between a shard commit and its progress commit) — the
+    #    shard IS the recording's data; re-ingesting would duplicate it.
+    for i, pid in shard_patient.items():
+        rec = completed.get(pid)
+        if rec is None or rec.get("shard") is None:
+            completed[pid] = {
+                "n_windows": writer.shard_rows(i),
+                "excluded": None, "error": None, "shard": i,
+            }
+    _write_ingest_progress(store_dir, completed)
+
+    reports: List[IngestReport] = []
+    pending = []
+    skipped = 0
+    for job in jobs:
+        edf_path, _xml, patient_id = job
+        prior = completed.get(patient_id)
+        if prior is not None and prior.get("error") is None:
+            # Included or excluded on a previous run: its shard (if any)
+            # is already committed; reconstruct the report and move on.
+            skipped += 1
+            reports.append(IngestReport(
+                patient_id, edf_path,
+                n_windows=int(prior.get("n_windows", 0)),
+                excluded=prior.get("excluded"),
+            ))
+        else:
+            pending.append(job)
+
+    t0 = time.perf_counter()
+    rows_written = 0
+    bytes_written = 0
+    done = skipped
+    total = len(jobs)
+    for (edf_path, _xml, patient_id), (ws, report) in zip(
+        pending, _job_results(pending, config, workers, mode)
+    ):
+        record: Dict[str, Optional[str]] = {
+            "n_windows": report.n_windows,
+            "excluded": report.excluded,
+            "error": report.error,
+        }
+        if ws is not None:
+            if tuple(ws.channels) != tuple(config.channels):
+                raise ValueError(
+                    f"recording {patient_id} decoded channels "
+                    f"{ws.channels}, store expects {tuple(config.channels)}"
+                )
+            shard = {
+                "x": ws.x.astype(np.float32, copy=False),
+                "y": ws.y,
+                "patient_ids": ws.patient_ids.astype(_PATIENT_ID_DTYPE),
+                "start_time_s": ws.start_time_s,
+            }
+            record["shard"] = writer.append_shard(
+                shard, patient_range=(patient_id, patient_id)
+            )
+            rows_written += len(ws)
+            bytes_written += sum(np.asarray(a).nbytes for a in shard.values())
+        completed[patient_id] = record
+        # Progress commits AFTER the shard commit.  A kill in the gap
+        # leaves one committed shard the progress file doesn't know
+        # about; the rerun would append a duplicate — which the
+        # per-patient shard check at finalize time detects loudly.
+        _write_ingest_progress(store_dir, completed)
+        reports.append(report)
+        done += 1
+        if run_log is not None:
+            elapsed = max(time.perf_counter() - t0, 1e-9)
+            run_log.event(
+                "ingest_progress", done=done, total=total, skipped=skipped,
+                rows=rows_written, rows_per_s=round(rows_written / elapsed, 3),
+                bytes_written=bytes_written, rss_bytes=peak_rss_bytes(),
+            )
+    if run_log is not None and jobs and not pending:
+        # A fully-resumed run processes nothing; still record the outcome
+        # (every recording skipped) so the run's summary isn't silent.
+        run_log.event(
+            "ingest_progress", done=done, total=total, skipped=skipped,
+            rows=0, rows_per_s=0.0, bytes_written=0,
+            rss_bytes=peak_rss_bytes(),
+        )
+
+    if writer.num_shards == 0:
+        return None, reports
+    store = writer.finalize()
+    _check_no_duplicate_shards(store)
+    return store, reports
+
+
+def _check_no_duplicate_shards(store) -> None:
+    """Belt-and-braces invariant check at finalize: the reconcile loop
+    above adopts any shard whose progress record was lost, so no rerun
+    should ever append a second shard for a patient — if one exists
+    anyway (hand-edited progress file, two concurrent ingests), fail
+    loudly instead of silently double-counting a patient's windows."""
+    seen = {}
+    for i, rng in enumerate(store.patient_ranges()):
+        if rng is None:
+            continue
+        pid = rng[0]
+        if pid in seen:
+            raise ValueError(
+                f"store holds duplicate shards ({seen[pid]} and {i}) for "
+                f"patient {pid} — concurrent or inconsistently-resumed "
+                f"ingests; delete the store directory and re-run"
+            )
+        seen[pid] = i
 
 
 # -- reference CSV interop ------------------------------------------------

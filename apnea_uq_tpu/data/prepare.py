@@ -29,12 +29,15 @@ import numpy as np
 
 from apnea_uq_tpu.config import PrepareConfig
 from apnea_uq_tpu.data import registry as reg
+from apnea_uq_tpu.data import store as store_mod
 from apnea_uq_tpu.data.ingest import WindowSet
 from apnea_uq_tpu.data.registry import ArtifactRegistry
 from apnea_uq_tpu.data.sampling import (
     grouped_train_test_split,
     random_undersample,
     smote_oversample,
+    iter_smote_synthetic,
+    undersample_indices,
     verify_no_group_overlap,
 )
 
@@ -182,15 +185,28 @@ def save_prepared(
     prepared: PreparedDatasets,
     registry: ArtifactRegistry,
     config: Optional[PrepareConfig] = None,
+    *,
+    store: bool = False,
+    rows_per_shard: int = store_mod.DEFAULT_ROWS_PER_SHARD,
 ) -> None:
     """Persist the bundle under canonical keys (the save block at
-    prepare_numpy_datasets.py:223-245, minus the name drift)."""
-    registry.save_arrays(
+    prepare_numpy_datasets.py:223-245, minus the name drift).
+
+    ``store=True`` writes sharded memmap stores (``array_store`` kind,
+    data/store.py) instead of monolithic ``.npz`` bundles, so later
+    stages memory-map instead of materializing; contents are identical.
+    """
+    save = (
+        (lambda key, arrays, **kw: registry.save_array_store(
+            key, arrays, rows_per_shard=rows_per_shard, **kw))
+        if store else registry.save_arrays
+    )
+    save(
         reg.TRAIN_STD_SMOTE,
         {"x": prepared.x_train, "y": prepared.y_train},
         config=config,
     )
-    registry.save_arrays(
+    save(
         reg.TEST_STD_UNBALANCED,
         {
             "x": prepared.x_test,
@@ -200,7 +216,7 @@ def save_prepared(
         config=config,
     )
     if prepared.x_test_rus is not None:
-        registry.save_arrays(
+        save(
             reg.TEST_STD_RUS,
             {"x": prepared.x_test_rus, "y": prepared.y_test_rus},
             config=config,
@@ -208,26 +224,233 @@ def save_prepared(
 
 
 def load_prepared(
-    registry: ArtifactRegistry, *, include_train: bool = True
+    registry: ArtifactRegistry, *, include_train: bool = True,
+    mmap: bool = False,
 ) -> PreparedDatasets:
     """Load the bundle saved by :func:`save_prepared`.
 
     ``include_train=False`` skips the SMOTE-balanced training arrays —
     the registry's largest artifact — for stages that only evaluate.
+    Each artifact is loaded by the exact key subset a consumer reads
+    (``names=``), so nothing is decompressed and then dropped.
+
+    ``mmap=True`` returns memmap-backed window arrays for ``array_store``
+    artifacts (data/store.py): zero copy, zero load time — the streamed
+    trainers/predictors then slice batches straight off the mapping and
+    steady-state host RSS stays O(prefetch × batch) regardless of
+    dataset rows.  Labels and patient ids (O(rows) scalars/strings) are
+    always materialized; ``.npz`` artifacts are unaffected.
     """
-    train = registry.load_arrays(reg.TRAIN_STD_SMOTE) if include_train else None
-    test = registry.load_arrays(reg.TEST_STD_UNBALANCED)
+    train = (registry.load_arrays(reg.TRAIN_STD_SMOTE, names=("x", "y"),
+                                  mmap=mmap)
+             if include_train else None)
+    test = registry.load_arrays(
+        reg.TEST_STD_UNBALANCED, names=("x", "y", "patient_ids"), mmap=mmap
+    )
     if registry.exists(reg.TEST_STD_RUS):
-        rus = registry.load_arrays(reg.TEST_STD_RUS)
-        x_rus, y_rus = rus["x"], rus["y"]
+        rus = registry.load_arrays(reg.TEST_STD_RUS, names=("x", "y"),
+                                   mmap=mmap)
+        x_rus, y_rus = rus["x"], np.asarray(rus["y"])
     else:
         x_rus = y_rus = None
     return PreparedDatasets(
         x_train=train["x"] if train is not None else None,
-        y_train=train["y"] if train is not None else None,
+        y_train=np.asarray(train["y"]) if train is not None else None,
         x_test=test["x"],
-        y_test=test["y"],
-        patient_ids_test=test["patient_ids"].astype(str),
+        y_test=np.asarray(test["y"]),
+        patient_ids_test=np.asarray(test["patient_ids"]).astype(str),
         x_test_rus=x_rus,
         y_test_rus=y_rus,
     )
+
+
+# -- out-of-core prepare: sharded store in, sharded stores out -------------
+
+def streaming_nan_stats(x, fit_mask: np.ndarray, *, block_rows: int):
+    """(has_nan anywhere, per-(time, channel) NaN-ignoring means over the
+    ``fit_mask`` rows) in one streaming pass of O(block_rows) memory.
+
+    Accumulates in float64 (a blockwise float32 sum would drift with the
+    block size); the in-core :func:`nan_column_means` reduces in float32
+    pairwise order instead, so the two agree to float32 roundoff — exact
+    whenever the data has no NaNs at all, because then the means are
+    never applied."""
+    x = store_mod.as_host_source(x)
+    fit_mask = np.asarray(fit_mask, bool)
+    tail = tuple(np.shape(x))[1:]
+    total = np.zeros(tail, np.float64)
+    count = np.zeros(tail, np.int64)
+    has_nan = False
+    blocks = (x.iter_blocks(block_rows)
+              if isinstance(x, store_mod.ShardedArray)
+              else ((lo, np.asarray(x[lo:lo + block_rows]))
+                    for lo in range(0, len(x), block_rows)))
+    for lo, block in blocks:
+        nan = np.isnan(block)
+        has_nan = has_nan or bool(nan.any())
+        fit = fit_mask[lo:lo + len(block)]
+        if fit.any():
+            sub = block[fit]
+            sub_nan = nan[fit]
+            total += np.where(sub_nan, 0.0, sub).sum(axis=0, dtype=np.float64)
+            count += (~sub_nan).sum(axis=0)
+    with np.errstate(invalid="ignore"):
+        means = np.where(count > 0, total / np.maximum(count, 1), 0.0)
+    return has_nan, means.astype(np.float32)
+
+
+def _stream_standardized(x, rows: np.ndarray, *, means, eps: float,
+                         block_rows: int):
+    """Yield imputed + per-window-standardized float32 blocks of the
+    selected rows — the row-local math of the in-core path, applied
+    O(block_rows) at a time."""
+    rows = np.asarray(rows)
+    for lo in range(0, len(rows), block_rows):
+        block = np.asarray(x[rows[lo:lo + block_rows]], dtype=np.float32)
+        if means is not None and np.isnan(block).any():
+            block = fill_nan_with_column_means(block, means=means)
+        yield standardize_per_window(block, eps)
+
+
+def prepare_from_store(
+    store: store_mod.ArrayStore,
+    registry: ArtifactRegistry,
+    config: PrepareConfig = PrepareConfig(),
+    *,
+    block_rows: int = 16384,
+    rows_per_shard: int = store_mod.DEFAULT_ROWS_PER_SHARD,
+) -> None:
+    """Out-of-core :func:`prepare_datasets`: windows stream from a
+    sharded memmap store and the three prepared artifacts stream into
+    sharded stores, so peak host memory is O(block) + O(labels) instead
+    of the in-core path's 4-5 whole-set copies.
+
+    Where the math allows it the pipeline is block-local and matches the
+    in-core path exactly: per-window standardization and NaN imputation
+    are row-local, the grouped split / SMOTE / RUS all operate on INDEX
+    arrays (sampling.py's factored helpers draw the identical RNG
+    streams), and SMOTE's synthesis needs only the standardized minority
+    rows resident (gathered back off the just-written train store's
+    mmap).  The one permitted divergence: streaming NaN means accumulate
+    in float64 (see :func:`streaming_nan_stats`), so imputed values can
+    differ from in-core by float32 roundoff — bit-identical whenever the
+    windows carry no NaNs.
+    """
+    y_all = np.asarray(store.read("y", mmap=False))
+    groups = np.asarray(store.read("patient_ids", mmap=False)).astype(str)
+    x_all = store.read("x")  # lazy
+
+    train_idx, test_idx = grouped_train_test_split(
+        groups, test_size=config.test_size, seed=config.seed
+    )
+    verify_no_group_overlap(groups, train_idx, test_idx)
+    y_train = y_all[train_idx]
+    y_test = y_all[test_idx]
+    ids_test = groups[test_idx]
+
+    # Streaming pass for NaN presence + imputation means over the fit set.
+    if config.nan_fill == "train":
+        fit_mask = np.zeros(len(y_all), bool)
+        fit_mask[train_idx] = True
+    elif config.nan_fill == "global":
+        fit_mask = np.ones(len(y_all), bool)
+    else:
+        raise ValueError(
+            f"nan_fill must be 'train' or 'global', got {config.nan_fill!r}"
+        )
+    has_nan, means = streaming_nan_stats(x_all, fit_mask,
+                                         block_rows=block_rows)
+    if not has_nan:
+        means = None
+
+    steps, feats = tuple(np.shape(x_all))[1:]
+
+    # -- train: standardized originals, then SMOTE synthetic shards ------
+    train_path = registry.path_for(reg.TRAIN_STD_SMOTE, ".store")
+    writer = store_mod.StoreWriter(train_path, resume=False)
+    for lo, block in zip(
+        range(0, len(train_idx), block_rows),
+        _stream_standardized(x_all, train_idx, means=means,
+                             eps=config.standardize_eps,
+                             block_rows=block_rows),
+    ):
+        writer.append_shard({
+            "x": block, "y": y_train[lo:lo + len(block)],
+        })
+    if config.smote:
+        # The try covers ONLY "can SMOTE run?" (class structure, minority
+        # size — what the in-core path's fallback catches); the shard
+        # writes below run outside it, so a store error mid-append fails
+        # loudly instead of silently adopting a half-oversampled train
+        # set.  iter_smote_synthetic validates and draws eagerly, then
+        # yields O(block) synthetic rows at a time — peak memory tracks
+        # the minority rows + one block, never the majority count.
+        smote_plan = None
+        try:
+            classes, counts = np.unique(y_train, return_counts=True)
+            if classes.size != 2:
+                raise ValueError(
+                    f"binary SMOTE only, got classes {classes.tolist()}")
+            minority = classes[np.argmin(counts)]
+            n_needed = int(counts.max() - counts.min())
+            if n_needed:
+                # Gather ONLY the standardized minority rows back off the
+                # just-written store — O(minority), not O(train).
+                train_x = store_mod.ArrayStore.open(train_path).read("x")
+                min_rows = np.flatnonzero(y_train == minority)
+                x_min = train_x[min_rows].reshape(len(min_rows),
+                                                  steps * feats)
+                smote_plan = (minority, iter_smote_synthetic(
+                    x_min, n_needed, k_neighbors=config.smote_k_neighbors,
+                    seed=config.seed, block_rows=rows_per_shard,
+                ))
+        except ValueError:
+            # Reference fallback: unbalanced training set when SMOTE
+            # cannot run (prepare_numpy_datasets.py:194-197).
+            smote_plan = None
+        if smote_plan is not None:
+            minority, blocks = smote_plan
+            for block in blocks:
+                writer.append_shard({
+                    "x": block.reshape(-1, steps, feats),
+                    "y": np.full(len(block), minority, dtype=y_train.dtype),
+                })
+    writer.finalize()
+    registry.adopt_array_store(reg.TRAIN_STD_SMOTE, config=config)
+
+    # -- test: standardized, unbalanced ----------------------------------
+    test_path = registry.path_for(reg.TEST_STD_UNBALANCED, ".store")
+    writer = store_mod.StoreWriter(test_path, resume=False)
+    for lo, block in zip(
+        range(0, len(test_idx), block_rows),
+        _stream_standardized(x_all, test_idx, means=means,
+                             eps=config.standardize_eps,
+                             block_rows=block_rows),
+    ):
+        hi = lo + len(block)
+        ids_block = ids_test[lo:hi].astype(np.str_)
+        id_sorted = sorted(ids_block.tolist())
+        writer.append_shard(
+            {"x": block, "y": y_test[lo:hi], "patient_ids": ids_block},
+            patient_range=(id_sorted[0], id_sorted[-1]),
+        )
+    writer.finalize()
+    registry.adopt_array_store(reg.TEST_STD_UNBALANCED, config=config)
+
+    # -- RUS-balanced test copy: index selection, streamed gather --------
+    if config.rus:
+        try:
+            keep_idx = undersample_indices(y_test, seed=config.seed)
+        except ValueError:
+            keep_idx = None  # reference skips the balanced set (:218-220)
+        if keep_idx is not None:
+            test_x = store_mod.ArrayStore.open(test_path).read("x")
+            rus_path = registry.path_for(reg.TEST_STD_RUS, ".store")
+            writer = store_mod.StoreWriter(rus_path, resume=False)
+            for lo in range(0, len(keep_idx), block_rows):
+                rows = keep_idx[lo:lo + block_rows]
+                writer.append_shard({
+                    "x": test_x[rows], "y": y_test[rows],
+                })
+            writer.finalize()
+            registry.adopt_array_store(reg.TEST_STD_RUS, config=config)

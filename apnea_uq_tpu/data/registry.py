@@ -13,11 +13,13 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
 from apnea_uq_tpu.config import _to_jsonable
+from apnea_uq_tpu.data import store as store_mod
 
 MANIFEST_NAME = "manifest.json"
 
@@ -114,15 +116,148 @@ class ArtifactRegistry:
         )
         return path
 
-    def load_arrays(self, key: str) -> Dict[str, np.ndarray]:
+    def save_array_store(
+        self,
+        key: str,
+        arrays: Dict[str, np.ndarray],
+        *,
+        rows_per_shard: int = store_mod.DEFAULT_ROWS_PER_SHARD,
+        config: Any = None,
+        meta: Optional[Dict[str, Any]] = None,
+        patient_id_field: Optional[str] = None,
+    ) -> str:
+        """Persist arrays as a sharded memmap store (``array_store`` kind,
+        data/store.py) instead of a monolithic ``.npz`` — the out-of-core
+        artifact format: readers memory-map it instead of materializing,
+        and writers stream into it shard by shard."""
+        path = self.path_for(key, ".store")
+        store_mod.write_store(
+            path, arrays, rows_per_shard=rows_per_shard, meta=meta,
+            patient_id_field=patient_id_field,
+        )
+        return self.adopt_array_store(key, config=config)
+
+    def adopt_array_store(self, key: str, *, config: Any = None) -> str:
+        """Record an already-written store directory at this key's
+        canonical path (``<key>.store``) as an ``array_store`` artifact —
+        the ingest path writes shards straight into the directory and
+        adopts it once complete."""
+        path = self.path_for(key, ".store")
+        store = store_mod.ArrayStore.open(path)
+        self._record(
+            key,
+            {
+                "file": os.path.basename(path),
+                "kind": "array_store",
+                "arrays": {
+                    **{
+                        name: {
+                            "shape": [store.rows] + list(spec["shape"]),
+                            "dtype": spec["dtype"],
+                        }
+                        for name, spec in store.fields.items()
+                    },
+                    **{
+                        name: {
+                            "shape": list(np.shape(extra["values"])),
+                            "dtype": extra["dtype"],
+                        }
+                        for name, extra in store.extra_arrays.items()
+                    },
+                },
+                "rows": store.rows,
+                "shards": store.num_shards,
+                "config": _to_jsonable(config),
+            },
+        )
+        return path
+
+    def open_array_store(self, key: str) -> store_mod.ArrayStore:
+        entry = self._entry(key)
+        if entry.get("kind") != "array_store":
+            raise ValueError(
+                f"artifact {key!r} is kind {entry.get('kind')!r}, not "
+                f"'array_store' (migrate it with "
+                f"`apnea-uq migrate --key {key}`)"
+            )
+        return store_mod.ArrayStore.open(os.path.join(self.root, entry["file"]))
+
+    def _entry(self, key: str) -> Dict[str, Any]:
         entry = self.describe(key)
         if entry is None:
             raise KeyError(
                 f"artifact {key!r} not in registry at {self.root} "
                 f"(have: {sorted(self.manifest()['artifacts'])})"
             )
-        with np.load(os.path.join(self.root, entry["file"]), allow_pickle=False) as z:
-            return {name: z[name] for name in z.files}
+        return entry
+
+    def load_arrays(
+        self,
+        key: str,
+        *,
+        names: Optional[Sequence[str]] = None,
+        mmap: bool = False,
+    ) -> Dict[str, np.ndarray]:
+        """Load an array artifact — either kind.
+
+        ``names`` selects a subset so consumers stop decompressing keys
+        they never read (each ``.npz`` member decompresses on access;
+        store fields simply aren't mapped).  ``mmap=True`` returns
+        memmap-backed lazy arrays for ``array_store`` artifacts (zero
+        copy, zero load time) and is a no-op for ``.npz`` (the zip
+        container cannot be mapped).  Emits one ``data_load`` telemetry
+        event per call when a run log is active."""
+        entry = self._entry(key)
+        t0 = time.perf_counter()
+        if entry.get("kind") == "array_store":
+            store = store_mod.ArrayStore.open(
+                os.path.join(self.root, entry["file"])
+            )
+            unknown = (set(names or ()) - set(store.fields)
+                       - set(store.extra_arrays))
+            if unknown:
+                raise KeyError(
+                    f"artifact {key!r} has no array(s) {sorted(unknown)} "
+                    f"(have: {sorted(store.fields)})"
+                )
+            out = store.arrays(names, mmap=mmap)
+        else:
+            with np.load(os.path.join(self.root, entry["file"]),
+                         allow_pickle=False) as z:
+                unknown = set(names or ()) - set(z.files)
+                if unknown:
+                    raise KeyError(
+                        f"artifact {key!r} has no array(s) "
+                        f"{sorted(unknown)} (have: {sorted(z.files)})"
+                    )
+                out = {name: z[name]
+                       for name in (names if names is not None else z.files)}
+        self._record_data_load(key, entry, out, time.perf_counter() - t0,
+                               mmap=mmap)
+        return out
+
+    def _record_data_load(self, key: str, entry: Dict[str, Any], arrays,
+                          load_s: float, *, mmap: bool) -> None:
+        """``data_load`` telemetry: how long a stage-start artifact load
+        took, its logical volume, and the process's peak RSS — so the
+        npz-vs-store cold-start cost is a gateable number, not prose."""
+        from apnea_uq_tpu.telemetry.runlog import current_run
+
+        run = current_run()
+        if run is None:
+            return
+        rows = 0
+        logical = 0
+        for a in arrays.values():
+            shape = np.shape(a)
+            rows = max(rows, int(shape[0]) if shape else 0)
+            logical += int(getattr(a, "nbytes", 0))
+        run.event(
+            "data_load", key=key, artifact_kind=entry.get("kind"),
+            mmap=bool(mmap), rows=rows, bytes=logical,
+            load_s=round(load_s, 6),
+            rss_bytes=store_mod.peak_rss_bytes(),
+        )
 
     # -- tables -----------------------------------------------------------
 
@@ -188,5 +323,41 @@ class ArtifactRegistry:
             {"file": os.path.basename(path), "kind": "directory"},
         )
         return path
+
+
+def migrate_to_store(
+    registry: ArtifactRegistry,
+    key: str,
+    *,
+    rows_per_shard: int = store_mod.DEFAULT_ROWS_PER_SHARD,
+    keep_npz: bool = True,
+) -> str:
+    """Convert an ``arrays`` (.npz) artifact to the ``array_store`` kind
+    in place: same key, same array contents, sharded memmap layout.
+    Old registries stay readable without migrating — this exists so a
+    one-off command upgrades them to the zero-copy path.  The original
+    ``.npz`` file is kept by default (the manifest no longer references
+    it); ``keep_npz=False`` deletes it after a verified store write."""
+    entry = registry._entry(key)
+    if entry.get("kind") == "array_store":
+        return os.path.join(registry.root, entry["file"])
+    if entry.get("kind") != "arrays":
+        raise ValueError(
+            f"artifact {key!r} is kind {entry.get('kind')!r}; only "
+            f"'arrays' (.npz) artifacts can migrate to a store"
+        )
+    arrays = registry.load_arrays(key)
+    config = entry.get("config")
+    path = registry.save_array_store(
+        key, arrays, rows_per_shard=rows_per_shard, config=config,
+        patient_id_field="patient_ids" if "patient_ids" in arrays else None,
+    )
+    store_mod.ArrayStore.open(path).verify()
+    if not keep_npz:
+        try:
+            os.remove(os.path.join(registry.root, entry["file"]))
+        except OSError:
+            pass
+    return path
 
 

@@ -146,25 +146,79 @@ def smote_oversample(
         return x.copy(), y.copy()
 
     min_idx = np.flatnonzero(y == minority)
-    x_min = x[min_idx].astype(np.float32, copy=False)
-    if len(min_idx) <= 1:
+    synthetic = smote_synthesize(
+        x[min_idx], n_needed, k_neighbors=k_neighbors, seed=seed,
+        knn_chunk=knn_chunk,
+    )
+
+    x_out = np.concatenate([x, synthetic.astype(x.dtype, copy=False)])
+    y_out = np.concatenate([y, np.full(n_needed, minority, dtype=y.dtype)])
+    return x_out, y_out
+
+
+def smote_synthesize(
+    x_min: np.ndarray,
+    n_needed: int,
+    *,
+    k_neighbors: int = 5,
+    seed: int = 2025,
+    knn_chunk: int = 2048,
+) -> np.ndarray:
+    """All ``n_needed`` SMOTE synthetic rows as one array — the in-core
+    convenience over :func:`iter_smote_synthetic`."""
+    blocks = list(iter_smote_synthetic(
+        x_min, n_needed, k_neighbors=k_neighbors, seed=seed,
+        knn_chunk=knn_chunk, block_rows=max(n_needed, 1),
+    ))
+    if not blocks:
+        x_min = np.asarray(x_min)
+        return np.empty((0, x_min.shape[1]), np.float32)
+    return np.concatenate(blocks)
+
+
+def iter_smote_synthetic(
+    x_min: np.ndarray,
+    n_needed: int,
+    *,
+    k_neighbors: int = 5,
+    seed: int = 2025,
+    knn_chunk: int = 2048,
+    block_rows: int = 65536,
+):
+    """The SMOTE synthesis core, factored so the out-of-core prepare path
+    shares it bit-for-bit with :func:`smote_oversample`: given the 2-D
+    minority rows alone (O(minority) memory — the majority never needs to
+    be resident), return an iterator of float32 synthetic blocks whose
+    concatenation equals the in-core path exactly.
+
+    Validation, the minority kNN, and ALL RNG draws (base rows, neighbor
+    columns, gaps — O(n_needed) scalars, not rows) happen eagerly before
+    this returns, so a caller can separate "can SMOTE run?" errors from
+    the block iteration; only the O(block_rows) row synthesis is lazy,
+    which is what keeps the streamed prepare's peak memory off the
+    majority-class count."""
+    x_min = np.asarray(x_min).astype(np.float32, copy=False)
+    if len(x_min) <= 1:
         raise ValueError(
-            f"minority class {minority!r} has {len(min_idx)} sample(s); "
+            f"minority class has {len(x_min)} sample(s); "
             "SMOTE needs at least 2"
         )
     nn = _minority_knn(x_min, k_neighbors, chunk=knn_chunk)
 
     rng = np.random.default_rng(seed)
-    base = rng.integers(0, len(min_idx), n_needed)
+    base = rng.integers(0, len(x_min), n_needed)
     neighbor_col = rng.integers(0, nn.shape[1], n_needed)
     gaps = rng.random((n_needed, 1), dtype=np.float32)
-    x_base = x_min[base]
-    x_nn = x_min[nn[base, neighbor_col]]
-    synthetic = x_base + gaps * (x_nn - x_base)
 
-    x_out = np.concatenate([x, synthetic.astype(x.dtype, copy=False)])
-    y_out = np.concatenate([y, np.full(n_needed, minority, dtype=y.dtype)])
-    return x_out, y_out
+    def blocks():
+        for lo in range(0, n_needed, block_rows):
+            hi = min(lo + block_rows, n_needed)
+            b = base[lo:hi]
+            x_base = x_min[b]
+            x_nn = x_min[nn[b, neighbor_col[lo:hi]]]
+            yield x_base + gaps[lo:hi] * (x_nn - x_base)
+
+    return blocks()
 
 
 def random_undersample(
@@ -182,6 +236,20 @@ def random_undersample(
     with the same kept indices.  Rows keep their original relative order.
     """
     y = np.asarray(y)
+    keep_idx = undersample_indices(y, seed=seed)
+    return (
+        np.asarray(x)[keep_idx],
+        y[keep_idx],
+        tuple(np.asarray(e)[keep_idx] for e in extras),
+    )
+
+
+def undersample_indices(y: np.ndarray, *, seed: int = 2025) -> np.ndarray:
+    """The kept-row indices of :func:`random_undersample`, factored so
+    the out-of-core prepare path can select rows by INDEX and stream
+    them into result shards — identical draw, identical order, without
+    the feature matrix ever being resident."""
+    y = np.asarray(y)
     classes, counts = np.unique(y, return_counts=True)
     if classes.size < 2:
         raise ValueError(
@@ -194,9 +262,4 @@ def random_undersample(
     for cls in classes:
         cls_idx = np.flatnonzero(y == cls)
         kept.append(rng.choice(cls_idx, size=n_keep, replace=False))
-    keep_idx = np.sort(np.concatenate(kept))
-    return (
-        np.asarray(x)[keep_idx],
-        y[keep_idx],
-        tuple(np.asarray(e)[keep_idx] for e in extras),
-    )
+    return np.sort(np.concatenate(kept))

@@ -1,0 +1,514 @@
+"""Sharded, memory-mapped array store — the out-of-core data plane (L1/L2).
+
+The reference pipeline (and the first eight PRs here) hands datasets
+between stages as monolithic in-memory arrays: ``ingest_directory``
+concatenates every recording in host RAM and ``registry.save_arrays`` /
+``load_arrays`` round-trips the whole set through one compressed ``.npz``
+— full materialization on every stage start.  At SHHS2 scale host memory,
+not the TPU, becomes the ceiling.  This module replaces that shape:
+
+- **On disk**: a directory of per-shard raw ``.npy`` files written via
+  ``np.lib.format.open_memmap`` plus one JSON manifest recording row
+  counts, shapes/dtypes, per-shard patient-id ranges and content hashes.
+  Raw ``.npy`` (not ``.npz``) because the numpy format maps directly —
+  a reader faults in only the pages it touches.
+- **Writes are atomic per shard**: each field lands under a temp name,
+  is flushed, renamed, and only then recorded in the manifest (the
+  commit point, itself an atomic replace).  A ``kill -9`` mid-shard
+  leaves stray temp/unreferenced files that the next writer cleans up —
+  never a torn shard a reader could see.
+- **Reads are zero-copy**: :class:`ShardedArray` presents the shard
+  sequence as one lazy array (``shape``/``dtype``/``__getitem__``) whose
+  row gathers materialize only the requested rows; contiguous slices
+  stay lazy views.  The streamed trainers/predictors slice batches
+  straight off it, so steady-state host RSS is O(prefetch × batch)
+  independent of dataset rows — and on a multi-process mesh each process
+  faults in only the pages its data-axis shards actually read (memmap
+  laziness makes per-process shard mapping automatic).
+
+Deliberately jax-free: like the registry, this is pure host-side plumbing
+that must import in telemetry/CLI contexts where no backend exists.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+STORE_MANIFEST_NAME = "store_manifest.json"
+DEFAULT_ROWS_PER_SHARD = 65536
+_TMP_PREFIX = ".tmp-"
+
+
+def peak_rss_bytes() -> Optional[int]:
+    """This process's peak resident set size in bytes (Linux/macOS), or
+    None where the ``resource`` module is unavailable.  Telemetry-grade:
+    best-effort, never raises."""
+    try:
+        import resource
+        import sys
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KiB on Linux, bytes on macOS.
+        return int(rss) if sys.platform == "darwin" else int(rss) * 1024
+    except Exception:
+        return None
+
+
+def atomic_write_json(path: str, data: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _content_hash(a: np.ndarray) -> str:
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(a).tobytes())
+    return f"sha256:{h.hexdigest()[:16]}"
+
+
+class ShardedArray:
+    """Read-only lazy concatenation of per-shard ``.npy`` memmaps.
+
+    Presents ``shape``/``dtype``/``ndim``/``len`` like an ndarray.
+    Integer-array indexing (``a[rows]``, any index shape) gathers and
+    materializes ONLY the requested rows; a unit-step slice returns
+    another lazy view sharing the open memmaps; ``np.asarray(a)``
+    materializes the whole selection (the in-HBM consumers' path).
+    Shard files open lazily and stay memory-mapped, so a view over a
+    multi-GB store costs pages actually touched, not bytes on disk.
+    """
+
+    def __init__(self, paths: Sequence[str], counts: Sequence[int],
+                 shape_tail: Tuple[int, ...], dtype,
+                 start: int = 0, stop: Optional[int] = None,
+                 _maps: Optional[list] = None):
+        self._paths = list(paths)
+        self._offsets = np.concatenate(
+            [[0], np.cumsum(np.asarray(counts, np.int64))]
+        )
+        total = int(self._offsets[-1])
+        if not 0 <= start <= total:
+            raise ValueError(f"start {start} out of range [0, {total}]")
+        self._start = int(start)
+        self._stop = total if stop is None else int(stop)
+        if not self._start <= self._stop <= total:
+            raise ValueError(f"stop {stop} out of range [{start}, {total}]")
+        self._tail = tuple(int(d) for d in shape_tail)
+        self._dtype = np.dtype(dtype)
+        # Views share the open-memmap cache with their parent, so slicing
+        # never re-opens files.
+        self._maps = [None] * len(self._paths) if _maps is None else _maps
+
+    # -- array-likeness ---------------------------------------------------
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self._stop - self._start,) + self._tail
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._dtype
+
+    @property
+    def ndim(self) -> int:
+        return 1 + len(self._tail)
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) * self._dtype.itemsize
+
+    def __len__(self) -> int:
+        return self._stop - self._start
+
+    def __repr__(self) -> str:
+        return (f"ShardedArray(shape={self.shape}, dtype={self._dtype}, "
+                f"shards={len(self._paths)})")
+
+    def _shard(self, i: int) -> np.ndarray:
+        if self._maps[i] is None:
+            self._maps[i] = np.load(self._paths[i], mmap_mode="r")
+        return self._maps[i]
+
+    def _gather(self, rows: np.ndarray) -> np.ndarray:
+        """Materialize the requested rows (any integer-index shape)."""
+        rows = np.asarray(rows)
+        flat = rows.reshape(-1).astype(np.int64, copy=True)
+        n = len(self)
+        if flat.size:
+            if flat.min() < -n or flat.max() >= n:
+                raise IndexError(
+                    f"row index out of range for length-{n} ShardedArray"
+                )
+            flat[flat < 0] += n
+        flat += self._start
+        out = np.empty((flat.size,) + self._tail, self._dtype)
+        shard_idx = np.searchsorted(self._offsets, flat, side="right") - 1
+        for si in np.unique(shard_idx):
+            m = shard_idx == si
+            out[m] = self._shard(int(si))[flat[m] - self._offsets[si]]
+        return out.reshape(rows.shape + self._tail)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            lo, hi, step = idx.indices(len(self))
+            if step == 1:
+                return ShardedArray(
+                    self._paths, np.diff(self._offsets),
+                    self._tail, self._dtype,
+                    start=self._start + lo, stop=self._start + max(hi, lo),
+                    _maps=self._maps,
+                )
+            return self._gather(np.arange(lo, hi, step))
+        if isinstance(idx, (int, np.integer)):
+            return self._gather(np.asarray([idx]))[0]
+        idx = np.asarray(idx)
+        if idx.dtype == bool:
+            idx = np.flatnonzero(idx)
+        return self._gather(idx)
+
+    def __array__(self, dtype=None, copy=None):
+        out = self._gather(np.arange(len(self)))
+        if dtype is not None and np.dtype(dtype) != self._dtype:
+            out = out.astype(dtype)
+        return out
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        for i in range(len(self)):
+            yield self[i]
+
+    def iter_blocks(self, block_rows: int) -> Iterator[Tuple[int, np.ndarray]]:
+        """Yield ``(start_row, materialized block)`` over the whole view —
+        the out-of-core scan primitive (each block is O(block_rows))."""
+        if block_rows < 1:
+            raise ValueError(f"block_rows must be >= 1, got {block_rows}")
+        for lo in range(0, len(self), block_rows):
+            hi = min(lo + block_rows, len(self))
+            yield lo, self._gather(np.arange(lo, hi))
+
+
+def as_host_source(x, dtype=np.float32):
+    """Normalize a host-side batch source WITHOUT materializing it.
+
+    A :class:`ShardedArray` (or an ndarray/memmap already at ``dtype``)
+    passes through untouched — the streamed feeds then gather only the
+    rows of each batch, which is what keeps host RSS O(batch) over a
+    memmap-backed dataset.  Anything else falls back to
+    ``np.asarray(x, dtype)`` (a view when dtypes already match, the
+    historical copy otherwise)."""
+    dtype = np.dtype(dtype)
+    if isinstance(x, ShardedArray):
+        if x.dtype == dtype:
+            return x
+        # Wrong-dtype lazy source: materialize (correctness over economy;
+        # the pipeline writes float32 stores, so this is the escape hatch,
+        # not the path).
+        return np.asarray(x, dtype)
+    return np.asarray(x, dtype)
+
+
+class StoreWriter:
+    """Appends shards to (or resumes) an on-disk sharded array store.
+
+    The first ``append_shard`` fixes the field schema (names, trailing
+    shapes, dtypes); every later shard must match.  Each shard's files
+    are written whole, flushed, renamed into place, and only then
+    recorded in the manifest — the atomic commit point.  Opening a
+    writer over an interrupted store keeps every committed shard and
+    deletes stray uncommitted files, so ``kill -9`` mid-shard costs at
+    most the shard in flight.
+    """
+
+    def __init__(self, directory: str, *, resume: bool = True,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._manifest_path = os.path.join(directory, STORE_MANIFEST_NAME)
+        if resume and os.path.exists(self._manifest_path):
+            with open(self._manifest_path) as f:
+                self._manifest = json.load(f)
+            if meta:
+                self._manifest.setdefault("meta", {}).update(meta)
+        else:
+            self._manifest = {
+                "version": 1, "complete": False,
+                "fields": {}, "meta": dict(meta or {}), "shards": [],
+            }
+            self._commit()
+        self._clean_uncommitted()
+
+    # -- internals --------------------------------------------------------
+
+    def _commit(self) -> None:
+        atomic_write_json(self._manifest_path, self._manifest)
+
+    def _committed_files(self) -> set:
+        return {
+            fname
+            for shard in self._manifest["shards"]
+            for fname in shard["files"].values()
+        }
+
+    def _clean_uncommitted(self) -> None:
+        """Delete shard files a dead writer left behind uncommitted — a
+        torn shard must never survive into a reader's view."""
+        keep = self._committed_files() | {STORE_MANIFEST_NAME}
+        for name in os.listdir(self.directory):
+            if name in keep or not name.endswith(".npy"):
+                continue
+            if name.startswith(_TMP_PREFIX) or name.startswith("shard-"):
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    # -- API --------------------------------------------------------------
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._manifest["shards"])
+
+    @property
+    def rows(self) -> int:
+        return sum(s["rows"] for s in self._manifest["shards"])
+
+    def shard_rows(self, i: int) -> int:
+        return int(self._manifest["shards"][i]["rows"])
+
+    def patient_ranges(self) -> List[Optional[Tuple[str, str]]]:
+        return [
+            tuple(s["patient_range"]) if s.get("patient_range") else None
+            for s in self._manifest["shards"]
+        ]
+
+    def append_shard(self, arrays: Dict[str, np.ndarray], *,
+                     patient_range: Optional[Tuple[str, str]] = None) -> int:
+        """Write one shard (a dict of equal-leading-dim arrays) and commit
+        it to the manifest.  Returns the shard index."""
+        if not arrays:
+            raise ValueError("cannot append an empty shard")
+        rows = {name: int(np.shape(a)[0]) for name, a in arrays.items()}
+        if len(set(rows.values())) != 1:
+            raise ValueError(f"shard arrays disagree on row count: {rows}")
+        n_rows = next(iter(rows.values()))
+        if n_rows == 0:
+            raise ValueError("cannot append a zero-row shard")
+
+        fields = self._manifest["fields"]
+        if fields:
+            if set(arrays) != set(fields):
+                raise ValueError(
+                    f"shard fields {sorted(arrays)} != store schema "
+                    f"{sorted(fields)}"
+                )
+        for name, a in arrays.items():
+            a = np.asarray(a)
+            tail = list(a.shape[1:])
+            dtype = str(a.dtype)
+            spec = fields.get(name)
+            if spec is None:
+                fields[name] = {"shape": tail, "dtype": dtype}
+            elif spec["shape"] != tail or spec["dtype"] != dtype:
+                raise ValueError(
+                    f"shard field {name!r} is {tail}/{dtype}, store schema "
+                    f"says {spec['shape']}/{spec['dtype']}"
+                )
+
+        idx = self.num_shards
+        files: Dict[str, str] = {}
+        hashes: Dict[str, str] = {}
+        for name, a in arrays.items():
+            a = np.ascontiguousarray(a)
+            safe = name.replace(os.sep, "_")
+            final = f"shard-{idx:05d}.{safe}.npy"
+            tmp = os.path.join(self.directory, _TMP_PREFIX + final)
+            mm = np.lib.format.open_memmap(
+                tmp, mode="w+", dtype=a.dtype, shape=a.shape
+            )
+            mm[:] = a
+            mm.flush()
+            del mm
+            os.replace(tmp, os.path.join(self.directory, final))
+            files[name] = final
+            hashes[name] = _content_hash(a)
+        entry: Dict[str, Any] = {
+            "rows": n_rows, "files": files, "hashes": hashes,
+        }
+        if patient_range is not None:
+            entry["patient_range"] = [str(patient_range[0]),
+                                      str(patient_range[1])]
+        self._manifest["shards"].append(entry)
+        self._commit()  # the commit point: shard is now visible
+        return idx
+
+    def finalize(self, *, meta: Optional[Dict[str, Any]] = None) -> "ArrayStore":
+        if meta:
+            self._manifest.setdefault("meta", {}).update(meta)
+        self._manifest["complete"] = True
+        self._commit()
+        return ArrayStore.open(self.directory)
+
+
+class ArrayStore:
+    """Read side of a sharded store directory (see module docstring)."""
+
+    def __init__(self, directory: str, manifest: Dict[str, Any]):
+        self.directory = directory
+        self.manifest = manifest
+
+    @classmethod
+    def open(cls, directory: str) -> "ArrayStore":
+        path = os.path.join(directory, STORE_MANIFEST_NAME)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no {STORE_MANIFEST_NAME} under {directory!r} — not a "
+                f"sharded array store"
+            )
+        with open(path) as f:
+            return cls(directory, json.load(f))
+
+    @property
+    def fields(self) -> Dict[str, Dict[str, Any]]:
+        return self.manifest["fields"]
+
+    @property
+    def meta(self) -> Dict[str, Any]:
+        return self.manifest.get("meta", {})
+
+    @property
+    def extra_arrays(self) -> Dict[str, Dict[str, Any]]:
+        """Small non-row-aligned arrays carried whole in the manifest
+        (e.g. the windows bundle's ``channels`` — (n_channels,) next to
+        (N, ...) fields), so a migrated ``.npz`` artifact loses nothing:
+        ``{name: {"values": [...], "dtype": str}}``."""
+        return self.meta.get("extra_arrays", {})
+
+    @property
+    def rows(self) -> int:
+        return sum(s["rows"] for s in self.manifest["shards"])
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.manifest["shards"])
+
+    @property
+    def nbytes(self) -> int:
+        total = 0
+        for name, spec in self.fields.items():
+            per_row = int(np.prod(spec["shape"], dtype=np.int64)
+                          if spec["shape"] else 1)
+            total += self.rows * per_row * np.dtype(spec["dtype"]).itemsize
+        return total
+
+    def patient_ranges(self) -> List[Optional[Tuple[str, str]]]:
+        return [
+            tuple(s["patient_range"]) if s.get("patient_range") else None
+            for s in self.manifest["shards"]
+        ]
+
+    def read(self, name: str, *, mmap: bool = True):
+        """One field across every shard: a lazy :class:`ShardedArray`
+        (memmap-backed; ``mmap=True``) or the materialized ndarray.
+        Manifest-carried extra arrays come back as plain ndarrays."""
+        spec = self.fields.get(name)
+        if spec is None:
+            extra = self.extra_arrays.get(name)
+            if extra is not None:
+                return np.asarray(extra["values"],
+                                  dtype=np.dtype(extra["dtype"]))
+            raise KeyError(
+                f"field {name!r} not in store at {self.directory} "
+                f"(have: {sorted(self.fields) + sorted(self.extra_arrays)})"
+            )
+        shards = self.manifest["shards"]
+        paths = [os.path.join(self.directory, s["files"][name])
+                 for s in shards]
+        counts = [s["rows"] for s in shards]
+        if not shards:
+            return np.empty((0,) + tuple(spec["shape"]),
+                            np.dtype(spec["dtype"]))
+        arr = ShardedArray(paths, counts, tuple(spec["shape"]),
+                           spec["dtype"])
+        return arr if mmap else np.asarray(arr)
+
+    def arrays(self, names: Optional[Sequence[str]] = None, *,
+               mmap: bool = True) -> Dict[str, Any]:
+        if names is None:
+            names = list(self.fields) + list(self.extra_arrays)
+        return {name: self.read(name, mmap=mmap) for name in list(names)}
+
+    def verify(self) -> None:
+        """Recompute every shard file's content hash against the manifest;
+        raises ValueError naming the first mismatch (bit rot, torn write
+        that somehow got committed, manual tampering)."""
+        for i, shard in enumerate(self.manifest["shards"]):
+            for name, fname in shard["files"].items():
+                a = np.load(os.path.join(self.directory, fname),
+                            mmap_mode="r")
+                got = _content_hash(np.asarray(a))
+                want = shard["hashes"][name]
+                if got != want:
+                    raise ValueError(
+                        f"content hash mismatch for shard {i} field "
+                        f"{name!r} ({fname}): manifest {want}, disk {got}"
+                    )
+
+
+def write_store(
+    directory: str,
+    arrays: Dict[str, np.ndarray],
+    *,
+    rows_per_shard: int = DEFAULT_ROWS_PER_SHARD,
+    meta: Optional[Dict[str, Any]] = None,
+    patient_id_field: Optional[str] = None,
+) -> ArrayStore:
+    """Write in-memory arrays as a fresh sharded store (the migrate /
+    save path).  ``patient_id_field`` names the per-row id array used to
+    stamp each shard's patient range.
+
+    Arrays whose leading dimension disagrees with the dominant (largest)
+    array's row count are not row-aligned data (e.g. the windows
+    bundle's per-channel name list) — they ride whole in the manifest as
+    ``extra_arrays`` and read back via :meth:`ArrayStore.read` like any
+    field, so migrating a mixed ``.npz`` bundle is lossless."""
+    if rows_per_shard < 1:
+        raise ValueError(f"rows_per_shard must be >= 1, got {rows_per_shard}")
+    arrays = {name: np.asarray(a) for name, a in arrays.items()}
+    n = 0
+    if arrays:
+        anchor = max(arrays.values(), key=lambda a: a.nbytes)
+        n = int(anchor.shape[0]) if anchor.ndim else 0
+    extras = {
+        name: {"values": a.tolist(), "dtype": str(a.dtype)}
+        for name, a in arrays.items()
+        if a.ndim == 0 or int(a.shape[0]) != n
+    }
+    if extras:
+        meta = dict(meta or {})
+        meta.setdefault("extra_arrays", {}).update(extras)
+        arrays = {name: a for name, a in arrays.items()
+                  if name not in extras}
+    # A fresh write replaces any previous store at this path wholesale.
+    if os.path.exists(os.path.join(directory, STORE_MANIFEST_NAME)):
+        import shutil
+
+        shutil.rmtree(directory)
+    writer = StoreWriter(directory, resume=False, meta=meta)
+    for lo in range(0, n, rows_per_shard):
+        hi = min(lo + rows_per_shard, n)
+        block = {name: np.asarray(a[lo:hi]) for name, a in arrays.items()}
+        prange = None
+        if patient_id_field is not None and patient_id_field in block:
+            # np.min/max lack a ufunc loop for unicode dtypes; go through
+            # Python strings (shard-sized lists, negligible).
+            ids = sorted(block[patient_id_field].astype(str).tolist())
+            prange = (ids[0], ids[-1])
+        writer.append_shard(block, patient_range=prange)
+    return writer.finalize()

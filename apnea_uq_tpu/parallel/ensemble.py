@@ -431,7 +431,10 @@ def _stream_ensemble_epoch(
     val_mstate = _member_metric_state(n_members) if track_metrics else None
     for s in range(val_steps):
         lo, hi = s * batch_size, min((s + 1) * batch_size, n_val)
-        xb, yb = x_val[lo:hi], y_val[lo:hi]
+        # Materialize ONE validation batch off a (possibly store-backed
+        # lazy) slice; free view for plain ndarrays.
+        # apnea-lint: disable=host-sync-in-timed-region -- x_val/y_val are HOST-resident (ndarray or memmap-backed store slice), not device arrays; the O(batch) gather serializes nothing in flight
+        xb, yb = np.asarray(x_val[lo:hi]), np.asarray(y_val[lo:hi])
         pad = batch_size - (hi - lo)
         if pad:
             xb = np.concatenate([xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
@@ -501,7 +504,12 @@ def _setup_ensemble_run(
     if streaming:
         # The dataset stays in HOST memory; the streamed epoch pumps
         # per-member batch stacks through the prefetch feed.
-        x = np.asarray(x_train, np.float32)
+        # as_host_source keeps a memmap-backed store array lazy
+        # (data/store.py): each step gathers only its (members x batch)
+        # row stack, so host RSS stays bounded over an out-of-core set.
+        from apnea_uq_tpu.data.store import as_host_source
+
+        x = as_host_source(x_train)
         y = np.asarray(y_train, np.float32)
     else:
         x = jnp.asarray(x_train, jnp.float32)

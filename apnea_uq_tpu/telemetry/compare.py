@@ -86,12 +86,17 @@ class Comparison:
 
 
 def unit_direction(unit: Optional[str]) -> bool:
-    """higher-is-better for throughput-like units, lower for cost-like."""
+    """higher-is-better for throughput-like units, lower for cost-like.
+
+    A trailing ``_s`` (``load_s``, ``predict_s``) is a seconds suffix,
+    not a per-second rate — rates always carry a slash (``windows/s``) —
+    and ``byte`` anywhere (``bytes``, ``rss_bytes``, ``d2h_bytes``)
+    means volume; both gate lower-is-better."""
     u = (unit or "").lower()
     if "/sec" in u or "/s" in u or u in ("ratio", "speedup", "x"):
         return True
     if (u in ("seconds", "s", "ms", "milliseconds", "flops", "flop")
-            or "byte" in u):
+            or u.endswith("_s") or "byte" in u):
         return False
     return True
 
@@ -182,6 +187,19 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
                 name = f"eval.{e.get('label', '?')}.d2h_bytes"
                 out[name] = Metric(name, float(e["d2h_bytes"]), "bytes",
                                    False)
+        elif kind == "data_load":
+            # Stage-start artifact-load cost (registry data_load events):
+            # seconds to first batch and peak host RSS, both
+            # lower-is-better per artifact key — so a store falling back
+            # to whole-set materialization gates like a speed regression.
+            if e.get("load_s") is not None:
+                name = f"data.{e.get('key', '?')}.load_s"
+                out[name] = Metric(name, float(e["load_s"]), "load_s",
+                                   False)
+            if e.get("rss_bytes") is not None:
+                name = f"data.{e.get('key', '?')}.rss_bytes"
+                out[name] = Metric(name, float(e["rss_bytes"]),
+                                   "rss_bytes", False)
         elif kind == "memory_profile" and e.get("peak_bytes") is not None:
             name = f"memory.{e.get('label', '?')}.peak_bytes"
             out[name] = Metric(name, float(e["peak_bytes"]), "bytes",
@@ -233,7 +251,8 @@ def load_metrics(path: str) -> Dict[str, Metric]:
             raise NoComparableMetrics(
                 f"no comparable metrics in source {path!r}: the run's "
                 f"events carry no bench/eval throughput, d2h, "
-                f"memory-peak, compile-cost, or program-audit metrics"
+                f"memory-peak, compile-cost, data-load, or "
+                f"program-audit metrics"
             )
         return metrics
     with open(path) as f:

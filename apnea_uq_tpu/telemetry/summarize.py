@@ -177,6 +177,41 @@ def _render_program_audits(audits: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _render_data_loads(loads: List[Dict[str, Any]]) -> List[str]:
+    """The data-plane stage-start table from ``data_load`` events
+    (registry artifact loads: cold npz decompress vs zero-copy store
+    mmap)."""
+    lines = ["data plane (artifact loads):"]
+    for e in loads:
+        parts = [
+            f"  {e.get('key', '?')}:",
+            f"{e.get('artifact_kind', '?')}"
+            + (" (mmap)" if e.get("mmap") else ""),
+            f"{e.get('rows', '?')} rows",
+            f"{_mb(e.get('bytes'))} MiB",
+            f"in {_fmt(e.get('load_s'), 3)}s",
+        ]
+        if e.get("rss_bytes") is not None:
+            parts.append(f"rss {_mb(e['rss_bytes'])} MiB")
+        lines.append(" ".join(parts))
+    return lines
+
+
+def _render_ingest(progress: List[Dict[str, Any]]) -> List[str]:
+    """One line from the LAST ``ingest_progress`` event — the stream is
+    per-recording; the tail carries the run's totals."""
+    e = progress[-1]
+    line = (
+        f"ingest: {e.get('done', '?')}/{e.get('total', '?')} recordings"
+        f" ({e.get('skipped', 0)} resumed), {e.get('rows', '?')} rows"
+        f" at {_fmt(e.get('rows_per_s'), 1)} rows/s,"
+        f" {_mb(e.get('bytes_written'))} MiB written"
+    )
+    if e.get("rss_bytes") is not None:
+        line += f", peak rss {_mb(e['rss_bytes'])} MiB"
+    return [line]
+
+
 def _compile_aggregate(comps: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Roll-up of a run's compile_event stream: acquisition count, hit
     ratio (store/cache vs fresh jit compiles), and the total
@@ -228,6 +263,12 @@ _PROGRAM_AUDIT_FIELDS = (
     "label", "group", "flops", "bytes_accessed",
     "arithmetic_intensity", "collectives", "donated_args",
     "aliased_outputs", "const_bytes", "peak_bytes")
+_DATA_LOAD_FIELDS = (
+    "key", "artifact_kind", "mmap", "rows", "bytes", "load_s",
+    "rss_bytes")
+_INGEST_PROGRESS_FIELDS = (
+    "done", "total", "skipped", "rows", "rows_per_s", "bytes_written",
+    "rss_bytes")
 
 
 def _section(events: List[Dict[str, Any]], kind: str,
@@ -346,6 +387,16 @@ def summarize_events(run_dir: str,
         lines.append("")
         lines.extend(_render_program_audits(audits))
 
+    ingest = _section(events, "ingest_progress", _INGEST_PROGRESS_FIELDS)
+    if ingest:
+        lines.append("")
+        lines.extend(_render_ingest(ingest))
+
+    loads = _section(events, "data_load", _DATA_LOAD_FIELDS)
+    if loads:
+        lines.append("")
+        lines.extend(_render_data_loads(loads))
+
     errors = [e for e in events if e.get("kind") == "error"]
     lines.append("")
     if errors:
@@ -432,5 +483,8 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
         "program_audits": section("program_audit", _PROGRAM_AUDIT_FIELDS),
         "compile_events": compile_events,
         "compile": _compile_aggregate(compile_events),
+        "data_loads": section("data_load", _DATA_LOAD_FIELDS),
+        "ingest_progress": section("ingest_progress",
+                                   _INGEST_PROGRESS_FIELDS),
         "errors": section("error", ("where", "error")),
     }

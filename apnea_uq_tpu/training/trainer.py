@@ -347,8 +347,11 @@ def _stream_eval_loss(model, variables, x, y, batch_size, data_sharding,
     def batches():
         for i in range(steps):
             lo, hi = i * batch_size, min((i + 1) * batch_size, n)
-            xb = x[lo:hi]
-            yb = y[lo:hi]
+            # Materializes ONE batch off a lazy store-backed slice (free
+            # view for plain ndarrays) so device_put sees a concrete
+            # array.
+            # apnea-lint: disable=host-sync-in-timed-region -- x/y are HOST-resident (ndarray or memmap-backed store slice), not device arrays; this is the O(batch) gather that keeps the streamed path bounded, and it serializes nothing in flight
+            xb, yb = np.asarray(x[lo:hi]), np.asarray(y[lo:hi])
             pad = batch_size - (hi - lo)
             if pad:
                 xb = np.concatenate([xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
@@ -440,7 +443,13 @@ def fit(
         # The dataset stays in HOST memory; batches flow through the
         # double-buffered prefetch feed (data/feed.py).  Same math as the
         # in-HBM path — same permutation, batches, masks, RNG streams.
-        x = np.asarray(x_train, np.float32)
+        # as_host_source passes a memmap-backed store array
+        # (data/store.py ShardedArray / np.memmap) through WITHOUT
+        # materializing it: each step then gathers only its batch rows,
+        # so host RSS stays O(prefetch x batch) over an out-of-core set.
+        from apnea_uq_tpu.data.store import as_host_source
+
+        x = as_host_source(x_train)
         y = np.asarray(y_train, np.float32)
     else:
         x = jnp.asarray(x_train, jnp.float32)

@@ -212,9 +212,13 @@ def _stream_chunked(x, batch_size: int, n_rows: int, prefetch: int, compute,
     import numpy as np
 
     from apnea_uq_tpu.data.feed import prefetch_to_device
+    from apnea_uq_tpu.data.store import as_host_source
     from apnea_uq_tpu.utils.multihost import host_values
 
-    x = np.asarray(x, np.float32)
+    # A memmap-backed store array (data/store.py) passes through lazily:
+    # each chunk's modular gather materializes only its rows, so an
+    # HBM-exceeding test set streams at O(prefetch x batch) host RSS too.
+    x = as_host_source(x)
     m = x.shape[0]
     n_chunks = -(-m // batch_size)
 

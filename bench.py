@@ -44,6 +44,9 @@ BENCH_SKIP_AUDIT=1 to skip the program-audit context (the IR-level
 `apnea-uq audit` over the inference zoo as a CPU subprocess — lowering
 only, no device time; records per-program FLOPs/arithmetic intensity
 and whether the lowered-IR promises still hold),
+BENCH_SKIP_DATA=1 to skip the data-plane context (cold stage-start
+load of the same window set as monolithic .npz vs sharded memmap
+store + one streamed pass — host-only, no device time),
 BENCH_DE_CHUNK for its DE chunk size,
 BENCH_WASTE_EPOCHS for the early-stop-waste context's epoch cap (0
 skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
@@ -649,6 +652,72 @@ def bench_compile_startup(n_windows: int, n_passes: int, chunk: int) -> dict:
     return out
 
 
+def bench_data_plane(n_windows: int, chunk: int) -> dict:
+    """Out-of-core data plane vs the monolithic artifact path (ISSUE 9):
+    the same synthetic window set saved both ways into a temp registry,
+    then the cold stage-start cost measured for each — the full ``.npz``
+    decompress-and-materialize versus the sharded store's zero-copy
+    memmap open, plus one full streamed pass over the store in
+    ``chunk``-row gathers (what a streamed epoch actually reads).  The
+    registry emits a ``data_load`` telemetry event per load, so the
+    same numbers land in the run log and `telemetry compare` can gate
+    them."""
+    import shutil
+    import tempfile
+
+    from apnea_uq_tpu.data import registry as reg
+    from apnea_uq_tpu.data.registry import ArtifactRegistry
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(n_windows, 60, 4)).astype(np.float32)
+    y = rng.integers(0, 2, n_windows).astype(np.int8)
+    arrays = {"x": x, "y": y}
+    run_log = _bench_run_log()
+
+    td = tempfile.mkdtemp(prefix="bench_data_")
+    try:
+        registry = ArtifactRegistry(td)
+        registry.save_arrays(reg.WINDOWS, arrays)
+        store_key = f"{reg.WINDOWS}:store"
+        registry.save_array_store(
+            store_key, arrays,
+            rows_per_shard=max(1, min(n_windows, 65536)),
+        )
+        with run_log.stage("data_plane", windows=n_windows, chunk=chunk):
+            t0 = time.perf_counter()
+            npz = registry.load_arrays(reg.WINDOWS)
+            npz_rows = int(np.asarray(npz["x"]).shape[0])
+            t_npz = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            mapped = registry.load_arrays(store_key, mmap=True)
+            t_open = time.perf_counter() - t0
+
+            xs = mapped["x"]
+            t0 = time.perf_counter()
+            rows_read = 0
+            for lo in range(0, xs.shape[0], chunk):
+                rows_read += len(np.asarray(
+                    xs[np.arange(lo, min(lo + chunk, xs.shape[0]))]
+                ))
+            t_stream = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+    return {
+        "rows": n_windows,
+        "npz_load_s": round(t_npz, 4),
+        "npz_rows_per_s": round(npz_rows / max(t_npz, 1e-9), 1),
+        "store_open_s": round(t_open, 4),
+        "store_stream_s": round(t_stream, 4),
+        "store_rows_per_s": round(rows_read / max(t_stream, 1e-9), 1),
+        # Cold time-to-first-batch: full npz materialization vs the
+        # store's mmap open + ONE chunk gather.
+        "store_vs_npz_first_batch": round(
+            (t_open + t_stream * chunk / max(n_windows, 1))
+            / max(t_npz, 1e-9), 4),
+    }
+
+
 def bench_program_audit() -> dict:
     """IR-level audit of the inference zoo (`apnea-uq audit`, ISSUE 8)
     as a CPU subprocess: the bench capture's context records whether the
@@ -857,6 +926,13 @@ def bench_mcd() -> dict:
     result["context"]["program_audit"] = _guarded(
         bench_program_audit,
         skip=bool(os.environ.get("BENCH_SKIP_AUDIT")),
+    )
+    # Out-of-core data plane: cold stage-start load of the same window
+    # set as monolithic .npz vs sharded memmap store (+ one streamed
+    # pass), host-only — no device time.
+    result["context"]["data_plane"] = _guarded(
+        lambda: bench_data_plane(n_windows, chunk),
+        skip=bool(os.environ.get("BENCH_SKIP_DATA")),
     )
     _progress_record("primary", result)
     return result

@@ -164,6 +164,16 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert compile_ctx["warm"]["source"] == "store"
     assert compile_ctx["warm"]["persistent_cache_misses"] == 0
     assert compile_ctx["warm"]["total_s"] > 0
+    # Data-plane block (ISSUE 9): cold stage-start load of the same
+    # window set via monolithic .npz vs sharded memmap store, plus a
+    # full streamed pass — all host-side, so the smoke run exercises it
+    # for real.
+    data_ctx = ctx["data_plane"]
+    assert "error" not in data_ctx, data_ctx
+    assert data_ctx["rows"] == 256
+    assert data_ctx["npz_load_s"] > 0 and data_ctx["store_stream_s"] > 0
+    assert data_ctx["store_rows_per_s"] > 0
+    assert data_ctx["store_vs_npz_first_batch"] > 0
     # IR-audit block (ISSUE 8): the `apnea-uq audit` subprocess lowered
     # the inference zoo on CPU and found it clean against the checked-in
     # manifest, with per-program cost facts attached to the capture.
@@ -218,7 +228,7 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     # lockstep epoch program (memory_profile), and BENCH_PROFILE left a
     # bounded trace artifact announced via profile_captured.
     assert {"memory_snapshot", "memory_profile",
-            "profile_captured"} <= kinds, sorted(kinds)
+            "profile_captured", "data_load"} <= kinds, sorted(kinds)
     mem_labels = {e["label"] for e in events
                   if e["kind"] == "memory_profile"}
     assert "ensemble_epoch" in mem_labels

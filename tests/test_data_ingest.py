@@ -264,6 +264,21 @@ class TestIngestRecording:
         np.testing.assert_array_equal(ws.x[0, 30:], ws.x[1, :30])
 
 
+def test_float32_end_to_end(tmp_path, rng):
+    """Dtype hygiene (ISSUE 9 satellite): the ingest path stays float32
+    end to end — the FFT's float64 scratch is per-channel transient and
+    must never leak into the window artifact (it would double ingest
+    host memory)."""
+    edf, xml = synth_recording(tmp_path, rng, n_seconds=300)
+    ws, report = ingest_recording(edf, xml, "200001")
+    assert report.excluded is None
+    assert ws.x.dtype == np.float32
+    # And fft_resample itself honors float32-in -> float32-out (the
+    # scipy-parity contract TestFftResample pins in detail).
+    out = fft_resample(rng.normal(size=100).astype(np.float32), 37)
+    assert out.dtype == np.float32
+
+
 class TestIngestDirectory:
     def test_multi_patient(self, tmp_path, rng):
         synth_recording(tmp_path, rng, patient="200001")
@@ -293,6 +308,45 @@ class TestIngestDirectory:
         ws_par, _ = ingest_directory(str(tmp_path), str(tmp_path), workers=4)
         np.testing.assert_array_equal(ws_seq.x, ws_par.x)
         np.testing.assert_array_equal(ws_seq.y, ws_par.y)
+
+    def test_pool_modes_keep_job_order_and_results(self, tmp_path, rng):
+        """Both pool flavors produce the sequential path's exact report
+        order and window bytes (Executor.map preserves input order; the
+        process mode additionally pickles jobs+config)."""
+        for p in ("200003", "200001", "200002"):
+            synth_recording(tmp_path, rng, patient=p)
+        ws_seq, rep_seq = ingest_directory(str(tmp_path), str(tmp_path))
+        order = [r.patient_id for r in rep_seq]
+        assert order == sorted(order)  # job list is name-sorted
+        for mode in ("thread", "process"):
+            ws, rep = ingest_directory(str(tmp_path), str(tmp_path),
+                                       workers=3, mode=mode)
+            assert [r.patient_id for r in rep] == order, mode
+            np.testing.assert_array_equal(ws.x, ws_seq.x)
+        with pytest.raises(ValueError, match="mode"):
+            ingest_directory(str(tmp_path), str(tmp_path), workers=2,
+                             mode="fork")
+
+    def test_error_reports_carry_traceback_tail(self, tmp_path, rng):
+        """A failing recording's report names the failing frame, not
+        just str(e) (ISSUE 9 satellite) — in sequential AND pool modes,
+        at its job-order position."""
+        synth_recording(tmp_path, rng, patient="200001")
+        (tmp_path / "shhs2-200000.edf").write_bytes(b"not an edf")
+        (tmp_path / "shhs2-200000-nsrr.xml").write_text(
+            "<PSGAnnotation><ScoredEvents></ScoredEvents></PSGAnnotation>"
+        )
+        for kwargs in ({}, {"workers": 2, "mode": "thread"},
+                       {"workers": 2, "mode": "process"}):
+            ws, reports = ingest_directory(str(tmp_path), str(tmp_path),
+                                           **kwargs)
+            assert [r.patient_id for r in reports] == ["200000", "200001"]
+            err = reports[0].error
+            assert err is not None and err.startswith("ValueError:"), err
+            # The tail must point INTO the failing callee, not only
+            # repeat the message.
+            assert "read_edf" in err or "edf.py" in err, err
+            assert reports[1].error is None and ws is not None
 
 
 def test_reference_csv_roundtrip(tmp_path, rng):

@@ -1,0 +1,800 @@
+"""Out-of-core data plane (ISSUE 9): the sharded memmap window store.
+
+Covers the store round-trip (hypothesis sweep over odd shard sizes),
+write atomicity + resume-after-kill (no torn shard ever visible),
+bounded-host-memory scale proofs (ingest at O(one recording); a
+streamed epoch at O(batch) independent of dataset rows),
+store-vs-npz bit-parity through the actual consumers (MCD + DE,
+streamed and in-HBM, plus the streamed trainer), the out-of-core
+prepare against the in-core reference, the registry's names=/mmap=
+selectors + migrate, and the data_load/ingest_progress telemetry with
+its compare gating.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.config import PrepareConfig
+from apnea_uq_tpu.data.registry import ArtifactRegistry, migrate_to_store
+from apnea_uq_tpu.data.store import (
+    ArrayStore,
+    ShardedArray,
+    StoreWriter,
+    as_host_source,
+    write_store,
+)
+
+
+def _windows(rng, n, steps=12, feats=4):
+    return rng.normal(size=(n, steps, feats)).astype(np.float32)
+
+
+# --------------------------------------------------------------- round-trip
+
+class TestStoreRoundTrip:
+    def test_multi_field_roundtrip_and_manifest(self, tmp_path, rng):
+        x = _windows(rng, 103)
+        y = rng.integers(0, 2, 103).astype(np.int8)
+        ids = np.asarray([f"2{i % 7:05d}" for i in range(103)], dtype="U32")
+        store = write_store(
+            str(tmp_path / "w.store"), {"x": x, "y": y, "patient_ids": ids},
+            rows_per_shard=17, patient_id_field="patient_ids",
+        )
+        assert store.num_shards == 7 and store.rows == 103
+        assert store.manifest["complete"] is True
+        # mmap read equality vs the in-core arrays, all fields.
+        np.testing.assert_array_equal(np.asarray(store.read("x")), x)
+        np.testing.assert_array_equal(np.asarray(store.read("y")), y)
+        np.testing.assert_array_equal(
+            np.asarray(store.read("patient_ids")), ids)
+        # Per-shard patient ranges recorded.
+        assert all(r is not None for r in store.patient_ranges())
+        store.verify()
+
+    def test_lazy_indexing_matches_numpy(self, tmp_path, rng):
+        x = _windows(rng, 90)
+        store = write_store(str(tmp_path / "w.store"), {"x": x},
+                            rows_per_shard=13)
+        a = store.read("x")
+        assert isinstance(a, ShardedArray)
+        assert a.shape == x.shape and a.dtype == x.dtype and len(a) == 90
+        rows = np.asarray([0, 89, 13, 13, 52, 26])
+        np.testing.assert_array_equal(a[rows], x[rows])
+        # 2-D index (the lockstep ensemble's per-member batch stacks).
+        idx2 = rng.integers(0, 90, size=(3, 8))
+        np.testing.assert_array_equal(a[idx2], x[idx2])
+        # Unit-step slices stay lazy views; nested slicing composes.
+        v = a[10:60]
+        assert isinstance(v, ShardedArray) and v.shape == (50, 12, 4)
+        np.testing.assert_array_equal(np.asarray(v), x[10:60])
+        np.testing.assert_array_equal(v[5:9][1], x[10:60][5:9][1])
+        np.testing.assert_array_equal(a[::7], x[::7])  # stepped -> gather
+        np.testing.assert_array_equal(a[x[:, 0, 0] > 0],
+                                      x[x[:, 0, 0] > 0])
+        with pytest.raises(IndexError):
+            a[np.asarray([90])]
+        np.testing.assert_array_equal(a[-1], x[-1])
+
+    def test_hypothesis_roundtrip_odd_shard_sizes(self, tmp_path, rng):
+        hyp = pytest.importorskip("hypothesis")
+        st = pytest.importorskip("hypothesis.strategies")
+
+        @hyp.settings(max_examples=25, deadline=None)
+        @hyp.given(
+            n=st.integers(min_value=1, max_value=160),
+            rows_per_shard=st.integers(min_value=1, max_value=37),
+            seed=st.integers(min_value=0, max_value=2**31 - 1),
+        )
+        def check(n, rows_per_shard, seed):
+            r = np.random.default_rng(seed)
+            x = _windows(r, n, steps=5, feats=3)
+            y = r.integers(-4, 9, n).astype(np.int32)
+            d = str(tmp_path / f"h-{n}-{rows_per_shard}-{seed}.store")
+            store = write_store(d, {"x": x, "y": y},
+                                rows_per_shard=rows_per_shard)
+            xa, ya = store.read("x"), store.read("y")
+            np.testing.assert_array_equal(np.asarray(xa), x)
+            np.testing.assert_array_equal(np.asarray(ya), y)
+            rows = r.integers(0, n, size=min(n, 23))
+            np.testing.assert_array_equal(xa[rows], x[rows])
+            lo, hi = sorted(r.integers(0, n + 1, size=2))
+            np.testing.assert_array_equal(np.asarray(xa[lo:hi]), x[lo:hi])
+            store.verify()
+
+        check()
+
+    def test_schema_enforced_across_shards(self, tmp_path, rng):
+        w = StoreWriter(str(tmp_path / "s.store"))
+        w.append_shard({"x": _windows(rng, 4)})
+        with pytest.raises(ValueError, match="schema"):
+            w.append_shard({"x": _windows(rng, 4, steps=9)})
+        with pytest.raises(ValueError, match="disagree"):
+            w.append_shard({"x": _windows(rng, 4),
+                            "y": np.zeros(3, np.int8)})
+        with pytest.raises(ValueError, match="zero-row"):
+            w.append_shard({"x": _windows(rng, 0)})
+
+
+# ------------------------------------------------- atomicity / kill-resume
+
+class TestWriterResume:
+    def test_uncommitted_files_are_swept_on_reopen(self, tmp_path, rng):
+        d = str(tmp_path / "k.store")
+        w = StoreWriter(d)
+        w.append_shard({"x": _windows(rng, 10)})
+        committed = set(os.listdir(d))
+        # Simulate a kill mid-shard: field files on disk, manifest never
+        # updated (the commit point was not reached) — including a
+        # half-renamed pair.
+        np.save(os.path.join(d, "shard-00001.x.npy"), _windows(rng, 4))
+        np.lib.format.open_memmap(
+            os.path.join(d, ".tmp-shard-00002.x.npy"), mode="w+",
+            dtype=np.float32, shape=(4, 12, 4),
+        ).flush()
+        w2 = StoreWriter(d)  # resume
+        assert set(os.listdir(d)) == committed  # torn shard files swept
+        assert w2.num_shards == 1
+        # Appending continues at the next index; the store reads clean.
+        x2 = _windows(rng, 6)
+        w2.append_shard({"x": x2})
+        store = w2.finalize()
+        assert store.num_shards == 2 and store.rows == 16
+        np.testing.assert_array_equal(np.asarray(store.read("x"))[10:], x2)
+        store.verify()
+
+    def test_resume_false_wipes_previous_shards(self, tmp_path, rng):
+        d = str(tmp_path / "f.store")
+        StoreWriter(d).append_shard({"x": _windows(rng, 8)})
+        w = StoreWriter(d, resume=False)
+        assert w.num_shards == 0
+        assert not [f for f in os.listdir(d) if f.endswith(".npy")]
+
+    def test_verify_detects_corruption(self, tmp_path, rng):
+        d = str(tmp_path / "c.store")
+        store = write_store(d, {"x": _windows(rng, 8)}, rows_per_shard=8)
+        fname = store.manifest["shards"][0]["files"]["x"]
+        a = np.load(os.path.join(d, fname), mmap_mode="r+")
+        a[0, 0, 0] += 1.0
+        a.flush()
+        with pytest.raises(ValueError, match="hash mismatch"):
+            ArrayStore.open(d).verify()
+
+
+# ------------------------------------------------------ store-backed ingest
+
+class TestIngestToStore:
+    def _synth_dir(self, tmp_path, rng, patients):
+        from test_data_ingest import synth_recording
+
+        for p in patients:
+            synth_recording(tmp_path, rng, patient=p)
+        return str(tmp_path)
+
+    def test_matches_in_memory_ingest_and_resumes(self, tmp_path, rng):
+        from apnea_uq_tpu.data.ingest import (
+            ingest_directory,
+            ingest_directory_to_store,
+            read_ingest_progress,
+        )
+
+        d = self._synth_dir(tmp_path, rng, ("200001", "200002", "200003"))
+        ws, _ = ingest_directory(d, d)
+        sd = str(tmp_path / "w.store")
+
+        # "Kill" after two recordings: a partial run via num_files=2.
+        store, reports = ingest_directory_to_store(d, d, sd, num_files=2)
+        assert store.num_shards == 2
+        assert len(read_ingest_progress(sd)) == 2
+
+        # The rerun skips the completed two and ingests only the third.
+        store, reports = ingest_directory_to_store(d, d, sd)
+        assert [r.patient_id for r in reports] == ["200001", "200002",
+                                                   "200003"]
+        assert store.num_shards == 3 and store.rows == len(ws)
+        np.testing.assert_array_equal(np.asarray(store.read("x")), ws.x)
+        np.testing.assert_array_equal(np.asarray(store.read("y")), ws.y)
+        np.testing.assert_array_equal(
+            np.asarray(store.read("patient_ids")).astype(str),
+            ws.patient_ids)
+        assert store.meta["channels"] == list(ws.channels)
+        store.verify()  # no torn shard anywhere
+
+    def test_kill_between_shard_and_progress_commit_self_heals(
+            self, tmp_path, rng):
+        from apnea_uq_tpu.data.ingest import (
+            _write_ingest_progress,
+            ingest_directory_to_store,
+            read_ingest_progress,
+        )
+
+        d = self._synth_dir(tmp_path, rng, ("200001", "200002"))
+        sd = str(tmp_path / "w.store")
+        ingest_directory_to_store(d, d, sd, num_files=1)
+        # Simulate the one-event gap: shard 0 committed, progress lost.
+        _write_ingest_progress(sd, {})
+        store, reports = ingest_directory_to_store(d, d, sd)
+        # The orphaned shard was adopted, not duplicated.
+        assert store.num_shards == 2
+        assert len({r[0] for r in store.patient_ranges()}) == 2
+        assert read_ingest_progress(sd)["200001"]["shard"] == 0
+
+    def test_stale_progress_without_shard_reingests(self, tmp_path, rng):
+        """Progress records whose shard is gone (e.g. a --fresh run
+        killed mid-reset) must NOT be trusted: the recording re-ingests
+        instead of being silently skipped with its data missing."""
+        from apnea_uq_tpu.data.ingest import (
+            _write_ingest_progress,
+            ingest_directory_to_store,
+            read_ingest_progress,
+        )
+
+        d = self._synth_dir(tmp_path, rng, ("200001", "200002"))
+        sd = str(tmp_path / "w.store")
+        store, _ = ingest_directory_to_store(d, d, sd)
+        n_rows = store.rows
+        # Corrupt: claim a completed recording whose shard doesn't exist
+        # (and drop the real records), as a kill in the --fresh gap would.
+        _write_ingest_progress(sd, {"200001": {
+            "n_windows": 5, "excluded": None, "error": None, "shard": 7,
+        }})
+        store2, reports = ingest_directory_to_store(d, d, sd)
+        # Both recordings present (adopted from the intact shards), the
+        # phantom shard-7 record dropped, and no data lost.
+        assert store2.rows == n_rows and store2.num_shards == 2
+        prog = read_ingest_progress(sd)
+        assert prog["200001"]["shard"] in (0, 1)
+        assert all(r.n_windows > 0 for r in reports)
+
+    def test_ingest_progress_events(self, tmp_path, rng):
+        from apnea_uq_tpu.data.ingest import ingest_directory_to_store
+        from apnea_uq_tpu.telemetry import read_events, start_run
+
+        d = self._synth_dir(tmp_path, rng, ("200001", "200002"))
+        run_dir = str(tmp_path / "run")
+        with start_run(run_dir, stage="ingest"):
+            ingest_directory_to_store(d, d, str(tmp_path / "w.store"))
+        events = [e for e in read_events(run_dir)
+                  if e["kind"] == "ingest_progress"]
+        assert len(events) == 2
+        last = events[-1]
+        assert last["done"] == 2 and last["total"] == 2
+        assert last["rows"] > 0 and last["rows_per_s"] > 0
+        assert last["bytes_written"] > 0
+        assert last["skipped"] == 0
+
+
+# --------------------------------------------- bounded-memory scale proofs
+#
+# The O() claims are about HOST allocations (the thing that OOMs a box at
+# SHHS2 scale).  tracemalloc tracks numpy's anonymous allocations exactly
+# and excludes memmap FILE pages — which is the right instrument: mapped
+# pages are reclaimable page cache the kernel bounds under pressure, and
+# counting them (as ru_maxrss does) would flag a perfectly lazy reader.
+
+
+def _traced_peak(fn) -> int:
+    """Peak tracemalloc-tracked bytes allocated while fn runs."""
+    import gc
+    import tracemalloc
+
+    gc.collect()
+    tracemalloc.start()
+    try:
+        tracemalloc.reset_peak()
+        fn()
+        _current, peak = tracemalloc.get_traced_memory()
+    finally:
+        tracemalloc.stop()
+    return peak
+
+
+def test_ingest_memory_bounded_by_one_recording(tmp_path, rng):
+    """Scale proof (acceptance): store ingest of N recordings — window
+    payload made large via overlapping windows — peaks at O(one
+    recording), never O(dataset).  The in-memory concat path holds every
+    window and would blow the bound immediately."""
+    from test_data_ingest import synth_recording
+
+    from apnea_uq_tpu.config import IngestConfig
+    from apnea_uq_tpu.data.ingest import ingest_directory_to_store
+
+    n_rec, n_seconds = 10, 7200  # 2 h per recording
+    for i in range(n_rec):
+        synth_recording(tmp_path, rng, patient=f"20{i:04d}",
+                        n_seconds=n_seconds)
+    # stride 5 s -> 12x overlapping windows: per-recording window payload
+    # ~ (n_seconds/5) x 60 x 4 f32.
+    cfg = IngestConfig(overlap_s=55)
+    one_rec = (n_seconds // 5) * 60 * 4 * 4
+
+    result = {}
+
+    def run():
+        result["store"], result["reports"] = ingest_directory_to_store(
+            str(tmp_path), str(tmp_path), str(tmp_path / "w.store"), cfg)
+
+    peak = _traced_peak(run)
+    store = result["store"]
+    assert not [r.error for r in result["reports"] if r.error]
+    assert store.num_shards == n_rec
+    # The dataset is many recordings; peak host allocation must track ONE
+    # (decode transients + the shard in flight), with allocator slack.
+    assert store.nbytes > 6 * one_rec
+    bound = 8 * one_rec + 8 * 2**20
+    assert peak < bound, (
+        f"ingest peak host alloc {peak / 2**20:.1f} MiB (bound "
+        f"{bound / 2**20:.1f} MiB, dataset {store.nbytes / 2**20:.1f} MiB)"
+        f" — O(one recording) lost"
+    )
+
+
+def test_streamed_epoch_memory_independent_of_dataset_rows(tmp_path):
+    """Scale proof (acceptance): a streamed training epoch over a
+    memmap-backed store allocates O(prefetch x batch) host memory
+    INDEPENDENT of dataset rows — 5x the rows must not move the peak.
+    A whole-set np.asarray materialization in the streaming path fails
+    both assertions immediately."""
+    import jax
+
+    from apnea_uq_tpu.config import ModelConfig, TrainConfig
+    from apnea_uq_tpu.data.store import StoreWriter
+    from apnea_uq_tpu.models import AlarconCNN1D
+    from apnea_uq_tpu.training import create_train_state
+    from apnea_uq_tpu.training.trainer import fit
+
+    def build(n, name):
+        w = StoreWriter(str(tmp_path / name))
+        r = np.random.default_rng(0)
+        shard = 6000
+        for lo in range(0, n, shard):
+            hi = min(lo + shard, n)
+            w.append_shard({
+                "x": r.normal(size=(hi - lo, 60, 4)).astype(np.float32),
+                "y": (r.random(hi - lo) < 0.4).astype(np.float32),
+            })
+        return w.finalize()
+
+    model = AlarconCNN1D(ModelConfig(
+        features=(8, 12, 8), kernel_sizes=(5, 3, 3),
+        dropout_rates=(0.3, 0.4, 0.5)))
+    state = create_train_state(model, jax.random.key(0))
+    cfg = TrainConfig(batch_size=2048, num_epochs=1,
+                      validation_split=0.1, seed=1)
+
+    def epoch_peak(store):
+        x, y = store.read("x"), np.asarray(store.read("y"))
+        return _traced_peak(
+            lambda: fit(model, state, x, y, cfg, streaming=True))
+
+    small = build(12_000, "small.store")
+    big = build(60_000, "big.store")
+    # Warm the jit caches so neither measured run pays tracing overhead.
+    epoch_peak(small)
+    peak_small = epoch_peak(small)
+    peak_big = epoch_peak(big)
+
+    window_bytes = 60 * 4 * 4
+    assert big.nbytes > 50 * 2**20
+    assert peak_big < big.nbytes // 2, (
+        f"streamed epoch allocated {peak_big / 2**20:.1f} MiB over a "
+        f"{big.nbytes / 2**20:.1f} MiB memmap dataset — it materialized"
+    )
+    # Rows x5 -> near-flat peak.  The CPU backend retains a few hundred
+    # bytes/row of batch buffers across async-dispatched steps (jax CPU
+    # arrays alias the numpy batches zero-copy, and nothing blocks per
+    # step), so the slope is bounded at HALF a window row — a whole-set
+    # materialization costs the full 960 B/row and fails immediately.
+    slope = (peak_big - peak_small) / (len(big.read("y")) -
+                                       len(small.read("y")))
+    assert slope < window_bytes / 2, (
+        f"peak scaled with rows at {slope:.0f} B/row "
+        f"({peak_small / 2**20:.1f} MiB @12K -> "
+        f"{peak_big / 2**20:.1f} MiB @60K) — the dataset is materializing"
+    )
+
+
+# --------------------------------------------- store-vs-npz consumer parity
+
+@pytest.fixture(scope="module")
+def prepared_two_ways(tmp_path_factory):
+    """The same prepared bundle saved as .npz and as a sharded store."""
+    from apnea_uq_tpu.data.ingest import WindowSet
+    from apnea_uq_tpu.data.prepare import prepare_datasets, save_prepared
+
+    rng = np.random.default_rng(11)
+    n = 420
+    ws = WindowSet(
+        x=rng.normal(size=(n, 60, 4)).astype(np.float32),
+        y=(rng.random(n) < 0.3).astype(np.int8),
+        patient_ids=np.asarray([f"2{i % 11:04d}" for i in range(n)]),
+        start_time_s=np.zeros(n, np.int32),
+        channels=("SaO2", "PR", "THOR RES", "ABDO RES"),
+    )
+    cfg = PrepareConfig(smote_k_neighbors=3)
+    prepared = prepare_datasets(ws, cfg)
+    root = tmp_path_factory.mktemp("two_ways")
+    r_npz = ArtifactRegistry(str(root / "npz"))
+    save_prepared(prepared, r_npz, cfg)
+    r_store = ArtifactRegistry(str(root / "store"))
+    save_prepared(prepared, r_store, cfg, store=True, rows_per_shard=97)
+    return r_npz, r_store
+
+
+class TestStoreBackedParity:
+    """Acceptance: store-backed train/eval bit-identical to the .npz
+    path on CPU — MCD + DE, streamed and in-HBM."""
+
+    def _load_both(self, prepared_two_ways):
+        from apnea_uq_tpu.data.prepare import load_prepared
+
+        r_npz, r_store = prepared_two_ways
+        a = load_prepared(r_npz)
+        b = load_prepared(r_store, mmap=True)
+        assert isinstance(b.x_test, ShardedArray)  # really the lazy path
+        return a, b
+
+    def test_loaded_bundles_bit_identical(self, prepared_two_ways):
+        a, b = self._load_both(prepared_two_ways)
+        for name in ("x_train", "y_train", "x_test", "y_test",
+                     "x_test_rus", "y_test_rus"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+                err_msg=name)
+        np.testing.assert_array_equal(a.patient_ids_test,
+                                      b.patient_ids_test)
+
+    def test_mcd_eval_parity_streamed_and_in_hbm(self, prepared_two_ways,
+                                                 tiny_model):
+        import jax
+
+        from apnea_uq_tpu.models import init_variables
+        from apnea_uq_tpu.uq.predict import (
+            mc_dropout_predict,
+            mc_dropout_predict_streaming,
+        )
+        from apnea_uq_tpu.utils import prng
+
+        a, b = self._load_both(prepared_two_ways)
+        variables = init_variables(tiny_model, jax.random.key(0))
+        key = prng.stochastic_key(5)
+        kw = dict(n_passes=4, batch_size=64, key=key)
+        p_npz = np.asarray(mc_dropout_predict(
+            tiny_model, variables, a.x_test, **kw))
+        p_store = np.asarray(mc_dropout_predict(
+            tiny_model, variables, b.x_test, **kw))
+        np.testing.assert_array_equal(p_npz, p_store)
+        s_npz = mc_dropout_predict_streaming(
+            tiny_model, variables, a.x_test, **kw)
+        s_store = mc_dropout_predict_streaming(
+            tiny_model, variables, b.x_test, **kw)
+        np.testing.assert_array_equal(s_npz, s_store)
+        np.testing.assert_array_equal(p_npz, s_store)
+
+    def test_de_eval_parity_streamed_and_in_hbm(self, prepared_two_ways,
+                                                tiny_model):
+        import jax
+
+        from apnea_uq_tpu.models import init_variables
+        from apnea_uq_tpu.uq.predict import (
+            ensemble_predict,
+            ensemble_predict_streaming,
+            stack_member_variables,
+        )
+
+        a, b = self._load_both(prepared_two_ways)
+        members = stack_member_variables([
+            init_variables(tiny_model, jax.random.key(s)) for s in range(3)
+        ])
+        p_npz = np.asarray(ensemble_predict(
+            tiny_model, members, a.x_test, batch_size=64))
+        p_store = np.asarray(ensemble_predict(
+            tiny_model, members, b.x_test, batch_size=64))
+        np.testing.assert_array_equal(p_npz, p_store)
+        s_npz = ensemble_predict_streaming(
+            tiny_model, members, a.x_test, batch_size=64)
+        s_store = ensemble_predict_streaming(
+            tiny_model, members, b.x_test, batch_size=64)
+        np.testing.assert_array_equal(s_npz, s_store)
+        np.testing.assert_array_equal(p_npz, s_store)
+
+    def test_streamed_train_parity(self, prepared_two_ways, tiny_model):
+        import jax
+
+        from apnea_uq_tpu.config import TrainConfig
+        from apnea_uq_tpu.training import create_train_state
+        from apnea_uq_tpu.training.trainer import fit
+
+        a, b = self._load_both(prepared_two_ways)
+        cfg = TrainConfig(batch_size=64, num_epochs=2,
+                          validation_split=0.1, seed=1)
+        state = create_train_state(tiny_model, jax.random.key(1))
+        r_npz = fit(tiny_model, state, a.x_train, a.y_train, cfg,
+                    streaming=True)
+        r_store = fit(tiny_model, state, b.x_train, b.y_train, cfg,
+                      streaming=True)
+        assert r_npz.history == r_store.history
+
+
+# --------------------------------------------------- out-of-core prepare
+
+class TestPrepareFromStore:
+    def _window_set(self, rng, n=400, with_nans=False):
+        from apnea_uq_tpu.data.ingest import WindowSet
+
+        x = rng.normal(size=(n, 12, 4)).astype(np.float32)
+        if with_nans:
+            x[5, 3, 1] = np.nan
+            x[n // 2, 0, 0] = np.nan
+        y = (rng.random(n) < 0.3).astype(np.int8)
+        ids = np.asarray([f"2{i % 13:04d}" for i in range(n)])
+        return WindowSet(x=x, y=y, patient_ids=ids,
+                         start_time_s=np.zeros(n, np.int32),
+                         channels=("a", "b", "c", "d"))
+
+    def _both(self, tmp_path, ws, cfg):
+        from apnea_uq_tpu.data.prepare import (
+            load_prepared,
+            prepare_datasets,
+            prepare_from_store,
+            save_prepared,
+        )
+
+        r_in = ArtifactRegistry(str(tmp_path / "incore"))
+        save_prepared(prepare_datasets(ws, cfg), r_in, cfg)
+        r_ooc = ArtifactRegistry(str(tmp_path / "ooc"))
+        store = write_store(
+            str(tmp_path / "w.store"),
+            {"x": ws.x, "y": ws.y,
+             "patient_ids": ws.patient_ids.astype("U32")},
+            rows_per_shard=37, patient_id_field="patient_ids",
+        )
+        prepare_from_store(store, r_ooc, cfg, block_rows=50)
+        return load_prepared(r_in), load_prepared(r_ooc, mmap=True)
+
+    def test_bit_identical_without_nans(self, tmp_path, rng):
+        ws = self._window_set(rng)
+        a, b = self._both(tmp_path, ws, PrepareConfig(smote_k_neighbors=3))
+        for name in ("x_train", "y_train", "x_test", "y_test",
+                     "x_test_rus", "y_test_rus"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+                err_msg=name)
+        np.testing.assert_array_equal(a.patient_ids_test,
+                                      b.patient_ids_test)
+
+    def test_nan_imputation_matches_to_f32_roundoff(self, tmp_path, rng):
+        """Streaming NaN means accumulate in float64 vs in-core's f32
+        pairwise nanmean — the one documented divergence, bounded at
+        float32 roundoff."""
+        ws = self._window_set(rng, with_nans=True)
+        a, b = self._both(tmp_path, ws, PrepareConfig(smote_k_neighbors=3))
+        for name in ("x_train", "x_test"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+                rtol=2e-6, atol=2e-6, err_msg=name)
+        np.testing.assert_array_equal(a.y_train, np.asarray(b.y_train))
+
+    def test_smote_fallback_single_class(self, tmp_path, rng):
+        """All-one-class labels: in-core falls back to the unbalanced
+        set and skips RUS; out-of-core must do the same, not crash."""
+        from apnea_uq_tpu.data.prepare import load_prepared, prepare_from_store
+
+        ws = self._window_set(rng, n=120)
+        ws = type(ws)(x=ws.x, y=np.zeros(120, np.int8),
+                      patient_ids=ws.patient_ids,
+                      start_time_s=ws.start_time_s, channels=ws.channels)
+        r = ArtifactRegistry(str(tmp_path / "ooc"))
+        store = write_store(
+            str(tmp_path / "w.store"),
+            {"x": ws.x, "y": ws.y,
+             "patient_ids": ws.patient_ids.astype("U32")},
+            rows_per_shard=50,
+        )
+        prepare_from_store(store, r, PrepareConfig(), block_rows=64)
+        p = load_prepared(r, mmap=True)
+        assert len(p.y_train) + len(p.y_test) == 120  # unbalanced, no RUS
+        assert p.x_test_rus is None
+
+
+# --------------------------------------------------- registry round-trips
+
+class TestRegistrySelectors:
+    def test_names_subset_and_unknown(self, tmp_path, rng):
+        r = ArtifactRegistry(str(tmp_path / "reg"))
+        r.save_arrays("windows", {"x": _windows(rng, 5),
+                                  "y": np.zeros(5, np.int8)})
+        assert sorted(r.load_arrays("windows", names=("y",))) == ["y"]
+        with pytest.raises(KeyError, match="nope"):
+            r.load_arrays("windows", names=("nope",))
+        r.save_array_store("w2", {"x": _windows(rng, 5)})
+        assert sorted(r.load_arrays("w2", names=("x",))) == ["x"]
+        with pytest.raises(KeyError, match="nope"):
+            r.load_arrays("w2", names=("nope",))
+
+    def test_migrate_real_windows_bundle_keeps_channels(self, tmp_path,
+                                                        rng):
+        """The primary artifact `apnea-uq migrate` meets is
+        WindowSet.to_arrays(): row-aligned fields PLUS the
+        (n_channels,)-length 'channels' array.  Non-row arrays ride the
+        store manifest as extras, so migration is lossless and a
+        WindowSet round-trips."""
+        from apnea_uq_tpu.data.ingest import WindowSet, windows_from_store
+
+        n = 30
+        ws = WindowSet(
+            x=_windows(rng, n, steps=60), y=np.zeros(n, np.int8),
+            patient_ids=np.asarray([f"p{i % 3}" for i in range(n)]),
+            start_time_s=np.arange(n, dtype=np.int32) * 60,
+            channels=("SaO2", "PR", "THOR RES", "ABDO RES"),
+        )
+        r = ArtifactRegistry(str(tmp_path / "reg"))
+        r.save_arrays("windows", ws.to_arrays())
+        migrate_to_store(r, "windows", rows_per_shard=8)
+        back = WindowSet.from_arrays(r.load_arrays("windows"))
+        assert back.channels == ws.channels
+        np.testing.assert_array_equal(back.x, ws.x)
+        np.testing.assert_array_equal(back.start_time_s, ws.start_time_s)
+        assert list(back.patient_ids) == list(ws.patient_ids)
+        # And the store-native constructor agrees.
+        ws2 = windows_from_store(r.open_array_store("windows"))
+        assert ws2.channels == ws.channels
+        np.testing.assert_array_equal(np.asarray(ws2.x), ws.x)
+
+    def test_migrate_in_place(self, tmp_path, rng):
+        r = ArtifactRegistry(str(tmp_path / "reg"))
+        x = _windows(rng, 50)
+        ids = np.asarray([f"p{i % 3}" for i in range(50)], dtype="U8")
+        r.save_arrays("windows", {"x": x, "patient_ids": ids})
+        migrate_to_store(r, "windows", rows_per_shard=16)
+        entry = r.describe("windows")
+        assert entry["kind"] == "array_store"
+        assert entry["rows"] == 50 and entry["shards"] == 4
+        out = r.load_arrays("windows", mmap=True)
+        assert isinstance(out["x"], ShardedArray)
+        np.testing.assert_array_equal(np.asarray(out["x"]), x)
+        # Idempotent; and non-array kinds refuse.
+        migrate_to_store(r, "windows")
+        r.save_json("doc", {"a": 1})
+        with pytest.raises(ValueError, match="kind"):
+            migrate_to_store(r, "doc")
+
+    def test_mmap_false_materializes(self, tmp_path, rng):
+        r = ArtifactRegistry(str(tmp_path / "reg"))
+        x = _windows(rng, 9)
+        r.save_array_store("w", {"x": x}, rows_per_shard=4)
+        out = r.load_arrays("w")
+        assert isinstance(out["x"], np.ndarray)
+        np.testing.assert_array_equal(out["x"], x)
+
+    def test_as_host_source_zero_copy(self, tmp_path, rng):
+        x = _windows(rng, 20)
+        store = write_store(str(tmp_path / "w.store"), {"x": x},
+                            rows_per_shard=7)
+        lazy = store.read("x")
+        assert as_host_source(lazy) is lazy
+        plain = np.zeros((4, 3), np.float32)
+        assert as_host_source(plain) is plain  # or a free view
+        casted = as_host_source(np.zeros((4, 3), np.float64))
+        assert casted.dtype == np.float32
+
+
+# ----------------------------------------------------------- CLI plumbing
+
+class TestStoreCLI:
+    def test_ingest_store_prepare_store_and_migrate(self, tmp_path, rng,
+                                                    capsys):
+        """`apnea-uq ingest --store` -> `prepare --store` -> the prepared
+        artifacts are sharded stores; `migrate` upgrades a .npz key in
+        place — the README quickstart, end to end through the real CLI."""
+        from test_data_ingest import synth_recording
+
+        from apnea_uq_tpu.cli.main import main
+        from apnea_uq_tpu.data import registry as reg
+        from apnea_uq_tpu.data.prepare import load_prepared
+
+        for p in ("200001", "200002", "200003", "200004"):
+            synth_recording(tmp_path, rng, patient=p, n_seconds=720)
+        registry_dir = str(tmp_path / "registry")
+        run_dir = str(tmp_path / "run")
+        assert main(["ingest", "--edf-dir", str(tmp_path), "--xml-dir",
+                     str(tmp_path), "--registry", registry_dir, "--store",
+                     "--workers", "2", "--run-dir", run_dir]) == 0
+        registry = ArtifactRegistry(registry_dir)
+        assert registry.describe(reg.WINDOWS)["kind"] == "array_store"
+        # Rerun resumes: every recording skipped, artifact unchanged.
+        rows = registry.describe(reg.WINDOWS)["rows"]
+        assert main(["ingest", "--edf-dir", str(tmp_path), "--xml-dir",
+                     str(tmp_path), "--registry", registry_dir, "--store",
+                     "--run-dir", run_dir]) == 0
+        assert registry.describe(reg.WINDOWS)["rows"] == rows
+
+        # Plain prepare (no --store) over the store-kind windows must
+        # work too — channels come from the store manifest, not a field.
+        assert main(["prepare", "--registry", registry_dir,
+                     "--run-dir", str(tmp_path / "prep_run_incore")]) == 0
+        assert registry.describe(reg.TEST_STD_UNBALANCED)["kind"] == "arrays"
+
+        assert main(["prepare", "--registry", registry_dir, "--store",
+                     "--run-dir", str(tmp_path / "prep_run")]) == 0
+        for key in (reg.TRAIN_STD_SMOTE, reg.TEST_STD_UNBALANCED):
+            assert registry.describe(key)["kind"] == "array_store", key
+        prepared = load_prepared(registry, mmap=True)
+        assert isinstance(prepared.x_test, ShardedArray)
+        assert len(prepared.y_test) > 0
+
+        # migrate: a fresh registry seeded with .npz windows upgrades.
+        npz_dir = str(tmp_path / "npz_registry")
+        r2 = ArtifactRegistry(npz_dir)
+        r2.save_arrays("windows", {"x": _windows(rng, 6)})
+        assert main(["migrate", "--registry", npz_dir]) == 0
+        assert r2.describe("windows")["kind"] == "array_store"
+        capsys.readouterr()
+
+        # The ingest run log carries the progress + data-plane events.
+        from apnea_uq_tpu.telemetry import read_events
+
+        kinds = {e["kind"] for e in read_events(run_dir)}
+        assert "ingest_progress" in kinds
+
+
+# ------------------------------------------------------ telemetry + gating
+
+class TestDataPlaneTelemetry:
+    def _run_with_load(self, run_dir, registry, key, *, mmap, slow=0.0):
+        import time as time_mod
+
+        from apnea_uq_tpu.telemetry import start_run
+
+        with start_run(str(run_dir), stage="test"):
+            if slow:
+                time_mod.sleep(slow)
+            registry.load_arrays(key, mmap=mmap)
+
+    def test_data_load_event_fields_and_summarize(self, tmp_path, rng):
+        from apnea_uq_tpu.telemetry import read_events, summarize_run
+        from apnea_uq_tpu.telemetry.summarize import summarize_data
+
+        r = ArtifactRegistry(str(tmp_path / "reg"))
+        x = _windows(rng, 30)
+        r.save_array_store("w", {"x": x}, rows_per_shard=10)
+        run_dir = tmp_path / "run"
+        self._run_with_load(run_dir, r, "w", mmap=True)
+        (ev,) = [e for e in read_events(str(run_dir))
+                 if e["kind"] == "data_load"]
+        assert ev["key"] == "w" and ev["artifact_kind"] == "array_store"
+        assert ev["mmap"] is True and ev["rows"] == 30
+        assert ev["bytes"] == x.nbytes and ev["load_s"] >= 0
+        text = summarize_run(str(run_dir))
+        assert "data plane (artifact loads):" in text
+        assert "array_store (mmap)" in text
+        data = summarize_data(str(run_dir))
+        assert data["data_loads"][0]["key"] == "w"
+
+    def test_compare_gates_load_regression(self, tmp_path, rng):
+        from apnea_uq_tpu.telemetry import compare as compare_mod
+
+        r = ArtifactRegistry(str(tmp_path / "reg"))
+        r.save_arrays("w", {"x": _windows(rng, 30)})
+        base_dir, cand_dir = tmp_path / "base", tmp_path / "cand"
+        self._run_with_load(base_dir, r, "w", mmap=False)
+        self._run_with_load(cand_dir, r, "w", mmap=False)
+
+        base = compare_mod.load_metrics(str(base_dir))
+        cand = compare_mod.load_metrics(str(cand_dir))
+        assert "data.w.load_s" in base and "data.w.rss_bytes" in base
+        assert base["data.w.load_s"].higher_better is False
+        assert base["data.w.rss_bytes"].higher_better is False
+        # Inject a 10x load-time regression: it must gate.
+        cand["data.w.load_s"].value = base["data.w.load_s"].value * 10 + 1.0
+        deltas = compare_mod.compare_metrics(base, cand, threshold_pct=5.0)
+        regressed = {d.name for d in deltas if d.regressed}
+        assert "data.w.load_s" in regressed
+
+    def test_unit_direction_infers_new_units(self):
+        from apnea_uq_tpu.telemetry.compare import unit_direction
+
+        assert unit_direction("load_s") is False
+        assert unit_direction("rss_bytes") is False
+        assert unit_direction("windows/s") is True  # rates keep a slash
