@@ -76,16 +76,17 @@ def merge_rows(programs: Dict[str, Any],
 
 
 def write_manifest(path: str, rows: Dict[str, Dict[str, Any]]) -> None:
+    from apnea_uq_tpu.utils.io import atomic_write_json
+
     doc = {
         "version": MANIFEST_VERSION,
         "programs": {label: rows[label] for label in sorted(rows)},
     }
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=2, sort_keys=False)
-        f.write("\n")
-    os.replace(tmp, path)
+    # sort_keys=False keeps the version header first and the hand-read
+    # row layout; the shared writer supplies the fsync the old local
+    # tmp+rename skipped.
+    atomic_write_json(path, doc, sort_keys=False, trailing_newline=True)
 
 
 def save_manifest(path: str, programs: Dict[str, Any],

@@ -737,6 +737,7 @@ def cmd_sweep(args, config) -> int:
         return 0
 
     from apnea_uq_tpu.analysis.sweep import de_member_sweep, mcd_pass_sweep
+    from apnea_uq_tpu.data import registry as reg
     from apnea_uq_tpu.training import restore_state
     from apnea_uq_tpu.utils import prng
 
@@ -765,7 +766,10 @@ def cmd_sweep(args, config) -> int:
             member_counts=counts, config=config.uq,
             mesh=_mesh(config, num_members=max(counts)),
         )
-    key = f"sweep:{args.method}"
+    # Canonical key, not a literal: `apnea-uq flow` flags string-spelled
+    # keys as artifact-key-drift (this very line was the true positive).
+    key = f"{reg.SWEEP}:{args.method}"
+    # apnea-lint: disable=artifact-never-consumed -- end product: the convergence table is plotted here and read back by analysts, not by a later stage
     registry.save_table(key, frame)
     log(frame.to_string(index=False))
     if args.plot:
@@ -1165,6 +1169,15 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     from apnea_uq_tpu.lint import cli as lint_cli
 
     lint_cli.register(sub)
+
+    # `flow` is the lint's pipeline-dataflow sibling (apnea_uq_tpu/flow/):
+    # jax-free like lint, it extracts the registry producer->consumer
+    # graph, verifies the artifact contract against the checked-in
+    # flow/manifest.json, and enforces the tmp->fsync->os.replace
+    # write discipline.
+    from apnea_uq_tpu.flow import cli as flow_cli
+
+    flow_cli.register(sub)
 
     # `audit` is the lint's IR-level sibling (apnea_uq_tpu/audit/):
     # lowers the compile-cache zoo on CPU — no dispatch, no registry —

@@ -440,14 +440,15 @@ class ProgramStore:
                 return  # one writer on multi-process topologies
         except Exception:  # noqa: BLE001 - no backend: single process
             pass
+        from apnea_uq_tpu.utils.io import atomic_write_bytes
+
         os.makedirs(self.root, exist_ok=True)
         for path, data in ((self._blob_path(key), blob),
                            (self._meta_path(key),
                             json.dumps(meta, indent=2).encode())):
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
+            # tmp -> fsync -> replace (pid-suffixed tmp: multi-process
+            # meshes can race on a shared store root).
+            atomic_write_bytes(path, data)
 
     def _load_serialized(self, key: str):
         """(blob, meta) when both files exist and parse, else None."""

@@ -34,6 +34,17 @@ DETAILED_WINDOWS = "detailed_windows"          # L5 -> L6: per-window CSV
 METRICS = "metrics"                            # L5 side: aggregates/CIs/classification JSON
 PATIENT_SUMMARY = "patient_summary"            # L6 -> L7: per-patient CSV
 CHECKPOINT = "checkpoint"                      # L3 -> L5: model checkpoints (dir)
+SWEEP = "sweep"                                # L7 side: T/N convergence table
+
+#: Every canonical artifact key, in pipeline order.  The flow gate
+#: (`apnea-uq flow`, apnea_uq_tpu/flow/) keys its producer->consumer
+#: dataflow graph and the checked-in flow/manifest.json off this tuple,
+#: so a key added above without a row here fails statically.
+CANONICAL_KEYS = (
+    WINDOWS, TRAIN_STD_SMOTE, TEST_STD_UNBALANCED, TEST_STD_RUS,
+    RAW_PREDICTIONS, UQ_STATS, DETAILED_WINDOWS, METRICS,
+    PATIENT_SUMMARY, CHECKPOINT, SWEEP,
+)
 
 
 class ArtifactRegistry:
@@ -60,13 +71,18 @@ class ArtifactRegistry:
         with open(path) as f:
             return json.load(f)
 
+    def _save_manifest(self, manifest: Dict[str, Any]) -> None:
+        """The registry's commit point: every artifact becomes visible to
+        readers only through this write, so it routes through the shared
+        tmp -> fsync -> replace writer (utils/io.py) — the bare
+        tmp+rename it used before PR 10 left a power-loss window where
+        the rename could land before the data blocks."""
+        store_mod.atomic_write_json(self._manifest_path(), manifest)
+
     def _record(self, key: str, entry: Dict[str, Any]) -> None:
         manifest = self.manifest()
         manifest["artifacts"][key] = entry
-        tmp = self._manifest_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(manifest, f, indent=2, sort_keys=True)
-        os.replace(tmp, self._manifest_path())
+        self._save_manifest(manifest)
 
     def describe(self, key: str) -> Optional[Dict[str, Any]]:
         return self.manifest()["artifacts"].get(key)
@@ -101,7 +117,15 @@ class ArtifactRegistry:
         config: Any = None,
     ) -> str:
         path = self.path_for(key, ".npz")
-        np.savez(path, **arrays)
+        # Same-key re-saves reuse the path the manifest already points
+        # at, so the .npz must commit atomically: a reader of the prior
+        # entry must never map a half-written archive.
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         self._record(
             key,
             {
@@ -262,9 +286,17 @@ class ArtifactRegistry:
     # -- tables -----------------------------------------------------------
 
     def save_table(self, key: str, frame, *, config: Any = None) -> str:
-        """Save a pandas DataFrame as CSV."""
+        """Save a pandas DataFrame as CSV (atomic commit: a same-key
+        re-save overwrites in place, so readers of the previous entry
+        must never see a torn file)."""
         path = self.path_for(key, ".csv")
-        frame.to_csv(path, index=False)
+        tmp = path + ".tmp"
+        # newline=""/utf-8 match what to_csv(path) would open itself with.
+        with open(tmp, "w", newline="", encoding="utf-8") as f:
+            frame.to_csv(f, index=False)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         self._record(
             key,
             {
@@ -290,10 +322,7 @@ class ArtifactRegistry:
     def save_json(self, key: str, document: Dict[str, Any], *, config: Any = None) -> str:
         """Save a JSON-able dict (numpy values are converted)."""
         path = self.path_for(key, ".json")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(_to_jsonable(document), f, indent=2, sort_keys=True)
-        os.replace(tmp, path)
+        store_mod.atomic_write_json(path, _to_jsonable(document))
         self._record(
             key,
             {

@@ -59,13 +59,12 @@ def peak_rss_bytes() -> Optional[int]:
         return None
 
 
-def atomic_write_json(path: str, data: Dict[str, Any]) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(data, f, indent=2, sort_keys=True)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+# The commit protocol this module established now lives in the shared
+# jax-free home (utils/io.py) so every artifact writer — registry
+# manifest, run-dir JSON, program blobs — routes through ONE
+# implementation; re-exported here because the store's callers (ingest,
+# tests) import it from this module's namespace.
+from apnea_uq_tpu.utils.io import atomic_write_json  # noqa: F401  (re-export)
 
 
 def _content_hash(a: np.ndarray) -> str:

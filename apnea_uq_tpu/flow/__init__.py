@@ -1,0 +1,60 @@
+"""``apnea-uq flow`` — pipeline dataflow analysis (ISSUE 10).
+
+Third static-analysis family on the lint engine: extract every
+:class:`~apnea_uq_tpu.data.registry.ArtifactRegistry` read/write site
+into a producer -> consumer graph over pipeline stages
+(:mod:`apnea_uq_tpu.flow.extract`), verify the artifact contract and
+the filesystem crash-consistency discipline
+(:mod:`apnea_uq_tpu.flow.rules`), diff against the checked-in
+``flow/manifest.json`` (:mod:`apnea_uq_tpu.flow.manifest`), and render
+the generated ``docs/PIPELINE.md`` (:mod:`apnea_uq_tpu.flow.pipedoc`).
+Jax-free end to end.
+"""
+
+from apnea_uq_tpu.flow.extract import extract_graph, graph_rows
+from apnea_uq_tpu.flow.rules import FLOW_RULES, run_flow_rules
+
+__all__ = ["extract_graph", "graph_rows", "FLOW_RULES", "run_flow_rules",
+           "run_flow"]
+
+
+def run_flow(paths, *, rules=None, repo_root=None, manifest=None):
+    """Programmatic twin of the CLI: lint-engine file loading +
+    extraction + flow rules + suppression resolution, returning the
+    same :class:`~apnea_uq_tpu.lint.engine.LintResult` shape the
+    reporters render.  ``manifest`` is the loaded row dict (None skips
+    the graph-drift rule) or a callable ``graph -> rows`` resolved after
+    extraction — the ``--update-manifest`` path diffs against the
+    freshly merged rows without re-running the analysis.  Returns
+    ``(result, graph)``."""
+    from apnea_uq_tpu.flow.rules import FlowContext
+    from apnea_uq_tpu.lint.engine import (
+        LintContext, LintResult, apply_suppressions, default_repo_root,
+        load_files,
+    )
+
+    paths = list(paths)
+    if not paths:
+        raise ValueError("run_flow needs at least one path")
+    if repo_root is None:
+        repo_root = default_repo_root(paths)
+    files = load_files(paths, repo_root)
+    context = LintContext(files=files, repo_root=repo_root)
+    graph = extract_graph(context)
+    if callable(manifest):
+        manifest = manifest(graph)
+    fc = FlowContext(context=context, graph=graph, manifest=manifest)
+    selected = tuple(dict.fromkeys(rules)) if rules is not None \
+        else tuple(sorted(FLOW_RULES))
+    findings = run_flow_rules(fc, rules=selected)
+    by_path = {f.path: f for f in files}
+    findings = [
+        apply_suppressions(f, by_path[f.path]) if f.path in by_path else f
+        for f in findings
+    ]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    result = LintResult(
+        findings=findings, files_scanned=len(files), rules_run=selected,
+        scanned_paths=tuple(f.path for f in files),
+    )
+    return result, graph

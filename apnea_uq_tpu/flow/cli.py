@@ -1,0 +1,146 @@
+"""The ``apnea-uq flow`` subcommand.
+
+``apnea-uq flow [paths ...] [--json | --format gha] [--rule NAME ...]
+[--manifest PATH] [--update-manifest] [--update-docs [--docs PATH]]`` —
+exits 0 when every finding is suppressed-with-justification, 1 on
+unsuppressed findings, 2 on usage errors (including a missing manifest:
+run ``--update-manifest`` once to record the golden graph).  With no
+paths it analyzes the installed package plus the repo's ``bench.py`` —
+the exact scope the tier-1 gate (``tests/test_flow.py``) runs.
+
+Kept jax-free end to end, like ``apnea-uq lint``: the handler imports
+only the flow package, the lint engine, and the shared reporters.
+"""
+
+from __future__ import annotations
+
+from apnea_uq_tpu.telemetry import log
+
+
+def cmd_flow(args) -> int:
+    from apnea_uq_tpu.flow import graph_rows, run_flow
+    from apnea_uq_tpu.flow.manifest import (
+        load_manifest, merge_rows, write_manifest,
+    )
+    from apnea_uq_tpu.lint.cli import default_paths
+    from apnea_uq_tpu.lint.engine import default_repo_root
+    from apnea_uq_tpu.lint.report import emit_result, resolve_format
+    from apnea_uq_tpu.telemetry.logging_shim import narration_to_stderr
+
+    fmt = resolve_format(args)
+
+    def narrate(message: str) -> None:
+        # In --json mode stdout is one machine-readable document;
+        # manifest/docs progress lines go to stderr so `flow --json |
+        # jq .` parses without stripping (the audit CLI's contract).
+        if fmt == "json":
+            with narration_to_stderr():
+                log(message)
+        else:
+            log(message)
+
+    paths = args.paths or default_paths()
+    try:
+        manifest = load_manifest(args.manifest)
+    except ValueError as e:
+        log(f"apnea-uq flow: {e}")
+        raise SystemExit(2)
+
+    # First pass without the manifest diff: extraction + every other
+    # rule.  The drift rule needs the effective rows, which depend on
+    # --update-manifest (merged rows drive the diff NOW; the file is
+    # written only after the rules pass, so a failed update never
+    # mutates the golden manifest — the audit CLI's pattern).
+    try:
+        if args.update_manifest:
+            prior = manifest
+
+            def effective_rows(graph):
+                # Partial scope extracts a partial graph: keep the prior
+                # rows rather than blessing an incomplete extraction.
+                return (merge_rows(graph) if graph.full_scope
+                        else (prior or {}))
+
+            result, graph = run_flow(paths, rules=args.rule or None,
+                                     manifest=effective_rows)
+            rows = effective_rows(graph)
+        else:
+            if manifest is None:
+                log(f"apnea-uq flow: no manifest at {args.manifest!r} — "
+                    f"run `apnea-uq flow --update-manifest` once to "
+                    f"record the golden dataflow rows")
+                raise SystemExit(2)
+            result, graph = run_flow(paths, rules=args.rule or None,
+                                     manifest=manifest)
+    except (FileNotFoundError, ValueError, SyntaxError) as e:
+        # Usage errors exit 2, distinct from exit 1 = real findings.
+        log(f"apnea-uq flow: {e}")
+        raise SystemExit(2)
+
+    if args.update_manifest:
+        if result.unsuppressed:
+            narrate("flow: manifest NOT updated — unsuppressed finding(s) "
+                    "remain; fix (or suppress) them, then re-run "
+                    "--update-manifest")
+        elif not graph.full_scope:
+            narrate("flow: manifest NOT updated — the scan scope lacks "
+                    "the registry catalog and/or cli/stages.py, so the "
+                    "extracted graph is partial")
+        else:
+            write_manifest(args.manifest, rows)
+            narrate(f"manifest -> {args.manifest} ({len(rows)} row(s))")
+
+    if args.update_docs:
+        import os
+
+        from apnea_uq_tpu.flow.pipedoc import render_pipeline_doc
+        from apnea_uq_tpu.utils.io import atomic_write_text
+
+        docs_path = args.docs or os.path.join(
+            default_repo_root(paths), "docs", "PIPELINE.md")
+        if not graph.full_scope:
+            narrate("flow: docs NOT updated — partial scan scope")
+        else:
+            os.makedirs(os.path.dirname(os.path.abspath(docs_path)),
+                        exist_ok=True)
+            atomic_write_text(docs_path, render_pipeline_doc(graph))
+            narrate(f"pipeline doc -> {docs_path}")
+
+    emit_result(result, fmt, json_extra={
+        "artifacts": graph_rows(graph) if graph.full_scope else {},
+    })
+    return 1 if result.unsuppressed else 0
+
+
+def register(sub) -> None:
+    """Attach the ``flow`` subcommand to the CLI's subparser registry."""
+    from apnea_uq_tpu.flow.manifest import DEFAULT_MANIFEST_PATH
+    from apnea_uq_tpu.lint.report import add_format_args
+
+    p = sub.add_parser(
+        "flow",
+        help="Pipeline dataflow analysis: statically verify the "
+             "artifact contract (producer->consumer graph over registry "
+             "keys, diffed against flow/manifest.json) and the "
+             "filesystem crash-consistency discipline.")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="Files/directories to analyze; default: the "
+                        "apnea_uq_tpu package plus bench.py beside it.")
+    add_format_args(p)
+    p.add_argument("--rule", action="append", default=[], metavar="NAME",
+                   help="Run only this flow rule (repeatable); default: "
+                        "all — see docs/LINT.md \"Flow rules\".")
+    p.add_argument("--manifest", default=DEFAULT_MANIFEST_PATH,
+                   help="Manifest path (default: the in-package golden "
+                        "apnea_uq_tpu/flow/manifest.json).")
+    p.add_argument("--update-manifest", action="store_true",
+                   help="Regenerate the manifest rows from the live "
+                        "extraction (stale rows pruned); written only "
+                        "when every rule passes.")
+    p.add_argument("--update-docs", action="store_true",
+                   help="Regenerate the generated dataflow table in "
+                        "docs/PIPELINE.md from the live extraction.")
+    p.add_argument("--docs", default=None,
+                   help="With --update-docs: destination path (default "
+                        "<repo>/docs/PIPELINE.md).")
+    p.set_defaults(fn=cmd_flow)

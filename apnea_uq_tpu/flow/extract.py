@@ -1,0 +1,427 @@
+"""Registry dataflow extraction: every artifact read/write site in scope,
+resolved to canonical keys, as one producer -> consumer graph.
+
+The pipeline's interface is files on disk, mediated by
+:class:`~apnea_uq_tpu.data.registry.ArtifactRegistry`: a stage *promises*
+to write key K with fields F, and a later stage *assumes* both.  Those
+promises live in call sites scattered across the package (plus
+``bench.py``), so a refactor can orphan a consumer or strand a producer
+without any single file looking wrong.  This module makes the graph a
+static object: an AST walk collects every ``save_arrays`` /
+``save_array_store`` / ``adopt_array_store`` / ``save_table`` /
+``save_json`` / ``directory_for`` / ``load_arrays`` /
+``open_array_store`` / ``load_table`` / ``load_json`` call, resolves its
+key expression, and records the statically-known field sets.
+
+Key resolution handles the package's real idioms:
+
+- ``reg.WINDOWS`` attribute constants (any alias of the registry
+  module), resolved against the catalog parsed from the in-scope
+  ``registry.py`` (``CANONICAL_KEYS`` when present, else every
+  module-level ``UPPER = "string"`` assignment);
+- direct constant imports (``from ..registry import WINDOWS``);
+- ``f"{reg.UQ_STATS}:{label}"`` tag-suffix construction — the tagged
+  variant resolves to its *base* catalog entry, so ``save_run``'s
+  per-label keys never read as drift;
+- locals assigned earlier in the same function
+  (``key = f"{reg.METRICS}:{args.label}"; registry.load_json(key)``);
+- local write aliases (``save = registry.save_array_store if store else
+  registry.save_arrays; save(KEY, {...})``).
+
+Anything else is dynamic and is deliberately *not* guessed at: an
+unresolvable key contributes no graph edge (and no finding).
+
+Jax-free by construction, like the lint engine it rides.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from apnea_uq_tpu.lint.engine import LintContext, SourceFile
+
+#: Registry write methods and the artifact kind each records.
+WRITE_METHODS: Dict[str, str] = {
+    "save_arrays": "arrays",
+    "save_array_store": "array_store",
+    "adopt_array_store": "array_store",
+    "save_table": "table",
+    "save_json": "json",
+}
+
+#: Registry read methods.
+READ_METHODS: Tuple[str, ...] = (
+    "load_arrays", "open_array_store", "load_table", "load_json",
+)
+
+#: Managed-handle methods: ``directory_for`` both creates and locates a
+#: directory artifact, so a site counts as producer AND consumer.
+MANAGE_METHODS: Tuple[str, ...] = ("directory_for",)
+
+#: Methods that take a fields mapping as their second argument.
+_FIELD_WRITE_METHODS = ("save_arrays", "save_array_store")
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyRef:
+    """One resolved key expression."""
+
+    base: Optional[str]     # canonical base key text; None = unresolvable
+    tagged: bool = False    # carries a ':<tag>' suffix
+    literal: bool = False   # base spelled as a raw string literal
+
+
+@dataclasses.dataclass(frozen=True)
+class AccessSite:
+    """One registry access call, located and classified."""
+
+    path: str               # repo-root-relative display path
+    line: int
+    function: str           # enclosing function name ('<module>' at top level)
+    method: str             # registry method (aliased writes join with '|')
+    role: str               # 'produce' | 'consume' | 'manage'
+    key: KeyRef
+    kinds: Tuple[str, ...] = ()              # artifact kind(s), writes only
+    fields: Optional[Tuple[str, ...]] = None  # written names / names= subset
+
+    @property
+    def site(self) -> str:
+        """Line-independent identity used in flow/manifest.json rows."""
+        return f"{self.path.replace(chr(92), '/')}::{self.function}"
+
+
+@dataclasses.dataclass
+class Catalog:
+    """The canonical key catalog parsed from the in-scope registry.py."""
+
+    path: Optional[str] = None           # display path, None = not in scope
+    names: Dict[str, str] = dataclasses.field(default_factory=dict)
+    lines: Dict[str, int] = dataclasses.field(default_factory=dict)
+    order: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def values(self) -> Set[str]:
+        return set(self.order)
+
+
+@dataclasses.dataclass
+class FlowGraph:
+    catalog: Catalog
+    sites: List[AccessSite]
+    #: Graph-completeness rules need the whole pipeline universe in
+    #: scope: the registry module (the catalog) AND the stage registry
+    #: (cli/stages.py).  Mirrors the telemetry-schema rule's anchor
+    #: logic — a partial scan must never claim an artifact is orphaned.
+    full_scope: bool = False
+
+    def sites_for(self, base: str) -> List[AccessSite]:
+        return [s for s in self.sites if s.key.base == base]
+
+
+# ------------------------------------------------------------- catalog --
+
+def _registry_file(context: LintContext) -> Optional[SourceFile]:
+    return context.file_named("registry.py")
+
+
+def parse_catalog(sf: SourceFile) -> Catalog:
+    """Module-level ``UPPER = "string"`` assignments, ordered by the
+    ``CANONICAL_KEYS`` tuple when the module declares one (the real
+    registry does), else by declaration order (synthetic fixtures)."""
+    names: Dict[str, str] = {}
+    lines: Dict[str, int] = {}
+    canonical: Optional[List[str]] = None
+    for node in sf.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        if (target.id == "CANONICAL_KEYS"
+                and isinstance(node.value, ast.Tuple)):
+            canonical = [e.id for e in node.value.elts
+                         if isinstance(e, ast.Name)]
+        elif (target.id.isupper()
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            names[target.id] = node.value.value
+            lines.setdefault(node.value.value, node.lineno)
+    if canonical is not None:
+        order = [names[n] for n in canonical if n in names]
+    else:
+        order = list(dict.fromkeys(names.values()))
+    return Catalog(path=sf.path, names=names, lines=lines, order=order)
+
+
+# ------------------------------------------------------------- aliases --
+
+def _registry_aliases(tree: ast.Module) -> Tuple[Set[str], Dict[str, str]]:
+    """(module aliases, directly-imported constant names) for the
+    registry module in one file — ``import ... as reg`` and
+    ``from ...registry import WINDOWS as W`` both resolve."""
+    mod_aliases: Set[str] = set()
+    const_names: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[-1] == "registry" and alias.asname:
+                    mod_aliases.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[-1] == "registry":
+                for alias in node.names:
+                    const_names[alias.asname or alias.name] = alias.name
+            else:
+                for alias in node.names:
+                    if alias.name == "registry":
+                        mod_aliases.add(alias.asname or "registry")
+    return mod_aliases, const_names
+
+
+# ------------------------------------------------------ key resolution --
+
+def walk_scope(stmts: Sequence[ast.stmt]):
+    """Like ``ast.walk`` over ``stmts`` but pruned at nested function
+    boundaries: a call (or assignment) inside an inner ``def`` belongs
+    to the inner scope, which gets its own pass."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+
+
+class _Scope:
+    """One function (or the module top level): local assignments for
+    name resolution, in source order."""
+
+    def __init__(self, name: str, body: Sequence[ast.stmt]):
+        self.name = name
+        self.assigns: Dict[str, List[Tuple[int, ast.AST]]] = {}
+        for node in walk_scope(body):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self.assigns.setdefault(target.id, []).append(
+                            (node.lineno, node.value))
+
+    def value_before(self, name: str, line: int) -> Optional[ast.AST]:
+        best: Optional[Tuple[int, ast.AST]] = None
+        for ln, value in self.assigns.get(name, ()):
+            if ln <= line and (best is None or ln > best[0]):
+                best = (ln, value)
+        return best[1] if best else None
+
+
+def _iter_scopes(tree: ast.Module):
+    """Yield (_Scope, statements) for the module top level (nested
+    function bodies excluded) and for every function, innermost wins for
+    nested defs because later scopes re-cover their own bodies."""
+    top = [s for s in tree.body
+           if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    yield _Scope("<module>", top), top
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield _Scope(node.name, node.body), node.body
+
+
+def resolve_key(
+    expr: ast.AST,
+    catalog: Catalog,
+    mod_aliases: Set[str],
+    const_names: Dict[str, str],
+    scope: _Scope,
+    line: int,
+    _depth: int = 0,
+) -> KeyRef:
+    """Resolve a key expression to its base catalog entry (tag suffixes
+    stripped).  Unresolvable expressions return ``KeyRef(None)``."""
+    if _depth > 4:
+        return KeyRef(None)
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        text = expr.value
+        base, sep, _tag = text.partition(":")
+        return KeyRef(base=base, tagged=bool(sep), literal=True)
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id in mod_aliases:
+        value = catalog.names.get(expr.attr)
+        return KeyRef(base=value) if value is not None else KeyRef(None)
+    if isinstance(expr, ast.Name):
+        if expr.id in const_names:
+            value = catalog.names.get(const_names[expr.id])
+            return KeyRef(base=value) if value is not None else KeyRef(None)
+        bound = scope.value_before(expr.id, line)
+        if bound is not None:
+            return resolve_key(bound, catalog, mod_aliases, const_names,
+                               scope, line, _depth + 1)
+        return KeyRef(None)
+    if isinstance(expr, ast.JoinedStr) and expr.values:
+        head = expr.values[0]
+        rest = expr.values[1:]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            text = head.value
+            base, sep, _ = text.partition(":")
+            tagged = bool(sep) or bool(rest)
+            return KeyRef(base=base, tagged=tagged, literal=True)
+        if isinstance(head, ast.FormattedValue):
+            inner = resolve_key(head.value, catalog, mod_aliases,
+                                const_names, scope, line, _depth + 1)
+            if inner.base is None:
+                return KeyRef(None)
+            if not rest:
+                return inner
+            # The remainder must start with the ':' tag separator for
+            # this to be a tagged variant of the base key.
+            nxt = rest[0]
+            if isinstance(nxt, ast.Constant) and isinstance(nxt.value, str) \
+                    and nxt.value.startswith(":"):
+                return KeyRef(base=inner.base, tagged=True,
+                              literal=inner.literal)
+            return KeyRef(None)
+    return KeyRef(None)
+
+
+# ----------------------------------------------------- field resolution --
+
+def _dict_display_keys(expr: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(expr, ast.Dict) and all(
+            isinstance(k, ast.Constant) and isinstance(k.value, str)
+            for k in expr.keys):
+        return tuple(k.value for k in expr.keys)  # type: ignore[union-attr]
+    return None
+
+
+def _resolve_fields_arg(expr: Optional[ast.AST], scope: _Scope,
+                        line: int) -> Optional[Tuple[str, ...]]:
+    """Written field names when statically known: a dict display at the
+    call, or a local assigned one earlier in the function."""
+    if expr is None:
+        return None
+    keys = _dict_display_keys(expr)
+    if keys is not None:
+        return keys
+    if isinstance(expr, ast.Name):
+        bound = scope.value_before(expr.id, line)
+        if bound is not None:
+            return _dict_display_keys(bound)
+    return None
+
+
+def _names_kwarg(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    for kw in call.keywords:
+        if kw.arg == "names" and isinstance(kw.value, (ast.Tuple, ast.List)):
+            if all(isinstance(e, ast.Constant) and isinstance(e.value, str)
+                   for e in kw.value.elts):
+                return tuple(e.value for e in kw.value.elts)
+    return None
+
+
+def _write_aliases(scope: _Scope) -> Dict[str, Tuple[str, ...]]:
+    """Local names bound to registry write methods (directly or via a
+    conditional/lambda expression): calls through them are writes of
+    every method the binding mentions."""
+    out: Dict[str, Tuple[str, ...]] = {}
+    for name, bindings in scope.assigns.items():
+        for _line, value in bindings:
+            methods = tuple(sorted({
+                node.attr for node in ast.walk(value)
+                if isinstance(node, ast.Attribute)
+                and node.attr in WRITE_METHODS
+            }))
+            if methods:
+                out[name] = methods
+    return out
+
+
+# ------------------------------------------------------------ extraction --
+
+def _extract_file_sites(sf: SourceFile, catalog: Catalog) -> List[AccessSite]:
+    mod_aliases, const_names = _registry_aliases(sf.tree)
+    sites: List[AccessSite] = []
+    seen: Set[int] = set()  # call node ids, so nested scopes don't double
+    for scope, body in _iter_scopes(sf.tree):
+        aliases = _write_aliases(scope)
+        for node in walk_scope(body):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            method: Optional[str] = None
+            methods: Tuple[str, ...] = ()
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in WRITE_METHODS or attr in READ_METHODS \
+                        or attr in MANAGE_METHODS:
+                    method = attr
+                    methods = (attr,)
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in aliases:
+                methods = aliases[node.func.id]
+                method = "|".join(methods)
+            if method is None or not node.args:
+                continue
+            seen.add(id(node))
+            key = resolve_key(node.args[0], catalog, mod_aliases,
+                              const_names, scope, node.lineno)
+            if methods[0] in MANAGE_METHODS:
+                role = "manage"
+                kinds: Tuple[str, ...] = ("directory",)
+                fields = None
+            elif methods[0] in WRITE_METHODS:
+                role = "produce"
+                kinds = tuple(sorted({WRITE_METHODS[m] for m in methods}))
+                fields = None
+                if any(m in _FIELD_WRITE_METHODS for m in methods):
+                    arg = node.args[1] if len(node.args) > 1 else None
+                    fields = _resolve_fields_arg(arg, scope, node.lineno)
+            else:
+                role = "consume"
+                kinds = ()
+                fields = _names_kwarg(node)
+            sites.append(AccessSite(
+                path=sf.path, line=node.lineno, function=scope.name,
+                method=method, role=role, key=key, kinds=kinds,
+                fields=fields,
+            ))
+    sites.sort(key=lambda s: (s.path, s.line, s.method))
+    return sites
+
+
+def extract_graph(context: LintContext) -> FlowGraph:
+    reg_sf = _registry_file(context)
+    catalog = parse_catalog(reg_sf) if reg_sf is not None else Catalog()
+    sites: List[AccessSite] = []
+    for sf in context.files:
+        sites.extend(_extract_file_sites(sf, catalog))
+    sites.sort(key=lambda s: (s.path, s.line, s.method))
+    full_scope = (reg_sf is not None
+                  and context.file_named("cli/stages.py") is not None)
+    return FlowGraph(catalog=catalog, sites=sites, full_scope=full_scope)
+
+
+# ------------------------------------------------------- manifest rows --
+
+def graph_rows(graph: FlowGraph) -> Dict[str, Dict[str, object]]:
+    """One structural row per canonical key — what flow/manifest.json
+    records and ``artifact-graph-drift`` diffs.  Line numbers stay out
+    (they churn under unrelated edits); ``path::function`` identities
+    move only when code actually moves."""
+    rows: Dict[str, Dict[str, object]] = {}
+    for key in graph.catalog.order:
+        produced = sorted({s.site for s in graph.sites_for(key)
+                           if s.role in ("produce", "manage")})
+        consumed = sorted({s.site for s in graph.sites_for(key)
+                           if s.role in ("consume", "manage")})
+        kinds = sorted({k for s in graph.sites_for(key) for k in s.kinds})
+        fields = sorted({f for s in graph.sites_for(key)
+                         if s.role == "produce" and s.fields
+                         for f in s.fields})
+        rows[key] = {
+            "kinds": kinds,
+            "producers": produced,
+            "consumers": consumed,
+            "fields": fields,
+        }
+    return rows

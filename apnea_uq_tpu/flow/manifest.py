@@ -1,0 +1,62 @@
+"""The golden pipeline-dataflow manifest, checked into the repo.
+
+One JSON row per canonical artifact key (``registry.py``'s
+``CANONICAL_KEYS``) records the structural dataflow facts of the
+pipeline — which stages produce it (``path::function``), which consume
+it, the artifact kinds it is stored as, and the statically-known field
+names — so CI fails the moment a refactor orphans a consumer, strands a
+producer, or silently changes a field set, against a file a reviewer
+can read in the diff.  Line numbers stay out: rows move only when code
+actually moves.
+
+``apnea-uq flow --update-manifest`` regenerates the rows from the live
+extraction (the same audit-manifest pattern as
+``apnea_uq_tpu/audit/manifest.json``); rows for keys that left the
+catalog are pruned.  This module is jax-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from apnea_uq_tpu.flow.extract import FlowGraph, graph_rows
+
+MANIFEST_VERSION = 1
+DEFAULT_MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "manifest.json")
+
+
+def load_manifest(path: str = DEFAULT_MANIFEST_PATH,
+                  ) -> Optional[Dict[str, Dict[str, Any]]]:
+    """key -> row, or None when no manifest exists yet."""
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "artifacts" not in doc:
+        raise ValueError(
+            f"{path!r} is not a flow manifest (no 'artifacts' key)")
+    return dict(doc["artifacts"])
+
+
+def merge_rows(graph: FlowGraph) -> Dict[str, Dict[str, Any]]:
+    """The would-be manifest after an update: one row per canonical key
+    from the live extraction.  Keys no longer in the catalog are pruned
+    (``--update-manifest`` is the documented remediation for the
+    stale-row finding, so it must actually remove them)."""
+    return graph_rows(graph)
+
+
+def write_manifest(path: str, rows: Dict[str, Dict[str, Any]]) -> None:
+    from apnea_uq_tpu.utils.io import atomic_write_json
+
+    doc = {
+        "version": MANIFEST_VERSION,
+        "artifacts": {key: rows[key] for key in rows},
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    # sort_keys=False keeps the version header first and the rows in
+    # catalog (pipeline) order — the reviewable layout.
+    atomic_write_json(path, doc, sort_keys=False, trailing_newline=True)

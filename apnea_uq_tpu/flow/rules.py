@@ -1,0 +1,450 @@
+"""The flow-rule family: static verification of the artifact contract
+and the filesystem crash-consistency discipline.
+
+Third rule family on the lint engine — same :class:`Finding` type, same
+severities, same ``# apnea-lint: disable=<rule> -- <why>`` suppressions,
+same reporters — but the subject is the *pipeline dataflow graph*
+(:mod:`apnea_uq_tpu.flow.extract`) plus the filesystem effects of every
+scanned function, not a single AST in isolation.
+
+Graph rules (need the full pipeline universe in scope — the registry
+module and ``cli/stages.py`` — exactly like the telemetry-schema rule's
+phantom direction):
+
+- ``artifact-never-produced`` — a canonical key some stage consumes but
+  nothing in scope produces: the refactor orphaned a consumer, and the
+  pipeline now fails at stage start instead of review time.
+- ``artifact-never-consumed`` — a canonical key produced but never read
+  back: a dead artifact (or a lost consumer).  End-product artifacts
+  read by analysts/tests rather than stages carry a justified
+  suppression at the producer site — the audit trail the gate pins.
+- ``artifact-key-drift`` — a key spelled as a string literal instead of
+  the ``registry.py`` catalog constant: exactly the contract drift the
+  registry exists to end (SURVEY §1), one typo away from a silent fork.
+- ``artifact-field-contract`` — a consumer's ``names=`` subset requests
+  a field some statically-known producer never writes: that pairing
+  KeyErrors at stage start on the producer's path.
+- ``artifact-graph-drift`` — the extracted graph no longer matches the
+  checked-in ``flow/manifest.json`` row (the audit-manifest pattern):
+  re-bless intended changes with ``apnea-uq flow --update-manifest``
+  and review the JSON diff.
+
+Write-discipline rules (always run, any scope):
+
+- ``non-atomic-artifact-write`` — an ``open(..., "w")`` / ``np.save*``
+  / ``.to_csv`` whose path derives from a registry root, run dir, or
+  store dir, in a function with no ``os.replace`` commit: readers can
+  observe a torn file.  Route through ``utils/io.py``'s atomic writers.
+- ``replace-without-fsync`` — a tmp -> ``os.replace`` commit that never
+  fsyncs the data first: after a power loss the rename can land before
+  the data blocks, publishing an empty/truncated file.  A memmap
+  ``.flush()`` (msync) counts — that is the shard writer's protocol.
+
+Jax-free by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from apnea_uq_tpu.flow.extract import FlowGraph, graph_rows, walk_scope
+from apnea_uq_tpu.lint.engine import (
+    SEVERITIES,
+    Finding,
+    LintContext,
+    Rule,
+    SourceFile,
+)
+
+FLOW_RULES: Dict[str, Rule] = {}
+
+
+def register_flow_rule(name: str, severity: str, summary: str):
+    """Decorator twin of :func:`apnea_uq_tpu.lint.engine.register_rule`
+    for rules that check the pipeline dataflow graph."""
+    if severity not in SEVERITIES:
+        raise ValueError(
+            f"severity must be one of {SEVERITIES}, got {severity!r}")
+
+    def wrap(fn):
+        FLOW_RULES[name] = Rule(name=name, severity=severity,
+                                summary=summary, check=fn)
+        return fn
+
+    return wrap
+
+
+@dataclasses.dataclass
+class FlowContext:
+    """Everything a flow rule sees: the parsed files, the extracted
+    graph, and the checked-in manifest rows (None = no manifest yet —
+    the drift rule then skips, fixtures and partial scans stay green)."""
+
+    context: LintContext
+    graph: FlowGraph
+    manifest: Optional[Dict[str, Dict[str, object]]] = None
+
+
+def _finding(rule: str, path: str, line: int, message: str) -> Finding:
+    return Finding(rule=rule, severity=FLOW_RULES[rule].severity,
+                   path=path, line=int(line), message=message)
+
+
+# ------------------------------------------------------------ graph rules --
+
+@register_flow_rule(
+    "artifact-never-produced", "error",
+    "a canonical artifact key is consumed by some stage but produced by "
+    "none — the pipeline fails at stage start, not review time",
+)
+def check_never_produced(fc: FlowContext) -> Iterable[Finding]:
+    if not fc.graph.full_scope:
+        return
+    for key in fc.graph.catalog.order:
+        sites = fc.graph.sites_for(key)
+        if any(s.role in ("produce", "manage") for s in sites):
+            continue
+        for s in sites:
+            if s.role == "consume":
+                yield _finding(
+                    "artifact-never-produced", s.path, s.line,
+                    f"artifact '{key}' is consumed here ({s.method}) but "
+                    f"no stage in scope produces it — the producer was "
+                    f"removed or renamed without this consumer",
+                )
+
+
+@register_flow_rule(
+    "artifact-never-consumed", "warning",
+    "a canonical artifact key is produced but consumed by no stage — a "
+    "dead artifact, or a consumer lost in a refactor",
+)
+def check_never_consumed(fc: FlowContext) -> Iterable[Finding]:
+    if not fc.graph.full_scope:
+        return
+    for key in fc.graph.catalog.order:
+        sites = fc.graph.sites_for(key)
+        if any(s.role in ("consume", "manage") for s in sites):
+            continue
+        for s in sites:
+            if s.role == "produce":
+                yield _finding(
+                    "artifact-never-consumed", s.path, s.line,
+                    f"artifact '{key}' is produced here ({s.method}) but "
+                    f"no stage in scope consumes it — dead artifact, or "
+                    f"its consumer was lost (suppress with a "
+                    f"justification if analysts/tests read it directly)",
+                )
+
+
+@register_flow_rule(
+    "artifact-key-drift", "error",
+    "an artifact key spelled as a string literal bypasses the canonical "
+    "registry.py catalog — the contract-drift class the registry ends",
+)
+def check_key_drift(fc: FlowContext) -> Iterable[Finding]:
+    catalog = fc.graph.catalog
+    if catalog.path is None:
+        return
+    for s in fc.graph.sites:
+        if s.key.base is None or not s.key.literal:
+            continue
+        if s.path == catalog.path:
+            continue  # the catalog module itself may spell its constants
+        if s.key.base in catalog.values:
+            hint = (f"use the registry catalog constant for "
+                    f"'{s.key.base}' instead of a string literal")
+        else:
+            hint = (f"'{s.key.base}' is not a canonical key — add it to "
+                    f"the registry.py catalog (and CANONICAL_KEYS) or "
+                    f"use an existing constant")
+        yield _finding(
+            "artifact-key-drift", s.path, s.line,
+            f"artifact key '{s.key.base}' is spelled as a string literal "
+            f"at this {s.method} site; {hint}",
+        )
+
+
+@register_flow_rule(
+    "artifact-field-contract", "error",
+    "a consumer's names= subset requests a field some statically-known "
+    "producer never writes — a stage-start KeyError on that path",
+)
+def check_field_contract(fc: FlowContext) -> Iterable[Finding]:
+    if not fc.graph.full_scope:
+        return
+    for key in fc.graph.catalog.order:
+        sites = fc.graph.sites_for(key)
+        producers = [s for s in sites
+                     if s.role == "produce" and s.fields is not None]
+        if not producers:
+            continue
+        for s in sites:
+            if s.role != "consume" or s.fields is None:
+                continue
+            for p in producers:
+                missing = sorted(set(s.fields) - set(p.fields))
+                if missing:
+                    yield _finding(
+                        "artifact-field-contract", s.path, s.line,
+                        f"consumer requests field(s) {missing} of "
+                        f"'{key}' that the producer at {p.path}:{p.line} "
+                        f"({p.method}) does not write "
+                        f"(writes {sorted(p.fields)})",
+                    )
+                    break  # one finding per consumer site
+
+
+@register_flow_rule(
+    "artifact-graph-drift", "error",
+    "the extracted producer->consumer graph no longer matches the "
+    "checked-in flow/manifest.json — re-bless intended changes with "
+    "`apnea-uq flow --update-manifest`",
+)
+def check_graph_drift(fc: FlowContext) -> Iterable[Finding]:
+    if not fc.graph.full_scope or fc.manifest is None:
+        return
+    catalog = fc.graph.catalog
+    rows = graph_rows(fc.graph)
+    anchor_path = catalog.path or "registry.py"
+    for key in catalog.order:
+        line = catalog.lines.get(key, 1)
+        prior = fc.manifest.get(key)
+        if prior is None:
+            yield _finding(
+                "artifact-graph-drift", anchor_path, line,
+                f"canonical key '{key}' has no flow/manifest.json row — "
+                f"run `apnea-uq flow --update-manifest` to record it",
+            )
+            continue
+        changed = sorted(
+            field for field in ("kinds", "producers", "consumers", "fields")
+            if prior.get(field) != rows[key][field]
+        )
+        if changed:
+            detail = "; ".join(
+                f"{field}: manifest {prior.get(field)} != extracted "
+                f"{rows[key][field]}" for field in changed
+            )
+            yield _finding(
+                "artifact-graph-drift", anchor_path, line,
+                f"artifact '{key}' drifted from its manifest row "
+                f"({detail}) — review and re-bless with "
+                f"`apnea-uq flow --update-manifest`",
+            )
+    for key in sorted(set(fc.manifest) - set(catalog.order)):
+        yield _finding(
+            "artifact-graph-drift", anchor_path, 1,
+            f"flow/manifest.json has a stale row for '{key}', which is "
+            f"no longer a canonical key — `apnea-uq flow "
+            f"--update-manifest` prunes it",
+        )
+
+
+# ------------------------------------------------- write-discipline rules --
+
+#: Calls that locate artifact storage: anything derived from them is an
+#: artifact-rooted path.
+MARKER_CALLS = frozenset({
+    "path_for", "_manifest_path", "directory_for", "default_run_dir",
+    "_progress_path", "_blob_path", "_meta_path",
+})
+
+#: Names that *are* artifact roots wherever they appear.
+MARKER_NAMES = frozenset({"run_dir", "store_dir", "registry_root"})
+
+#: Attribute names that are artifact roots (``self.root``,
+#: ``run_log.run_dir``, ``store.directory``).
+MARKER_ATTRS = MARKER_NAMES | frozenset({"root", "directory"})
+
+
+def _is_rooted(expr: ast.AST, tainted: Set[str]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None)
+            if name in MARKER_CALLS:
+                return True
+        elif isinstance(node, ast.Name):
+            if node.id in MARKER_NAMES or node.id in tainted:
+                return True
+        elif isinstance(node, ast.Attribute):
+            if node.attr in MARKER_ATTRS:
+                return True
+    return False
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+@dataclasses.dataclass
+class _FnEffects:
+    """Filesystem effects of one function scope."""
+
+    write_calls: List[Tuple[ast.Call, ast.AST]]  # (call, path expr)
+    replace_lines: List[int]
+    has_fsync: bool
+    has_memmap_flush: bool
+    tainted: Set[str]
+
+
+def _scan_effects(body) -> _FnEffects:
+    nodes = list(walk_scope(body))
+    # Two taint passes: assignments may chain (path = join(run_dir, x);
+    # tmp = path + '.tmp').
+    tainted: Set[str] = set()
+    for _ in range(2):
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                if _is_rooted(node.value, tainted):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tainted.add(t.id)
+    handle_names: Set[str] = set()   # file objects from open(...)
+    memmap_names: Set[str] = set()   # arrays from open_memmap(...)
+    for node in nodes:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = _call_name(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if name == "open":
+                        handle_names.add(t.id)
+                    elif name == "open_memmap":
+                        memmap_names.add(t.id)
+        elif isinstance(node, ast.withitem) and isinstance(
+                node.context_expr, ast.Call):
+            if _call_name(node.context_expr) == "open" and isinstance(
+                    node.optional_vars, ast.Name):
+                handle_names.add(node.optional_vars.id)
+
+    write_calls: List[Tuple[ast.Call, ast.AST]] = []
+    replace_lines: List[int] = []
+    has_fsync = False
+    has_memmap_flush = False
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name == "replace" and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "os":
+            # os.replace only — str.replace must not read as a commit.
+            replace_lines.append(node.lineno)
+        elif name == "fsync":
+            has_fsync = True
+        elif (name == "flush" and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in memmap_names):
+            has_memmap_flush = True
+        elif name == "open" and isinstance(node.func, ast.Name):
+            mode = None
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if isinstance(mode, str) and mode.startswith(("w", "x")) \
+                    and node.args:
+                write_calls.append((node, node.args[0]))
+        elif name == "open_memmap" and node.args:
+            mode = None
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if not isinstance(mode, str) or "w" in mode or "+" in mode:
+                write_calls.append((node, node.args[0]))
+        elif name in ("save", "savez", "savez_compressed") and isinstance(
+                node.func, ast.Attribute) and isinstance(
+                node.func.value, ast.Name) and node.func.value.id in (
+                "np", "numpy") and node.args:
+            if not (isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in handle_names):
+                write_calls.append((node, node.args[0]))
+        elif name == "to_csv" and isinstance(node.func, ast.Attribute) \
+                and node.args:
+            if not (isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in handle_names):
+                write_calls.append((node, node.args[0]))
+    return _FnEffects(write_calls=write_calls, replace_lines=replace_lines,
+                      has_fsync=has_fsync,
+                      has_memmap_flush=has_memmap_flush, tainted=tainted)
+
+
+def _iter_fn_effects(sf: SourceFile):
+    from apnea_uq_tpu.flow.extract import _iter_scopes
+
+    for scope, body in _iter_scopes(sf.tree):
+        yield scope, _scan_effects(body)
+
+
+@register_flow_rule(
+    "non-atomic-artifact-write", "error",
+    "a write landing under a registry root / run dir / store dir "
+    "without a tmp -> os.replace commit — readers can observe a torn "
+    "file; route through utils/io.py's atomic writers",
+)
+def check_non_atomic_write(fc: FlowContext) -> Iterable[Finding]:
+    for sf in fc.context.files:
+        for _scope, fx in _iter_fn_effects(sf):
+            if fx.replace_lines:
+                continue  # this function commits atomically
+            for call, path_expr in fx.write_calls:
+                if _is_rooted(path_expr, fx.tainted):
+                    yield _finding(
+                        "non-atomic-artifact-write", sf.path, call.lineno,
+                        "artifact-rooted write without a tmp -> "
+                        "os.replace commit — a crash (or a concurrent "
+                        "reader) can observe a torn file; route through "
+                        "apnea_uq_tpu.utils.io.atomic_write_json/"
+                        "text/bytes",
+                    )
+
+
+@register_flow_rule(
+    "replace-without-fsync", "warning",
+    "a tmp -> os.replace commit that never fsyncs the data first — a "
+    "power loss can publish an empty/truncated file",
+)
+def check_replace_without_fsync(fc: FlowContext) -> Iterable[Finding]:
+    for sf in fc.context.files:
+        for _scope, fx in _iter_fn_effects(sf):
+            if not fx.replace_lines or not fx.write_calls:
+                continue
+            if fx.has_fsync or fx.has_memmap_flush:
+                continue
+            yield _finding(
+                "replace-without-fsync", sf.path, fx.replace_lines[0],
+                "tmp -> os.replace commit without an os.fsync (or memmap "
+                ".flush) of the written data — after a power loss the "
+                "rename can land before the data blocks, publishing a "
+                "truncated file; route through "
+                "apnea_uq_tpu.utils.io's atomic writers",
+            )
+
+
+# ----------------------------------------------------------------- runner --
+
+def run_flow_rules(fc: FlowContext,
+                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    if rules is None:
+        selected: Tuple[str, ...] = tuple(sorted(FLOW_RULES))
+    else:
+        selected = tuple(dict.fromkeys(rules))
+    unknown = [r for r in selected if r not in FLOW_RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown flow rule(s) {unknown}; "
+            f"available: {sorted(FLOW_RULES)}")
+    findings: List[Finding] = []
+    for name in selected:
+        findings.extend(FLOW_RULES[name].check(fc))
+    return findings

@@ -43,6 +43,8 @@ from typing import Any, Dict, Optional
 
 import jax
 
+from apnea_uq_tpu.utils.io import atomic_write_bytes
+
 # Public HBM capacity per chip kind — the fallback sizing hint when the
 # runtime exposes no memory_stats (the tunneled TPU backend returns
 # None).  bench.py seeds its reference-pattern set size from this table
@@ -212,8 +214,8 @@ def snapshot_device_memory(run_log, label: str) -> Optional[Dict[str, Any]]:
                                f"{label.replace(os.sep, '_')}.pprof.gz")
             path = os.path.join(run_log.run_dir, rel)
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(path, "wb") as f:
-                f.write(profile)
+            # Atomic: snapshots land in a run dir summarize reads live.
+            atomic_write_bytes(path, profile)
             fields["profile_path"] = rel
             fields["profile_bytes"] = len(profile)
         except Exception:  # noqa: BLE001 - profiler-less builds

@@ -220,9 +220,12 @@ def start_run(run_dir: str, *, stage: Optional[str] = None,
         run_log.run_started(stage=stage, config=config, argv=argv)
         if config is not None:
             from apnea_uq_tpu.config import _to_jsonable
+            from apnea_uq_tpu.utils.io import atomic_write_json
 
-            with open(os.path.join(run_dir, "config.json"), "w") as f:
-                json.dump(_to_jsonable(config), f, indent=2)
+            # Atomic commit: summarize/compare read run dirs while runs
+            # are live, and a torn config.json would poison both.
+            atomic_write_json(os.path.join(run_dir, "config.json"),
+                              _to_jsonable(config))
     _ACTIVE.append(run_log)
     return run_log
 

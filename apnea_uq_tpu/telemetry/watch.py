@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from apnea_uq_tpu.telemetry.logging_shim import log
 from apnea_uq_tpu.telemetry.runlog import default_run_dir, start_run
+from apnea_uq_tpu.utils.io import atomic_write_text
 
 # Backoff schedule shared with bench.py's init retry (its unit tests pin
 # the first two sleeps at 20.0 and 32.0 seconds).
@@ -192,8 +193,9 @@ def run_evidence_ritual(
                 if isinstance(text, bytes):  # TimeoutExpired keeps bytes
                     text = text.decode(errors="replace")
                 rel = f"{step.name}.{stream}.txt"
-                with open(os.path.join(run_log.run_dir, rel), "w") as f:
-                    f.write(text)
+                # Atomic: the ritual evidence lands in a run dir other
+                # tools read back; a torn capture is false evidence.
+                atomic_write_text(os.path.join(run_log.run_dir, rel), text)
                 outputs[f"{stream}_path"] = rel
             run_log.event(
                 "ritual_step", name=step.name, argv=step.argv,

@@ -709,12 +709,14 @@ def save_run(registry, result: UQRunResult, *, config=None) -> Dict[str, str]:
 
     paths = {}
     if result.predictions is not None:
+        # apnea-lint: disable=artifact-never-consumed -- end product: the raw (K, M) stack is read by analysts/offline tooling (the reference's mc_raw_pred*.npy), not by a pipeline stage
         paths["raw_predictions"] = registry.save_arrays(
             f"{reg.RAW_PREDICTIONS}:{result.label}",
             {"predictions": result.predictions},
             config=config,
         )
     if result.stats is not None:
+        # apnea-lint: disable=artifact-never-consumed -- end product: the (4, M) sufficient statistics are the fused run's audit artifact, consumed by tests/analysts rather than a stage
         paths["uq_stats"] = registry.save_arrays(
             f"{reg.UQ_STATS}:{result.label}",
             {"stats": result.stats},

@@ -1,4 +1,18 @@
-from apnea_uq_tpu.utils.prng import member_key, seed_key
-from apnea_uq_tpu.utils.timing import Timer
+"""Shared utilities.  Lazy exports: ``utils.io`` (the crash-consistent
+artifact writers) is imported by jax-free contexts — the data plane, the
+lint/flow gates, telemetry — so importing this package must not drag in
+the jax-loaded ``prng``/``timing`` modules as a side effect."""
 
 __all__ = ["seed_key", "member_key", "Timer"]
+
+
+def __getattr__(name):
+    if name in ("seed_key", "member_key"):
+        from apnea_uq_tpu.utils import prng
+
+        return getattr(prng, name)
+    if name == "Timer":
+        from apnea_uq_tpu.utils.timing import Timer
+
+        return Timer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

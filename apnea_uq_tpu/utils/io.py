@@ -1,0 +1,69 @@
+"""Crash-consistent file writers — the one commit protocol for every
+artifact the pipeline persists.
+
+The interface between pipeline stages is files on disk (SURVEY §1), so
+the repo's durability story is only as strong as its *weakest* writer: a
+plain ``open(path, "w")`` under a registry root or run dir can expose a
+torn file to a concurrent reader, and a ``tmp -> os.replace`` commit
+that skips ``fsync`` can surface as an empty/old file after a power
+loss (the rename may be journaled before the data blocks land).  The
+out-of-core data plane (data/store.py) established the discipline —
+**tmp, flush, fsync, atomic replace** — and ``apnea-uq flow`` (the
+pipeline dataflow lint, apnea_uq_tpu/flow/) statically enforces that
+every artifact-rooted write routes through here or hand-rolls the same
+protocol.
+
+Deliberately jax-free and dependency-free: these writers run in
+telemetry/CLI contexts where no backend exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+
+def atomic_write_json(path: str, data: Dict[str, Any], *,
+                      sort_keys: bool = True,
+                      trailing_newline: bool = False) -> None:
+    """Write ``data`` as JSON at ``path`` via tmp -> fsync -> replace.
+
+    ``sort_keys``/``trailing_newline`` exist for writers whose on-disk
+    byte layout is itself a contract (the audit manifest keeps its
+    insertion order and POSIX trailing newline); the durability protocol
+    is identical either way.  The fsync *before* the replace is the
+    crash-consistency half the bare rename idiom misses; the replace
+    happens after close (replacing an open file fails on Windows).
+    """
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=sort_keys)
+        if trailing_newline:
+            f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Write ``text`` at ``path`` via tmp -> fsync -> replace."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` at ``path`` via tmp -> fsync -> replace.  The tmp
+    name is pid-suffixed: byte-blob writers (the AOT program store) can
+    race across processes, and two writers must never interleave into
+    one tmp file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -125,14 +125,13 @@ def _progress_reset() -> None:
 
 
 def _atomic_write_json(path: str, data: dict) -> None:
-    """tmp + rename so a kill -9 mid-write can never leave a truncated
-    file: the previous complete snapshot survives instead."""
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(data, f, indent=2)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    """tmp + fsync + rename so a kill -9 mid-write can never leave a
+    truncated file: the previous complete snapshot survives instead.
+    Routed through the shared commit-protocol writer (utils/io.py) the
+    flow gate enforces for every artifact-rooted write."""
+    from apnea_uq_tpu.utils.io import atomic_write_json
+
+    atomic_write_json(path, data)
 
 
 def _progress_read() -> dict:

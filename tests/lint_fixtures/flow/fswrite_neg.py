@@ -1,0 +1,45 @@
+"""Negative fixture: the commit protocol done right — zero findings.
+
+Routed writes, a correct hand-rolled tmp -> fsync -> replace, the shard
+writer's memmap flush (msync) variant, append-mode JSONL, and writes to
+paths that are not artifact-rooted."""
+
+import json
+import os
+
+from numpy.lib.format import open_memmap
+
+from apnea_uq_tpu.utils.io import atomic_write_json
+
+
+def routed(run_dir, doc):
+    atomic_write_json(os.path.join(run_dir, "config.json"), doc)
+
+
+def hand_rolled(run_dir, doc):
+    path = os.path.join(run_dir, "state.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def shard_commit(store_dir, a):
+    tmp = os.path.join(store_dir, ".tmp-shard.npy")
+    mm = open_memmap(tmp, mode="w+", dtype=a.dtype, shape=a.shape)
+    mm[:] = a
+    mm.flush()
+    del mm
+    os.replace(tmp, os.path.join(store_dir, "shard.npy"))
+
+
+def appends_are_fine(run_dir, line):
+    with open(os.path.join(run_dir, "events.jsonl"), "a") as f:
+        f.write(line)
+
+
+def unrooted_writes_are_fine(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f)

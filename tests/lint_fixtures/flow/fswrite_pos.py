@@ -1,0 +1,27 @@
+"""Positive fixture: write-discipline violations (exact counts pinned).
+
+Two non-atomic artifact-rooted writes (a run-dir JSON and a registry
+.npz) and one tmp -> os.replace commit that never fsyncs."""
+
+import json
+import os
+
+import numpy as np
+
+
+def torn_config(run_dir, doc):
+    with open(os.path.join(run_dir, "config.json"), "w") as f:
+        json.dump(doc, f)
+
+
+def torn_npz(registry, arrays):
+    path = registry.path_for("windows", ".npz")
+    np.savez(path, **arrays)
+
+
+def fsyncless_manifest(registry, manifest):
+    path = registry._manifest_path()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, path)

@@ -1,0 +1,18 @@
+"""Negative fixture: idiomatic cross-function dataflow — tag-suffix
+keys built from catalog constants (``f"{reg.ALPHA}:{label}"``), locals
+holding keys, and ``names=`` subsets the producer actually writes.
+Zero findings from every graph rule."""
+
+from data import registry as reg
+
+
+def evaluate(registry, label, frame):
+    key = f"{reg.ALPHA}:{label}"
+    registry.save_arrays(key, {"x": 1, "y": 2})
+    registry.save_table(f"{reg.BETA}:{label}", frame)
+
+
+def read_back(registry, label):
+    key = f"{reg.ALPHA}:{label}"
+    registry.load_arrays(key, names=("x",))
+    registry.load_table(f"{reg.BETA}:{label}")

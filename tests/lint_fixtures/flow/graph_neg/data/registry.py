@@ -1,0 +1,4 @@
+"""Synthetic catalog for the flow graph-rule negative fixtures."""
+
+ALPHA = "alpha"
+BETA = "beta"

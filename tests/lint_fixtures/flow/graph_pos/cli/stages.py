@@ -1,0 +1,18 @@
+"""Positive fixture: one finding per graph-rule class (exact counts are
+pinned by tests/test_flow.py).  DELTA's producer lives in module_b.py —
+cross-file matching keeps it out of the never-produced findings even
+though its (drifted, literal) consumer is here."""
+
+from data import registry as reg
+
+
+def produce(registry, frame):
+    registry.save_arrays(reg.ALPHA, {"x": 1, "y": 2})
+    registry.save_json(reg.BETA, {"doc": 1})           # never-consumed (1)
+    registry.save_table("rogue_table", frame)          # key-drift (1 of 2)
+
+
+def consume(registry):
+    registry.load_arrays(reg.ALPHA, names=("x", "z"))  # field-contract (1)
+    registry.load_json(reg.GAMMA)                      # never-produced (1)
+    registry.load_arrays("delta")                      # key-drift (2 of 2)

@@ -1,0 +1,6 @@
+"""Synthetic catalog for the flow graph-rule positive fixtures."""
+
+ALPHA = "alpha"
+BETA = "beta"
+GAMMA = "gamma"
+DELTA = "delta"

@@ -1,0 +1,9 @@
+"""DELTA's producer, in a different module than its consumer: the
+extractor must match them across files (and through a direct constant
+import, not just a module alias)."""
+
+from data.registry import DELTA
+
+
+def make(registry):
+    registry.save_arrays(DELTA, {"x": 1})

@@ -77,6 +77,13 @@ def test_readme_smoke_recipe_pins_every_smoke_knob():
         "README smoke recipe lost the `apnea-uq lint` gate; the static "
         "hazard lint is part of the pre-capture ritual"
     )
+    # And the flow gate (ISSUE 10): the artifact-contract + write-
+    # discipline check is the other seconds-fast, jax-free pre-flight
+    # that catches bug classes no CPU smoke run can observe.
+    assert "apnea-uq flow" in readme, (
+        "README smoke recipe lost the `apnea-uq flow` gate; the "
+        "pipeline dataflow check is part of the pre-capture ritual"
+    )
 
 
 def _smoke_env(progress_file: str, run_dir: str) -> dict:

@@ -11,7 +11,8 @@ from apnea_uq_tpu.cli.main import build_parser
 
 REPO = Path(__file__).resolve().parent.parent
 DOCS = [REPO / "README.md", REPO / "docs" / "MIGRATION.md",
-        REPO / "docs" / "OBSERVABILITY.md", REPO / "docs" / "LINT.md"]
+        REPO / "docs" / "OBSERVABILITY.md", REPO / "docs" / "LINT.md",
+        REPO / "docs" / "PIPELINE.md"]
 
 # README "Environment": packages claimed absent at runtime.  The claim
 # rotted once (r2 verdict: sklearn/scipy imports on the prepare and
@@ -222,6 +223,30 @@ def test_design_doc_tracks_chunk_rounding():
             "predict.effective_batch_size is gone but DESIGN.md still "
             "cites it; update the doc and this test together"
         )
+
+
+def test_pipeline_doc_matches_live_extraction():
+    """docs/PIPELINE.md is *generated* (`apnea-uq flow --update-docs`):
+    the dataflow table must equal a fresh render from the live
+    registry-access extraction, byte for byte, so the documented
+    producer->consumer graph can never drift from the code."""
+    from apnea_uq_tpu.flow import run_flow
+    from apnea_uq_tpu.flow.pipedoc import GENERATED_MARKER, render_pipeline_doc
+
+    _result, graph = run_flow(
+        [str(REPO / "apnea_uq_tpu"), str(REPO / "bench.py")],
+        manifest=None,
+    )
+    assert graph.full_scope, "extraction scope lost registry/stages anchors"
+    rendered = render_pipeline_doc(graph)
+    on_disk = (REPO / "docs" / "PIPELINE.md").read_text()
+    assert GENERATED_MARKER in on_disk, (
+        "docs/PIPELINE.md lost its generated-file marker"
+    )
+    assert on_disk == rendered, (
+        "docs/PIPELINE.md is stale — regenerate with "
+        "`apnea-uq flow --update-docs`"
+    )
 
 
 def test_bench_env_knobs_are_documented():

@@ -325,6 +325,14 @@ def test_package_gate_zero_unsuppressed_findings():
                 "apnea_uq_tpu/data/store.py",
                 "apnea_uq_tpu/data/ingest.py",
                 "apnea_uq_tpu/data/registry.py",
+                # The flow gate (ISSUE 10): the dataflow analyzer and the
+                # shared crash-consistent writers it enforces.
+                "apnea_uq_tpu/flow/extract.py",
+                "apnea_uq_tpu/flow/rules.py",
+                "apnea_uq_tpu/flow/manifest.py",
+                "apnea_uq_tpu/flow/pipedoc.py",
+                "apnea_uq_tpu/flow/cli.py",
+                "apnea_uq_tpu/utils/io.py",
                 "bench.py"):
         assert rel in scanned, f"{rel} moved out of the lint gate's scope"
 
