@@ -889,6 +889,66 @@ def cmd_telemetry_compare(args) -> int:
     return 1 if comparison.regressions else 0
 
 
+def cmd_telemetry_trend(args) -> int:
+    """The cross-run perf-trajectory ledger: ingest every archived
+    BENCH_r*.json round (error rounds become gaps, never crashes) plus
+    any extra capture files / run dirs, and render the per-metric
+    best/latest/delta series with regression flags.  ``--update-docs``
+    regenerates the byte-for-byte-pinned docs/BENCH_TRAJECTORY.md from
+    the archived rounds alone.  Needs no config and never imports jax."""
+    import json
+
+    from apnea_uq_tpu.telemetry import trend as trend_mod
+
+    archived = trend_mod.repo_rounds(args.rounds_dir)
+    if args.update_docs:
+        if args.sources:
+            # The doc is byte-pinned against a render from the archived
+            # rounds alone; silently dropping extra sources would let
+            # the user believe their round made it into the doc.
+            raise SystemExit(
+                "telemetry trend --update-docs renders the archived "
+                "BENCH_r*.json rounds only and cannot include extra "
+                f"sources ({args.sources}); archive the capture as "
+                "BENCH_r<N>.json first, or render it ad hoc without "
+                "--update-docs"
+            )
+        if not archived:
+            raise SystemExit(
+                "telemetry trend --update-docs: no BENCH_r*.json rounds "
+                f"found under {args.rounds_dir or trend_mod.default_rounds_dir()!r}"
+            )
+        from apnea_uq_tpu.utils.io import atomic_write_text
+
+        # Archived rounds ONLY: the doc is pinned byte-for-byte against
+        # a fresh render, so ad-hoc extra sources must not leak into it.
+        traj = trend_mod.build_trajectory(
+            [trend_mod.load_round(p) for p in archived],
+            threshold_pct=args.threshold_pct,
+        )
+        docs_path = args.docs or os.path.join(
+            trend_mod.default_rounds_dir(), trend_mod.DOC_RELPATH)
+        atomic_write_text(docs_path, trend_mod.render_trajectory_doc(traj))
+        log(f"wrote {docs_path}")
+        return 0
+    paths = archived + list(args.sources or [])
+    if not paths:
+        raise SystemExit(
+            "telemetry trend: no BENCH_r*.json rounds found under "
+            f"{args.rounds_dir or trend_mod.default_rounds_dir()!r} and no extra "
+            "sources given"
+        )
+    traj = trend_mod.build_trajectory(
+        [trend_mod.load_round(p) for p in paths],
+        threshold_pct=args.threshold_pct,
+    )
+    if args.json:
+        log(json.dumps(trend_mod.trajectory_data(traj), indent=2))
+    else:
+        log(trend_mod.render_trajectory(traj))
+    return 0
+
+
 def cmd_telemetry_watch(args) -> int:
     """The hardware-watch evidence autopilot: probe the TPU backend with
     bench's backoff probe and, on the first green probe, run the
@@ -1143,6 +1203,32 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     pc.add_argument("--json", action="store_true",
                     help="Emit the comparison machine-readable.")
     pc.set_defaults(fn=cmd_telemetry_compare)
+
+    pt = tsub.add_parser(
+        "trend",
+        help="Cross-run perf-trajectory ledger: per-metric "
+             "best/latest/delta over every archived BENCH_r*.json round "
+             "(error rounds shown as gaps) plus any extra sources.")
+    pt.add_argument("sources", nargs="*", default=[],
+                    help="Extra rounds appended after the archived ones: "
+                         "bench capture JSON files or telemetry run "
+                         "directories (e.g. a fresh BENCH_RUN_DIR).")
+    pt.add_argument("--rounds-dir", default=None,
+                    help="Where the archived BENCH_r*.json rounds live "
+                         "(default: the repo checkout root).")
+    pt.add_argument("--threshold-pct", type=float, default=5.0,
+                    help="Worsening of latest-vs-best past this flags "
+                         "the metric REGRESSED (default 5%%).")
+    pt.add_argument("--json", action="store_true",
+                    help="Emit the trajectory machine-readable.")
+    pt.add_argument("--update-docs", action="store_true",
+                    help="Regenerate docs/BENCH_TRAJECTORY.md from the "
+                         "archived rounds only (byte-for-byte pinned by "
+                         "the docs-consistency suite).")
+    pt.add_argument("--docs", default=None,
+                    help="With --update-docs: destination path (default "
+                         "docs/BENCH_TRAJECTORY.md under the repo root).")
+    pt.set_defaults(fn=cmd_telemetry_trend)
 
     pw = tsub.add_parser(
         "watch",

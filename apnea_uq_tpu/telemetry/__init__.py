@@ -19,7 +19,9 @@ The layer every stage reports through (ISSUE 2 tentpole):
 - :mod:`~apnea_uq_tpu.telemetry.compare` — the metric regression
   comparator behind ``apnea-uq telemetry compare``;
 - :mod:`~apnea_uq_tpu.telemetry.watch` — the hardware-watch evidence
-  autopilot behind ``apnea-uq telemetry watch``.
+  autopilot behind ``apnea-uq telemetry watch``;
+- :mod:`~apnea_uq_tpu.telemetry.trend` — the cross-run perf-trajectory
+  ledger behind ``apnea-uq telemetry trend``.
 
 Only the logging shim is imported eagerly (the CLI needs ``log`` before
 anything heavy loads); everything touching jax resolves lazily via PEP
@@ -62,6 +64,9 @@ _LAZY = {
     # import binds the parent attribute).  Call telemetry.watch.watch().
     "wait_for_green": "watch",
     "probe_backend": "watch",
+    "build_trajectory": "trend",
+    "render_trajectory": "trend",
+    "trajectory_data": "trend",
 }
 
 __all__ = ["log", "get_logger"] + sorted(_LAZY)
@@ -72,7 +77,7 @@ __all__ = ["log", "get_logger"] + sorted(_LAZY)
 # resolves to the module — never to a same-named function inside it).
 _SUBMODULES = frozenset({
     "runlog", "steps", "trace", "summarize", "memory", "profiler",
-    "compare", "watch", "logging_shim",
+    "compare", "watch", "trend", "logging_shim",
 })
 
 

@@ -6,11 +6,12 @@ tool with an exit code, so bench/CI can *gate* on it:
     apnea-uq telemetry compare BASELINE CANDIDATE [--threshold-pct 5]
 
 ``BASELINE``/``CANDIDATE`` are each either a bench capture (a
-``BENCH_r*.json`` file — the driver-schema line bench.py prints) or a
-telemetry run directory (``events.jsonl``; the latest run of an appended
-log).  Metrics are extracted into one namespace, deltas computed per
-metric, and a delta that *worsens* past its threshold is a regression:
-the comparator (and the CLI) report nonzero.
+``BENCH_r*.json`` file — the driver-schema line bench.py prints, v1 or
+the schema-v2 per-block payload) or a telemetry run directory
+(``events.jsonl``; the latest run of an appended log).  Metrics are
+extracted into one namespace, deltas computed per metric, and a delta
+that *worsens* past its threshold is a regression: the comparator (and
+the CLI) report nonzero.
 
 Direction is inferred from the metric's unit — throughput (``.../sec``)
 higher-is-better, seconds/bytes lower-is-better — so a faster candidate
@@ -19,6 +20,15 @@ higher-is-better; override per metric with ``--metric-direction
 NAME=lower`` (``per_metric_direction`` programmatically) when that is
 wrong — without it, an unknown-unit lower-is-better metric could never
 regress.
+
+CPU-proxy captures (``proxy: true`` in the v2 payload — the bench ran
+its backend-independent blocks off-TPU) gate only *relative* and
+host-side metrics across the proxy boundary: when exactly one side of a
+comparison is a proxy capture, backend-bound absolute metrics (device
+throughput, device wall-clock, compiled HBM peaks, compile seconds) are
+dropped from the comparison and listed as skipped, never compared
+cross-backend.  Two proxy captures (or two device captures) compare
+everything.
 """
 
 from __future__ import annotations
@@ -26,7 +36,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from apnea_uq_tpu.telemetry.runlog import (EVENTS_FILENAME, latest_run,
                                            read_events)
@@ -35,21 +45,33 @@ DEFAULT_THRESHOLD_PCT = 5.0
 
 
 class NoComparableMetrics(ValueError):
-    """A source parsed cleanly but carries nothing gateable — e.g. a
-    ``bench_error`` capture (a run that never measured anything).  The
-    CLI maps this to the usage-error exit code (2), distinct from exit 1
-    = a real regression: a gate fed an error capture must fail the
+    """A comparison has nothing gateable — a source parsed cleanly but
+    carries no metrics (e.g. a ``bench_error`` capture: a run that never
+    measured anything), or no metric exists on both sides (including
+    after the proxy-boundary backend-bound drop).  The CLI maps this to
+    the usage-error exit code (2), distinct from exit 1 = a real
+    regression: a gate that cannot compare a single block must fail the
     *invocation*, never report a clean pass over zero metrics."""
 
 
 @dataclasses.dataclass
 class Metric:
-    """One comparable scalar: name, value, direction."""
+    """One comparable scalar: name, value, direction.
+
+    ``backend_bound`` marks absolute numbers tied to the backend OR
+    operating point that produced them — device throughput/wall-clock,
+    compiled HBM peaks, compile seconds, and the shape-derived volumes
+    and host-load costs (CPU-proxy mode shrinks the shape knobs, so
+    those absolutes differ by orders of magnitude from a device round's
+    purely from the shrink).  They are dropped when one side of a
+    comparison is a CPU-proxy capture and the other is not; relative
+    ratios and fixed-shape facts stay comparable."""
 
     name: str
     value: float
     unit: Optional[str] = None
     higher_better: bool = True
+    backend_bound: bool = False
 
 
 @dataclasses.dataclass
@@ -79,6 +101,12 @@ class Comparison:
     deltas: List[MetricDelta]
     only_in_baseline: List[str]
     only_in_candidate: List[str]
+    baseline_proxy: bool = False
+    candidate_proxy: bool = False
+    # Backend-bound absolute metrics refused across the proxy boundary
+    # (one side ran off-TPU in CPU-proxy mode): listed, never compared.
+    skipped_backend_bound: List[str] = dataclasses.field(
+        default_factory=list)
 
     @property
     def regressions(self) -> List[MetricDelta]:
@@ -101,39 +129,144 @@ def unit_direction(unit: Optional[str]) -> bool:
     return True
 
 
-def _metrics_from_bench_doc(doc: Dict[str, Any]) -> Dict[str, Metric]:
-    """The driver-schema blocks of one BENCH_r*.json line: primary +
-    optional secondary metric values and their vs_baseline speedups.
-    Two wrappers are unwrapped first: a BENCH_PROGRESS_FILE capture's
-    ``{"primary": {...}, "secondary": {...}}``, and the watch/driver
-    capture shape that stores the parsed stdout line under ``"parsed"``
-    (the repo's archived BENCH_r*.json files) — in both cases the
-    wrapped blocks must gate exactly like the printed line (extracting
-    only part of a wrapper would silently pass a regressed metric).
+# Headline records that are payload envelopes, not measurements: the
+# give-up line (bench_error), and the v2 block-count headlines a proxy
+# or mcd-less capture prints in the driver schema so its stdout line
+# stays parseable (value = ok-block count, unit "blocks").
+_HEADLINE_NON_METRICS = ("bench_error", "bench_cpu_proxy", "bench_partial")
 
-    ``bench_error`` records (the give-up line every failed capture
-    prints: value 0, unit "error") are NOT metrics — comparing two of
-    them would "pass" on the constant zero — so they are skipped here
-    and surface upstream as :class:`NoComparableMetrics`."""
+
+def _normalize_bench_doc(
+    doc: Dict[str, Any],
+) -> Tuple[Dict[str, Any], bool]:
+    """Unwrap the capture shapes onto one headline document and pull the
+    v2 ``proxy`` flag.  Wrappers handled: the watch/driver capture that
+    stores the parsed stdout line under ``"parsed"`` (the archived
+    BENCH_r*.json files) and the BENCH_PROGRESS_FILE capture's
+    ``{"primary": ..., "secondary": ...}`` — in both cases the wrapped
+    blocks must gate exactly like the printed line (extracting only part
+    of a wrapper would silently pass a regressed metric)."""
     if isinstance(doc.get("parsed"), dict):
         doc = doc["parsed"]
+    proxy = bool(doc.get("proxy"))
     if isinstance(doc.get("primary"), dict):
         merged = dict(doc["primary"])
         if "secondary" not in merged and isinstance(doc.get("secondary"),
                                                     dict):
             merged["secondary"] = doc["secondary"]
         doc = merged
+    return doc, proxy
+
+
+def _metrics_from_context(ctx: Any) -> Dict[str, Metric]:
+    """Gateable scalars of a capture's ``context`` blocks (v1 context or
+    the v2 payload's block values): the relative ratios and host-side
+    costs every round carries regardless of headline, so proxy rounds
+    and device rounds share a comparable namespace.  A block degraded to
+    an ``{"error": ...}`` field contributes nothing."""
+    out: Dict[str, Metric] = {}
+    if not isinstance(ctx, dict):
+        return out
+
+    def ok(name: str) -> Optional[Dict[str, Any]]:
+        v = ctx.get(name)
+        return v if isinstance(v, dict) and "error" not in v else None
+
+    def put(name: str, value: Any, unit: str, higher: bool,
+            *, bound: bool = False) -> None:
+        if isinstance(value, (int, float)):
+            out[name] = Metric(name, float(value), unit, higher,
+                               backend_bound=bound)
+
+    put("mcd.achieved_tflops", ctx.get("achieved_tflops"), "tflops/s",
+        True, bound=True)
+    boot = ok("bootstrap_b100_m293k")
+    if boot:
+        put("bootstrap.speedup", boot.get("speedup"), "ratio", True)
+    streamed = ok("streamed_overhead")
+    if streamed:
+        # Streamed-vs-in-HBM overhead: the ratio GROWING is the
+        # regression, so lower-is-better despite the ratio unit.
+        put("streamed.mcd_streamed_vs_inhbm",
+            streamed.get("mcd_streamed_vs_inhbm"), "ratio", False)
+        put("streamed.de10_streamed_vs_inhbm",
+            streamed.get("de10_streamed_vs_inhbm"), "ratio", False)
+    fused = ok("fused_reduction")
+    if fused:
+        put("fused.fused_vs_full", fused.get("fused_vs_full"), "ratio",
+            False)
+        # Shape-derived volumes: meaningful only among rounds at the
+        # same operating point -> bound.
+        put("fused.d2h_bytes_fused", fused.get("d2h_bytes_fused"),
+            "bytes", False, bound=True)
+        put("fused.d2h_bytes_full", fused.get("d2h_bytes_full"),
+            "bytes", False, bound=True)
+    comp = ok("compile")
+    if comp:
+        put("compile.cold_vs_warm_total", comp.get("cold_vs_warm_total"),
+            "ratio", True)
+        put("compile.cold_vs_warm_wall", comp.get("cold_vs_warm_wall"),
+            "ratio", True)
+    audit = ok("program_audit")
+    if audit:
+        # Same audit.<label>.flops namespace the run-dir program_audit
+        # events gate under — the two sources stay comparable.
+        for label, facts in sorted((audit.get("programs") or {}).items()):
+            if isinstance(facts, dict):
+                put(f"audit.{label}.flops", facts.get("flops"), "flops",
+                    False)
+    data = ok("data_plane")
+    if data:
+        # Host-side but row-count-dependent: a proxy round loads 256
+        # rows where a device round loads 32768, so the absolute
+        # seconds are operating-point-bound; the per-row rates stay
+        # roughly comparable but are kept bound too (page-cache and
+        # shard-count effects do not scale linearly).
+        put("data_plane.npz_load_s", data.get("npz_load_s"), "load_s",
+            False, bound=True)
+        put("data_plane.store_open_s", data.get("store_open_s"),
+            "load_s", False, bound=True)
+        put("data_plane.store_stream_s", data.get("store_stream_s"),
+            "load_s", False, bound=True)
+        put("data_plane.npz_rows_per_s", data.get("npz_rows_per_s"),
+            "rows/sec", True, bound=True)
+        put("data_plane.store_rows_per_s", data.get("store_rows_per_s"),
+            "rows/sec", True, bound=True)
+    d2h = ok("d2h_accounting")
+    if d2h:
+        put("d2h.bytes_full", d2h.get("d2h_bytes_full"), "bytes", False,
+            bound=True)
+        put("d2h.bytes_fused", d2h.get("d2h_bytes_fused"), "bytes",
+            False, bound=True)
+    return out
+
+
+def _metrics_from_bench_doc(doc: Dict[str, Any]) -> Dict[str, Metric]:
+    """The gateable metrics of one BENCH_r*.json capture: the
+    driver-schema primary + optional secondary metric values (marked
+    backend-bound) and their vs_baseline speedups, plus the relative /
+    host-side context metrics (:func:`_metrics_from_context`).
+
+    ``bench_error`` records (the give-up line every failed capture
+    prints: value 0, unit "error") and the v2 block-count headlines are
+    NOT metrics — comparing two of them would "pass" on a constant — so
+    they are skipped here; a capture with nothing else surfaces upstream
+    as :class:`NoComparableMetrics`."""
+    doc, _proxy = _normalize_bench_doc(doc)
     out: Dict[str, Metric] = {}
 
     def block(d: Dict[str, Any]) -> None:
         name = d.get("metric")
         if not name or d.get("value") is None:
             return
-        if name == "bench_error" or d.get("unit") == "error":
+        if name in _HEADLINE_NON_METRICS or d.get("unit") in ("error",
+                                                              "blocks"):
             return
         unit = d.get("unit")
+        # The headline value is an absolute device measurement
+        # (windows/sec/chip, train wall-clock): backend-bound.
         out[name] = Metric(name, float(d["value"]), unit,
-                           unit_direction(unit))
+                           unit_direction(unit), backend_bound=True)
         if isinstance(d.get("vs_baseline"), (int, float)):
             out[f"{name}.vs_baseline"] = Metric(
                 f"{name}.vs_baseline", float(d["vs_baseline"]), "ratio",
@@ -143,7 +276,19 @@ def _metrics_from_bench_doc(doc: Dict[str, Any]) -> Dict[str, Metric]:
     block(doc)
     if isinstance(doc.get("secondary"), dict):
         block(doc["secondary"])
+        sec_ctx = doc["secondary"].get("context")
+        if isinstance(sec_ctx, dict):
+            out.update(_metrics_from_context(sec_ctx))
+    out.update(_metrics_from_context(doc.get("context")))
     return out
+
+
+def bench_doc_proxy(doc: Dict[str, Any]) -> bool:
+    """Whether a bench capture document is a CPU-proxy round (one
+    unwrap path — :func:`_normalize_bench_doc` — so the flag can never
+    diverge from what the metric extraction saw)."""
+    _doc, proxy = _normalize_bench_doc(doc)
+    return proxy
 
 
 def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
@@ -164,7 +309,7 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
             name = e.get("metric") or f"bench.{e.get('role', '?')}"
             unit = e.get("unit")
             out[name] = Metric(name, float(e["value"]), unit,
-                               unit_direction(unit))
+                               unit_direction(unit), backend_bound=True)
             if isinstance(e.get("vs_baseline"), (int, float)):
                 out[f"{name}.vs_baseline"] = Metric(
                     f"{name}.vs_baseline", float(e["vs_baseline"]),
@@ -173,12 +318,13 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
         elif kind == "bench_throughput" and e.get("windows_per_s"):
             name = f"{e.get('metric', 'bench')}.windows_per_s"
             out[name] = Metric(name, float(e["windows_per_s"]),
-                               "windows/sec", True)
+                               "windows/sec", True, backend_bound=True)
         elif kind == "eval_predict":
             if e.get("windows_per_s"):
                 name = f"eval.{e.get('label', '?')}.windows_per_s"
                 out[name] = Metric(name, float(e["windows_per_s"]),
-                                   "windows/sec", True)
+                                   "windows/sec", True,
+                                   backend_bound=True)
             if e.get("d2h_bytes") is not None:
                 # Estimated device->host result volume of the predict —
                 # the fused-reduction win (bytes: lower is better), so a
@@ -192,18 +338,23 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
             # seconds to first batch and peak host RSS, both
             # lower-is-better per artifact key — so a store falling back
             # to whole-set materialization gates like a speed regression.
+            # Row-count-dependent absolutes -> operating-point-bound
+            # (a proxy bench run loads smoke-shape sets).
             if e.get("load_s") is not None:
                 name = f"data.{e.get('key', '?')}.load_s"
                 out[name] = Metric(name, float(e["load_s"]), "load_s",
-                                   False)
+                                   False, backend_bound=True)
             if e.get("rss_bytes") is not None:
                 name = f"data.{e.get('key', '?')}.rss_bytes"
                 out[name] = Metric(name, float(e["rss_bytes"]),
-                                   "rss_bytes", False)
+                                   "rss_bytes", False,
+                                   backend_bound=True)
         elif kind == "memory_profile" and e.get("peak_bytes") is not None:
+            # Compiled for a specific backend: cross-backend comparison
+            # of the peak is meaningless -> backend_bound.
             name = f"memory.{e.get('label', '?')}.peak_bytes"
             out[name] = Metric(name, float(e["peak_bytes"]), "bytes",
-                               False)
+                               False, backend_bound=True)
         elif kind == "program_audit":
             # The IR-level cost of one zoo program (`apnea-uq audit
             # --run-dir`): FLOPs and bytes accessed, both lower-is-better
@@ -224,16 +375,20 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
                               + (e.get("compile_s") or 0.0))
     if compile_n:
         out["compile.total_s"] = Metric(
-            "compile.total_s", round(compile_total, 6), "seconds", False)
+            "compile.total_s", round(compile_total, 6), "seconds", False,
+            backend_bound=True)
         out["compile.hit_ratio"] = Metric(
             "compile.hit_ratio", round(compile_hits / compile_n, 4),
             "ratio", True)
     return out
 
 
-def load_metrics(path: str) -> Dict[str, Metric]:
+def load_source(
+    path: str,
+) -> Tuple[Dict[str, Metric], Dict[str, Any]]:
     """Extract the comparable metrics of ``path`` — a BENCH_r*.json file
-    or a telemetry run directory (latest run of an appended log)."""
+    or a telemetry run directory (latest run of an appended log) — plus
+    source facts: ``{"kind": "bench"|"run_dir", "proxy": bool}``."""
     if os.path.isdir(path):
         events = read_events(path)
         if not events:
@@ -243,6 +398,13 @@ def load_metrics(path: str) -> Dict[str, Metric]:
             )
         events, _earlier = latest_run(events)
         metrics = _metrics_from_events(events)
+        # A proxy bench run stamps its mode into its own run dir
+        # (bench_mode event), so run-directory sources carry the same
+        # proxy provenance as the JSON payload — without it, a proxy
+        # run dir would compare its smoke-shape absolutes straight
+        # against device numbers.
+        dir_proxy = any(e.get("kind") == "bench_mode" and e.get("proxy")
+                        for e in events)
         if not metrics:
             # Same contract as the bench-JSON branch: a source with
             # nothing gateable is a usage error, never a clean pass
@@ -254,7 +416,7 @@ def load_metrics(path: str) -> Dict[str, Metric]:
                 f"memory-peak, compile-cost, data-load, or "
                 f"program-audit metrics"
             )
-        return metrics
+        return metrics, {"kind": "run_dir", "proxy": dir_proxy}
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
@@ -274,6 +436,13 @@ def load_metrics(path: str) -> Dict[str, Metric]:
         raise NoComparableMetrics(
             f"no comparable metrics in source {path!r}: {detail}"
         )
+    return metrics, {"kind": "bench", "proxy": bench_doc_proxy(doc)}
+
+
+def load_metrics(path: str) -> Dict[str, Metric]:
+    """Extract the comparable metrics of ``path`` — a BENCH_r*.json file
+    or a telemetry run directory (latest run of an appended log)."""
+    metrics, _info = load_source(path)
     return metrics
 
 
@@ -323,14 +492,30 @@ def compare_paths(
     per_metric_threshold: Optional[Dict[str, float]] = None,
     per_metric_direction: Optional[Dict[str, bool]] = None,
 ) -> Comparison:
-    baseline = load_metrics(baseline_path)
-    candidate = load_metrics(candidate_path)
+    baseline, b_info = load_source(baseline_path)
+    candidate, c_info = load_source(candidate_path)
+    skipped: List[str] = []
+    if b_info["proxy"] != c_info["proxy"]:
+        # One side is a CPU-proxy capture: absolute backend-bound
+        # numbers must not be compared cross-backend — drop them from
+        # BOTH sides and report them as skipped.
+        merged = dict(candidate)
+        merged.update(baseline)
+        skipped = sorted(n for n, m in merged.items() if m.backend_bound)
+        baseline = {n: m for n, m in baseline.items()
+                    if not m.backend_bound}
+        candidate = {n: m for n, m in candidate.items()
+                     if not m.backend_bound}
     common = set(baseline) & set(candidate)
     if not common:
-        raise ValueError(
+        proxy_note = (
+            " (after dropping backend-bound metrics "
+            f"{skipped} across the proxy boundary)" if skipped else ""
+        )
+        raise NoComparableMetrics(
             f"no common metrics between {baseline_path!r} "
             f"({sorted(baseline)}) and {candidate_path!r} "
-            f"({sorted(candidate)})"
+            f"({sorted(candidate)}){proxy_note}"
         )
     return Comparison(
         baseline_path=baseline_path,
@@ -342,6 +527,9 @@ def compare_paths(
         ),
         only_in_baseline=sorted(set(baseline) - common),
         only_in_candidate=sorted(set(candidate) - common),
+        baseline_proxy=b_info["proxy"],
+        candidate_proxy=c_info["proxy"],
+        skipped_backend_bound=skipped,
     )
 
 
@@ -360,18 +548,23 @@ def comparison_data(comparison: Comparison) -> Dict[str, Any]:
     return {
         "baseline": comparison.baseline_path,
         "candidate": comparison.candidate_path,
+        "baseline_proxy": comparison.baseline_proxy,
+        "candidate_proxy": comparison.candidate_proxy,
         "regressed": bool(comparison.regressions),
         "deltas": deltas,
         "only_in_baseline": comparison.only_in_baseline,
         "only_in_candidate": comparison.only_in_candidate,
+        "skipped_backend_bound": comparison.skipped_backend_bound,
     }
 
 
 def render_comparison(comparison: Comparison) -> str:
     """Human-readable delta table, regressions flagged."""
     lines = [
-        f"baseline:  {comparison.baseline_path}",
-        f"candidate: {comparison.candidate_path}",
+        f"baseline:  {comparison.baseline_path}"
+        + (" [cpu-proxy]" if comparison.baseline_proxy else ""),
+        f"candidate: {comparison.candidate_path}"
+        + (" [cpu-proxy]" if comparison.candidate_proxy else ""),
         "",
     ]
     header = ("metric", "baseline", "candidate", "delta", "threshold",
@@ -400,6 +593,11 @@ def render_comparison(comparison: Comparison) -> str:
         if names:
             lines.append("")
             lines.append(f"{label}: {', '.join(names)}")
+    if comparison.skipped_backend_bound:
+        lines.append("")
+        lines.append(
+            "skipped (backend-bound, refused across the cpu-proxy "
+            "boundary): " + ", ".join(comparison.skipped_backend_bound))
     lines.append("")
     n_reg = len(comparison.regressions)
     lines.append(f"regressions: {n_reg or 'none'}")

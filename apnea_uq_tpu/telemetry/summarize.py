@@ -212,6 +212,26 @@ def _render_ingest(progress: List[Dict[str, Any]]) -> List[str]:
     return [line]
 
 
+def _render_bench_blocks(blocks: List[Dict[str, Any]]) -> List[str]:
+    """The per-block status trail from ``bench_block`` events (bench.py's
+    isolated block runner): one line per block with its outcome, so a
+    partially-failed capture's shape is readable without re-parsing the
+    payload JSON."""
+    lines = ["bench blocks:"]
+    for e in blocks:
+        line = f"  {e.get('name', '?')}: {e.get('status', '?')}"
+        if e.get("seconds") is not None:
+            line += f" in {e['seconds']:g}s"
+        if e.get("reason"):
+            line += f" ({e['reason']})"
+        if e.get("error_tail"):
+            tail = e["error_tail"].strip().splitlines()
+            if tail:
+                line += f" — {tail[-1][:120]}"
+        lines.append(line)
+    return lines
+
+
 def _compile_aggregate(comps: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Roll-up of a run's compile_event stream: acquisition count, hit
     ratio (store/cache vs fresh jit compiles), and the total
@@ -266,6 +286,8 @@ _PROGRAM_AUDIT_FIELDS = (
 _DATA_LOAD_FIELDS = (
     "key", "artifact_kind", "mmap", "rows", "bytes", "load_s",
     "rss_bytes")
+_BENCH_BLOCK_FIELDS = (
+    "name", "status", "seconds", "error_tail", "reason")
 _INGEST_PROGRESS_FIELDS = (
     "done", "total", "skipped", "rows", "rows_per_s", "bytes_written",
     "rss_bytes")
@@ -397,6 +419,11 @@ def summarize_events(run_dir: str,
         lines.append("")
         lines.extend(_render_data_loads(loads))
 
+    bench_blocks = _section(events, "bench_block", _BENCH_BLOCK_FIELDS)
+    if bench_blocks:
+        lines.append("")
+        lines.extend(_render_bench_blocks(bench_blocks))
+
     errors = [e for e in events if e.get("kind") == "error"]
     lines.append("")
     if errors:
@@ -484,6 +511,7 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
         "compile_events": compile_events,
         "compile": _compile_aggregate(compile_events),
         "data_loads": section("data_load", _DATA_LOAD_FIELDS),
+        "bench_blocks": section("bench_block", _BENCH_BLOCK_FIELDS),
         "ingest_progress": section("ingest_progress",
                                    _INGEST_PROGRESS_FIELDS),
         "errors": section("error", ("where", "error")),

@@ -25,7 +25,15 @@ early on tunneled/remote TPU platforms (observed: a 1.1-TFLOP matmul
 bytes so the tunnel's bandwidth doesn't pollute a compute measurement.
 
 Prints ONE json line with the primary metric in the driver's schema
-({"metric", "value", "unit", "vs_baseline"}) plus the extra fields above.
+({"metric", "value", "unit", "vs_baseline"}) plus the extra fields above
+AND the result-v2 envelope (docs/OBSERVABILITY.md "Bench result payload
+v2"): "schema": 2, a "backend" facts section, a "proxy" flag, and a
+per-block "blocks" status map ({status: ok|error|skipped|unavailable,
+seconds, error_tail}) — every measurement runs as an ISOLATED block, so
+one raising block degrades to a per-block error status instead of
+sinking the whole capture ("bench_error" is now only the total-failure
+shape: watchdog fire or an init abort, and even those fold whatever
+per-block checkpoints survived into the payload).
 Every metric block is ALSO checkpointed to an on-disk progress file
 (BENCH_PROGRESS_FILE, default ./bench_progress.json, "" disables) the
 moment it is measured, and the final line is assembled from that file —
@@ -52,8 +60,24 @@ BENCH_WASTE_EPOCHS for the early-stop-waste context's epoch cap (0
 skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
 BENCH_WATCHDOG_SECS to change or disable (0) the hang watchdog
 (default 45 min), BENCH_INIT_WAIT_SECS to change or disable (0) the
-backend-init retry budget (default 25 min; BENCH_INIT_PROBE_SECS caps
-each individual probe, default 2 min), BENCH_RUN_DIR for the telemetry
+backend-init retry budget (default 25 min; BENCH_BACKEND_BUDGET_S is
+the same budget under its watch-era name and wins when both are set;
+BENCH_INIT_PROBE_SECS caps each individual probe, default 2 min;
+BENCH_BACKEND_PROBES caps the probe COUNT, 0 = budget-only — each
+probe attempt is also replayed into the run log as a `probe` telemetry
+event, so the r03-r05 tunnel-outage pattern is diagnosable from
+events.jsonl instead of one error string),
+BENCH_CPU_PROXY for the CPU-proxy capture mode: =1 forces it, unset
+auto-selects it when the init probe budget is exhausted (the r03-r05
+condition), =0 forbids the automatic fallback and restores the exit-2
+abort.  Proxy mode retargets jax to CPU, shrinks the shape knobs to the
+smoke operating point, runs ONLY the backend-independent blocks
+(compile cold/warm, data plane, program audit, D2H accounting — device
+blocks report status "unavailable"), and marks the payload
+"proxy": true so `telemetry compare` refuses cross-backend
+absolute-throughput comparisons while still gating the relative
+metrics.  BENCH_PLATFORM wins over BENCH_CPU_PROXY when both are set.
+BENCH_RUN_DIR for the telemetry
 run directory (default ./bench_run; "" falls back to a temp dir — the
 run log is never disabled, because the DE context block is *sourced*
 from its ensemble_fit events; read it back with
@@ -80,9 +104,15 @@ import jax
 # Must precede any device use: the environment's sitecustomize forces
 # JAX_PLATFORMS=axon at interpreter start, so an env var alone cannot
 # retarget the bench — only this config update can (the same dance
-# tests/conftest.py does for the CPU test rig).
+# tests/conftest.py does for the CPU test rig).  An explicit
+# BENCH_CPU_PROXY=1 is the same dance toward CPU; the automatic
+# exhaustion-triggered variant applies it in _resolve_backend instead
+# (still before any device use in this process — probes run in
+# subprocesses).
 if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+elif os.environ.get("BENCH_CPU_PROXY", "") not in ("", "0"):
+    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np
@@ -102,12 +132,45 @@ _CHIP_PEAK_TFLOPS = {
 }
 
 
+# The result-v2 payload contract (docs/OBSERVABILITY.md "Bench result
+# payload v2"): schema-versioned, always parseable, per-block statuses.
+RESULT_SCHEMA_VERSION = 2
+
+# CPU-proxy mode (ISSUE 11 tentpole, piece 2): set once by main() after
+# backend resolution; every knob-default helper below consults it so a
+# proxy capture shrinks to the smoke operating point automatically.
+_PROXY = [False]
+
+
+def _proxy_active() -> bool:
+    return bool(_PROXY[0])
+
+
+def _set_proxy(on: bool) -> None:
+    _PROXY[0] = bool(on)
+
+
 def _bench_dtype() -> str:
     """Compute dtype for both timed model paths (default the TPU operating
     point, bf16 on the MXU).  BENCH_DTYPE=float32 exists for the CPU smoke
     run — CPU backends emulate bf16 convolutions orders of magnitude too
-    slowly to execute the bench logic at any size."""
-    return os.environ.get("BENCH_DTYPE", "bfloat16")
+    slowly to execute the bench logic at any size — and is the CPU-proxy
+    default for the same reason."""
+    explicit = os.environ.get("BENCH_DTYPE")
+    if explicit:
+        return explicit
+    return "float32" if _proxy_active() else "bfloat16"
+
+
+def _shape_knobs() -> tuple:
+    """(windows, passes, chunk) for the MCD-shaped blocks.  Defaults are
+    the TPU operating point; CPU-proxy mode shrinks them to the smoke
+    shapes (the compile probe subprocesses execute the real programs at
+    these sizes off-TPU).  Env knobs win in both modes."""
+    dw, dp, dc = (256, 4, 64) if _proxy_active() else (32768, 50, 512)
+    return (int(os.environ.get("BENCH_WINDOWS", dw)),
+            int(os.environ.get("BENCH_PASSES", dp)),
+            int(os.environ.get("BENCH_CHUNK", dc)))
 
 
 def _progress_path() -> str:
@@ -193,55 +256,135 @@ def _last_ensemble_fit_event(run_log) -> dict:
     return fits[-1]
 
 
-def _emit_bench_error(msg: str) -> None:
+def _emit_bench_error(msg: str, *, this_run: bool = True) -> None:
     """The driver-schema error line; shared by every give-up path (init
-    retry exhaustion, hang watchdog) so the parsers downstream see one
-    shape."""
-    # The driver-schema stdout contract: this line must be raw stdout,
-    # not telemetry.log (which an active run log would also mirror and
-    # narration_to_stderr would redirect away from the parser).
-    # apnea-lint: disable=bare-print -- bench stdout IS the machine interface; see one-JSON-line contract in tests/test_bench_smoke.py
-    print(json.dumps({
+    retry exhaustion with the proxy fallback forbidden, hang watchdog)
+    so the parsers downstream see one shape.  Whatever per-block
+    checkpoints survived in BENCH_PROGRESS_FILE are folded into the
+    payload — a hang after N good blocks still reports N blocks, and
+    `telemetry compare` can gate the survived metrics.
+
+    ``this_run=False`` marks the progress file as a PREVIOUS run's
+    (the init-abort path fires before ``_progress_reset``): the content
+    is still preserved under ``prior_progress`` — never discarded — but
+    not as this run's blocks/primary, so a stale capture can never gate
+    as fresh evidence or count as surviving blocks downstream."""
+    doc = {
         "metric": "bench_error",
         "value": 0,
         "unit": "error",
         "vs_baseline": 0,
         "error": msg,
-    }), flush=True)
+        "schema": RESULT_SCHEMA_VERSION,
+    }
+    saved = _progress_read()
+    if this_run:
+        for key in ("proxy", "backend", "blocks", "primary",
+                    "secondary"):
+            if saved.get(key) is not None:
+                doc[key] = saved[key]
+        # Context values checkpointed before a headline existed (proxy
+        # mode / dead mcd block) ride at top level; compare extracts
+        # them like any capture's context.
+        if saved.get("context") and not saved.get("primary"):
+            doc["context"] = saved["context"]
+    elif saved:
+        doc["prior_progress"] = saved
+    # The driver-schema stdout contract: this line must be raw stdout,
+    # not telemetry.log (which an active run log would also mirror and
+    # narration_to_stderr would redirect away from the parser).
+    # apnea-lint: disable=bare-print -- bench stdout IS the machine interface; see one-JSON-line contract in tests/test_bench_smoke.py
+    print(json.dumps(doc), flush=True)
 
 
-def _wait_for_backend() -> None:
-    """Retry backend init until it works or a budget expires (r4 verdict:
+def _resolve_backend() -> tuple:
+    """Decide what backend this capture runs against; returns
+    ``(proxy, probe_records)`` where each probe record is the
+    ``{attempt, green, detail}`` shape the `probe` telemetry event
+    carries (main replays them into the run log once one exists).
+
+    Retry backend init until it works or a budget expires (r4 verdict:
     the round-4 capture died in seconds on a fast ``UNAVAILABLE`` from a
-    flapping tunnel, and the watchdog only covers the *hang* failure mode).
+    flapping tunnel, and the watchdog only covers the *hang* failure
+    mode).  The probe loop itself — ``jax.devices()`` in a budgeted
+    subprocess (the call can hang indefinitely during a tunnel outage,
+    so it must not run in this process), backoff between failures, the
+    final sleep clamped to the remaining budget — lives in
+    telemetry/watch.py (``wait_for_green``), where ``apnea-uq telemetry
+    watch`` reuses it as the tunnel-watcher.  Budget:
+    BENCH_BACKEND_BUDGET_S, falling back to BENCH_INIT_WAIT_SECS
+    (default 25 min, 0 disables); per-probe cap BENCH_INIT_PROBE_SECS;
+    probe-count cap BENCH_BACKEND_PROBES (0 = budget-only).
 
-    The probe loop itself — ``jax.devices()`` in a budgeted subprocess
-    (the call can hang indefinitely during a tunnel outage, so it must
-    not run in this process), backoff between failures, the final sleep
-    clamped to the remaining budget — lives in telemetry/watch.py
-    (``wait_for_green``), where ``apnea-uq telemetry watch`` reuses it as
-    the tunnel-watcher.  Budget: BENCH_INIT_WAIT_SECS (default 25 min, 0
-    disables), per-probe cap BENCH_INIT_PROBE_SECS.  On exhaustion, emit
-    the standard error JSON line and exit non-zero.  Skipped entirely
-    under BENCH_PLATFORM (an explicitly retargeted backend, e.g. the CPU
-    smoke run, has no tunnel to wait for)."""
+    On exhaustion the capture degrades to CPU-proxy mode (the r03-r05
+    rounds each lost a whole PR's evidence to this abort) unless
+    BENCH_CPU_PROXY=0 pins the old behavior — then the standard error
+    JSON line (with surviving progress folded in) is emitted and the
+    process exits 2.  Skipped entirely under BENCH_PLATFORM (an
+    explicitly retargeted backend has no tunnel to wait for) and under
+    an explicit BENCH_CPU_PROXY=1 (proxy was requested, not probed
+    into)."""
     from apnea_uq_tpu.telemetry.watch import wait_for_green
 
     if os.environ.get("BENCH_PLATFORM"):
-        return
-    budget = float(os.environ.get("BENCH_INIT_WAIT_SECS", 1500))
+        return False, []
+    cpu_proxy = os.environ.get("BENCH_CPU_PROXY", "")
+    if cpu_proxy not in ("", "0"):
+        return True, []
+    budget = float(os.environ.get("BENCH_BACKEND_BUDGET_S")
+                   or os.environ.get("BENCH_INIT_WAIT_SECS", 1500))
     if budget <= 0:
-        return
+        return False, []
     probe_timeout = float(os.environ.get("BENCH_INIT_PROBE_SECS", 120))
+    max_probes = int(os.environ.get("BENCH_BACKEND_PROBES", 0))
+    records = []
+
+    def on_attempt(n: int, green: bool, detail: str) -> None:
+        records.append({"attempt": n, "green": green, "detail": detail})
+
     green, attempts, last = wait_for_green(
-        budget, probe_timeout_s=probe_timeout
+        budget, probe_timeout_s=probe_timeout,
+        max_attempts=max_probes or None, on_attempt=on_attempt,
     )
     if green:
-        return
-    _emit_bench_error(
-        f"TPU backend unavailable after {attempts} init probes "
-        f"over {budget:.0f}s; last: {last}"
-    )
+        return False, records
+    msg = (f"TPU backend unavailable after {attempts} init probes "
+           f"over {budget:.0f}s; last: {last}")
+    if cpu_proxy == "0":
+        _abort_unavailable(msg, records)
+    # Auto-proxy (the tentpole's point): the same config update the
+    # explicit modes perform, still before any device use in this
+    # process (every probe ran in a subprocess).
+    jax.config.update("jax_platforms", "cpu")
+    return True, records
+
+
+def _abort_unavailable(msg: str, records: list) -> None:
+    """The forbidden-proxy give-up path: leave the probe trail in the
+    run log (no run_started topology probe — jax.devices() against the
+    dead backend is exactly what hangs), emit the folded error payload,
+    exit 2."""
+    from apnea_uq_tpu.telemetry.runlog import SCHEMA_VERSION, RunLog
+
+    run_dir = os.environ.get("BENCH_RUN_DIR", "bench_run")
+    if not run_dir:
+        # Same contract as _bench_run_log: "" means a temp dir, never a
+        # disabled log — the probe trail IS the outage diagnosis.
+        import tempfile
+
+        run_dir = tempfile.mkdtemp(prefix="bench_run_")
+    run_log = RunLog(run_dir)
+    run_log.event("run_started", schema_version=SCHEMA_VERSION,
+                  stage="bench",
+                  topology={"platform": "unavailable"})
+    for record in records:
+        run_log.event("probe", **record)
+    run_log.event("error", where="backend", error=msg)
+    run_log.close(status="error")
+    # No block of THIS run has executed yet, so anything in the
+    # progress file is a previous run's capture: preserve it as
+    # prior_progress, never as this run's blocks.
+    _emit_bench_error(msg, this_run=False)
     sys.exit(2)
 
 
@@ -390,11 +533,10 @@ def bench_de_train(progress_key: str = "secondary") -> dict:
         },
     }
     _progress_record(progress_key, result)
-    result["context"]["early_stop_waste"] = _guarded(
-        lambda: bench_de_earlystop_waste(model, x, y, batch),
-        skip=int(os.environ.get("BENCH_WASTE_EPOCHS", 12)) <= 0,
-    )
-    return result
+    # The early-stop-waste measurement is its own isolated block now
+    # (main's orchestrator runs it with this state and attaches the
+    # value under context.early_stop_waste).
+    return result, {"model": model, "x": x, "y": y, "batch": batch}
 
 
 def bench_de_earlystop_waste(model, x, y, batch: int) -> dict:
@@ -481,16 +623,74 @@ def bench_bootstrap(n_windows: int, n_boot: int = 100, n_chain: int = 10) -> dic
     }
 
 
-def _guarded(fn, *, skip: bool = False):
-    """Run a secondary context block, degrading failure to a recorded
-    error so the primary metric still prints (the main() watchdog covers
-    hangs; this covers raises)."""
-    if skip:
-        return None
+def _run_block(run_log, blocks: dict, name: str, fn, *,
+               skip: bool = False, unavailable: bool = False,
+               reason: str = None):
+    """Run ONE bench block in isolation (the tentpole's promotion of the
+    old ``_guarded`` helper): the block's outcome is recorded as a
+    status record {status: ok|error|skipped|unavailable, seconds,
+    error_tail, reason} in ``blocks``, mirrored as a ``bench_block``
+    telemetry event, and checkpointed to the progress file — so one
+    raising block degrades to a per-block error instead of sinking the
+    capture (the main() watchdog still covers hangs).  Returns the
+    block's value, or None for any non-ok outcome."""
+    value = None
+    if unavailable:
+        # The backend this block needs is absent (CPU-proxy mode).
+        rec = {"status": "unavailable"}
+        if reason:
+            rec["reason"] = reason
+    elif skip:
+        rec = {"status": "skipped"}
+        if reason:
+            rec["reason"] = reason
+    else:
+        t0 = time.perf_counter()
+        try:
+            value = fn()
+            rec = {"status": "ok",
+                   "seconds": round(time.perf_counter() - t0, 3)}
+        except Exception as e:  # noqa: BLE001 — a block must not kill the bench
+            import traceback
+
+            rec = {"status": "error",
+                   "seconds": round(time.perf_counter() - t0, 3),
+                   "error_tail":
+                       "".join(traceback.format_exception(e))[-800:]}
+            run_log.error(f"block:{name}", e)
+    blocks[name] = rec
+    run_log.event("bench_block", name=name, **rec)
+    _progress_record("blocks", blocks)
+    return value
+
+
+def _ctx_entry(blocks: dict, name: str, value):
+    """A block's slot in the payload ``context`` section: the measured
+    value when ok, a degraded ``{"error": ...}`` field when it raised
+    (the shape the pre-v2 ``_guarded`` consumers expect), None when the
+    block was skipped or the backend unavailable."""
+    rec = blocks.get(name) or {}
+    if rec.get("status") == "ok":
+        return value
+    if rec.get("status") == "error":
+        return {"error": rec.get("error_tail", "").strip()
+                .splitlines()[-1] if rec.get("error_tail") else "error"}
+    return None
+
+
+def _backend_facts(proxy: bool) -> dict:
+    """The payload's ``backend`` section: what backend this capture
+    actually ran against (vs what was requested), so a proxy round can
+    never masquerade as a device round."""
     try:
-        return fn()
-    except Exception as e:  # noqa: BLE001 — context must not kill the bench
-        return {"error": f"{type(e).__name__}: {e}"}
+        dev = jax.devices()[0]
+        facts = {"platform": dev.platform, "device_kind": dev.device_kind}
+    except Exception as e:  # noqa: BLE001 — facts are best-effort
+        facts = {"platform": "unavailable",
+                 "error": f"{type(e).__name__}: {e}"}
+    facts["requested"] = (os.environ.get("BENCH_PLATFORM")
+                          or ("cpu-proxy" if proxy else "default"))
+    return facts
 
 
 def bench_streamed(model, variables, x_host, n_passes, chunk) -> dict:
@@ -618,6 +818,10 @@ def bench_compile_startup(n_windows: int, n_passes: int, chunk: int) -> dict:
     ]
     if os.environ.get("BENCH_PLATFORM"):
         cmd += ["--platform", os.environ["BENCH_PLATFORM"]]
+    elif _proxy_active():
+        # CPU-proxy: the probe subprocesses inherit the tunnel-pinned
+        # env, so they need the same explicit retarget this process got.
+        cmd += ["--platform", "cpu"]
 
     def run_probe() -> dict:
         t0 = time.perf_counter()
@@ -763,10 +967,9 @@ def bench_mcd() -> dict:
 
     # Env knobs allow a small-shape smoke run on CPU (BENCH_WINDOWS=256
     # BENCH_PASSES=4 BENCH_CHUNK=64); defaults are the TPU operating point
-    # (chunk 512 measured fastest on v5e; 2048 exceeds HBM at T=50).
-    n_windows = int(os.environ.get("BENCH_WINDOWS", 32768))
-    n_passes = int(os.environ.get("BENCH_PASSES", 50))
-    chunk = int(os.environ.get("BENCH_CHUNK", 512))
+    # (chunk 512 measured fastest on v5e; 2048 exceeds HBM at T=50),
+    # shrunk to the smoke shapes in CPU-proxy mode.
+    n_windows, n_passes, chunk = _shape_knobs()
 
     rng = np.random.default_rng(2025)
     x = jnp.asarray(rng.normal(size=(n_windows, 60, 4)), jnp.float32)
@@ -884,57 +1087,33 @@ def bench_mcd() -> dict:
     }
     # The headline number is banked on disk BEFORE the context blocks run:
     # a backend death inside a context measurement (the one mid-run window
-    # the init retry + watchdog don't cover) can no longer lose it.
+    # the init retry + watchdog don't cover) can no longer lose it.  The
+    # context blocks themselves run as ISOLATED blocks in main's
+    # orchestrator, which needs this state to time the streamed/fused
+    # variants at the exact shapes the headline ran.
     _progress_record("primary", result)
-    # Bootstrap engines at the reference test-set scale (~293K windows,
-    # SURVEY §1), where the exact engine's gather cost is representative
-    # (BENCH_BOOT_WINDOWS shrinks it for smoke runs).
-    result["context"]["bootstrap_b100_m293k"] = _guarded(lambda: bench_bootstrap(
-        int(os.environ.get("BENCH_BOOT_WINDOWS", 293_000))))
-    _progress_record("primary", result)
-    # Host-streamed vs in-HBM inference at the same shapes — the measured
-    # cost of the HBM-exceeding-set scaling path.  A context block must
-    # never sink the primary metric (the r3 bench shipped nothing because
-    # one failure took down the whole run), so failures degrade to an
-    # error field.
-    result["context"]["streamed_overhead"] = _guarded(
-        lambda: bench_streamed(
-            model, variables, np.asarray(x), n_passes, chunk
-        ),
-        skip=bool(os.environ.get("BENCH_SKIP_STREAMED")),
-    )
-    # Fused on-device UQ reduction vs the full (T, M) round-trip at the
-    # same shapes — the measured D2H win behind the eval default
-    # (UQConfig.fused_reduction).
-    result["context"]["fused_reduction"] = _guarded(
-        lambda: bench_fused(model, variables, np.asarray(x), n_passes,
-                            chunk),
-        skip=bool(os.environ.get("BENCH_SKIP_FUSED")),
-    )
-    _progress_record("primary", result)
-    # Cold-vs-warm process start (persistent compile cache + program
-    # store) at the bench shapes — the startup cost the compile-cost
-    # subsystem removes, measured as two real process starts.
-    result["context"]["compile"] = _guarded(
-        lambda: bench_compile_startup(n_windows, n_passes, chunk),
-        skip=bool(os.environ.get("BENCH_SKIP_COMPILE")),
-    )
-    # Static IR audit of the inference zoo (CPU subprocess, no device
-    # time): the capture records whether the programs behind this
-    # round's numbers still honor the lowered-IR promises.
-    result["context"]["program_audit"] = _guarded(
-        bench_program_audit,
-        skip=bool(os.environ.get("BENCH_SKIP_AUDIT")),
-    )
-    # Out-of-core data plane: cold stage-start load of the same window
-    # set as monolithic .npz vs sharded memmap store (+ one streamed
-    # pass), host-only — no device time.
-    result["context"]["data_plane"] = _guarded(
-        lambda: bench_data_plane(n_windows, chunk),
-        skip=bool(os.environ.get("BENCH_SKIP_DATA")),
-    )
-    _progress_record("primary", result)
-    return result
+    state = {"model": model, "variables": variables,
+             "x": np.asarray(x), "n_passes": n_passes, "chunk": chunk}
+    return result, state
+
+
+def bench_d2h_accounting(n_windows: int, n_passes: int) -> dict:
+    """Backend-independent D2H volume accounting of the fused reduction:
+    the exact device->host byte contract of one eval at the configured
+    shapes — full (T, M) probability matrix vs the fused (4, M)
+    sufficient-statistics stack — derived from shapes alone, so the
+    CPU-proxy mode can gate the transfer contract with no device."""
+    from apnea_uq_tpu.uq.metrics import N_STAT_ROWS
+
+    full = n_passes * n_windows * 4
+    fused = N_STAT_ROWS * n_windows * 4
+    return {
+        "windows": n_windows,
+        "passes": n_passes,
+        "d2h_bytes_full": full,
+        "d2h_bytes_fused": fused,
+        "reduction_factor": round(full / fused, 3),
+    }
 
 
 def _start_watchdog():
@@ -968,8 +1147,11 @@ def _start_watchdog():
 def _record_metric_event(run_log, result: dict, role: str) -> None:
     """Mirror one driver-schema metric block into the run log, so the
     telemetry capture carries the same headline numbers the JSON line
-    prints (``telemetry summarize`` shows both sides of a run)."""
-    if not isinstance(result, dict):
+    prints (``telemetry summarize`` shows both sides of a run).  The v2
+    block-count headlines (unit "blocks": a proxy or mcd-less capture's
+    parseable stand-in) are payload envelopes, not measurements — they
+    must not land as gateable bench_metric events."""
+    if not isinstance(result, dict) or result.get("unit") == "blocks":
         return
     run_log.event(
         "bench_metric", role=role, metric=result.get("metric"),
@@ -978,10 +1160,203 @@ def _record_metric_event(run_log, result: dict, role: str) -> None:
     )
 
 
+def _run_bench(run_log, proxy: bool) -> dict:
+    """Orchestrate the bench as isolated blocks and assemble the
+    result-v2 payload.  Device blocks are marked ``unavailable`` in
+    CPU-proxy mode; the backend-independent blocks (compile, data
+    plane, program audit, D2H accounting) run either way, so the exact
+    r03-r05 condition still yields a gateable capture."""
+    blocks: dict = {}
+    state: dict = {}
+    ctx_values: dict = {}
+    n_windows, n_passes, chunk = _shape_knobs()
+    backend = _backend_facts(proxy)
+    _progress_record("schema", RESULT_SCHEMA_VERSION)
+    _progress_record("proxy", proxy)
+    _progress_record("backend", backend)
+    # The run dir's own record of the capture mode, so run-directory
+    # sources carry the same proxy provenance the JSON payload does
+    # (compare/trend refuse cross-backend absolutes for dirs too).
+    run_log.event("bench_mode", proxy=proxy,
+                  platform=backend.get("platform"),
+                  requested=backend.get("requested"))
+
+    de_only = os.environ.get("BENCH_METRIC") == "de_train"
+    waste_skip = int(os.environ.get("BENCH_WASTE_EPOCHS", 12)) <= 0
+
+    def run(name, fn, *, device=False, skip=False, reason=None):
+        return _run_block(run_log, blocks, name, fn, skip=skip,
+                          unavailable=device and proxy, reason=reason)
+
+    primary = secondary = None
+
+    def attach(ctx_key, block_name, value):
+        """Land one context block's value in the payload AND the
+        progress file the moment it exists (the pre-v2 per-block
+        re-record contract: a watchdog fire after N good context blocks
+        must not lose their measured values — the folded error payload
+        still gates them)."""
+        ctx_values[ctx_key] = _ctx_entry(blocks, block_name, value)
+        if primary is not None:
+            primary.setdefault("context", {})[ctx_key] = \
+                ctx_values[ctx_key]
+            _progress_record("primary", primary)
+        else:
+            # No device headline yet (proxy mode / dead mcd block):
+            # checkpoint the growing context on its own key; the error
+            # and final payload paths both fold it back in.
+            _progress_record("context", ctx_values)
+    if de_only:
+        def de_primary():
+            result, waste_state = bench_de_train("primary")
+            state["waste"] = waste_state
+            return result
+
+        primary = run("de_train", de_primary, device=True)
+        for name in ("mcd", "bootstrap", "streamed", "fused", "compile",
+                     "program_audit", "data_plane", "d2h_accounting"):
+            run(name, None, skip=True, reason="BENCH_METRIC=de_train")
+    else:
+        def mcd():
+            result, mcd_state = bench_mcd()
+            state["mcd"] = mcd_state
+            return result
+
+        primary = run("mcd", mcd, device=True)
+        boot = run(
+            "bootstrap",
+            lambda: bench_bootstrap(
+                int(os.environ.get("BENCH_BOOT_WINDOWS", 293_000))),
+            device=True,
+        )
+        attach("bootstrap_b100_m293k", "bootstrap", boot)
+        ms = state.get("mcd")
+        dep_gone = ms is None and not proxy
+        streamed = run(
+            "streamed",
+            (lambda: bench_streamed(ms["model"], ms["variables"],
+                                    ms["x"], ms["n_passes"], ms["chunk"]))
+            if ms else None,
+            device=True,
+            skip=bool(os.environ.get("BENCH_SKIP_STREAMED")) or dep_gone,
+            reason="mcd block did not complete" if dep_gone else None,
+        )
+        attach("streamed_overhead", "streamed", streamed)
+        fused = run(
+            "fused",
+            (lambda: bench_fused(ms["model"], ms["variables"], ms["x"],
+                                 ms["n_passes"], ms["chunk"]))
+            if ms else None,
+            device=True,
+            skip=bool(os.environ.get("BENCH_SKIP_FUSED")) or dep_gone,
+            reason="mcd block did not complete" if dep_gone else None,
+        )
+        attach("fused_reduction", "fused", fused)
+
+        def de():
+            result, waste_state = bench_de_train("secondary")
+            state["waste"] = waste_state
+            return result
+
+        secondary = run("de_train", de, device=True,
+                        skip=bool(os.environ.get("BENCH_SKIP_DE")),
+                        reason="BENCH_SKIP_DE"
+                        if os.environ.get("BENCH_SKIP_DE") else None)
+
+    ws = state.get("waste")
+    if waste_skip:
+        waste_reason = None
+    elif os.environ.get("BENCH_SKIP_DE") and not de_only:
+        waste_reason = "BENCH_SKIP_DE"  # deliberate, not a failure
+    elif ws is None and not proxy:
+        waste_reason = "de_train block did not complete"
+    else:
+        waste_reason = None
+    waste = run(
+        "earlystop_waste",
+        (lambda: bench_de_earlystop_waste(ws["model"], ws["x"], ws["y"],
+                                          ws["batch"])) if ws else None,
+        device=True,
+        skip=waste_skip or (ws is None and not proxy),
+        reason=waste_reason,
+    )
+
+    if not de_only:
+        # Backend-independent blocks: exactly what a CPU-proxy round
+        # can still measure (compile cold/warm through the persistent
+        # cache + program store, the host-side data plane, the IR-level
+        # audit, and the arithmetic D2H contract).
+        compile_v = run(
+            "compile",
+            lambda: bench_compile_startup(n_windows, n_passes, chunk),
+            skip=bool(os.environ.get("BENCH_SKIP_COMPILE")))
+        attach("compile", "compile", compile_v)
+        audit_v = run("program_audit", bench_program_audit,
+                      skip=bool(os.environ.get("BENCH_SKIP_AUDIT")))
+        attach("program_audit", "program_audit", audit_v)
+        data_v = run("data_plane",
+                     lambda: bench_data_plane(n_windows, chunk),
+                     skip=bool(os.environ.get("BENCH_SKIP_DATA")))
+        attach("data_plane", "data_plane", data_v)
+        d2h_v = run("d2h_accounting",
+                    lambda: bench_d2h_accounting(n_windows, n_passes))
+        attach("d2h_accounting", "d2h_accounting", d2h_v)
+
+    n_ok = sum(1 for r in blocks.values() if r.get("status") == "ok")
+    headline = primary
+    if headline is None:
+        # No device headline (proxy mode, or the mcd/de block died):
+        # the stdout line still needs the driver schema, so a
+        # block-count stand-in keeps it parseable.  compare treats the
+        # "blocks" unit as an envelope, never a metric.  The context
+        # values attach() checkpointed along the way fold in here.
+        headline = {
+            "metric": "bench_cpu_proxy" if proxy else "bench_partial",
+            "value": n_ok,
+            "unit": "blocks",
+            "vs_baseline": 0,
+        }
+        if not de_only:
+            headline["context"] = dict(ctx_values)
+    waste_home = primary if de_only else secondary
+    if waste_home is not None:
+        waste_home.setdefault("context", {})["early_stop_waste"] = (
+            _ctx_entry(blocks, "earlystop_waste", waste))
+    _progress_record("primary", headline)
+    if secondary is not None:
+        _progress_record("secondary", secondary)
+
+    payload = dict(headline)
+    if secondary is not None:
+        payload["secondary"] = secondary
+    payload["schema"] = RESULT_SCHEMA_VERSION
+    payload["proxy"] = proxy
+    payload["backend"] = backend
+    payload["blocks"] = blocks
+    return payload
+
+
+def _payload_from_progress(fallback: dict) -> dict:
+    """The final line is assembled FROM the progress file (when
+    enabled), so the printed result and the crash-surviving on-disk
+    capture are one and the same artifact and cannot drift."""
+    saved = _progress_read()
+    if not saved.get("primary"):
+        return fallback
+    payload = dict(saved["primary"])
+    if isinstance(saved.get("secondary"), dict):
+        payload["secondary"] = saved["secondary"]
+    for key in ("schema", "proxy", "backend", "blocks"):
+        if saved.get(key) is not None:
+            payload[key] = saved[key]
+    return payload
+
+
 def main() -> None:
     from apnea_uq_tpu.telemetry.logging_shim import narration_to_stderr
 
-    _wait_for_backend()
+    proxy, probe_records = _resolve_backend()
+    _set_proxy(proxy)
     watchdog = _start_watchdog()
     _progress_reset()
     # stdout is this script's machine interface — exactly one JSON line.
@@ -991,24 +1366,16 @@ def main() -> None:
     # and are unaffected.
     with narration_to_stderr():
         run_log = _bench_run_log()
+        # Replay the init-probe trail into the run log (it could not be
+        # open during the wait: opening it probes device topology, and
+        # jax.devices() against a flapping tunnel is the hang the probes
+        # exist to avoid) — the watch autopilot's diagnosable pattern.
+        for record in probe_records:
+            run_log.event("probe", **record)
+        if probe_records and probe_records[-1].get("green"):
+            run_log.event("probe_green", attempts=len(probe_records))
         try:
-            if os.environ.get("BENCH_METRIC") == "de_train":
-                result = _progress_record("primary",
-                                          bench_de_train("primary"))
-            else:
-                result = _progress_record("primary", bench_mcd())
-                if not os.environ.get("BENCH_SKIP_DE"):
-                    result["secondary"] = _progress_record(
-                        "secondary", bench_de_train("secondary"))
-            # The final line is assembled FROM the progress file (when
-            # enabled), so the printed result and the crash-surviving
-            # on-disk capture are one and the same artifact and cannot
-            # drift.
-            saved = _progress_read()
-            if saved.get("primary"):
-                result = saved["primary"]
-                if "secondary" in saved:
-                    result["secondary"] = saved["secondary"]
+            result = _payload_from_progress(_run_bench(run_log, proxy))
             _record_metric_event(run_log, result, "primary")
             if isinstance(result.get("secondary"), dict):
                 _record_metric_event(run_log, result["secondary"],
@@ -1017,11 +1384,18 @@ def main() -> None:
             run_log.error("bench", e)
             run_log.close(status="error")
             raise
-        run_log.close()
+        n_ok = sum(1 for r in (result.get("blocks") or {}).values()
+                   if isinstance(r, dict) and r.get("status") == "ok")
+        run_log.close(status="ok" if n_ok else "error")
     if watchdog is not None:
         watchdog.cancel()
     # apnea-lint: disable=bare-print -- the ONE result line of the stdout machine contract (driver schema); must not route through telemetry.log
     print(json.dumps(result))
+    if n_ok == 0:
+        # Nothing measured: the one remaining whole-capture failure
+        # shape (every block errored/skipped) — same exit code as the
+        # historical init-retry exhaustion.
+        sys.exit(2)
 
 
 if __name__ == "__main__":

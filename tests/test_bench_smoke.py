@@ -10,11 +10,16 @@ that impossible:
   JSON line carries the full schema — primary metric, DE secondary, and
   the streamed-overhead + bootstrap context blocks with no degraded
   ``error`` fields;
-* the ``_wait_for_backend`` unit tests cover the init retry loop added
+* the ``_resolve_backend`` unit tests cover the init retry loop added
   for the *fast-fail* outage mode (r4's capture died in seconds on
   ``UNAVAILABLE``): transient failures retry with backoff, an exhausted
-  budget emits the standard ``bench_error`` JSON line and exits 2, and
-  explicit platform overrides skip the probe entirely.
+  budget degrades to the CPU-proxy capture (BENCH_CPU_PROXY=0 restores
+  the exit-2 abort, now folding surviving progress into the error
+  payload), and explicit platform overrides skip the probe entirely;
+* the block-isolation tests force blocks to raise and assert the
+  result-v2 payload stays parseable with per-block statuses, and that
+  ``telemetry compare`` gates the surviving blocks (exit 2 only when
+  NO block is comparable).
 """
 
 import glob
@@ -83,6 +88,17 @@ def test_readme_smoke_recipe_pins_every_smoke_knob():
     assert "apnea-uq flow" in readme, (
         "README smoke recipe lost the `apnea-uq flow` gate; the "
         "pipeline dataflow check is part of the pre-capture ritual"
+    )
+    # The CPU-proxy recipe (ISSUE 11): the off-TPU capture mode that
+    # keeps the perf trajectory alive through tunnel outages, plus the
+    # trajectory ledger that reads it back.
+    assert "BENCH_CPU_PROXY=1 python bench.py" in readme, (
+        "README lost the CPU-proxy smoke recipe "
+        "(`BENCH_CPU_PROXY=1 python bench.py`)"
+    )
+    assert "apnea-uq telemetry trend" in readme, (
+        "README lost the `apnea-uq telemetry trend` trajectory-ledger "
+        "recipe"
     )
 
 
@@ -189,14 +205,38 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert audit_ctx["clean"] is True and audit_ctx["unsuppressed"] == 0
     for label in ("mcd_predict_fused", "de_predict_fused", "predict_eval"):
         assert audit_ctx["programs"][label]["flops"] > 0, (label, audit_ctx)
+    # D2H-accounting block (ISSUE 11): the arithmetic transfer contract
+    # at the run's shapes, present even when no device ran.
+    d2h_ctx = ctx["d2h_accounting"]
+    assert d2h_ctx["d2h_bytes_full"] == 4 * 256 * 4
+    assert d2h_ctx["d2h_bytes_fused"] == 4 * 256 * 4
+
+    # Result-v2 envelope (ISSUE 11): schema-versioned payload with
+    # backend facts and a per-block status map, every block ok on the
+    # full smoke run.
+    assert result["schema"] == 2
+    assert result["proxy"] is False
+    assert result["backend"]["platform"] == "cpu"
+    assert result["backend"]["requested"] == "cpu"
+    blocks = result["blocks"]
+    assert {n for n, b in blocks.items() if b["status"] == "ok"} == {
+        "mcd", "bootstrap", "streamed", "fused", "de_train",
+        "earlystop_waste", "compile", "program_audit", "data_plane",
+        "d2h_accounting"}, blocks
+    assert all(b["seconds"] >= 0 for b in blocks.values()), blocks
 
     # The printed line was assembled from the on-disk progress capture:
-    # the two artifacts are the same result by construction.
+    # the two artifacts are the same result by construction (the v2
+    # envelope keys live beside primary/secondary in the progress file).
     with open(progress) as f:
         saved = json.load(f)
     assert saved["secondary"] == sec
-    primary_only = {k: v for k, v in result.items() if k != "secondary"}
+    primary_only = {k: v for k, v in result.items()
+                    if k not in ("secondary", "schema", "proxy",
+                                 "backend", "blocks")}
     assert saved["primary"] == primary_only
+    assert saved["blocks"] == blocks
+    assert saved["schema"] == 2 and saved["proxy"] is False
 
     # The run's telemetry event log (BENCH_RUN_DIR) captured the whole
     # bench: stages bracketed, per-epoch ensemble step metrics with
@@ -209,7 +249,13 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     kinds = {e["kind"] for e in events}
     assert {"run_started", "stage_start", "stage_end", "step",
             "ensemble_epoch", "ensemble_fit", "bench_throughput",
-            "bench_metric", "run_finished"} <= kinds, sorted(kinds)
+            "bench_metric", "bench_block", "run_finished"} <= kinds, \
+        sorted(kinds)
+    # Every block's outcome is mirrored into the run log as it happens.
+    block_events = {e["name"]: e["status"] for e in events
+                    if e["kind"] == "bench_block"}
+    assert block_events == {n: "ok" for n in result["blocks"]}, \
+        block_events
     assert events[-1] == {**events[-1], "kind": "run_finished",
                           "status": "ok"}
     stages = {e["stage"] for e in events if e["kind"] == "stage_start"}
@@ -251,6 +297,7 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert "hbm (compiled memory analysis):" in text
     assert "ensemble_epoch" in text
     assert "profiler traces:" in text
+    assert "bench blocks:" in text and "  mcd: ok" in text
 
     # The regression gate closes the loop on the same artifacts: the
     # capture against itself is clean (exit 0), and an injected -50%
@@ -267,6 +314,85 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
         json.dump(worse, f)
     assert cli_main(["telemetry", "compare", baseline, baseline]) == 0
     assert cli_main(["telemetry", "compare", baseline, regressed]) == 1
+
+
+@pytest.mark.slow  # two compile-probe subprocesses + the audit lowering
+def test_bench_cpu_proxy_end_to_end(tmp_path, capsys):
+    """The ISSUE 11 acceptance path: with the TPU backend absent (the
+    exact r03-r05 condition, here entered explicitly via
+    BENCH_CPU_PROXY=1 — the auto-selection on probe exhaustion is
+    unit-tested in TestResolveBackend), `python bench.py` exits 0 with
+    a schema-v2 payload whose backend-independent blocks are all ok,
+    `telemetry compare` gates the relative metrics against a prior
+    round, and `telemetry trend` renders r01-r05 plus the new round."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+           and not k.startswith("BENCH_")}
+    env["BENCH_CPU_PROXY"] = "1"
+    env["BENCH_PROGRESS_FILE"] = str(tmp_path / "progress.json")
+    env["BENCH_RUN_DIR"] = str(tmp_path / "bench_run")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(__file__), ".jax_cache"))
+    proc = subprocess.run(
+        [sys.executable, BENCH], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, f"proxy bench failed:\n{proc.stderr[-3000:]}"
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE json line, got: {proc.stdout!r}"
+    result = json.loads(lines[0])
+
+    # Schema-v2 proxy payload, still in the driver schema.
+    assert result["schema"] == 2 and result["proxy"] is True
+    assert result["metric"] == "bench_cpu_proxy"
+    assert result["unit"] == "blocks" and result["value"] >= 3
+    assert result["backend"]["platform"] == "cpu"
+    assert result["backend"]["requested"] == "cpu-proxy"
+    statuses = {n: b["status"] for n, b in result["blocks"].items()}
+    # >= 3 ok blocks including compile, data-plane, audit (the
+    # acceptance floor), plus the arithmetic D2H contract.
+    for name in ("compile", "data_plane", "program_audit",
+                 "d2h_accounting"):
+        assert statuses[name] == "ok", statuses
+    # Device blocks are unavailable, not errors.
+    for name in ("mcd", "bootstrap", "streamed", "fused", "de_train"):
+        assert statuses[name] == "unavailable", statuses
+    compile_ctx = result["context"]["compile"]
+    assert compile_ctx["warm"]["persistent_cache_misses"] == 0
+    assert result["context"]["data_plane"]["rows"] == 256  # proxy shapes
+
+    # compare: clean against itself, gating a worsened relative metric,
+    # and refusing absolute throughput across the proxy boundary.
+    from apnea_uq_tpu.cli.main import main as cli_main
+
+    payload = tmp_path / "proxy_round.json"
+    payload.write_text(lines[0])
+    worse_doc = json.loads(lines[0])
+    worse_doc["context"]["compile"]["cold_vs_warm_total"] /= 2
+    worse = tmp_path / "proxy_worse.json"
+    worse.write_text(json.dumps(worse_doc))
+    assert cli_main(["telemetry", "compare", str(payload),
+                     str(payload)]) == 0
+    assert cli_main(["telemetry", "compare", str(payload),
+                     str(worse)]) == 1
+    capsys.readouterr()
+    r02 = os.path.join(REPO, "BENCH_r02.json")
+    if os.path.exists(r02):
+        # The archived device round shares no backend-independent
+        # metrics with a proxy round -> exit 2 (refused), never a bogus
+        # cross-backend throughput comparison.
+        with pytest.raises(SystemExit) as exc:
+            cli_main(["telemetry", "compare", r02, str(payload)])
+        assert exc.value.code == 2
+        out = capsys.readouterr().out
+        assert "backend-bound" in out or "no common metrics" in out
+
+    # trend: the trajectory covers r01-r05 plus the new round.
+    assert cli_main(["telemetry", "trend", str(payload)]) == 0
+    text = capsys.readouterr().out
+    for label in ("r01[ok]", "r02[ok]", "r03[error]", "r04[error]",
+                  "r05[error]", "proxy_round[proxy]"):
+        assert label in text, text
 
 
 @pytest.mark.slow  # real bench subprocess up to the primary metric
@@ -376,31 +502,49 @@ class TestProgressFile:
 @pytest.fixture(scope="module")
 def bench_mod():
     # exec_module runs bench.py's top level IN THIS PROCESS; an ambient
-    # BENCH_PLATFORM would make it jax.config.update the suite's global
-    # platform mid-run, so shield it for the import (module-scope fixture,
-    # so no monkeypatch — restore by hand).
-    saved = os.environ.pop("BENCH_PLATFORM", None)
+    # BENCH_PLATFORM (or BENCH_CPU_PROXY, which triggers the same
+    # config update) would make it jax.config.update the suite's global
+    # platform mid-run, so shield both for the import (module-scope
+    # fixture, so no monkeypatch — restore by hand).
+    saved = {k: os.environ.pop(k, None)
+             for k in ("BENCH_PLATFORM", "BENCH_CPU_PROXY")}
     try:
         spec = importlib.util.spec_from_file_location(
             "_bench_under_test", BENCH)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
     finally:
-        if saved is not None:
-            os.environ["BENCH_PLATFORM"] = saved
-    return mod
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+    yield mod
+    mod._set_proxy(False)  # never leak proxy state into other tests
 
 
 def _proc(rc: int, stderr: str = "") -> types.SimpleNamespace:
     return types.SimpleNamespace(returncode=rc, stderr=stderr, stdout="")
 
 
-class TestWaitForBackend:
+class TestResolveBackend:
+    """The init retry + CPU-proxy fallback (ISSUE 11 tentpole, piece 2):
+    transient failures retry with backoff, exhaustion now degrades to
+    the CPU-proxy capture instead of aborting (the exact r03-r05 loss),
+    BENCH_CPU_PROXY=0 restores the exit-2 abort WITH surviving progress
+    folded into the error payload, and the budget/probe-count knobs are
+    env-configurable."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_env(self, monkeypatch):
+        for k in ("BENCH_PLATFORM", "BENCH_CPU_PROXY",
+                  "BENCH_BACKEND_BUDGET_S", "BENCH_BACKEND_PROBES"):
+            monkeypatch.delenv(k, raising=False)
+        # Keep the abort path's probe run log out of the repo cwd.
+        monkeypatch.setenv("BENCH_RUN_DIR", "")
+
     def test_transient_unavailable_retries_then_succeeds(
         self, bench_mod, monkeypatch
     ):
         calls, sleeps = [], []
-        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
         monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "600")
 
         def fake_run(cmd, **kw):
@@ -412,43 +556,105 @@ class TestWaitForBackend:
 
         monkeypatch.setattr(subprocess, "run", fake_run)
         monkeypatch.setattr(time, "sleep", sleeps.append)
-        bench_mod._wait_for_backend()  # returns without raising
+        proxy, records = bench_mod._resolve_backend()
+        assert proxy is False
         assert len(calls) == 3
         assert sleeps == [20.0, 32.0]  # backoff between failed probes
+        # The probe trail is returned for replay into the run log.
+        assert [r["green"] for r in records] == [False, False, True]
+        assert records[0]["attempt"] == 1
 
-    def test_exhausted_budget_emits_error_json_and_exits(
+    def test_exhausted_budget_degrades_to_cpu_proxy(
         self, bench_mod, monkeypatch, capsys
     ):
-        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
         monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "1")
         monkeypatch.setattr(
             subprocess, "run",
             lambda cmd, **kw: _proc(1, "UNAVAILABLE: flapping tunnel"),
         )
-        # With sleep a no-op the loop spins probes until the 1s budget's
-        # monotonic deadline passes, then gives up with the error line.
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        config_updates = []
+        monkeypatch.setattr(bench_mod.jax.config, "update",
+                            lambda k, v: config_updates.append((k, v)))
+        proxy, records = bench_mod._resolve_backend()
+        assert proxy is True
+        assert records and not any(r["green"] for r in records)
+        assert "UNAVAILABLE: flapping tunnel" in records[-1]["detail"]
+        # The auto-proxy retargeted jax, and nothing printed to stdout
+        # (no bench_error line: the capture continues).
+        assert ("jax_platforms", "cpu") in config_updates
+        assert capsys.readouterr().out == ""
+
+    def test_cpu_proxy_zero_forbids_fallback_and_folds_progress(
+        self, bench_mod, monkeypatch, capsys, tmp_path
+    ):
+        """The old abort contract, opted back into — now preserving the
+        checkpoints that survived in BENCH_PROGRESS_FILE inside the
+        error payload (ISSUE 11 satellite 1).  The abort fires BEFORE
+        the per-run progress reset, so the surviving content is a
+        previous run's: it rides under prior_progress, never as this
+        run's blocks/primary (which compare/watch would gate as fresh
+        evidence)."""
+        monkeypatch.setenv("BENCH_CPU_PROXY", "0")
+        monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "1")
+        monkeypatch.setenv("BENCH_RUN_DIR", str(tmp_path / "rl"))
+        progress = tmp_path / "progress.json"
+        progress.write_text(json.dumps({
+            "blocks": {"compile": {"status": "ok", "seconds": 3.0}},
+            "primary": {"metric": "mcd_t50_inference_throughput",
+                        "value": 9000.0, "unit": "windows/sec/chip"},
+        }))
+        monkeypatch.setenv("BENCH_PROGRESS_FILE", str(progress))
+        monkeypatch.setattr(
+            subprocess, "run",
+            lambda cmd, **kw: _proc(1, "UNAVAILABLE: flapping tunnel"),
+        )
         monkeypatch.setattr(time, "sleep", lambda s: None)
         with pytest.raises(SystemExit) as exc:
-            bench_mod._wait_for_backend()
+            bench_mod._resolve_backend()
         assert exc.value.code == 2
         err = json.loads(capsys.readouterr().out.strip())
         assert err["metric"] == "bench_error"
         assert err["unit"] == "error"
         assert "UNAVAILABLE: flapping tunnel" in err["error"]
+        assert err["schema"] == 2
+        # The surviving checkpoints ride along under prior_progress —
+        # preserved, but never as THIS run's blocks (nothing ran yet).
+        assert "blocks" not in err and "primary" not in err
+        prior = err["prior_progress"]
+        assert prior["blocks"]["compile"]["status"] == "ok"
+        assert prior["primary"]["value"] == 9000.0
+        # And the probe trail landed in the run log, without a topology
+        # probe that could hang on the dead backend.
+        from apnea_uq_tpu import telemetry
 
-    def test_hang_mode_reported(self, bench_mod, monkeypatch, capsys):
-        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+        events = telemetry.read_events(str(tmp_path / "rl"))
+        assert [e["kind"] for e in events][:2] == ["run_started", "probe"]
+        assert events[-1] == {**events[-1], "kind": "run_finished",
+                              "status": "error"}
+
+    def test_explicit_cpu_proxy_skips_probe(self, bench_mod, monkeypatch):
+        def boom(cmd, **kw):  # pragma: no cover - must not run
+            raise AssertionError("probe must not run under BENCH_CPU_PROXY")
+
+        monkeypatch.setenv("BENCH_CPU_PROXY", "1")
+        monkeypatch.setattr(subprocess, "run", boom)
+        assert bench_mod._resolve_backend() == (True, [])
+
+    def test_hang_mode_reported_in_probe_trail(self, bench_mod,
+                                               monkeypatch):
         monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "1")
         monkeypatch.setattr(time, "sleep", lambda s: None)
+        monkeypatch.setattr(bench_mod.jax.config, "update",
+                            lambda k, v: None)
 
         def hang(cmd, **kw):
             raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 120))
 
         monkeypatch.setattr(subprocess, "run", hang)
-        with pytest.raises(SystemExit):
-            bench_mod._wait_for_backend()
-        err = json.loads(capsys.readouterr().out.strip())
-        assert "hung" in err["error"]
+        proxy, records = bench_mod._resolve_backend()
+        assert proxy is True
+        assert "hung" in records[-1]["detail"]
 
     def test_platform_override_skips_probe(self, bench_mod, monkeypatch):
         def boom(cmd, **kw):  # pragma: no cover - must not run
@@ -456,23 +662,104 @@ class TestWaitForBackend:
 
         monkeypatch.setenv("BENCH_PLATFORM", "cpu")
         monkeypatch.setattr(subprocess, "run", boom)
-        bench_mod._wait_for_backend()
+        assert bench_mod._resolve_backend() == (False, [])
 
     def test_zero_budget_disables(self, bench_mod, monkeypatch):
-        monkeypatch.delenv("BENCH_PLATFORM", raising=False)
         monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "0")
         monkeypatch.setattr(
             subprocess, "run",
             lambda cmd, **kw: (_ for _ in ()).throw(AssertionError),
         )
-        bench_mod._wait_for_backend()
+        assert bench_mod._resolve_backend() == (False, [])
+
+    def test_backend_budget_env_wins_over_init_wait(self, bench_mod,
+                                                    monkeypatch):
+        # BENCH_BACKEND_BUDGET_S=0 disables even with a nonzero
+        # BENCH_INIT_WAIT_SECS: the new knob is the one consulted first.
+        monkeypatch.setenv("BENCH_BACKEND_BUDGET_S", "0")
+        monkeypatch.setenv("BENCH_INIT_WAIT_SECS", "600")
+        monkeypatch.setattr(
+            subprocess, "run",
+            lambda cmd, **kw: (_ for _ in ()).throw(AssertionError),
+        )
+        assert bench_mod._resolve_backend() == (False, [])
+
+    def test_backend_probes_caps_attempt_count(self, bench_mod,
+                                               monkeypatch):
+        monkeypatch.setenv("BENCH_BACKEND_BUDGET_S", "600")
+        monkeypatch.setenv("BENCH_BACKEND_PROBES", "2")
+        calls = []
+        monkeypatch.setattr(
+            subprocess, "run",
+            lambda cmd, **kw: (calls.append(cmd)
+                               or _proc(1, "UNAVAILABLE")),
+        )
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        monkeypatch.setattr(bench_mod.jax.config, "update",
+                            lambda k, v: None)
+        proxy, records = bench_mod._resolve_backend()
+        assert proxy is True
+        assert len(calls) == 2 and len(records) == 2
+
+
+def _stub_blocks(bench_mod, monkeypatch, *, fail=(), values=None):
+    """Stub every heavy bench block with tiny dict payloads; block names
+    in ``fail`` raise instead.  Returns the value map for assertions."""
+    values = values or {}
+
+    def v(name, default):
+        return values.get(name, default)
+
+    def make(name, result, state=None):
+        def fn(*a, **k):
+            if name in fail:
+                raise RuntimeError(f"{name} boom")
+            return (result, state) if state is not None else result
+        return fn
+
+    monkeypatch.setattr(bench_mod, "bench_mcd", make(
+        "mcd",
+        v("mcd", {"metric": "mcd_t50_inference_throughput", "value": 100.0,
+                  "unit": "windows/sec/chip", "vs_baseline": 10.0}),
+        {"model": None, "variables": None, "x": None,
+         "n_passes": 4, "chunk": 64}))
+    monkeypatch.setattr(bench_mod, "bench_de_train", make(
+        "de_train",
+        v("de_train", {"metric": "de2_train_wallclock", "value": 2.0,
+                       "unit": "seconds", "vs_baseline": 3.0}),
+        {"model": None, "x": None, "y": None, "batch": 32}))
+    monkeypatch.setattr(bench_mod, "bench_bootstrap", make(
+        "bootstrap", v("bootstrap", {"speedup": 20.0})))
+    monkeypatch.setattr(bench_mod, "bench_streamed", make(
+        "streamed", v("streamed", {"mcd_streamed_vs_inhbm": 1.1,
+                                   "de10_streamed_vs_inhbm": 1.2})))
+    monkeypatch.setattr(bench_mod, "bench_fused", make(
+        "fused", v("fused", {"fused_vs_full": 0.8,
+                             "d2h_bytes_full": 4096,
+                             "d2h_bytes_fused": 4096})))
+    monkeypatch.setattr(bench_mod, "bench_de_earlystop_waste", make(
+        "earlystop_waste", v("earlystop_waste", {"patience": 5})))
+    monkeypatch.setattr(bench_mod, "bench_compile_startup", make(
+        "compile", v("compile", {"cold_vs_warm_total": 4.0})))
+    monkeypatch.setattr(bench_mod, "bench_program_audit", make(
+        "program_audit", v("program_audit", {
+            "clean": True, "unsuppressed": 0,
+            "programs": {"mcd_predict_fused": {"flops": 1000,
+                                               "arithmetic_intensity": 2}},
+        })))
+    monkeypatch.setattr(bench_mod, "bench_data_plane", make(
+        "data_plane", v("data_plane", {"npz_load_s": 0.5,
+                                       "store_rows_per_s": 1000.0})))
+    monkeypatch.setattr(bench_mod, "bench_d2h_accounting", make(
+        "d2h_accounting", v("d2h_accounting", {"d2h_bytes_full": 4096,
+                                               "d2h_bytes_fused": 4096})))
 
 
 class TestMainDispatch:
-    """main()'s metric routing and watchdog lifecycle, with the heavy
-    bench functions stubbed out — the only bench.py lines the CPU smoke
-    does not execute are the BENCH_METRIC=de_train and BENCH_SKIP_DE
-    branches."""
+    """main()'s block orchestration, metric routing, and watchdog
+    lifecycle, with the heavy bench blocks stubbed out — the branches
+    the CPU smoke run does not execute (BENCH_METRIC=de_train,
+    BENCH_SKIP_DE, and the per-block failure paths)."""
 
     @pytest.fixture(autouse=True)
     def stub(self, bench_mod, monkeypatch, tmp_path):
@@ -483,15 +770,16 @@ class TestMainDispatch:
                            str(tmp_path / "progress.json"))
         monkeypatch.setenv("BENCH_RUN_DIR", str(tmp_path / "bench_run"))
         # Every test starts from a clean knob state — ambient exported
-        # BENCH_METRIC/BENCH_SKIP_DE must not reroute the branch under
+        # BENCH_METRIC/BENCH_SKIP_* must not reroute the branch under
         # test (the same sanitization the subprocess smoke test does).
-        monkeypatch.delenv("BENCH_METRIC", raising=False)
-        monkeypatch.delenv("BENCH_SKIP_DE", raising=False)
-        monkeypatch.setattr(bench_mod, "bench_mcd", lambda: {"metric": "mcd"})
-        monkeypatch.setattr(
-            bench_mod, "bench_de_train",
-            lambda progress_key="secondary": {"metric": "de"})
+        for k in ("BENCH_METRIC", "BENCH_SKIP_DE", "BENCH_SKIP_STREAMED",
+                  "BENCH_SKIP_FUSED", "BENCH_SKIP_COMPILE",
+                  "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
+                  "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
+            monkeypatch.delenv(k, raising=False)
+        _stub_blocks(bench_mod, monkeypatch)
         self.bench_mod = bench_mod
+        self.tmp_path = tmp_path
 
     def _run(self, capsys):
         self.bench_mod.main()
@@ -499,19 +787,35 @@ class TestMainDispatch:
 
     def test_default_is_mcd_plus_de_secondary(self, capsys):
         out = self._run(capsys)
-        assert out["metric"] == "mcd"
-        assert out["secondary"]["metric"] == "de"
+        assert out["metric"] == "mcd_t50_inference_throughput"
+        assert out["secondary"]["metric"] == "de2_train_wallclock"
+        assert out["schema"] == 2 and out["proxy"] is False
+        ok = {n for n, b in out["blocks"].items() if b["status"] == "ok"}
+        assert ok == {"mcd", "bootstrap", "streamed", "fused", "de_train",
+                      "earlystop_waste", "compile", "program_audit",
+                      "data_plane", "d2h_accounting"}
+        assert out["context"]["bootstrap_b100_m293k"] == {"speedup": 20.0}
+        assert (out["secondary"]["context"]["early_stop_waste"]
+                == {"patience": 5})
 
     def test_skip_de_drops_secondary(self, monkeypatch, capsys):
         monkeypatch.setenv("BENCH_SKIP_DE", "1")
         out = self._run(capsys)
-        assert out["metric"] == "mcd"
+        assert out["metric"] == "mcd_t50_inference_throughput"
         assert "secondary" not in out
+        assert out["blocks"]["de_train"] == {"status": "skipped",
+                                             "reason": "BENCH_SKIP_DE"}
+        assert out["blocks"]["earlystop_waste"]["status"] == "skipped"
 
     def test_de_train_metric_runs_alone(self, monkeypatch, capsys):
         monkeypatch.setenv("BENCH_METRIC", "de_train")
         out = self._run(capsys)
-        assert out == {"metric": "de"}
+        assert out["metric"] == "de2_train_wallclock"
+        assert "secondary" not in out
+        assert out["blocks"]["de_train"]["status"] == "ok"
+        assert out["blocks"]["mcd"] == {"status": "skipped",
+                                        "reason": "BENCH_METRIC=de_train"}
+        assert out["context"]["early_stop_waste"] == {"patience": 5}
 
     def test_watchdog_cancelled_after_results(self, monkeypatch, capsys):
         cancelled = []
@@ -524,4 +828,135 @@ class TestMainDispatch:
             self.bench_mod, "_start_watchdog", lambda: Timer())
         self._run(capsys)
         assert cancelled == [True]
+
+
+class TestBlockIsolation:
+    """ISSUE 11 satellite 3: force blocks to raise and assert the
+    payload stays parseable, the other blocks keep their real values,
+    and `telemetry compare` gates the ok blocks — exiting 2 only when
+    NO block is comparable."""
+
+    @pytest.fixture(autouse=True)
+    def _env(self, bench_mod, monkeypatch, tmp_path):
+        monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+        monkeypatch.setenv("BENCH_PROGRESS_FILE",
+                           str(tmp_path / "progress.json"))
+        monkeypatch.setenv("BENCH_RUN_DIR", str(tmp_path / "bench_run"))
+        for k in ("BENCH_METRIC", "BENCH_SKIP_DE", "BENCH_SKIP_STREAMED",
+                  "BENCH_SKIP_FUSED", "BENCH_SKIP_COMPILE",
+                  "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
+                  "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
+            monkeypatch.delenv(k, raising=False)
+        self.bench_mod = bench_mod
+        self.tmp_path = tmp_path
+
+    def _run_to_file(self, capsys, name) -> str:
+        self.bench_mod.main()
+        line = capsys.readouterr().out.strip()
+        path = self.tmp_path / name
+        path.write_text(line)
+        return str(path)
+
+    def test_one_raising_block_degrades_to_its_status(
+        self, monkeypatch, capsys
+    ):
+        _stub_blocks(self.bench_mod, monkeypatch, fail=("bootstrap",))
+        self.bench_mod.main()  # exits 0: other blocks measured
+        out = json.loads(capsys.readouterr().out.strip())
+        # (a) the payload is parseable, in full driver schema.
+        assert out["metric"] == "mcd_t50_inference_throughput"
+        assert out["value"] == 100.0
+        # (b) the failed block carries its status + error tail; every
+        # other block reports ok with its real values.
+        boot = out["blocks"]["bootstrap"]
+        assert boot["status"] == "error"
+        assert "bootstrap boom" in boot["error_tail"]
+        assert boot["seconds"] >= 0
+        others = {n: b["status"] for n, b in out["blocks"].items()
+                  if n != "bootstrap"}
+        assert set(others.values()) == {"ok"}, others
+        assert out["context"]["bootstrap_b100_m293k"] == {
+            "error": "RuntimeError: bootstrap boom"}
+        assert out["context"]["data_plane"]["npz_load_s"] == 0.5
+        # The run log mirrors the per-block outcome.
+        from apnea_uq_tpu import telemetry
+
+        events = telemetry.read_events(str(self.tmp_path / "bench_run"))
+        block_events = {e["name"]: e["status"] for e in events
+                        if e["kind"] == "bench_block"}
+        assert block_events["bootstrap"] == "error"
+        assert block_events["compile"] == "ok"
+        # A run with a failed block still closes ok (blocks measured).
+        assert events[-1]["status"] == "ok"
+
+    def test_context_values_checkpoint_incrementally(
+        self, monkeypatch, capsys, tmp_path
+    ):
+        """The pre-v2 per-block re-record contract survives the block
+        runner: each context block's VALUE is on disk the moment it is
+        measured, so a watchdog fire after N good context blocks folds
+        N measured values — not just N ok statuses — into the error
+        payload."""
+        _stub_blocks(self.bench_mod, monkeypatch)
+        progress = self.tmp_path / "progress.json"
+        seen = {}
+
+        def spy(*a, **k):
+            # d2h_accounting is the LAST block: every earlier context
+            # value must already be checkpointed when it runs.
+            with open(progress) as f:
+                saved = json.load(f)
+            seen["ctx"] = dict(saved["primary"]["context"])
+            return {"d2h_bytes_full": 1, "d2h_bytes_fused": 1}
+
+        monkeypatch.setattr(self.bench_mod, "bench_d2h_accounting", spy)
+        self.bench_mod.main()
+        capsys.readouterr()
+        assert seen["ctx"]["bootstrap_b100_m293k"] == {"speedup": 20.0}
+        assert seen["ctx"]["compile"] == {"cold_vs_warm_total": 4.0}
+        assert seen["ctx"]["data_plane"]["npz_load_s"] == 0.5
+
+    def test_compare_gates_ok_blocks_of_partial_payload(
+        self, monkeypatch, capsys
+    ):
+        from apnea_uq_tpu.cli.main import main as cli_main
+
+        _stub_blocks(self.bench_mod, monkeypatch, fail=("bootstrap",))
+        base = self._run_to_file(capsys, "base.json")
+        # Same values -> clean pass over the ok blocks' metrics.
+        assert cli_main(["telemetry", "compare", base, base]) == 0
+        capsys.readouterr()
+        # Worsen one OK block's metric -> exit 1 (the gate still works
+        # over a partial payload).
+        _stub_blocks(self.bench_mod, monkeypatch, fail=("bootstrap",),
+                     values={"streamed": {"mcd_streamed_vs_inhbm": 2.5,
+                                          "de10_streamed_vs_inhbm": 1.2}})
+        worse = self._run_to_file(capsys, "worse.json")
+        assert cli_main(["telemetry", "compare", base, worse]) == 1
+        capsys.readouterr()
+
+    def test_compare_exits_2_only_when_no_block_comparable(
+        self, monkeypatch, capsys
+    ):
+        from apnea_uq_tpu.cli.main import main as cli_main
+
+        all_blocks = ("mcd", "de_train", "bootstrap", "streamed", "fused",
+                      "earlystop_waste", "compile", "program_audit",
+                      "data_plane", "d2h_accounting")
+        _stub_blocks(self.bench_mod, monkeypatch)
+        good = self._run_to_file(capsys, "good.json")
+        _stub_blocks(self.bench_mod, monkeypatch, fail=all_blocks)
+        with pytest.raises(SystemExit) as exc:
+            self.bench_mod.main()  # nothing measured -> exit 2
+        assert exc.value.code == 2
+        line = capsys.readouterr().out.strip()
+        dead = json.loads(line)  # still parseable
+        assert dead["metric"] == "bench_partial"
+        assert dead["value"] == 0 and dead["unit"] == "blocks"
+        dead_path = self.tmp_path / "dead.json"
+        dead_path.write_text(line)
+        with pytest.raises(SystemExit) as exc:
+            cli_main(["telemetry", "compare", str(dead_path), good])
+        assert exc.value.code == 2
+        assert "no comparable metrics" in capsys.readouterr().out
 

@@ -12,7 +12,8 @@ from apnea_uq_tpu.cli.main import build_parser
 REPO = Path(__file__).resolve().parent.parent
 DOCS = [REPO / "README.md", REPO / "docs" / "MIGRATION.md",
         REPO / "docs" / "OBSERVABILITY.md", REPO / "docs" / "LINT.md",
-        REPO / "docs" / "PIPELINE.md"]
+        REPO / "docs" / "PIPELINE.md",
+        REPO / "docs" / "BENCH_TRAJECTORY.md"]
 
 # README "Environment": packages claimed absent at runtime.  The claim
 # rotted once (r2 verdict: sklearn/scipy imports on the prepare and
@@ -246,6 +247,29 @@ def test_pipeline_doc_matches_live_extraction():
     assert on_disk == rendered, (
         "docs/PIPELINE.md is stale — regenerate with "
         "`apnea-uq flow --update-docs`"
+    )
+
+
+def test_bench_trajectory_doc_matches_live_render():
+    """docs/BENCH_TRAJECTORY.md is *generated* (`apnea-uq telemetry
+    trend --update-docs`): the round ledger must equal a fresh render
+    from the archived BENCH_r*.json rounds, byte for byte, so the
+    documented trajectory can never drift from the captures (the
+    docs/PIPELINE.md discipline)."""
+    from apnea_uq_tpu.telemetry import trend as trend_mod
+
+    paths = trend_mod.repo_rounds(str(REPO))
+    assert paths, "no archived BENCH_r*.json rounds found"
+    rendered = trend_mod.render_trajectory_doc(
+        trend_mod.build_trajectory(
+            [trend_mod.load_round(p) for p in paths]))
+    on_disk = (REPO / "docs" / "BENCH_TRAJECTORY.md").read_text()
+    assert trend_mod.GENERATED_MARKER in on_disk, (
+        "docs/BENCH_TRAJECTORY.md lost its generated-file marker"
+    )
+    assert on_disk == rendered, (
+        "docs/BENCH_TRAJECTORY.md is stale — regenerate with "
+        "`apnea-uq telemetry trend --update-docs`"
     )
 
 

@@ -310,6 +310,10 @@ def test_package_gate_zero_unsuppressed_findings():
                 "apnea_uq_tpu/telemetry/profiler.py",
                 "apnea_uq_tpu/telemetry/compare.py",
                 "apnea_uq_tpu/telemetry/watch.py",
+                # The perf-trajectory ledger (ISSUE 11): jax-free read
+                # side, but its doc render must stay in the bare-print /
+                # schema scan scope like the rest of the telemetry layer.
+                "apnea_uq_tpu/telemetry/trend.py",
                 "apnea_uq_tpu/telemetry/logging_shim.py",
                 "apnea_uq_tpu/parallel/ensemble.py",
                 "apnea_uq_tpu/uq/predict.py",

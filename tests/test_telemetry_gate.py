@@ -143,11 +143,131 @@ class TestCompare:
         with pytest.raises(SystemExit, match="events"):
             main(["telemetry", "compare", base, str(empty)])
 
-    def test_disjoint_metric_sets_raise(self, tmp_path):
+    def test_disjoint_metric_sets_exit_2(self, tmp_path, capsys):
+        """No metric in common = nothing gateable: the same exit-2
+        usage-error contract as a bench_error capture (ISSUE 11: exit 2
+        is reserved for 'no block comparable', exit 1 for real
+        regressions)."""
         base = _bench_json(tmp_path / "b.json", 1000.0)
         cand = _run_dir(tmp_path / "cand", peak_bytes=1, windows_per_s=0)
-        with pytest.raises(SystemExit, match="no common metrics"):
+        with pytest.raises(SystemExit) as exc:
             main(["telemetry", "compare", base, str(cand)])
+        assert exc.value.code == 2
+        assert "no common metrics" in capsys.readouterr().out
+
+    def test_proxy_boundary_drops_backend_bound_metrics(self, tmp_path):
+        """ISSUE 11: a CPU-proxy capture gates its relative metrics
+        against a device round, but absolute throughput is refused
+        across the proxy boundary — dropped and listed, never compared.
+        """
+        def v2(path, *, proxy, cold_vs_warm, throughput=1000.0):
+            if proxy:
+                head = {"metric": "bench_cpu_proxy", "value": 3,
+                        "unit": "blocks", "vs_baseline": 0}
+            else:
+                head = {"metric": "mcd_t50_inference_throughput",
+                        "value": throughput, "unit": "windows/sec/chip",
+                        "vs_baseline": 10.0}
+            head.update({
+                "schema": 2, "proxy": proxy,
+                "backend": {"platform": "cpu" if proxy else "tpu"},
+                "blocks": {"compile": {"status": "ok", "seconds": 1.0}},
+                "context": {"compile":
+                            {"cold_vs_warm_total": cold_vs_warm}},
+            })
+            with open(path, "w") as f:
+                json.dump(head, f)
+            return str(path)
+
+        device = v2(tmp_path / "device.json", proxy=False,
+                    cold_vs_warm=4.0)
+        proxy_same = v2(tmp_path / "proxy.json", proxy=True,
+                        cold_vs_warm=4.0)
+        comparison = compare_mod.compare_paths(device, proxy_same)
+        assert comparison.candidate_proxy and not comparison.baseline_proxy
+        # The device headline was dropped, not compared...
+        assert ("mcd_t50_inference_throughput"
+                in comparison.skipped_backend_bound)
+        names = {d.name for d in comparison.deltas}
+        assert "mcd_t50_inference_throughput" not in names
+        # ...while the relative compile metric still gates.
+        assert "compile.cold_vs_warm_total" in names
+        assert main(["telemetry", "compare", device, proxy_same]) == 0
+        proxy_worse = v2(tmp_path / "proxy_worse.json", proxy=True,
+                         cold_vs_warm=2.0)
+        assert main(["telemetry", "compare", device, proxy_worse]) == 1
+        # Two device rounds compare the throughput normally.
+        device_worse = v2(tmp_path / "device_worse.json", proxy=False,
+                          cold_vs_warm=4.0, throughput=500.0)
+        comparison = compare_mod.compare_paths(device, device_worse)
+        assert comparison.skipped_backend_bound == []
+        (reg,) = comparison.regressions
+        assert reg.name == "mcd_t50_inference_throughput"
+
+    def test_run_dir_proxy_mode_drops_shape_bound_metrics(self,
+                                                          tmp_path):
+        """A proxy bench run stamps bench_mode proxy:true into its own
+        run dir; comparing it against a device run dir must drop the
+        row-count-dependent data.* absolutes (smoke shapes vs device
+        shapes) while relative metrics still gate."""
+        def run_dir(path, *, proxy, load_s, hit):
+            os.makedirs(path, exist_ok=True)
+            events = [
+                {"seq": 0, "ts": 1.0, "kind": "run_started",
+                 "schema_version": 1, "stage": "bench"},
+                {"seq": 1, "ts": 1.5, "kind": "bench_mode",
+                 "proxy": proxy, "platform": "cpu" if proxy else "tpu"},
+                {"seq": 2, "ts": 2.0, "kind": "data_load",
+                 "key": "prepared", "load_s": load_s},
+                {"seq": 3, "ts": 2.5, "kind": "compile_event",
+                 "label": "mcd_predict_fused", "source": "store",
+                 "hit": hit, "lower_s": 0.0, "compile_s": 0.0},
+                {"seq": 4, "ts": 3.0, "kind": "run_finished",
+                 "status": "ok"},
+            ]
+            with open(os.path.join(path, telemetry.EVENTS_FILENAME),
+                      "w") as f:
+                for e in events:
+                    f.write(json.dumps(e) + "\n")
+            return str(path)
+
+        device = run_dir(tmp_path / "device", proxy=False, load_s=1.9,
+                         hit=True)
+        proxy = run_dir(tmp_path / "proxy", proxy=True, load_s=0.002,
+                        hit=True)
+        comparison = compare_mod.compare_paths(device, proxy)
+        assert comparison.candidate_proxy
+        assert "data.prepared.load_s" in comparison.skipped_backend_bound
+        names = {d.name for d in comparison.deltas}
+        assert "data.prepared.load_s" not in names
+        assert "compile.hit_ratio" in names
+        assert comparison.regressions == []
+        # Two device run dirs still compare the data-plane cost.
+        device2 = run_dir(tmp_path / "device2", proxy=False, load_s=4.0,
+                          hit=True)
+        comparison = compare_mod.compare_paths(device, device2)
+        (reg,) = comparison.regressions
+        assert reg.name == "data.prepared.load_s"
+
+    def test_v2_error_payload_with_surviving_blocks_still_gates(
+            self, tmp_path):
+        """A watchdog-killed v2 capture folds its surviving progress
+        into the bench_error payload; the survived primary must gate
+        like any other capture (a hang after N good blocks reports N
+        blocks — ISSUE 11 satellite 1)."""
+        err = {"metric": "bench_error", "value": 0, "unit": "error",
+               "vs_baseline": 0, "error": "watchdog fired", "schema": 2,
+               "blocks": {"mcd": {"status": "ok", "seconds": 9.0}},
+               "primary": {"metric": "mcd_t50_inference_throughput",
+                           "value": 900.0, "unit": "windows/sec/chip"}}
+        path = tmp_path / "killed.json"
+        with open(path, "w") as f:
+            json.dump(err, f)
+        base = _bench_json(tmp_path / "base.json", 1000.0)
+        comparison = compare_mod.compare_paths(base, str(path))
+        (reg,) = comparison.regressions
+        assert reg.name == "mcd_t50_inference_throughput"
+        assert reg.delta_pct == pytest.approx(-10.0)
 
     def test_progress_file_wrapper_gates_the_primary_too(self, tmp_path):
         """A BENCH_PROGRESS_FILE capture wraps the driver blocks as
@@ -366,17 +486,24 @@ def _green_probe(timeout_s):
     return True, "ok"
 
 
-def _fake_runner(records, rc_by_name=None, hang=()):
+def _fake_runner(records, rc_by_name=None, hang=(), stdout_by_name=None):
     """A subprocess.run stand-in that records each ritual invocation;
     steps named in ``hang`` raise TimeoutExpired like a tunnel-flap
-    hang hitting the step's timeout."""
+    hang hitting the step's timeout; ``stdout_by_name`` overrides a
+    step's stdout (e.g. a bench result payload)."""
     import subprocess
 
     rc_by_name = rc_by_name or {}
+    stdout_by_name = stdout_by_name or {}
 
     def runner(argv, cwd=None, env=None, capture_output=None, text=None,
                timeout=None):
-        name = "tpu_tests" if "pytest" in " ".join(argv) else "bench"
+        if "pytest" in argv:
+            name = "tpu_tests"
+        elif "trend" in argv:
+            name = "trend"
+        else:
+            name = "bench"
         records.append({"name": name, "argv": argv, "cwd": cwd,
                         "env": env, "timeout": timeout})
         if name in hang:
@@ -384,7 +511,8 @@ def _fake_runner(records, rc_by_name=None, hang=()):
                                             output=f"{name} partial\n")
         return types.SimpleNamespace(
             returncode=rc_by_name.get(name, 0),
-            stdout=f"{name} stdout\n", stderr="")
+            stdout=stdout_by_name.get(name, f"{name} stdout\n"),
+            stderr="")
 
     return runner
 
@@ -404,18 +532,23 @@ class TestWatch:
         assert kinds.count("probe") == 1
         assert "probe_green" in kinds
         steps = [e for e in events if e["kind"] == "ritual_step"]
-        assert [s["name"] for s in steps] == ["bench", "tpu_tests"]
+        assert [s["name"] for s in steps] == ["bench", "tpu_tests",
+                                              "trend"]
         assert all(s["returncode"] == 0 for s in steps)
+        assert all(s["passed"] is True for s in steps)
         assert events[-1] == {**events[-1], "kind": "run_finished",
                               "status": "ok"}
-        # The bench step lands its capture INSIDE the watch run dir, and
-        # the TPU-gated tests get their env switch.
-        bench, tests = records
+        # The bench step lands its capture INSIDE the watch run dir, the
+        # TPU-gated tests get their env switch, and the closing trend
+        # snapshot ingests the bench run dir as its extra source.
+        bench, tests, trend = records
         assert bench["env"]["BENCH_RUN_DIR"].startswith(run_dir)
         assert bench["env"]["BENCH_PROGRESS_FILE"].startswith(run_dir)
         assert bench["cwd"] == watch_mod._REPO_ROOT
         assert tests["env"]["APNEA_UQ_TEST_TPU"] == "1"
         assert "-k" in tests["argv"] and "on_tpu" in tests["argv"]
+        assert trend["argv"][-1] == os.path.join(run_dir, "bench")
+        assert "telemetry" in trend["argv"] and "trend" in trend["argv"]
         # Each step's stdout is preserved next to its event.
         for step in steps:
             path = os.path.join(run_dir, step["stdout_path"])
@@ -423,19 +556,62 @@ class TestWatch:
                 assert f"{step['name']} stdout" in f.read()
 
     def test_failing_step_does_not_stop_ritual(self, tmp_path):
-        # A red TPU test after a good bench capture must not discard it.
+        # A red bench (no parseable payload, rc 1) must not stop the
+        # later steps.
         records = []
         rc = watch_mod.watch(
             str(tmp_path), probe=_green_probe,
             runner=_fake_runner(records, {"bench": 1}), budget_s=60.0)
         assert rc == 1
-        assert [r["name"] for r in records] == ["bench", "tpu_tests"]
+        assert [r["name"] for r in records] == ["bench", "tpu_tests",
+                                                "trend"]
         (run_dir,) = glob.glob(str(tmp_path / "runs" / "watch-*"))
         events = telemetry.read_events(run_dir)
         rcs = [e["returncode"] for e in events
                if e["kind"] == "ritual_step"]
-        assert rcs == [1, 0]
+        assert rcs == [1, 0, 0]
         assert events[-1]["status"] == "error"
+
+    def test_bench_step_gates_on_per_block_statuses(self, tmp_path):
+        """ISSUE 11 tentpole piece 4: a bench that exited nonzero but
+        printed a v2 payload with surviving ok blocks is a PASSED step
+        (partial results are evidence), with the block counts on its
+        ritual_step event."""
+        payload = json.dumps({
+            "metric": "bench_partial", "value": 2, "unit": "blocks",
+            "vs_baseline": 0, "schema": 2, "proxy": True,
+            "blocks": {"compile": {"status": "ok", "seconds": 1.0},
+                       "data_plane": {"status": "ok", "seconds": 0.1},
+                       "mcd": {"status": "error", "error_tail": "boom"}},
+        })
+        records = []
+        rc = watch_mod.watch(
+            str(tmp_path), probe=_green_probe,
+            runner=_fake_runner(records, {"bench": 3},
+                                stdout_by_name={"bench": payload + "\n"}),
+            skip_tests=True, budget_s=60.0)
+        assert rc == 0  # bench passed on blocks, trend passed on rc
+        (run_dir,) = glob.glob(str(tmp_path / "runs" / "watch-*"))
+        events = telemetry.read_events(run_dir)
+        bench_step = next(e for e in events if e["kind"] == "ritual_step"
+                          and e["name"] == "bench")
+        assert bench_step["returncode"] == 3
+        assert bench_step["passed"] is True
+        assert bench_step["blocks_ok"] == 2
+        assert bench_step["blocks_error"] == 1
+        assert bench_step["proxy"] is True
+        assert events[-1]["status"] == "ok"
+        # An all-dead payload does NOT pass the step.
+        dead = json.dumps({"metric": "bench_error", "value": 0,
+                           "unit": "error", "vs_baseline": 0,
+                           "schema": 2, "blocks": {}})
+        records = []
+        rc = watch_mod.watch(
+            str(tmp_path), probe=_green_probe,
+            runner=_fake_runner(records, {"bench": 2},
+                                stdout_by_name={"bench": dead + "\n"}),
+            skip_tests=True, budget_s=60.0)
+        assert rc == 1
 
     def test_hung_step_times_out_instead_of_hanging_watch(self, tmp_path):
         """A tunnel flap AFTER the green probe hangs jax.devices() inside
@@ -449,6 +625,7 @@ class TestWatch:
         assert rc == 1
         assert records[0]["timeout"] == 7200.0  # bench's step budget
         assert records[1]["timeout"] == 3600.0
+        assert records[2]["timeout"] == 600.0   # trend snapshot
         (run_dir,) = glob.glob(str(tmp_path / "runs" / "watch-*"))
         events = telemetry.read_events(run_dir)
         hung = next(e for e in events if e["kind"] == "ritual_step"
@@ -469,12 +646,12 @@ class TestWatch:
         assert rc == 2
         assert not glob.glob(str(tmp_path / "out" / "runs" / "*"))
 
-    def test_skip_tests_runs_bench_only(self, tmp_path):
+    def test_skip_tests_runs_bench_and_trend(self, tmp_path):
         records = []
         assert watch_mod.watch(str(tmp_path), probe=_green_probe,
                                runner=_fake_runner(records),
                                skip_tests=True, budget_s=60.0) == 0
-        assert [r["name"] for r in records] == ["bench"]
+        assert [r["name"] for r in records] == ["bench", "trend"]
 
     def test_expired_budget_exits_2_without_a_run_dir(self, tmp_path,
                                                       monkeypatch):
@@ -521,7 +698,7 @@ class TestWatch:
                                 run=_fake_runner(records)))
         assert main(["telemetry", "watch", "--out", str(tmp_path),
                      "--budget-secs", "60", "--skip-tests"]) == 0
-        assert [r["name"] for r in records] == ["bench"]
+        assert [r["name"] for r in records] == ["bench", "trend"]
         out = capsys.readouterr().out
         assert "backend GREEN" in out
         assert "bench finished rc=0" in out
@@ -543,10 +720,26 @@ class TestWatch:
 
     def test_evidence_ritual_steps_are_parameterized(self, tmp_path):
         steps = watch_mod.evidence_ritual_steps(str(tmp_path))
-        assert [s.name for s in steps] == ["bench", "tpu_tests"]
+        assert [s.name for s in steps] == ["bench", "tpu_tests", "trend"]
         bench = steps[0]
         assert bench.argv[1].endswith("bench.py")
         assert bench.env["BENCH_RUN_DIR"] == str(tmp_path / "bench")
-        only_bench = watch_mod.evidence_ritual_steps(str(tmp_path),
-                                                     skip_tests=True)
-        assert [s.name for s in only_bench] == ["bench"]
+        assert bench.payload_json is True
+        trend = steps[-1]
+        assert trend.argv[-1] == str(tmp_path / "bench")
+        no_tests = watch_mod.evidence_ritual_steps(str(tmp_path),
+                                                   skip_tests=True)
+        assert [s.name for s in no_tests] == ["bench", "trend"]
+
+    def test_bench_payload_summary_shapes(self):
+        v2 = json.dumps({"metric": "m", "proxy": True,
+                         "blocks": {"a": {"status": "ok"},
+                                    "b": {"status": "error"}}})
+        assert watch_mod.bench_payload_summary(f"noise\n{v2}\n") == {
+            "payload_metric": "m", "proxy": True,
+            "blocks_ok": 1, "blocks_error": 1}
+        # v1 line: parseable, zero blocks.
+        v1 = json.dumps({"metric": "m", "value": 1.0})
+        assert watch_mod.bench_payload_summary(v1)["blocks_ok"] == 0
+        # No JSON at all: None (exit code stays the verdict).
+        assert watch_mod.bench_payload_summary("bench stdout\n") is None

@@ -1,0 +1,218 @@
+"""The cross-run perf-trajectory ledger (ISSUE 11 tentpole, piece 3):
+``telemetry/trend.py`` + ``apnea-uq telemetry trend``.  The repo's own
+archived BENCH_r01..r05 — two good rounds and three tunnel-outage error
+captures — are the motivating fixtures: the ledger must ingest ALL of
+them, render error rounds as gaps (never crash), reuse compare's
+unit-direction inference for best/latest/delta, and regenerate the
+byte-pinned docs/BENCH_TRAJECTORY.md deterministically."""
+
+import json
+import os
+
+import pytest
+
+from apnea_uq_tpu.cli.main import main
+from apnea_uq_tpu.telemetry import trend as trend_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestArchivedRounds:
+    def test_repo_rounds_ordered_numerically(self):
+        paths = trend_mod.repo_rounds(REPO)
+        labels = [trend_mod.round_label(p) for p in paths]
+        assert labels[:5] == ["r01", "r02", "r03", "r04", "r05"]
+
+    def test_error_rounds_are_gaps_not_crashes(self):
+        """The acceptance shape: a trajectory covering r01-r05 with the
+        three outage rounds as gaps."""
+        paths = trend_mod.repo_rounds(REPO)[:5]
+        rounds = [trend_mod.load_round(p) for p in paths]
+        assert [r.status for r in rounds] == ["ok", "ok", "error",
+                                              "error", "error"]
+        assert rounds[2].metrics == {} and rounds[2].detail
+        traj = trend_mod.build_trajectory(rounds)
+        m = next(x for x in traj.metrics
+                 if x.name == "mcd_t50_inference_throughput")
+        assert m.values[:2] == [9563.7, 9447.2]
+        assert m.values[2:] == [None, None, None]
+        assert m.best == 9563.7 and m.best_round == "r01"
+        assert m.latest == 9447.2 and m.latest_round == "r02"
+        assert m.delta_pct == pytest.approx(-1.22, abs=0.01)
+        assert not m.regressed  # -1.2% is inside the 5% band
+        # The archived r02 context also rides along (the same
+        # extraction compare gates with).
+        assert any(x.name == "bootstrap.speedup" for x in traj.metrics)
+
+    def test_threshold_flags_regression_vs_best(self):
+        paths = trend_mod.repo_rounds(REPO)[:5]
+        rounds = [trend_mod.load_round(p) for p in paths]
+        traj = trend_mod.build_trajectory(rounds, threshold_pct=1.0)
+        m = next(x for x in traj.metrics
+                 if x.name == "mcd_t50_inference_throughput")
+        assert m.regressed  # -1.2% vs best exceeds a 1% band
+        assert m.name in [x.name for x in traj.regressions]
+
+    def test_render_shows_round_statuses_and_gaps(self):
+        paths = trend_mod.repo_rounds(REPO)[:5]
+        traj = trend_mod.build_trajectory(
+            [trend_mod.load_round(p) for p in paths])
+        text = trend_mod.render_trajectory(traj)
+        assert "r03[error]" in text and "r05[error]" in text
+        assert "—" in text  # gaps, not zeros
+        assert "mcd_t50_inference_throughput (^)" in text
+
+
+class TestSyntheticRounds:
+    def _capture(self, path, metric, value, unit, **extra):
+        doc = {"metric": metric, "value": value, "unit": unit,
+               "vs_baseline": 1.0}
+        doc.update(extra)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return str(path)
+
+    def test_direction_aware_best_for_seconds(self, tmp_path):
+        a = self._capture(tmp_path / "BENCH_r01.json", "train_s", 10.0,
+                          "seconds")
+        b = self._capture(tmp_path / "BENCH_r02.json", "train_s", 4.0,
+                          "seconds")
+        c = self._capture(tmp_path / "BENCH_r03.json", "train_s", 6.0,
+                          "seconds")
+        rounds = [trend_mod.load_round(p) for p in (a, b, c)]
+        traj = trend_mod.build_trajectory(rounds)
+        m = next(x for x in traj.metrics if x.name == "train_s")
+        assert not m.higher_better
+        assert m.best == 4.0 and m.best_round == "r02"
+        assert m.latest == 6.0
+        assert m.delta_pct == pytest.approx(50.0)
+        assert m.regressed  # +50% on a lower-is-better metric
+
+    def test_backend_bound_series_split_by_mode(self, tmp_path):
+        """A proxy round's operating-point-bound absolutes (smoke-shape
+        D2H bytes, data-plane seconds) must NOT merge into the device
+        series — else the tiny proxy values become 'best' and every
+        later device round flags REGRESSED forever."""
+        device = tmp_path / "BENCH_r01.json"
+        with open(device, "w") as f:
+            json.dump({"metric": "mcd_t50_inference_throughput",
+                       "value": 9000.0, "unit": "windows/sec/chip",
+                       "vs_baseline": 12.0,
+                       "context": {"d2h_accounting":
+                                   {"d2h_bytes_full": 6_553_600}}}, f)
+        proxy = tmp_path / "BENCH_r02.json"
+        with open(proxy, "w") as f:
+            json.dump({"metric": "bench_cpu_proxy", "value": 3,
+                       "unit": "blocks", "vs_baseline": 0, "schema": 2,
+                       "proxy": True,
+                       "context": {"d2h_accounting":
+                                   {"d2h_bytes_full": 4096},
+                                   "compile":
+                                   {"cold_vs_warm_total": 4.0}}}, f)
+        rounds = [trend_mod.load_round(str(p)) for p in (device, proxy)]
+        traj = trend_mod.build_trajectory(rounds)
+        by_name = {m.name: m for m in traj.metrics}
+        # Two separate series, neither polluted by the other's shapes.
+        assert by_name["d2h.bytes_full"].values == [6_553_600.0, None]
+        assert not by_name["d2h.bytes_full"].regressed
+        assert by_name["d2h.bytes_full [proxy]"].values == [None, 4096.0]
+        # Relative metrics stay in one merged series.
+        assert "compile.cold_vs_warm_total" in by_name
+        assert "compile.cold_vs_warm_total [proxy]" not in by_name
+
+    def test_proxy_round_is_labeled(self, tmp_path):
+        path = tmp_path / "proxy.json"
+        with open(path, "w") as f:
+            json.dump({"metric": "bench_cpu_proxy", "value": 3,
+                       "unit": "blocks", "vs_baseline": 0, "schema": 2,
+                       "proxy": True,
+                       "context": {"compile":
+                                   {"cold_vs_warm_total": 4.0}}}, f)
+        point = trend_mod.load_round(str(path))
+        assert point.status == "proxy" and point.proxy
+        assert "compile.cold_vs_warm_total" in point.metrics
+
+    def test_run_dir_source_via_bench_metric_events(self, tmp_path):
+        run_dir = tmp_path / "bench_run"
+        os.makedirs(run_dir)
+        events = [
+            {"seq": 0, "ts": 1.0, "kind": "run_started",
+             "schema_version": 1, "stage": "bench"},
+            {"seq": 1, "ts": 2.0, "kind": "bench_metric",
+             "role": "primary", "metric": "mcd_t50_inference_throughput",
+             "value": 9000.0, "unit": "windows/sec/chip",
+             "vs_baseline": 12.0},
+            {"seq": 2, "ts": 3.0, "kind": "run_finished", "status": "ok"},
+        ]
+        with open(run_dir / "events.jsonl", "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        point = trend_mod.load_round(str(run_dir))
+        assert point.status == "ok"
+        assert point.label == "bench_run"
+        assert point.metrics["mcd_t50_inference_throughput"].value == 9000.0
+
+    def test_unreadable_source_is_an_error_round(self, tmp_path):
+        missing = trend_mod.load_round(str(tmp_path / "nope.json"))
+        assert missing.status == "error" and missing.metrics == {}
+        garbage = tmp_path / "garbage.json"
+        garbage.write_text("{truncated")
+        assert trend_mod.load_round(str(garbage)).status == "error"
+
+
+class TestTrendCLI:
+    def test_text_and_json_over_archive_plus_extra(self, tmp_path,
+                                                   capsys):
+        extra = tmp_path / "candidate.json"
+        with open(extra, "w") as f:
+            json.dump({"metric": "mcd_t50_inference_throughput",
+                       "value": 9800.0, "unit": "windows/sec/chip",
+                       "vs_baseline": 13.0}, f)
+        assert main(["telemetry", "trend", str(extra)]) == 0
+        text = capsys.readouterr().out
+        for label in ("r01[ok]", "r03[error]", "r05[error]",
+                      "candidate[ok]"):
+            assert label in text, text
+        assert main(["telemetry", "trend", str(extra), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert [r["label"] for r in doc["rounds"]][:5] == [
+            "r01", "r02", "r03", "r04", "r05"]
+        assert doc["rounds"][-1]["label"] == "candidate"
+        m = next(x for x in doc["metrics"]
+                 if x["name"] == "mcd_t50_inference_throughput")
+        assert m["latest"] == 9800.0 and m["best"] == 9800.0
+
+    def test_rounds_dir_override_and_empty_exit(self, tmp_path, capsys):
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"metric": "m", "value": 1.0, "unit": "ratio"}, f)
+        assert main(["telemetry", "trend",
+                     "--rounds-dir", str(tmp_path)]) == 0
+        assert "r01[ok]" in capsys.readouterr().out
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        with pytest.raises(SystemExit, match="no BENCH_r"):
+            main(["telemetry", "trend", "--rounds-dir", str(empty)])
+
+    def test_update_docs_rejects_extra_sources(self, tmp_path):
+        """The doc is pinned against the archived rounds alone; extra
+        sources must be rejected loudly, never silently dropped."""
+        extra = tmp_path / "fresh.json"
+        with open(extra, "w") as f:
+            json.dump({"metric": "m", "value": 1.0, "unit": "ratio"}, f)
+        with pytest.raises(SystemExit, match="archive the capture"):
+            main(["telemetry", "trend", str(extra), "--update-docs",
+                  "--docs", str(tmp_path / "TRAJ.md")])
+
+    def test_update_docs_writes_pinned_render(self, tmp_path, capsys):
+        out = tmp_path / "TRAJ.md"
+        assert main(["telemetry", "trend", "--update-docs",
+                     "--docs", str(out)]) == 0
+        text = out.read_text()
+        assert trend_mod.GENERATED_MARKER in text
+        # Deterministic: a second render is byte-identical (the docs
+        # pin's precondition).
+        rounds = [trend_mod.load_round(p)
+                  for p in trend_mod.repo_rounds()]
+        again = trend_mod.render_trajectory_doc(
+            trend_mod.build_trajectory(rounds))
+        assert text == again
