@@ -81,6 +81,11 @@ _F64_RE = re.compile(r"tensor<[^>]*f64>")
 # a sum whose accumulator carries bf16 — 8 mantissa bits — through the
 # reduction tree.
 _BF16_REDUCE_RE = re.compile(r"stablehlo\.reduce\b[^\n]*bf16")
+# Any tensor whose element type is bf16 (`tensor<8x60xbf16>`): legal
+# ONLY in programs whose label carries the `_bf16` tier suffix — the
+# program-dtype-drift rule's blessed-low-precision check.  Same
+# suffix-match reasoning as the f64 regex above.
+_BF16_RE = re.compile(r"tensor<[^>]*bf16>")
 
 
 @dataclasses.dataclass
@@ -107,10 +112,21 @@ class ProgramAudit:
     memory_fields: Optional[Dict[str, int]]
     platform: str
     num_devices: int
+    # bf16 tensor types anywhere in the lowered module: legal only under
+    # a `_bf16`-tier label (program-dtype-drift's blessed-low-precision
+    # check).  Defaulted so synthetic-capture tests predating the field
+    # keep constructing.
+    bf16_ops: int = 0
 
     @property
     def const_bytes(self) -> int:
         return sum(int(c["bytes"]) for c in self.consts)
+
+    @property
+    def tier(self) -> str:
+        """The label-declared precision tier ('f32' | 'bf16') — the
+        manifest's tier column derives from this, never from the IR."""
+        return "bf16" if self.label.endswith("_bf16") else "f32"
 
 
 def _iter_jaxprs(jaxpr) -> Any:
@@ -247,6 +263,7 @@ def capture_program(label: str, fn, args: tuple, kwargs: dict, *,
         collectives=collectives, hlo_collectives=hlo_collectives,
         f64_ops=len(_F64_RE.findall(hlo)),
         bf16_accum_reduces=len(_BF16_REDUCE_RE.findall(hlo)),
+        bf16_ops=len(_BF16_RE.findall(hlo)),
         consts=consts,
         donated_args=len(donate), aliased_outputs=_alias_count(compiled),
         host_callbacks=callbacks,

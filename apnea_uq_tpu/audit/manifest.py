@@ -30,9 +30,13 @@ def manifest_row(program) -> Dict[str, Any]:
     only: donation is recorded as booleans, not leaf counts (a config
     with more layers donates more leaves without changing the contract),
     and FLOPs/bytes stay out entirely (shape-dependent — they flow to
-    ``program_audit`` telemetry instead)."""
+    ``program_audit`` telemetry instead).  ``tier`` is the label-declared
+    precision tier ('f32' | '_bf16'-suffixed labels -> 'bf16') the
+    program-dtype-drift rule blesses bf16 tensor types under — in the
+    diff, a reviewer reads which programs are allowed low precision."""
     return {
         "group": program.group,
+        "tier": program.tier,
         "collectives": dict(sorted(program.collectives.items())),
         "donates": bool(program.donated_args),
         "aliased": bool(program.aliased_outputs),

@@ -82,6 +82,13 @@ def capture_zoo(config, *, groups: Tuple[str, ...] = WARM_GROUPS,
     stat_spec = ("nats", float(uq.entropy_eps))
     x_aval = jax.ShapeDtypeStruct((AUDIT_WINDOWS,) + AUDIT_WINDOW_SHAPE,
                                   jnp.float32)
+    # The dtype sweep: every eval label exists in an f32 and a `_bf16`
+    # tier (the variables are dtype-independent — params stay f32 under
+    # either compute dtype, so one init serves both models).
+    dtype_models = tuple(
+        AlarconCNN1D(dataclasses.replace(config.model, compute_dtype=d))
+        for d in ("float32", "bfloat16")
+    )
 
     with use_store(store):
         if "eval-mcd" in groups:
@@ -89,28 +96,39 @@ def capture_zoo(config, *, groups: Tuple[str, ...] = WARM_GROUPS,
             mesh = make_mesh_from_config(config.mesh,
                                          num_members=AUDIT_PASSES)
             key = prng.stochastic_key(config.train.seed)
-            for stats in (None, stat_spec):   # full AND fused variants
-                common = dict(n_passes=AUDIT_PASSES, mode=uq.mcd_mode,
-                              batch_size=AUDIT_BATCH, key=key, mesh=mesh,
-                              record_memory_only=True, stats=stats)
-                mc_dropout_predict(model, variables, x_aval, **common)
-                mc_dropout_predict_streaming(model, variables, x_aval,
-                                             **common)
-            predict_proba_batched(
-                model, variables, x_aval, batch_size=AUDIT_BATCH,
-                mesh=mesh, record_memory_only=True,
-            )
+            for dmodel in dtype_models:
+                # Engine sweep: the `_pallas` labels lower their CPU
+                # fallback body here (resolve_mcd_engine — the audit
+                # runs off-TPU by design), which is exactly the program
+                # a CPU process would dispatch under those labels.
+                for engine in ("xla", "pallas"):
+                    for stats in (None, stat_spec):  # full AND fused
+                        common = dict(n_passes=AUDIT_PASSES,
+                                      mode=uq.mcd_mode,
+                                      batch_size=AUDIT_BATCH, key=key,
+                                      mesh=mesh, record_memory_only=True,
+                                      stats=stats, engine=engine)
+                        mc_dropout_predict(dmodel, variables, x_aval,
+                                           **common)
+                        mc_dropout_predict_streaming(dmodel, variables,
+                                                     x_aval, **common)
+                predict_proba_batched(
+                    dmodel, variables, x_aval, batch_size=AUDIT_BATCH,
+                    mesh=mesh, record_memory_only=True,
+                )
 
         if "eval-de" in groups:
             store.group = "eval-de"
             members = stack_member_variables([variables] * AUDIT_MEMBERS)
             mesh = make_mesh_from_config(config.mesh,
                                          num_members=AUDIT_MEMBERS)
-            for stats in (None, stat_spec):
-                common = dict(batch_size=AUDIT_BATCH, mesh=mesh,
-                              record_memory_only=True, stats=stats)
-                ensemble_predict(model, members, x_aval, **common)
-                ensemble_predict_streaming(model, members, x_aval, **common)
+            for dmodel in dtype_models:
+                for stats in (None, stat_spec):
+                    common = dict(batch_size=AUDIT_BATCH, mesh=mesh,
+                                  record_memory_only=True, stats=stats)
+                    ensemble_predict(dmodel, members, x_aval, **common)
+                    ensemble_predict_streaming(dmodel, members, x_aval,
+                                               **common)
 
         need_train_data = bool({"train", "train-ensemble"} & set(groups))
         if need_train_data:

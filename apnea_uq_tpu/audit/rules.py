@@ -104,9 +104,10 @@ def _collective_axes(key: str) -> Tuple[str, ...]:
 
 @register_program_rule(
     "program-dtype-drift", "error",
-    "f64 ops anywhere in a lowered hot-path program, or bf16-accumulated "
-    "reductions in the _fused statistics programs (PARITY.md promises "
-    "f32 accumulation even under compute_dtype='bfloat16')",
+    "f64 ops anywhere in a lowered hot-path program; bf16 tensor types "
+    "outside the blessed `_bf16` label tier; and bf16-accumulated "
+    "reductions in ANY tier's _fused statistics programs (PARITY.md "
+    "promises f32 accumulation even under compute_dtype='bfloat16')",
 )
 def check_dtype_drift(context: AuditContext) -> Iterable[Finding]:
     for label, p in sorted(context.programs.items()):
@@ -117,13 +118,31 @@ def check_dtype_drift(context: AuditContext) -> Iterable[Finding]:
                 f"— an x64 leak doubles memory traffic and falls off the "
                 f"bf16/f32 matmul units",
             )
-        if label.endswith("_fused") and p.bf16_accum_reduces:
+        # Blessed low-precision tier: a `_bf16` label MAY carry bf16
+        # tensor types (that is what the tier declares — PARITY.md
+        # "Tolerance tiers", <=2e-2 vs f32); any other label carrying
+        # them is an unblessed precision leak.  Tier-blessed, never
+        # suppressed: there is no inline-disable path for this.
+        if p.tier != "bf16" and getattr(p, "bf16_ops", 0):
+            yield context.finding(
+                "program-dtype-drift", label,
+                f"{p.bf16_ops} bf16 tensor type(s) in an f32-tier "
+                f"program — low-precision compute must run under a "
+                f"`_bf16`-suffixed label (the blessed tier; "
+                f"ModelConfig.compute_dtype='bfloat16' labels programs "
+                f"automatically) so the parity suite's 2e-2 tolerance "
+                f"tier applies to it",
+            )
+        # The f32-accumulation promise holds in EVERY tier: `_fused`
+        # appears mid-label in the suffix grammar
+        # (mcd_predict_pallas_fused_bf16), so substring, not endswith.
+        if "_fused" in label and p.bf16_accum_reduces:
             yield context.finding(
                 "program-dtype-drift", label,
                 f"{p.bf16_accum_reduces} reduction(s) accumulate in bf16 "
                 f"— the fused sufficient-statistics reductions must "
-                f"accumulate in f32 (PARITY.md; pass dtype=jnp.float32 "
-                f"to the reducing op)",
+                f"accumulate in f32 even in the _bf16 tier (PARITY.md; "
+                f"pass dtype=jnp.float32 to the reducing op)",
             )
 
 

@@ -458,6 +458,39 @@ def _eval_uq_config(args, config):
     return config.uq
 
 
+def _add_compute_dtype_arg(p) -> None:
+    from apnea_uq_tpu.config import VALID_COMPUTE_DTYPES
+
+    p.add_argument("--compute-dtype", choices=VALID_COMPUTE_DTYPES,
+                   default=None,
+                   help="Inference compute dtype for this invocation "
+                        "(ModelConfig.compute_dtype): 'bfloat16' runs "
+                        "conv/dense math on the MXU in bf16 with f32 "
+                        "parameters and f32 stats/entropy accumulation "
+                        "— the blessed low-precision tier, <=2e-2 vs "
+                        "f32 (PARITY.md \"Tolerance tiers\"); programs "
+                        "price/store under `_bf16` labels.")
+
+
+def _apply_eval_overrides(args, config):
+    """Fold the eval-only CLI overrides (--compute-dtype, --mcd-engine)
+    into the ExperimentConfig BEFORE the stage's run log opens, so the
+    run-dir config snapshot records the dtype/engine the eval actually
+    ran — a bf16 number must never be attributable to an f32 config."""
+    import dataclasses
+
+    dtype = getattr(args, "compute_dtype", None)
+    if dtype:
+        config = dataclasses.replace(
+            config, model=dataclasses.replace(config.model,
+                                              compute_dtype=dtype))
+    engine = getattr(args, "mcd_engine", None)
+    if engine:
+        config = dataclasses.replace(
+            config, uq=dataclasses.replace(config.uq, mcd_engine=engine))
+    return config
+
+
 def _add_profile_arg(p) -> None:
     p.add_argument("--profile-dir", default=None,
                    help="Wrap the evaluation in a jax.profiler trace and "
@@ -521,6 +554,7 @@ def cmd_eval_mcd(args, config) -> int:
     from apnea_uq_tpu.telemetry.profiler import TraceSession
 
     _no_double_profile(args)
+    config = _apply_eval_overrides(args, config)
     registry = _registry(args)
     model, template = _baseline_template(config)
     state = restore_state(os.path.join(_ckpt_root(args), "baseline"), template)
@@ -564,6 +598,7 @@ def cmd_eval_de(args, config) -> int:
     from apnea_uq_tpu.telemetry.profiler import TraceSession
 
     _no_double_profile(args)
+    config = _apply_eval_overrides(args, config)
     registry = _registry(args)
     model, member_variables = _restore_members(args, config, args.num_members)
     n_members = len(member_variables)  # resolved count (0 -> all existing)
@@ -1074,6 +1109,15 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     _add_run_dir_arg(p)
     _add_no_detailed_arg(p)
     _add_full_probs_arg(p)
+    _add_compute_dtype_arg(p)
+    p.add_argument("--mcd-engine", choices=("xla", "pallas"), default=None,
+                   help="MCD predictor engine for this invocation "
+                        "(UQConfig.mcd_engine): 'pallas' runs the fused "
+                        "conv->BN->ReLU->dropout TPU kernel "
+                        "(ops/pallas_mcd.py; masks drawn in-kernel from "
+                        "the hardware PRNG), falling back to the "
+                        "default 'xla' body off-TPU / in parity mode / "
+                        "on a mesh.")
     _add_plots_arg(p)
     _add_profile_arg(p)
     _add_profile_flag(p)
@@ -1089,6 +1133,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                         "EnsembleConfig.keep_padded_members.")
     _add_no_detailed_arg(p)
     _add_full_probs_arg(p)
+    _add_compute_dtype_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
     _add_profile_flag(p)

@@ -36,12 +36,29 @@ WARM_GROUPS: Tuple[str, ...] = (
     "eval-mcd", "eval-de", "train", "train-ensemble",
 )
 
+# Label grammar (uq/predict.py mcd_program_label / de_program_label):
+# base + optional suffixes in fixed order — `_pallas` (the fused
+# ops/pallas_mcd.py MCD engine was requested; off-TPU the label runs the
+# XLA fallback body), `_fused` (on-device sufficient-statistics
+# reduction), `_bf16` (ModelConfig.compute_dtype='bfloat16', the
+# audit's blessed low-precision tier — audit/rules.py
+# program-dtype-drift; manifest rows carry the tier column).
 GROUP_LABELS: Dict[str, Tuple[str, ...]] = {
-    "eval-mcd": ("mcd_predict", "mcd_predict_fused",
-                 "mcd_chunk_predict", "mcd_chunk_predict_fused",
-                 "predict_eval"),
-    "eval-de": ("de_predict", "de_predict_fused",
-                "de_chunk_predict", "de_chunk_predict_fused"),
+    "eval-mcd": ("mcd_predict", "mcd_predict_bf16",
+                 "mcd_predict_fused", "mcd_predict_fused_bf16",
+                 "mcd_predict_pallas", "mcd_predict_pallas_bf16",
+                 "mcd_predict_pallas_fused",
+                 "mcd_predict_pallas_fused_bf16",
+                 "mcd_chunk_predict", "mcd_chunk_predict_bf16",
+                 "mcd_chunk_predict_fused", "mcd_chunk_predict_fused_bf16",
+                 "mcd_chunk_predict_pallas", "mcd_chunk_predict_pallas_bf16",
+                 "mcd_chunk_predict_pallas_fused",
+                 "mcd_chunk_predict_pallas_fused_bf16",
+                 "predict_eval", "predict_eval_bf16"),
+    "eval-de": ("de_predict", "de_predict_bf16",
+                "de_predict_fused", "de_predict_fused_bf16",
+                "de_chunk_predict", "de_chunk_predict_bf16",
+                "de_chunk_predict_fused", "de_chunk_predict_fused_bf16"),
     "train": ("train_epoch", "val_loss"),
     "train-ensemble": ("ensemble_epoch",),
 }
@@ -148,6 +165,7 @@ def warm_cache(
                 n_passes=uq.mc_passes, mode=uq.mcd_mode,
                 batch_size=uq.mcd_batch_size, key=key, mesh=mesh,
                 run_log=run_log, record_memory_only=True, stats=stat_spec,
+                engine=uq.mcd_engine,
             )
             if i == 0:
                 # The drivers' deterministic sanity probe runs on the

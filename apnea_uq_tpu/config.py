@@ -26,6 +26,17 @@ TIME_STEPS = 60
 NUM_CHANNELS = 4
 CHANNELS = ("SaO2", "PR", "THOR RES", "ABDO RES")
 
+# The blessed inference compute dtypes (PARITY.md "Tolerance tiers"):
+# f32 is the parity tier (fused==full <=1e-6), bf16 the documented
+# low-precision tier (<=2e-2 vs f32) — validated at config load so a
+# typo fails immediately, not at first trace.
+VALID_COMPUTE_DTYPES = ("float32", "bfloat16")
+
+# MCD predictor engines (UQConfig.mcd_engine): 'xla' is the default
+# vmap-over-keys path; 'pallas' the fused conv->BN->ReLU->dropout TPU
+# kernel (ops/pallas_mcd.py), which falls back to 'xla' off-TPU.
+VALID_MCD_ENGINES = ("xla", "pallas")
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -52,6 +63,19 @@ class ModelConfig:
     # so compute_dtype='float32' alone is NOT strict f32 there — set
     # matmul_precision='highest' for strict numerical-parity work.
     matmul_precision: str | None = None
+
+    def __post_init__(self):
+        # Reject at config load, not at first trace: a typo'd dtype would
+        # otherwise surface minutes later as an opaque jnp.dtype error
+        # inside the first jitted program.  The two members are the
+        # blessed inference tiers (PARITY.md "Tolerance tiers"); anything
+        # else (f16, f64, int8) is unblessed by the parity suite and the
+        # audit's program-dtype-drift rule.
+        if self.compute_dtype not in VALID_COMPUTE_DTYPES:
+            raise ValueError(
+                f"ModelConfig.compute_dtype must be one of "
+                f"{VALID_COMPUTE_DTYPES}, got {self.compute_dtype!r}"
+            )
 
 
 @dataclass(frozen=True)
@@ -135,6 +159,16 @@ class UQConfig:
     # on TPU at reference scale, backend-specific stream
     # (ops/pallas_bootstrap.py).
     bootstrap_engine: str = "exact"
+    # MCD predictor engine: 'xla' (default) is the vmap-over-keys path;
+    # 'pallas' the fused conv->BN->ReLU->dropout TPU kernel
+    # (ops/pallas_mcd.py) — masks drawn in-kernel from the hardware PRNG
+    # (never materialized in HBM), weights + the window tile read once
+    # per tile instead of once per pass.  Off-TPU (and in 'parity' mode
+    # or on a mesh) the pallas engine falls back to the XLA body exactly
+    # like the bootstrap kernel; like that kernel its mask stream is
+    # backend-specific, so cross-engine bit-parity is not provided —
+    # the kernel math itself is pinned by interpret-mode tests.
+    mcd_engine: str = "xla"
     mcd_mode: str = "clean"
     # Stream MCD / DE window chunks from host memory
     # (mc_dropout_predict_streaming / ensemble_predict_streaming) instead
@@ -163,6 +197,16 @@ class UQConfig:
     mcd_batch_size: int = 512
     entropy_eps: float = 1e-10  # uq_techniques.py:35
     decision_threshold: float = 0.5
+
+    def __post_init__(self):
+        # Same load-time rejection contract as ModelConfig.compute_dtype:
+        # an unknown engine must fail when the config is built, not deep
+        # inside the first eval's predictor dispatch.
+        if self.mcd_engine not in VALID_MCD_ENGINES:
+            raise ValueError(
+                f"UQConfig.mcd_engine must be one of {VALID_MCD_ENGINES}, "
+                f"got {self.mcd_engine!r}"
+            )
 
 
 @dataclass(frozen=True)

@@ -87,7 +87,12 @@ class AlarconCNN1D(nn.Module):
 
         # Global average pooling over the time axis
         # (cnn_baseline_train.py:91), then the single-logit head (:94).
-        x = jnp.mean(x, axis=1)
+        # The 60-element mean accumulates in f32 even under
+        # compute_dtype='bfloat16' — a bf16 accumulator loses ~3 bits
+        # over the reduction tree, and the audit's program-dtype-drift
+        # rule treats bf16-accumulated reduces as unblessed in every
+        # tier (PARITY.md "Tolerance tiers").
+        x = jnp.mean(x.astype(jnp.float32), axis=1).astype(dtype)
         x = nn.Dense(
             features=1,
             dtype=dtype,

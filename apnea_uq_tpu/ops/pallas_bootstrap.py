@@ -69,6 +69,43 @@ _CDF = [
 _ICDF = [int(t * (1 << 24)) for t in _CDF]
 
 
+def _counts_from_bits(bits: jax.Array) -> jax.Array:
+    """24-bit uniform draws -> Poisson(1) counts via the truncated
+    inverse CDF (10 integer threshold compares).  Shared by the
+    hardware-PRNG kernel and the injected-bits interpret twin, so the
+    interpret-mode tests exercise the shipped count math."""
+    counts = jnp.zeros(bits.shape, jnp.int32)
+    for t in _ICDF:
+        counts = counts + (bits > t).astype(jnp.int32)
+    return counts
+
+
+def _count_matmul(counts: jax.Array, v: jax.Array) -> jax.Array:
+    """(B, tile) counts x (N_ROWS, tile) packed rows -> (B, N_ROWS).
+    Full-f32 matmul precision is REQUIRED: the TPU MXU's default
+    single-pass bf16 truncates v's mantissa, which both biases the sums
+    (~0.25% observed on near-constant entropy rows) and collapses the
+    tiny across-resample variance the CIs are made of.  HIGHEST selects
+    the multi-pass bf16 decomposition that recovers f32 accuracy;
+    counts are small integers (exact in any precision)."""
+    return jax.lax.dot_general(
+        counts.astype(jnp.float32), v,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def _accumulate_tile(out_ref, acc, j) -> None:
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = acc
+
+    @pl.when(j != 0)
+    def _accum():
+        out_ref[...] += acc
+
+
 def _kernel(seed_ref, v_ref, out_ref, *, b_padded, tile):
     j = pl.program_id(0)
     # Deterministic per (key, tile) stream: the tile index is folded into
@@ -77,29 +114,60 @@ def _kernel(seed_ref, v_ref, out_ref, *, b_padded, tile):
     # independent of grid size.
     pltpu.prng_seed(seed_ref[0], seed_ref[1] ^ (j * 0x61C88647))
     bits = pltpu.prng_random_bits((b_padded, tile)) & 0x00FFFFFF
-    counts = jnp.zeros((b_padded, tile), jnp.int32)
-    for t in _ICDF:
-        counts = counts + (bits > t).astype(jnp.int32)
-    # Full-f32 matmul precision is REQUIRED: the TPU MXU's default
-    # single-pass bf16 truncates v's mantissa, which both biases the sums
-    # (~0.25% observed on near-constant entropy rows) and collapses the
-    # tiny across-resample variance the CIs are made of.  HIGHEST selects
-    # the multi-pass bf16 decomposition that recovers f32 accuracy;
-    # counts are small integers (exact in any precision).
-    acc = jax.lax.dot_general(
-        counts.astype(jnp.float32), v_ref[...],
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )  # (b_padded, N_ROWS)
+    counts = _counts_from_bits(bits)
+    acc = _count_matmul(counts, v_ref[...])  # (b_padded, N_ROWS)
+    _accumulate_tile(out_ref, acc, j)
 
-    @pl.when(j == 0)
-    def _init():
-        out_ref[...] = acc
 
-    @pl.when(j != 0)
-    def _accum():
-        out_ref[...] += acc
+def _injected_bits_kernel(bits_ref, v_ref, out_ref):
+    """Interpret-mode twin of :func:`_kernel`: the same count inverse-CDF
+    and the same HIGHEST-precision count matmul, with the uniform draws
+    read from an operand instead of the hardware PRNG (interpret mode has
+    none) — so tier-1 exercises the kernel body on CPU, not just the XLA
+    fallback (ISSUE 12 satellite)."""
+    j = pl.program_id(0)
+    counts = _counts_from_bits(bits_ref[...] & 0x00FFFFFF)
+    _accumulate_tile(out_ref, _count_matmul(counts, v_ref[...]), j)
+
+
+def poisson_sums_from_bits(v, bits, *, tile: int = 2048,
+                           interpret: bool = True):
+    """(B, N_ROWS) count-weighted sums from INJECTED 24-bit uniform draws
+    ``bits`` ((B, M) int32), running the kernel body under
+    ``pl.pallas_call(..., interpret=True)`` on any backend.  Test/parity
+    surface only — the production entry point is
+    :func:`poisson_bootstrap_sums`."""
+    v = jnp.asarray(v, jnp.float32)
+    if v.ndim != 2 or v.shape[0] != N_ROWS:
+        raise ValueError(f"expected ({N_ROWS}, M) packed rows, got {v.shape}")
+    bits = jnp.asarray(bits, jnp.int32)
+    if bits.ndim != 2 or bits.shape[1] != v.shape[1]:
+        raise ValueError(
+            f"bits must be (B, {v.shape[1]}), got {bits.shape}")
+    n_boot = bits.shape[0]
+    b_padded = -(-n_boot // 8) * 8
+    m = v.shape[1]
+    m_padded = -(-m // tile) * tile
+    if m_padded != m:
+        v = jnp.pad(v, ((0, 0), (0, m_padded - m)))
+        # Zero-padded draws sit below every CDF threshold -> count 0,
+        # AND they multiply all-zero metric rows; either alone suffices
+        # for exactness.
+        bits = jnp.pad(bits, ((0, 0), (0, m_padded - m)))
+    if b_padded != n_boot:
+        bits = jnp.pad(bits, ((0, b_padded - n_boot), (0, 0)))
+    out = pl.pallas_call(
+        _injected_bits_kernel,
+        grid=(m_padded // tile,),
+        in_specs=[
+            pl.BlockSpec((b_padded, tile), lambda j: (0, j)),
+            pl.BlockSpec((N_ROWS, tile), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((b_padded, N_ROWS), lambda j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b_padded, N_ROWS), jnp.float32),
+        interpret=interpret,
+    )(bits, v)
+    return out[:n_boot]
 
 
 @partial(jax.jit, static_argnames=("n_boot", "tile"))

@@ -1,0 +1,394 @@
+"""Fused MC-Dropout inference as a Pallas TPU kernel family.
+
+MC Dropout is the eval pipeline's dominant cost: T=50 stochastic forward
+passes per window batch.  The XLA path (uq/predict.py ``_mcd_passes``)
+vmaps the passes over dropout keys, which keeps the MXU fed but makes
+every pass re-stream the weights and the window chunk through HBM, and
+materializes every threefry dropout mask as a full activation-shaped
+tensor (mask generation alone measured ~40% of MCD wall-clock on TPU;
+utils/prng.py).  The passes differ ONLY by dropout mask — Gal &
+Ghahramani's estimator is embarrassingly parallel across them — so the
+weights and the input tile are loop-invariant T times over.
+
+This kernel restructures the hot loop around that invariance.  Per
+window tile it
+
+- loads the tile and ALL layer operands (conv kernels, biases, the
+  frozen-BatchNorm statistics folded to one per-channel affine) into
+  VMEM **once**, then runs every pass against the resident copies —
+  weights and windows are read once per tile instead of once per pass;
+- draws the dropout masks **in-kernel** from the TPU's hardware PRNG
+  (``pltpu.prng_random_bits``, the bootstrap kernel's count trick —
+  ops/pallas_bootstrap.py): masks never materialize in HBM at all;
+- keeps each pass's activations resident in VMEM across the
+  conv->ReLU->BN->dropout blocks (no per-layer HBM round-trips), with
+  passes processed in ``pass_group``-sized batches so the live
+  activation block stays inside the ~16 MB VMEM budget: at the default
+  geometry (``window_tile=16``, ``pass_group=8``) the widest layer
+  (256 ch) holds 8x16x60x256 f32 ~= 7.9 MB in + ~6.9 MB out, next to
+  ~3.4 MB of resident weights.
+
+Mask-stream discipline: the per-(pass, chunk) ``fold_in`` key
+discipline of the XLA path (PR-1) maps here to a per-(key, chunk, tile)
+hardware-PRNG seed — ``fold_in(key, chunk_idx)``'s key data, with the
+tile index folded into the second seed word exactly like the bootstrap
+kernel — so masks are position-stable (same key + same chunk + same
+tile -> same masks, independent of grid size).  Like the bootstrap
+kernel, the hardware stream differs from threefry: the pallas engine is
+distributionally equivalent to the XLA engine, not bit-equal — the
+kernel *math* is pinned elementwise by the interpret-mode tests below.
+
+Restrictions (uq/predict.py ``resolve_mcd_engine`` falls back to the
+XLA body, exactly like the bootstrap kernel's off-TPU fallback):
+
+- ``mode='clean'`` only: parity mode's BatchNorm batch statistics are
+  whole-chunk reductions, incompatible with independent window tiles.
+- single device (``mesh=None``): the kernel is a per-chip program.
+- TPU backend with the pallas TPU package importable.
+
+Off-TPU the kernel BODY still runs under tier-1: the injected-mask
+entry (:func:`mcd_forward_with_masks`) executes the identical tile body
+under ``pl.pallas_call(..., interpret=True)`` with caller-supplied keep
+masks (interpret mode has no hardware PRNG), compared in tests against
+an independent ``lax.conv_general_dilated`` reference at the PARITY.md
+tolerance tiers (f32 <=1e-6-grade, bf16 <=2e-2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+# Default tile geometry: the VMEM budget math in the module docstring.
+# Both are kwargs on the public entry points — the bench `mcd_kernel`
+# block is where alternative operating points get measured.
+DEFAULT_WINDOW_TILE = 16
+DEFAULT_PASS_GROUP = 8
+
+# Dropout thresholds quantize rates to 24-bit uniforms, like the
+# bootstrap kernel's Poisson inverse CDF.
+_MASK_BITS = 24
+
+# Odd golden-ratio constant decorrelating per-tile seed words (shared
+# convention with ops/pallas_bootstrap.py).
+_TILE_SEED_STRIDE = 0x61C88647
+
+
+def pallas_mcd_available() -> bool:
+    """Whether the fused kernel can actually run here (TPU backend with
+    the pallas TPU package importable) — the same gate the bootstrap
+    kernel's dispatch uses."""
+    return pltpu is not None and jax.default_backend() == "tpu"
+
+
+class LayerOperands(NamedTuple):
+    """One conv block's kernel-resident operands.  BatchNorm enters as a
+    single per-channel affine: clean-mode MCD freezes BN at the running
+    statistics, so (x - mean) * scale/sqrt(var + eps) + bias folds to
+    x * bn_scale + bn_shift outside the kernel."""
+
+    kernel: jax.Array    # (k, c_in, c_out) f32
+    bias: jax.Array      # (1, c_out) f32
+    bn_scale: jax.Array  # (1, c_out) f32
+    bn_shift: jax.Array  # (1, c_out) f32
+
+
+def fold_layer_params(
+    model, variables
+) -> Tuple[List[LayerOperands], jax.Array, jax.Array]:
+    """Flax variable tree -> the kernel's flat operand list:
+    per-block :class:`LayerOperands` plus the dense head's
+    ((c, 1) kernel, (1, 1) bias).  Biases and BN affines are shipped as
+    (1, c) 2-D rows — 1-D operands tile poorly on TPU."""
+    cfg = model.config
+    params = variables["params"]
+    stats = variables["batch_stats"]
+    layers = []
+    for i in range(len(cfg.features)):
+        conv = params[f"conv_{i}"]
+        bn = params[f"bn_{i}"]
+        mean = stats[f"bn_{i}"]["mean"].astype(jnp.float32)
+        var = stats[f"bn_{i}"]["var"].astype(jnp.float32)
+        a = params[f"bn_{i}"]["scale"].astype(jnp.float32) * jax.lax.rsqrt(
+            var + cfg.bn_epsilon
+        )
+        b = bn["bias"].astype(jnp.float32) - mean * a
+        layers.append(LayerOperands(
+            kernel=conv["kernel"].astype(jnp.float32),
+            bias=conv["bias"].reshape(1, -1).astype(jnp.float32),
+            bn_scale=a.reshape(1, -1),
+            bn_shift=b.reshape(1, -1),
+        ))
+    head = params["head"]
+    return (layers, head["kernel"].astype(jnp.float32),
+            head["bias"].reshape(1, -1).astype(jnp.float32))
+
+
+def _conv1d_same(x: jax.Array, kernel: jax.Array, dtype) -> jax.Array:
+    """SAME-padded 1-D convolution as k shifted MXU matmuls: operands
+    cast to the compute dtype, accumulation pinned f32
+    (``preferred_element_type``) in every tier.  x: (n, t, c_in),
+    kernel: (k, c_in, c_out) -> (n, t, c_out) f32."""
+    n, t, c_in = x.shape
+    k = kernel.shape[0]
+    left = (k - 1) // 2
+    xp = jnp.pad(x.astype(dtype), ((0, 0), (left, k - 1 - left), (0, 0)))
+    out = None
+    for j in range(k):
+        xs = jax.lax.slice_in_dim(xp, j, j + t, axis=1)
+        contrib = jax.lax.dot_general(
+            xs.reshape(n * t, c_in), kernel[j].astype(dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        out = contrib if out is None else out + contrib
+    return out.reshape(n, t, -1)
+
+
+def _tile_body(x_tile, layers, head_w, head_b, rates, masks_for,
+               n_passes_padded: int, pass_group: int, compute_dtype):
+    """The shared kernel math: (tile_w, t, c) windows -> (T_padded,
+    tile_w) probabilities.  ``masks_for(g0, g, li, shape)`` supplies the
+    float 0/1 keep mask of one pass group's dropout layer — drawn from
+    the hardware PRNG on the TPU path, loaded from an injected operand
+    on the interpret path — so BOTH paths execute this exact body and
+    the interpret tests exercise the shipped math, not a transcript of
+    it.  Per pass group, activations stay in (VMEM-resident) values
+    across all conv blocks; only the (g, tile_w) probabilities leave."""
+    dtype = jnp.dtype(compute_dtype)
+    tile_w, t_steps, _ = x_tile.shape
+    rows = []
+    for g0 in range(0, n_passes_padded, pass_group):
+        g = min(pass_group, n_passes_padded - g0)
+        a = jnp.broadcast_to(x_tile[None], (g,) + x_tile.shape)
+        a = a.reshape(g * tile_w, t_steps, x_tile.shape[-1])
+        for li, layer in enumerate(layers):
+            a = _conv1d_same(a, layer.kernel, dtype)
+            a = a + layer.bias[None]
+            a = jnp.maximum(a, 0.0)
+            a = a * layer.bn_scale[None] + layer.bn_shift[None]
+            rate = rates[li]
+            if rate > 0.0:
+                keep = masks_for(g0, g, li, a.shape)
+                a = a * (keep / (1.0 - rate))
+        # GAP accumulates f32 like the Flax model (models/cnn1d.py).
+        pooled = jnp.mean(a.astype(jnp.float32), axis=1)
+        logits = jax.lax.dot_general(
+            pooled.astype(dtype), head_w.astype(dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + head_b
+        probs = jax.nn.sigmoid(logits[:, 0].astype(jnp.float32))
+        rows.append(probs.reshape(g, tile_w))
+    return jnp.concatenate(rows, axis=0)
+
+
+def _split_layer_refs(param_refs, n_layers: int):
+    layers = [
+        LayerOperands(*(param_refs[4 * i + j][...] for j in range(4)))
+        for i in range(n_layers)
+    ]
+    head_w = param_refs[4 * n_layers][...]
+    head_b = param_refs[4 * n_layers + 1][...]
+    return layers, head_w, head_b
+
+
+def _prng_kernel(seed_ref, x_ref, *refs, n_layers, rates, thresholds,
+                 n_passes_padded, pass_group, compute_dtype):
+    """TPU kernel: per tile, seed the hardware PRNG from (key, chunk,
+    tile) and draw every pass group's keep masks in-kernel — the masks
+    live only as VMEM values, never as HBM tensors."""
+    out_ref = refs[-1]
+    layers, head_w, head_b = _split_layer_refs(refs[:-1], n_layers)
+    j = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0], seed_ref[1] ^ (j * _TILE_SEED_STRIDE))
+
+    def masks_for(g0, g, li, shape):
+        n, t_steps, c = shape
+        bits = pltpu.prng_random_bits((n * t_steps, c)) & 0x00FFFFFF
+        # keep iff bits >= rate * 2^24  ->  P(keep) = 1 - rate, the
+        # flax bernoulli(keep_prob) semantics on a 24-bit uniform.
+        return (bits >= thresholds[li]).astype(jnp.float32).reshape(shape)
+
+    out_ref[...] = _tile_body(
+        x_ref[...], layers, head_w, head_b, rates, masks_for,
+        n_passes_padded, pass_group, compute_dtype,
+    )
+
+
+def _injected_kernel(x_ref, *refs, n_layers, n_masked, rates,
+                     n_passes_padded, pass_group, compute_dtype):
+    """Interpret-mode twin: identical body, keep masks read from
+    operands instead of the hardware PRNG (interpret mode has none) —
+    the CPU tier-1 exercise of the kernel math (ISSUE 12 satellite)."""
+    out_ref = refs[-1]
+    mask_refs = refs[-1 - n_masked:-1]
+    layers, head_w, head_b = _split_layer_refs(refs[:-1 - n_masked],
+                                               n_layers)
+    masked_order = [li for li, r in enumerate(rates) if r > 0.0]
+
+    def masks_for(g0, g, li, shape):
+        m = mask_refs[masked_order.index(li)][...]
+        return m[g0:g0 + g].reshape(shape)
+
+    out_ref[...] = _tile_body(
+        x_ref[...], layers, head_w, head_b, rates, masks_for,
+        n_passes_padded, pass_group, compute_dtype,
+    )
+
+
+def _pad_axis(a: jax.Array, multiple: int, axis: int) -> jax.Array:
+    n = a.shape[axis]
+    padded = -(-n // multiple) * multiple
+    if padded == n:
+        return a
+    pads = [(0, 0)] * a.ndim
+    pads[axis] = (0, padded - n)
+    return jnp.pad(a, pads)
+
+
+def _param_specs(layers, head_w, head_b):
+    """Whole-array BlockSpecs for the resident operands: every tile maps
+    to block (0, ..) — read once, reused for all T passes."""
+    specs = []
+    operands = []
+    for layer in layers:
+        for arr in layer:
+            operands.append(arr)
+            specs.append(pl.BlockSpec(
+                arr.shape, lambda j, nd=arr.ndim: (0,) * nd))
+    for arr in (head_w, head_b):
+        operands.append(arr)
+        specs.append(pl.BlockSpec(
+            arr.shape, lambda j, nd=arr.ndim: (0,) * nd))
+    return operands, specs
+
+
+def mcd_pallas_passes(
+    model,
+    variables: dict,
+    chunk: jax.Array,
+    key: jax.Array,
+    chunk_idx,
+    n_passes: int,
+    *,
+    window_tile: int = DEFAULT_WINDOW_TILE,
+    pass_group: int = DEFAULT_PASS_GROUP,
+) -> jax.Array:
+    """(T, bs) clean-mode MCD probabilities of ONE window chunk through
+    the fused TPU kernel — the drop-in pallas twin of uq/predict.py's
+    ``_mcd_passes`` body (same signature role, same output contract).
+    Traceable; call sites gate on :func:`pallas_mcd_available` (the
+    kernel itself assumes a TPU backend).
+
+    Zero-padded windows are exact here the same way the bootstrap
+    kernel's padding is: clean-mode MCD has no cross-window coupling
+    (BN frozen, GAP per window), so padded windows produce padded
+    probability columns that the caller slices off."""
+    cfg = model.config
+    rates = tuple(float(r) for r in cfg.dropout_rates)
+    thresholds = tuple(int(r * (1 << _MASK_BITS)) for r in rates)
+    layers, head_w, head_b = fold_layer_params(model, variables)
+    m = chunk.shape[0]
+    x = _pad_axis(jnp.asarray(chunk, jnp.float32), window_tile, axis=0)
+    n_padded = -(-n_passes // pass_group) * pass_group
+    # Per-(key, chunk) seed words; the tile index decorrelates in-kernel.
+    seeds = jnp.asarray(
+        jax.random.key_data(jax.random.fold_in(key, chunk_idx)), jnp.uint32
+    ).astype(jnp.int32).reshape(-1)[:2]
+    operands, specs = _param_specs(layers, head_w, head_b)
+    out = pl.pallas_call(
+        partial(
+            _prng_kernel, n_layers=len(layers), rates=rates,
+            thresholds=thresholds, n_passes_padded=n_padded,
+            pass_group=pass_group, compute_dtype=cfg.compute_dtype,
+        ),
+        grid=(x.shape[0] // window_tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((window_tile,) + x.shape[1:],
+                         lambda j: (j, 0, 0)),
+            *specs,
+        ],
+        out_specs=pl.BlockSpec((n_padded, window_tile), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((n_padded, x.shape[0]),
+                                       jnp.float32),
+    )(seeds, x, *operands)
+    return out[:n_passes, :m]
+
+
+def mcd_forward_with_masks(
+    model,
+    variables: dict,
+    chunk,
+    masks: Sequence,
+    *,
+    window_tile: int = 8,
+    pass_group: int = 4,
+    interpret: bool = True,
+) -> jax.Array:
+    """The kernel body under ``pl.pallas_call(..., interpret=True)``
+    with INJECTED keep masks — tier-1's CPU exercise of the kernel math.
+
+    ``masks`` holds one float 0/1 array of shape ``(T, M, time,
+    features_i)`` per dropout layer with a nonzero rate, in layer order.
+    Returns (T, M) probabilities.  The interpret path runs the exact
+    ``_tile_body`` the TPU kernel runs; only the mask source differs
+    (interpret mode has no hardware PRNG)."""
+    cfg = model.config
+    rates = tuple(float(r) for r in cfg.dropout_rates)
+    masked = [li for li, r in enumerate(rates) if r > 0.0]
+    if not masked:
+        raise ValueError(
+            "model has no nonzero dropout rates — the injected-mask "
+            "entry exists to exercise the mask math; use the eval-mode "
+            "model directly for a deterministic forward"
+        )
+    if len(masks) != len(masked):
+        raise ValueError(
+            f"expected {len(masked)} mask arrays (one per nonzero-rate "
+            f"dropout layer), got {len(masks)}"
+        )
+    layers, head_w, head_b = fold_layer_params(model, variables)
+    m = chunk.shape[0]
+    n_passes = masks[0].shape[0]
+    x = _pad_axis(jnp.asarray(chunk, jnp.float32), window_tile, axis=0)
+    n_padded = -(-n_passes // pass_group) * pass_group
+    mask_arrays = []
+    mask_specs = []
+    for mask in masks:
+        mk = _pad_axis(jnp.asarray(mask, jnp.float32), pass_group, axis=0)
+        mk = _pad_axis(mk, window_tile, axis=1)
+        mask_arrays.append(mk)
+        mask_specs.append(pl.BlockSpec(
+            (n_padded, window_tile) + mk.shape[2:],
+            lambda j: (0, j, 0, 0)))
+    operands, specs = _param_specs(layers, head_w, head_b)
+    out = pl.pallas_call(
+        partial(
+            _injected_kernel, n_layers=len(layers), n_masked=len(masks),
+            rates=rates, n_passes_padded=n_padded, pass_group=pass_group,
+            compute_dtype=cfg.compute_dtype,
+        ),
+        grid=(x.shape[0] // window_tile,),
+        in_specs=[
+            pl.BlockSpec((window_tile,) + x.shape[1:],
+                         lambda j: (j, 0, 0)),
+            *specs,
+            *mask_specs,
+        ],
+        out_specs=pl.BlockSpec((n_padded, window_tile), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((n_padded, x.shape[0]),
+                                       jnp.float32),
+        interpret=interpret,
+    )(x, *operands, *mask_arrays)
+    return out[:n_passes, :m]

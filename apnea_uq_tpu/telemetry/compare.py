@@ -191,6 +191,16 @@ def _metrics_from_context(ctx: Any) -> Dict[str, Metric]:
             streamed.get("mcd_streamed_vs_inhbm"), "ratio", False)
         put("streamed.de10_streamed_vs_inhbm",
             streamed.get("de10_streamed_vs_inhbm"), "ratio", False)
+    kernel = ok("mcd_kernel")
+    if kernel:
+        # XLA-vs-Pallas and f32-vs-bf16 speedups at the fixed smoke
+        # operating point: relative, backend-INDEPENDENT ratios (like
+        # bootstrap.speedup) — deliberately NOT bound, so they gate
+        # across the CPU-proxy boundary whenever both rounds carry them.
+        put("mcd_kernel.xla_vs_pallas", kernel.get("xla_vs_pallas"),
+            "ratio", True)
+        put("mcd_kernel.f32_vs_bf16", kernel.get("f32_vs_bf16"),
+            "ratio", True)
     fused = ok("fused_reduction")
     if fused:
         put("fused.fused_vs_full", fused.get("fused_vs_full"), "ratio",

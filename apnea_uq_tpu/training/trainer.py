@@ -226,10 +226,15 @@ def predict_proba_batched(model, variables, x, *, batch_size: int = 8192,
     """Deterministic (eval-mode) probabilities, chunked over windows;
     with ``mesh``, each chunk shards over its ``data`` axis.  The program
     is acquired through the compile-cost subsystem (label
-    ``predict_eval``) when a store is active, so the eval drivers'
+    ``predict_eval``, ``predict_eval_bf16`` under
+    ``ModelConfig.compute_dtype='bfloat16'`` — the audit's blessed
+    low-precision tier) when a store is active, so the eval drivers'
     sanity probe starts hot in a warmed process.
     ``record_memory_only=True`` (warm-cache) acquires/prices from an
     abstract window set and dispatches nothing."""
+    label = ("predict_eval_bf16"
+             if jnp.dtype(model.config.compute_dtype) == jnp.bfloat16
+             else "predict_eval")
     data_sharding = None
     if mesh is not None:
         from apnea_uq_tpu.parallel import mesh as mesh_lib  # cycle-breaker
@@ -246,7 +251,7 @@ def predict_proba_batched(model, variables, x, *, batch_size: int = 8192,
     else:
         x = jnp.asarray(x, jnp.float32)
     args = (model, variables, x, batch_size, data_sharding)
-    program = program_store.get_program("predict_eval", _predict_jit, *args)
+    program = program_store.get_program(label, _predict_jit, *args)
     if record_memory_only:
         return None
     return program(*args) if program is not None else _predict_jit(*args)

@@ -472,6 +472,7 @@ def run_mcd_analysis(
                 run_log=run_log,
                 record_memory_only=record_memory_only,
                 stats=stat_spec,
+                engine=config.mcd_engine,
             )
         return mc_dropout_predict(
             model, variables, x,
@@ -483,6 +484,7 @@ def run_mcd_analysis(
             run_log=run_log,
             record_memory_only=record_memory_only,
             stats=stat_spec,
+            engine=config.mcd_engine,
         )
 
     if run_log is not None:

@@ -26,7 +26,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apnea_uq_tpu.compilecache import store as program_store
+from apnea_uq_tpu.config import VALID_MCD_ENGINES
 from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
+from apnea_uq_tpu.ops import pallas_mcd
 from apnea_uq_tpu.parallel import mesh as mesh_lib
 from apnea_uq_tpu.telemetry import memory as telemetry_memory
 from apnea_uq_tpu.uq.metrics import N_STAT_ROWS, sufficient_stats
@@ -40,6 +42,87 @@ else:
     from jax.experimental.shard_map import shard_map as _shard_map
 
 _MCD_MODES = {"clean": "mcd_clean", "parity": "mcd_parity"}
+
+# Every program label the predictors can emit, spelled as LITERALS: the
+# warm-cache zoo (compilecache/zoo.py GROUP_LABELS), the audit manifest,
+# and the drift pin (tests/test_compilecache.py scrapes these sources
+# for label string constants) all key off these exact strings.  The
+# grammar is base + optional suffixes in fixed order:
+#   mcd[_chunk]_predict[_pallas][_fused][_bf16]
+#   de[_chunk]_predict[_fused][_bf16]
+# `_chunk` = the streamed per-chunk program, `_pallas` = the fused
+# ops/pallas_mcd.py engine was REQUESTED (the label tracks the request;
+# off-TPU the same label runs the XLA fallback body, exactly like the
+# bootstrap kernel), `_fused` = on-device sufficient-statistics
+# reduction, `_bf16` = ModelConfig.compute_dtype='bfloat16' (the audit's
+# blessed low-precision tier — audit/rules.py program-dtype-drift).
+MCD_PROGRAM_LABELS = (
+    "mcd_predict", "mcd_predict_bf16",
+    "mcd_predict_fused", "mcd_predict_fused_bf16",
+    "mcd_predict_pallas", "mcd_predict_pallas_bf16",
+    "mcd_predict_pallas_fused", "mcd_predict_pallas_fused_bf16",
+    "mcd_chunk_predict", "mcd_chunk_predict_bf16",
+    "mcd_chunk_predict_fused", "mcd_chunk_predict_fused_bf16",
+    "mcd_chunk_predict_pallas", "mcd_chunk_predict_pallas_bf16",
+    "mcd_chunk_predict_pallas_fused", "mcd_chunk_predict_pallas_fused_bf16",
+)
+DE_PROGRAM_LABELS = (
+    "de_predict", "de_predict_bf16",
+    "de_predict_fused", "de_predict_fused_bf16",
+    "de_chunk_predict", "de_chunk_predict_bf16",
+    "de_chunk_predict_fused", "de_chunk_predict_fused_bf16",
+)
+
+
+def _dtype_tag(model: AlarconCNN1D) -> str:
+    return ("_bf16" if jnp.dtype(model.config.compute_dtype) == jnp.bfloat16
+            else "")
+
+
+def mcd_program_label(model: AlarconCNN1D, *, streamed: bool, engine: str,
+                      fused: bool) -> str:
+    """The MCD program label a (model config, engine, path) combination
+    prices/stores/dispatches under.  Derived from the REQUESTED engine —
+    deterministic across backends — so a CPU audit, a warm-cache, and a
+    TPU eval of the same config all name the same program."""
+    label = "mcd_chunk_predict" if streamed else "mcd_predict"
+    if engine == "pallas":
+        label += "_pallas"
+    if fused:
+        label += "_fused"
+    label += _dtype_tag(model)
+    assert label in MCD_PROGRAM_LABELS, label
+    return label
+
+
+def de_program_label(model: AlarconCNN1D, *, streamed: bool,
+                     fused: bool) -> str:
+    label = "de_chunk_predict" if streamed else "de_predict"
+    if fused:
+        label += "_fused"
+    label += _dtype_tag(model)
+    assert label in DE_PROGRAM_LABELS, label
+    return label
+
+
+def resolve_mcd_engine(engine: str, mode: str,
+                       mesh: Optional[jax.sharding.Mesh]) -> str:
+    """The engine a predict call actually dispatches.  'pallas' resolves
+    to the fused kernel only where the kernel is valid — TPU backend,
+    ``mode='clean'`` (parity mode's BatchNorm batch statistics are
+    whole-chunk reductions, incompatible with independent window tiles),
+    single device — and silently falls back to the XLA body everywhere
+    else, exactly like the bootstrap kernel's off-TPU fallback
+    (ops/pallas_bootstrap.py).  Program LABELS track the requested
+    engine (:func:`mcd_program_label`); only the dispatched body
+    changes."""
+    if engine not in VALID_MCD_ENGINES:
+        raise ValueError(
+            f"engine must be one of {VALID_MCD_ENGINES}, got {engine!r}")
+    if (engine == "pallas" and mode == "clean" and mesh is None
+            and pallas_mcd.pallas_mcd_available()):
+        return "pallas"
+    return "xla"
 
 
 def _uq_stats(probs: jax.Array, base: str, eps: float) -> jax.Array:
@@ -112,10 +195,28 @@ def _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh):
     return jax.vmap(one_pass, spmd_axis_name=mesh_lib.AXIS_ENSEMBLE)(keys)
 
 
+def _chunk_passes(model, variables, chunk, key, keys, chunk_idx, mode,
+                  mesh, engine):
+    """ONE chunk's T stochastic passes under the RESOLVED engine: the
+    XLA vmap body (:func:`_mcd_passes`) or the fused Pallas kernel
+    (ops/pallas_mcd.py, clean-mode single-device TPU only — the
+    resolver guarantees it).  The pallas body re-derives its hardware
+    seed from (key, chunk_idx), the kernel-side spelling of the XLA
+    path's per-(pass, chunk) fold_in discipline."""
+    if engine == "pallas":
+        with jax.named_scope("mcd_pallas"):
+            return pallas_mcd.mcd_pallas_passes(
+                model, variables, chunk, key, chunk_idx, keys.shape[0])
+    return _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh)
+
+
 @partial(
-    jax.jit, static_argnames=("model", "n_passes", "mode", "batch_size", "mesh")
+    jax.jit,
+    static_argnames=("model", "n_passes", "mode", "batch_size", "mesh",
+                     "engine"),
 )
-def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None):
+def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None,
+             engine="xla"):
     """With ``mesh``, the T stochastic passes shard over the ``ensemble``
     axis and each chunk's windows over the ``data`` axis, so all devices
     work on every chunk; the computation per (pass, window) is unchanged —
@@ -127,8 +228,8 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None):
     def one_chunk(args):
         with jax.named_scope("mcd_chunk"):
             chunk, chunk_idx = args
-            return _mcd_passes(model, variables, chunk, keys, chunk_idx,
-                               mode, mesh)
+            return _chunk_passes(model, variables, chunk, key, keys,
+                                 chunk_idx, mode, mesh, engine)
 
     probs = jax.lax.map(
         one_chunk, (chunks, jnp.arange(chunks.shape[0]))
@@ -137,24 +238,26 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None):
     return probs[:, :m]
 
 
-@partial(jax.jit, static_argnames=("model", "n_passes", "mode", "mesh"))
+@partial(jax.jit,
+         static_argnames=("model", "n_passes", "mode", "mesh", "engine"))
 def _mcd_chunk_jit(model, variables, chunk, key, chunk_idx, n_passes, mode,
-                   mesh=None):
+                   mesh=None, engine="xla"):
     """All T passes of ONE window chunk — the streamed unit of work.
-    Same body as the in-HBM path (:func:`_mcd_passes`): split to T keys,
+    Same body as the in-HBM path (:func:`_chunk_passes`): split to T keys,
     fold in the chunk index, identical sharding, so streamed and in-HBM
     predictions are identical and a pod's chips all work on every chunk."""
     keys = jax.random.split(key, n_passes)
-    return _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh)
+    return _chunk_passes(model, variables, chunk, key, keys, chunk_idx,
+                         mode, mesh, engine)
 
 
 @partial(
     jax.jit,
     static_argnames=("model", "n_passes", "mode", "batch_size", "base",
-                     "mesh"),
+                     "mesh", "engine"),
 )
 def _mcd_stats_jit(model, variables, x, key, n_passes, mode, batch_size,
-                   base, eps, mesh=None):
+                   base, eps, mesh=None, engine="xla"):
     """Fused in-HBM MCD program: same chunked T-pass body as
     :func:`_mcd_jit` (same keys, same masks, same sharding), but each
     chunk's (T, bs) probabilities collapse on device to the (4, bs)
@@ -169,8 +272,8 @@ def _mcd_stats_jit(model, variables, x, key, n_passes, mode, batch_size,
     def one_chunk(args):
         with jax.named_scope("mcd_chunk"):
             chunk, chunk_idx = args
-            probs = _mcd_passes(model, variables, chunk, keys, chunk_idx,
-                                mode, mesh)
+            probs = _chunk_passes(model, variables, chunk, key, keys,
+                                  chunk_idx, mode, mesh, engine)
             return _constrain(_uq_stats(probs, base, eps), mesh, None,
                               mesh_lib.AXIS_DATA)
 
@@ -181,16 +284,19 @@ def _mcd_stats_jit(model, variables, x, key, n_passes, mode, batch_size,
     return stats[:, :m]
 
 
-@partial(jax.jit,
-         static_argnames=("model", "n_passes", "mode", "base", "mesh"))
+@partial(
+    jax.jit,
+    static_argnames=("model", "n_passes", "mode", "base", "mesh", "engine"),
+)
 def _mcd_chunk_stats_jit(model, variables, chunk, key, chunk_idx, n_passes,
-                         mode, base, eps, mesh=None):
+                         mode, base, eps, mesh=None, engine="xla"):
     """Fused streamed unit of work: all T passes of ONE chunk
     (:func:`_mcd_chunk_jit`'s exact body — same key discipline, same
     sharding) reduced on device to the chunk's (4, bs) sufficient
     statistics, so the per-chunk D2H fetch shrinks from T rows to 4."""
     keys = jax.random.split(key, n_passes)
-    probs = _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh)
+    probs = _chunk_passes(model, variables, chunk, key, keys, chunk_idx,
+                          mode, mesh, engine)
     return _constrain(_uq_stats(probs, base, eps), mesh, None,
                       mesh_lib.AXIS_DATA)
 
@@ -300,6 +406,7 @@ def mc_dropout_predict_streaming(
     run_log=None,
     record_memory_only: bool = False,
     stats=None,
+    engine: str = "xla",
 ) -> "np.ndarray":
     """(T, M) MCD probabilities with the window set streamed from HOST
     memory: chunks flow through the double-buffered prefetch feed
@@ -308,9 +415,13 @@ def mc_dropout_predict_streaming(
     whole set — the scaling story for test sets that exceed HBM
     (SURVEY §5.7; replaces the whole-set-as-one-batch pattern of
     uq_techniques.py:22).  Produces bit-identical results to
-    :func:`mc_dropout_predict` for the same key and ``mesh`` — both
-    paths chunk at :func:`effective_batch_size`, so toggling
-    streaming never changes predictions.
+    :func:`mc_dropout_predict` for the same key, ``mesh`` and resolved
+    ``engine`` — both paths chunk at :func:`effective_batch_size`, so
+    toggling streaming never changes predictions.
+
+    ``engine='pallas'`` runs each chunk's T passes through the fused
+    ops/pallas_mcd.py kernel where valid (clean mode, no mesh, TPU),
+    falling back to the XLA body elsewhere (:func:`resolve_mcd_engine`).
 
     ``stats=(entropy_base, eps)`` switches to the fused reduction: each
     chunk's T resident passes collapse on device to the per-window
@@ -327,6 +438,7 @@ def mc_dropout_predict_streaming(
         raise ValueError(f"mode must be 'clean' or 'parity', got {mode!r}")
     if key is None:
         key = prng.stochastic_key(seed)
+    resolved_engine = resolve_mcd_engine(engine, mode, mesh)
     if mesh is not None:
         # Chunks must place shard-wise (an unsharded device_put fails on
         # a process-spanning mesh); the rounding is shared with the
@@ -342,18 +454,24 @@ def mc_dropout_predict_streaming(
     if stats is not None:
         base, eps = stats
         eps = float(eps)
-        label, fn, n_rows = ("mcd_chunk_predict_fused", _mcd_chunk_stats_jit,
-                             N_STAT_ROWS)
+        label, fn, n_rows = (
+            mcd_program_label(model, streamed=True, engine=engine,
+                              fused=True),
+            _mcd_chunk_stats_jit, N_STAT_ROWS)
 
         def chunk_args(chunk, ci):
             return (model, variables, chunk, key, jnp.asarray(ci, jnp.int32),
-                    n_passes, _MCD_MODES[mode], base, eps, mesh)
+                    n_passes, _MCD_MODES[mode], base, eps, mesh,
+                    resolved_engine)
     else:
-        label, fn, n_rows = "mcd_chunk_predict", _mcd_chunk_jit, n_passes
+        label, fn, n_rows = (
+            mcd_program_label(model, streamed=True, engine=engine,
+                              fused=False),
+            _mcd_chunk_jit, n_passes)
 
         def chunk_args(chunk, ci):
             return (model, variables, chunk, key, jnp.asarray(ci, jnp.int32),
-                    n_passes, _MCD_MODES[mode], mesh)
+                    n_passes, _MCD_MODES[mode], mesh, resolved_engine)
 
     # Abstract chunk at the placement the real streamed chunks land with
     # (sharded over the data axis on a mesh), so the acquired/priced
@@ -401,8 +519,21 @@ def mc_dropout_predict(
     run_log=None,
     record_memory_only: bool = False,
     stats=None,
+    engine: str = "xla",
 ) -> jax.Array:
     """(T, M) positive-class probabilities from T stochastic passes.
+
+    ``engine='pallas'`` (``UQConfig.mcd_engine``) runs each chunk's T
+    passes through the fused conv->BN->ReLU->dropout TPU kernel
+    (ops/pallas_mcd.py): weights and the window tile load into VMEM once
+    per tile instead of once per pass, and the dropout masks are drawn
+    in-kernel from the hardware PRNG, never materializing in HBM.  Where
+    the kernel is invalid (off-TPU, 'parity' mode, a mesh) the call
+    silently falls back to the XLA body — :func:`resolve_mcd_engine`,
+    the same fallback contract as the bootstrap kernel.  The hardware
+    mask stream differs from threefry, so the two engines are
+    distributionally equivalent, not bit-equal (PARITY.md "Tolerance
+    tiers").
 
     ``stats=(entropy_base, eps)`` switches to the fused reduction:
     the same chunked T-pass program reduces each chunk on device to the
@@ -442,6 +573,7 @@ def mc_dropout_predict(
         raise ValueError(f"mode must be 'clean' or 'parity', got {mode!r}")
     if key is None:
         key = prng.stochastic_key(seed)
+    resolved_engine = resolve_mcd_engine(engine, mode, mesh)
     if record_memory_only:
         # The drivers' pre-timing pass lowers from an abstract window
         # set: same shape/dtype/sharding (so the compiled program — and
@@ -467,13 +599,15 @@ def mc_dropout_predict(
     # cannot drift from the executed one.
     if stats is not None:
         base, eps = stats
-        label, fn = "mcd_predict_fused", _mcd_stats_jit
+        label, fn = (mcd_program_label(model, streamed=False, engine=engine,
+                                       fused=True), _mcd_stats_jit)
         args = (model, variables, x, key, n_passes, _MCD_MODES[mode],
-                batch_size, base, float(eps), mesh)
+                batch_size, base, float(eps), mesh, resolved_engine)
     else:
-        label, fn = "mcd_predict", _mcd_jit
+        label, fn = (mcd_program_label(model, streamed=False, engine=engine,
+                                       fused=False), _mcd_jit)
         args = (model, variables, x, key, n_passes, _MCD_MODES[mode],
-                batch_size, mesh)
+                batch_size, mesh, resolved_engine)
     program = program_store.get_program(label, fn, *args, run_log=run_log)
     if run_log is not None:
         # Compiled-HBM accounting (one memory_profile event per program
@@ -695,21 +829,19 @@ def ensemble_predict_streaming(
     # cannot drift from the executed one.  Full-probs mesh chunks come
     # back with the wrap-padded member rows (sliced off after assembly);
     # fused chunks exclude the duplicates inside the jit.
+    label = de_program_label(model, streamed=True, fused=stats is not None)
     if mesh is None and stats is None:
-        label, fn, n_rows = "de_chunk_predict", _ensemble_chunk_jit, n_members
+        fn, n_rows = _ensemble_chunk_jit, n_members
         chunk_args = lambda chunk, ci: (model, member_variables, chunk)
     elif mesh is None:
-        label, fn, n_rows = ("de_chunk_predict_fused",
-                             _ensemble_chunk_stats_jit, N_STAT_ROWS)
+        fn, n_rows = _ensemble_chunk_stats_jit, N_STAT_ROWS
         chunk_args = lambda chunk, ci: (model, member_variables, chunk,
                                         base, eps)
     elif stats is None:
-        label, fn, n_rows = ("de_chunk_predict", _ensemble_chunk_mesh_jit,
-                             n_padded)
+        fn, n_rows = _ensemble_chunk_mesh_jit, n_padded
         chunk_args = lambda chunk, ci: (model, member_variables, chunk, mesh)
     else:
-        label, fn, n_rows = ("de_chunk_predict_fused",
-                             _ensemble_chunk_mesh_stats_jit, N_STAT_ROWS)
+        fn, n_rows = _ensemble_chunk_mesh_stats_jit, N_STAT_ROWS
         chunk_args = lambda chunk, ci: (model, member_variables, chunk,
                                         n_members, base, eps, mesh)
 
@@ -796,18 +928,19 @@ def ensemble_predict(
     # ONE (label, fn, args) tuple drives the program-store acquisition,
     # the memory pricing and the dispatch, so the priced/stored program
     # cannot drift from the executed one.
+    label = de_program_label(model, streamed=False, fused=stats is not None)
     if mesh is not None and stats is not None:
-        label, fn = "de_predict_fused", _ensemble_shard_map_stats_jit
+        fn = _ensemble_shard_map_stats_jit
         args = (model, member_variables, x, batch_size, n_members, base,
                 eps, mesh)
     elif mesh is not None:
-        label, fn = "de_predict", _ensemble_shard_map_jit
+        fn = _ensemble_shard_map_jit
         args = (model, member_variables, x, batch_size, mesh)
     elif stats is not None:
-        label, fn = "de_predict_fused", _ensemble_stats_jit
+        fn = _ensemble_stats_jit
         args = (model, member_variables, x, batch_size, base, eps)
     else:
-        label, fn = "de_predict", _ensemble_jit
+        fn = _ensemble_jit
         args = (model, member_variables, x, batch_size)
     program = program_store.get_program(label, fn, *args, run_log=run_log)
     if run_log is not None:

@@ -45,6 +45,10 @@ BENCH_SKIP_DE=1 to skip the DE secondary, BENCH_SKIP_STREAMED=1 to skip
 the streamed-overhead context, BENCH_SKIP_FUSED=1 to skip the
 fused-reduction context (fused (4, M) sufficient-stats output vs the
 full (T, M) probability round-trip, end-to-end incl. host fetch),
+BENCH_SKIP_MCD_KERNEL=1 to skip the mcd_kernel context (XLA-vs-Pallas
+MCD engines and f32-vs-bf16 compute at the fixed smoke operating
+point; its speedup ratios gate as backend-independent relatives
+across the CPU-proxy boundary),
 BENCH_SKIP_COMPILE=1 to skip the compile context (cold-vs-warm process
 start of the MCD hot path through the persistent compile cache + AOT
 program store, measured as two probe subprocesses),
@@ -793,6 +797,62 @@ def bench_fused(model, variables, x_host, n_passes, chunk) -> dict:
     }
 
 
+def bench_mcd_kernel() -> dict:
+    """Isolated ``mcd_kernel`` block (ISSUE 12): XLA-vs-Pallas MCD
+    engines and f32-vs-bf16 compute at the FIXED smoke operating point
+    (256 windows x T=4 x chunk 64 — deliberately not the headline
+    shapes, so every round measures the same cheap point on every chip).
+    The speedup ratios are backend-independent-relative metrics
+    (``mcd_kernel.xla_vs_pallas`` / ``mcd_kernel.f32_vs_bf16``, like
+    ``bootstrap.speedup``), so `telemetry compare`/`trend` gate them
+    across the CPU-proxy boundary instead of refusing them as
+    backend-bound absolutes.  Off-TPU the pallas engine resolves to its
+    XLA fallback (uq/predict.py ``resolve_mcd_engine``); the recorded
+    ``pallas_engine`` field names the body that actually ran, so a
+    fallback round's ~1.0 ratio reads as what it is.  The bf16 half runs
+    only when the bench dtype is bf16 (BENCH_DTYPE=float32 smoke runs
+    skip it — CPU emulates bf16 convs orders of magnitude too slowly)."""
+    from apnea_uq_tpu.config import ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.uq import mc_dropout_predict
+    from apnea_uq_tpu.uq.predict import resolve_mcd_engine
+    from apnea_uq_tpu.utils import prng
+
+    n_windows, n_passes, chunk = 256, 4, 64
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(n_windows, 60, 4)), jnp.float32)
+    key = prng.stochastic_key(5)
+
+    def timed(dtype: str, engine: str) -> float:
+        model = AlarconCNN1D(ModelConfig(compute_dtype=dtype))
+        variables = init_variables(model, jax.random.key(0))
+
+        def fn(x):
+            return jnp.sum(mc_dropout_predict(
+                model, variables, x, n_passes=n_passes, mode="clean",
+                batch_size=chunk, key=key, engine=engine,
+            ))
+
+        return _time(fn, x, reps=3)
+
+    t_xla = timed("float32", "xla")
+    t_pallas = timed("float32", "pallas")
+    out = {
+        "windows": n_windows,
+        "passes": n_passes,
+        "chunk": chunk,
+        "xla_f32_s": round(t_xla, 4),
+        "pallas_f32_s": round(t_pallas, 4),
+        "xla_vs_pallas": round(t_xla / t_pallas, 3),
+        "pallas_engine": resolve_mcd_engine("pallas", "clean", None),
+    }
+    if _bench_dtype() == "bfloat16":
+        t_bf16 = timed("bfloat16", "xla")
+        out["xla_bf16_s"] = round(t_bf16, 4)
+        out["f32_vs_bf16"] = round(t_xla / t_bf16, 3)
+    return out
+
+
 def bench_compile_startup(n_windows: int, n_passes: int, chunk: int) -> dict:
     """Cold-vs-warm process start of the MCD hot path, end to end
     (ISSUE 7): run the compile-cost probe subprocess twice against the
@@ -1213,8 +1273,9 @@ def _run_bench(run_log, proxy: bool) -> dict:
             return result
 
         primary = run("de_train", de_primary, device=True)
-        for name in ("mcd", "bootstrap", "streamed", "fused", "compile",
-                     "program_audit", "data_plane", "d2h_accounting"):
+        for name in ("mcd", "bootstrap", "streamed", "fused", "mcd_kernel",
+                     "compile", "program_audit", "data_plane",
+                     "d2h_accounting"):
             run(name, None, skip=True, reason="BENCH_METRIC=de_train")
     else:
         def mcd():
@@ -1252,6 +1313,13 @@ def _run_bench(run_log, proxy: bool) -> dict:
             reason="mcd block did not complete" if dep_gone else None,
         )
         attach("fused_reduction", "fused", fused)
+        kernel = run(
+            "mcd_kernel", bench_mcd_kernel, device=True,
+            skip=bool(os.environ.get("BENCH_SKIP_MCD_KERNEL")),
+            reason=("BENCH_SKIP_MCD_KERNEL"
+                    if os.environ.get("BENCH_SKIP_MCD_KERNEL") else None),
+        )
+        attach("mcd_kernel", "mcd_kernel", kernel)
 
         def de():
             result, waste_state = bench_de_train("secondary")

@@ -208,19 +208,43 @@ def test_f64_leak_flagged_with_pointable_location():
     assert f.line == ctx.label_lines["mcd_predict"] > 1
 
 
-def test_bf16_accumulation_flagged_only_for_fused_labels():
+def test_bf16_accumulation_and_tier_blessing():
+    """The blessed low-precision tier (ISSUE 12 satellite): bf16 tensor
+    types are legal ONLY under a `_bf16` label; bf16-ACCUMULATED reduces
+    fail the `_fused` statistics programs in every tier."""
     cap = _bf16_reduce_capture()
-    assert cap.bf16_accum_reduces >= 1
+    assert cap.bf16_accum_reduces >= 1 and cap.bf16_ops >= 1
+    assert cap.tier == "f32"
+    # An f32-tier fused label: both the unblessed bf16 types AND the
+    # bf16 accumulation are violations.
     fused = run_program_rules(
         _context({"mcd_predict_fused": cap}, manifest={}),
         rules=["program-dtype-drift"])
-    assert len(fused) == 1 and "bf16" in fused[0].message
-    # The same lowering under a non-stats label is legal bf16 compute.
+    assert len(fused) == 2
+    assert any("accumulate in bf16" in f.message for f in fused)
+    assert any("f32-tier" in f.message for f in fused)
+    # An f32-tier NON-fused label still needs the tier for its bf16
+    # tensor types (one finding, no accumulation complaint).
     relabeled = dataclasses.replace(cap, label="mcd_predict")
     plain = run_program_rules(
         _context({"mcd_predict": relabeled}, manifest={}),
         rules=["program-dtype-drift"])
-    assert plain == []
+    assert len(plain) == 1 and "f32-tier" in plain[0].message
+    # The blessed tier: `_bf16` labels may carry bf16 tensor types...
+    blessed = dataclasses.replace(cap, label="mcd_predict_bf16")
+    assert blessed.tier == "bf16"
+    assert run_program_rules(
+        _context({"mcd_predict_bf16": blessed}, manifest={}),
+        rules=["program-dtype-drift"]) == []
+    # ... but a fused `_bf16` program must STILL accumulate its
+    # statistics in f32 (`_fused` sits mid-label in the suffix grammar).
+    blessed_fused = dataclasses.replace(cap,
+                                        label="mcd_predict_fused_bf16")
+    findings = run_program_rules(
+        _context({"mcd_predict_fused_bf16": blessed_fused}, manifest={}),
+        rules=["program-dtype-drift"])
+    assert len(findings) == 1
+    assert "accumulate in bf16" in findings[0].message
 
 
 def test_cross_member_collective_is_unconditional_violation():
@@ -428,7 +452,16 @@ def test_checked_in_manifest_covers_every_zoo_label():
     assert manifest is not None
     assert set(manifest) == set(ALL_LABELS)
     for label, row in manifest.items():
-        assert set(row) == {"group", "collectives", "donates", "aliased"}
+        assert set(row) == {"group", "tier", "collectives", "donates",
+                            "aliased"}
+        # The tier column is label-derived and the manifest is its
+        # reviewer-readable mirror: `_bf16` labels are the blessed
+        # low-precision tier, everything else f32 (ISSUE 12 satellite).
+        assert row["tier"] == ("bf16" if label.endswith("_bf16")
+                               else "f32"), label
+    # Both tiers actually exist in the checked-in zoo.
+    tiers = {row["tier"] for row in manifest.values()}
+    assert tiers == {"f32", "bf16"}
     # The repo-wide promises, as checked-in facts: no explicit
     # collectives anywhere in the zoo, and the lockstep ensemble epoch
     # both declares donation and keeps it through compilation.
@@ -510,6 +543,8 @@ def test_cli_update_manifest_round_trip(monkeypatch, capsys, tmp_path,
     capsys.readouterr()
     assert load_manifest(path)["train_epoch"]["collectives"] == {
         "psum[data]": 1}
+    # The tier column survives the --update-manifest round trip.
+    assert load_manifest(path)["train_epoch"]["tier"] == "f32"
     # Drift: the program changes (loses its collective) -> exit 1.
     _patch_zoo(monkeypatch, {"train_epoch": _clean_capture(
         label="train_epoch", group="train")})

@@ -203,8 +203,20 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     audit_ctx = ctx["program_audit"]
     assert "error" not in audit_ctx, audit_ctx
     assert audit_ctx["clean"] is True and audit_ctx["unsuppressed"] == 0
-    for label in ("mcd_predict_fused", "de_predict_fused", "predict_eval"):
+    for label in ("mcd_predict_fused", "mcd_predict_pallas_fused",
+                  "mcd_predict_fused_bf16", "de_predict_fused",
+                  "predict_eval", "predict_eval_bf16"):
         assert audit_ctx["programs"][label]["flops"] > 0, (label, audit_ctx)
+    # MCD-kernel block (ISSUE 12): XLA-vs-Pallas at the smoke operating
+    # point.  Off-TPU the pallas engine resolves to the XLA fallback, so
+    # the smoke run pins the fallback contract (ratio ~1) and records
+    # which body ran; the bf16 half is skipped at BENCH_DTYPE=float32.
+    kernel_ctx = ctx["mcd_kernel"]
+    assert "error" not in kernel_ctx, kernel_ctx
+    assert kernel_ctx["xla_f32_s"] > 0 and kernel_ctx["pallas_f32_s"] > 0
+    assert kernel_ctx["xla_vs_pallas"] > 0
+    assert kernel_ctx["pallas_engine"] == "xla"
+    assert "f32_vs_bf16" not in kernel_ctx
     # D2H-accounting block (ISSUE 11): the arithmetic transfer contract
     # at the run's shapes, present even when no device ran.
     d2h_ctx = ctx["d2h_accounting"]
@@ -220,7 +232,7 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert result["backend"]["requested"] == "cpu"
     blocks = result["blocks"]
     assert {n for n, b in blocks.items() if b["status"] == "ok"} == {
-        "mcd", "bootstrap", "streamed", "fused", "de_train",
+        "mcd", "bootstrap", "streamed", "fused", "mcd_kernel", "de_train",
         "earlystop_waste", "compile", "program_audit", "data_plane",
         "d2h_accounting"}, blocks
     assert all(b["seconds"] >= 0 for b in blocks.values()), blocks
@@ -737,6 +749,10 @@ def _stub_blocks(bench_mod, monkeypatch, *, fail=(), values=None):
         "fused", v("fused", {"fused_vs_full": 0.8,
                              "d2h_bytes_full": 4096,
                              "d2h_bytes_fused": 4096})))
+    monkeypatch.setattr(bench_mod, "bench_mcd_kernel", make(
+        "mcd_kernel", v("mcd_kernel", {"xla_vs_pallas": 1.0,
+                                       "f32_vs_bf16": 1.5,
+                                       "pallas_engine": "xla"})))
     monkeypatch.setattr(bench_mod, "bench_de_earlystop_waste", make(
         "earlystop_waste", v("earlystop_waste", {"patience": 5})))
     monkeypatch.setattr(bench_mod, "bench_compile_startup", make(
@@ -773,7 +789,8 @@ class TestMainDispatch:
         # BENCH_METRIC/BENCH_SKIP_* must not reroute the branch under
         # test (the same sanitization the subprocess smoke test does).
         for k in ("BENCH_METRIC", "BENCH_SKIP_DE", "BENCH_SKIP_STREAMED",
-                  "BENCH_SKIP_FUSED", "BENCH_SKIP_COMPILE",
+                  "BENCH_SKIP_FUSED", "BENCH_SKIP_MCD_KERNEL",
+                  "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
                   "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
             monkeypatch.delenv(k, raising=False)
@@ -791,9 +808,9 @@ class TestMainDispatch:
         assert out["secondary"]["metric"] == "de2_train_wallclock"
         assert out["schema"] == 2 and out["proxy"] is False
         ok = {n for n, b in out["blocks"].items() if b["status"] == "ok"}
-        assert ok == {"mcd", "bootstrap", "streamed", "fused", "de_train",
-                      "earlystop_waste", "compile", "program_audit",
-                      "data_plane", "d2h_accounting"}
+        assert ok == {"mcd", "bootstrap", "streamed", "fused", "mcd_kernel",
+                      "de_train", "earlystop_waste", "compile",
+                      "program_audit", "data_plane", "d2h_accounting"}
         assert out["context"]["bootstrap_b100_m293k"] == {"speedup": 20.0}
         assert (out["secondary"]["context"]["early_stop_waste"]
                 == {"patience": 5})
@@ -843,7 +860,8 @@ class TestBlockIsolation:
                            str(tmp_path / "progress.json"))
         monkeypatch.setenv("BENCH_RUN_DIR", str(tmp_path / "bench_run"))
         for k in ("BENCH_METRIC", "BENCH_SKIP_DE", "BENCH_SKIP_STREAMED",
-                  "BENCH_SKIP_FUSED", "BENCH_SKIP_COMPILE",
+                  "BENCH_SKIP_FUSED", "BENCH_SKIP_MCD_KERNEL",
+                  "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
                   "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
             monkeypatch.delenv(k, raising=False)
@@ -941,8 +959,8 @@ class TestBlockIsolation:
         from apnea_uq_tpu.cli.main import main as cli_main
 
         all_blocks = ("mcd", "de_train", "bootstrap", "streamed", "fused",
-                      "earlystop_waste", "compile", "program_audit",
-                      "data_plane", "d2h_accounting")
+                      "mcd_kernel", "earlystop_waste", "compile",
+                      "program_audit", "data_plane", "d2h_accounting")
         _stub_blocks(self.bench_mod, monkeypatch)
         good = self._run_to_file(capsys, "good.json")
         _stub_blocks(self.bench_mod, monkeypatch, fail=all_blocks)

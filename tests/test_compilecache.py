@@ -335,10 +335,14 @@ class TestCompileEventReadSide:
 
 def _driver_labels():
     """Every program label the drivers price/acquire, scraped from the
-    sources (the labels are string literals matching the zoo grammar)."""
+    sources (the labels are string literals matching the zoo grammar —
+    uq/predict.py spells its full MCD/DE label grids as literal tuples
+    precisely so this scrape sees them).  Suffix grammar:
+    [_pallas][_fused][_bf16] in that order (ISSUE 12)."""
     label_re = re.compile(
-        r"^(?:(?:mcd|de)_(?:chunk_)?predict(?:_fused)?"
-        r"|train_epoch|val_loss|ensemble_epoch|predict_eval)$")
+        r"^(?:(?:mcd|de)_(?:chunk_)?predict(?:_pallas)?(?:_fused)?"
+        r"(?:_bf16)?"
+        r"|train_epoch|val_loss|ensemble_epoch|predict_eval(?:_bf16)?)$")
     found = set()
     for rel in ("apnea_uq_tpu/uq/predict.py",
                 "apnea_uq_tpu/training/trainer.py",
@@ -527,3 +531,34 @@ def test_warm_cache_then_eval_mcd_second_process(cli_registry):
         assert e["retraces"] == 0, e
     # And the summarizer reports the perfect hit ratio.
     assert telemetry.summarize_data(eval_dir)["compile"]["hit_ratio"] == 1.0
+
+
+def test_warm_cache_covers_bf16_and_pallas_labels(cli_registry):
+    """ISSUE 12: warm-cache warms the labels the config SELECTS — a
+    bf16 + pallas config acquires its programs under the suffixed zoo
+    labels (`_pallas`/`_bf16` grammar), so a later eval of that config
+    starts hot under exactly those names."""
+    import dataclasses
+
+    from apnea_uq_tpu.compilecache.store import ProgramStore
+    from apnea_uq_tpu.compilecache.zoo import warm_cache
+    from apnea_uq_tpu.config import load_config
+    from apnea_uq_tpu.data.registry import ArtifactRegistry
+
+    config = load_config(cli_registry["config"])
+    config = dataclasses.replace(
+        config,
+        model=dataclasses.replace(config.model, compute_dtype="bfloat16"),
+        uq=dataclasses.replace(config.uq, mcd_engine="pallas"),
+    )
+    registry = ArtifactRegistry(cli_registry["registry"])
+    store = ProgramStore(str(cli_registry["root"] / "bf16_store"))
+    with use_store(store):
+        events = warm_cache(registry, config, groups=("eval-mcd",))
+    labels = {e["label"] for e in events}
+    assert "mcd_predict_pallas_fused_bf16" in labels
+    assert "predict_eval_bf16" in labels
+    # The f32/xla labels are NOT warmed by this config — label selection
+    # is config-driven, not a blanket sweep (the audit covers the rest).
+    assert "mcd_predict_fused" not in labels
+    assert "predict_eval" not in labels

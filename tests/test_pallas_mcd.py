@@ -1,0 +1,360 @@
+"""Fused Pallas MCD kernel (ISSUE 12): interpret-mode kernel-body tests
+with injected masks (the CPU tier-1 exercise of the kernel MATH, not
+just the XLA fallback), engine resolution + fallback parity on every
+MCD program family, label/config validation, and the bootstrap kernel's
+injected-bits interpret twin.
+
+The hardware-PRNG path itself needs a TPU:
+``APNEA_UQ_TEST_TPU=1 pytest tests/test_pallas_mcd.py -k on_tpu``.
+"""
+
+import inspect
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apnea_uq_tpu.config import ModelConfig, UQConfig  # noqa: E402
+from apnea_uq_tpu.models import AlarconCNN1D, init_variables  # noqa: E402
+from apnea_uq_tpu.models.cnn1d import apply_model, predict_proba  # noqa: E402
+from apnea_uq_tpu.ops import pallas_mcd  # noqa: E402
+from apnea_uq_tpu.uq import mc_dropout_predict  # noqa: E402
+from apnea_uq_tpu.uq.predict import (  # noqa: E402
+    DE_PROGRAM_LABELS,
+    MCD_PROGRAM_LABELS,
+    de_program_label,
+    mc_dropout_predict_streaming,
+    mcd_program_label,
+    resolve_mcd_engine,
+)
+
+# The documented tolerance tiers (PARITY.md "Tolerance tiers").
+F32_TOL = dict(rtol=0, atol=1e-6)
+BF16_TOL = dict(rtol=0, atol=2e-2)
+
+
+def _model(dtype="float32", features=(6, 8), kernels=(5, 3),
+           rates=(0.3, 0.4)):
+    return AlarconCNN1D(ModelConfig(
+        features=features, kernel_sizes=kernels, dropout_rates=rates,
+        compute_dtype=dtype,
+    ))
+
+
+def _reference_forward(model, variables, x, masks):
+    """Independent forward: ``lax.conv_general_dilated`` convolutions
+    (NOT the kernel's shifted-matmul decomposition) + explicit BN/
+    dropout math, so agreement genuinely checks the kernel body."""
+    cfg = model.config
+    params = variables["params"]
+    stats = variables["batch_stats"]
+    n_passes = masks[0].shape[0] if masks else 1
+    h = jnp.broadcast_to(jnp.asarray(x, jnp.float32)[None],
+                         (n_passes,) + x.shape)
+    mask_i = 0
+    for i, rate in enumerate(cfg.dropout_rates):
+        flat = h.reshape((-1,) + tuple(h.shape[2:]))
+        out = jax.lax.conv_general_dilated(
+            flat, params[f"conv_{i}"]["kernel"], (1,), "SAME",
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        ) + params[f"conv_{i}"]["bias"]
+        out = jnp.maximum(out, 0.0)
+        out = (
+            (out - stats[f"bn_{i}"]["mean"])
+            * jax.lax.rsqrt(stats[f"bn_{i}"]["var"] + cfg.bn_epsilon)
+            * params[f"bn_{i}"]["scale"] + params[f"bn_{i}"]["bias"]
+        )
+        if rate > 0.0:
+            m = jnp.asarray(masks[mask_i], jnp.float32)
+            mask_i += 1
+            out = out * m.reshape(out.shape) / (1.0 - rate)
+        h = out.reshape((n_passes, -1) + tuple(out.shape[1:]))
+    pooled = h.mean(axis=2)
+    logits = pooled @ params["head"]["kernel"] + params["head"]["bias"]
+    return jax.nn.sigmoid(logits[..., 0])
+
+
+class TestInterpretKernel:
+    """The kernel BODY under pl.pallas_call(interpret=True) with
+    injected masks — identical `_tile_body` to the TPU path; only the
+    mask source differs (interpret mode has no hardware PRNG)."""
+
+    def test_keep_valued_masks_reduce_to_eval_mode(self, rng):
+        """Masks of constant value (1 - rate) cancel the dropout
+        scaling exactly, so the kernel must reproduce the deterministic
+        eval-mode model — end-to-end validation of the conv/BN/GAP/head
+        math against the real Flax forward."""
+        model = _model()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(11, 60, 4)).astype(np.float32)  # pads to 16
+        masks = [np.full((3, 11, 60, f), 1.0 - r, np.float32)
+                 for f, r in zip((6, 8), (0.3, 0.4))]
+        probs = np.asarray(pallas_mcd.mcd_forward_with_masks(
+            model, variables, x, masks))
+        ref = np.asarray(predict_proba(apply_model(
+            model, variables, jnp.asarray(x), mode="eval")[0]))
+        assert probs.shape == (3, 11)
+        np.testing.assert_allclose(probs, np.broadcast_to(ref, (3, 11)),
+                                   **F32_TOL)
+
+    def test_random_masks_match_independent_conv_reference(self, rng):
+        """Random 0/1 masks against the lax.conv reference: pins the
+        shifted-matmul convolution AND the mask application/scaling at
+        the f32 tier, across wrap-padded window tiles and pass groups."""
+        model = _model()
+        variables = init_variables(model, jax.random.key(1))
+        x = rng.normal(size=(13, 60, 4)).astype(np.float32)
+        masks = [(rng.uniform(size=(5, 13, 60, f)) > r).astype(np.float32)
+                 for f, r in zip((6, 8), (0.3, 0.4))]
+        probs = np.asarray(pallas_mcd.mcd_forward_with_masks(
+            model, variables, x, masks, window_tile=4, pass_group=2))
+        ref = np.asarray(_reference_forward(model, variables, x, masks))
+        assert probs.shape == (5, 13)
+        np.testing.assert_allclose(probs, ref, **F32_TOL)
+
+    def test_bf16_tier_against_f32_reference(self, rng):
+        """compute_dtype='bfloat16' through the kernel body stays within
+        the documented bf16 tier (<=2e-2) of the f32 reference — the
+        conv matmuls run bf16, accumulation and stats stay f32."""
+        model = _model("bfloat16")
+        f32_model = _model()
+        variables = init_variables(f32_model, jax.random.key(2))
+        x = rng.normal(size=(9, 60, 4)).astype(np.float32)
+        masks = [(rng.uniform(size=(2, 9, 60, f)) > r).astype(np.float32)
+                 for f, r in zip((6, 8), (0.3, 0.4))]
+        bf16 = np.asarray(pallas_mcd.mcd_forward_with_masks(
+            model, variables, x, masks))
+        ref = np.asarray(_reference_forward(f32_model, variables, x, masks))
+        np.testing.assert_allclose(bf16, ref, **BF16_TOL)
+
+    def test_mask_count_validated(self, rng):
+        model = _model()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(4, 60, 4)).astype(np.float32)
+        with pytest.raises(ValueError, match="mask arrays"):
+            pallas_mcd.mcd_forward_with_masks(
+                model, variables, x,
+                [np.ones((2, 4, 60, 6), np.float32)])  # needs 2, got 1
+        # A dropout-free model is a clear error, not an IndexError.
+        no_dropout = _model(rates=(0.0, 0.0))
+        with pytest.raises(ValueError, match="no nonzero dropout"):
+            pallas_mcd.mcd_forward_with_masks(no_dropout, variables, x, [])
+
+
+class TestEngineResolution:
+    """resolve_mcd_engine: the pallas engine is requested per call but
+    dispatches only where the kernel is valid; everywhere else the XLA
+    body runs under the SAME (pallas-suffixed) label — the bootstrap
+    kernel's fallback contract."""
+
+    def test_off_tpu_resolves_to_xla(self):
+        assert jax.default_backend() != "tpu"  # the CPU test rig
+        assert resolve_mcd_engine("pallas", "clean", None) == "xla"
+        assert resolve_mcd_engine("xla", "clean", None) == "xla"
+
+    def test_parity_mode_and_mesh_resolve_to_xla(self, monkeypatch):
+        # Even with the kernel nominally available, parity mode and a
+        # mesh must fall back: batch statistics are whole-chunk, and
+        # the kernel is a per-chip program.
+        monkeypatch.setattr(pallas_mcd, "pallas_mcd_available",
+                            lambda: True)
+        from apnea_uq_tpu.parallel import make_mesh
+
+        assert resolve_mcd_engine("pallas", "clean", None) == "pallas"
+        assert resolve_mcd_engine("pallas", "parity", None) == "xla"
+        assert resolve_mcd_engine(
+            "pallas", "clean", make_mesh(num_members=4)) == "xla"
+
+    def test_unknown_engine_rejected(self):
+        with pytest.raises(ValueError, match="engine"):
+            resolve_mcd_engine("bogus", "clean", None)
+
+    def test_fallback_is_bit_identical_on_every_family(self, rng):
+        """Off-TPU, engine='pallas' must produce EXACTLY the XLA path's
+        results on all four MCD program families — the fallback is the
+        same body, so toggling the engine off-TPU never changes
+        predictions (only the program label)."""
+        model = _model()
+        variables = init_variables(model, jax.random.key(0))
+        x = rng.normal(size=(21, 60, 4)).astype(np.float32)
+        key = jax.random.key(7)
+        stat_spec = ("nats", 1e-10)
+        for stats in (None, stat_spec):
+            ref = np.asarray(mc_dropout_predict(
+                model, variables, x, n_passes=4, batch_size=8, key=key,
+                stats=stats))
+            pal = np.asarray(mc_dropout_predict(
+                model, variables, x, n_passes=4, batch_size=8, key=key,
+                stats=stats, engine="pallas"))
+            np.testing.assert_array_equal(ref, pal)
+            streamed = np.asarray(mc_dropout_predict_streaming(
+                model, variables, x, n_passes=4, batch_size=8, key=key,
+                stats=stats, engine="pallas"))
+            np.testing.assert_array_equal(ref, streamed)
+
+
+class TestLabelsAndConfig:
+    def test_label_grammar(self):
+        f32 = _model()
+        bf16 = _model("bfloat16")
+        assert mcd_program_label(f32, streamed=False, engine="xla",
+                                 fused=False) == "mcd_predict"
+        assert mcd_program_label(bf16, streamed=True, engine="pallas",
+                                 fused=True) == \
+            "mcd_chunk_predict_pallas_fused_bf16"
+        assert de_program_label(bf16, streamed=False, fused=True) == \
+            "de_predict_fused_bf16"
+        assert de_program_label(f32, streamed=True, fused=False) == \
+            "de_chunk_predict"
+
+    def test_label_tables_cover_the_grammar(self):
+        """16 MCD labels (streamed x engine x fused x dtype) and 8 DE
+        labels (streamed x fused x dtype), no duplicates — and every
+        combination the builders can emit is in its table (the builders
+        assert membership on every call)."""
+        assert len(set(MCD_PROGRAM_LABELS)) == 16
+        assert len(set(DE_PROGRAM_LABELS)) == 8
+        for streamed in (False, True):
+            for engine in ("xla", "pallas"):
+                for fused in (False, True):
+                    for model in (_model(), _model("bfloat16")):
+                        mcd_program_label(model, streamed=streamed,
+                                          engine=engine, fused=fused)
+                        de_program_label(model, streamed=streamed,
+                                         fused=fused)
+
+    def test_compute_dtype_validated_at_config_load(self):
+        with pytest.raises(ValueError, match="compute_dtype"):
+            ModelConfig(compute_dtype="float16")
+        with pytest.raises(ValueError, match="compute_dtype"):
+            ModelConfig(compute_dtype="int8")
+        ModelConfig(compute_dtype="bfloat16")  # the blessed tier
+
+    def test_mcd_engine_validated_at_config_load(self):
+        with pytest.raises(ValueError, match="mcd_engine"):
+            UQConfig(mcd_engine="mosaic")
+        UQConfig(mcd_engine="pallas")
+
+    def test_config_json_round_trips_engine_and_dtype(self, tmp_path):
+        from apnea_uq_tpu.config import (ExperimentConfig, load_config,
+                                         save_config)
+
+        cfg = ExperimentConfig(
+            model=ModelConfig(compute_dtype="bfloat16"),
+            uq=UQConfig(mcd_engine="pallas"),
+        )
+        path = str(tmp_path / "config.json")
+        save_config(cfg, path)
+        loaded = load_config(path)
+        assert loaded.model.compute_dtype == "bfloat16"
+        assert loaded.uq.mcd_engine == "pallas"
+        # A hand-edited bad value fails AT LOAD, inside the dataclass.
+        text = open(path).read().replace('"bfloat16"', '"float16"')
+        open(path, "w").write(text)
+        with pytest.raises(ValueError, match="compute_dtype"):
+            load_config(path)
+
+    def test_eval_cli_flags_parse_and_override(self):
+        from apnea_uq_tpu.cli.main import build_parser
+        from apnea_uq_tpu.cli.stages import _apply_eval_overrides
+        from apnea_uq_tpu.config import ExperimentConfig
+
+        parser = build_parser()
+        args = parser.parse_args(
+            ["eval-mcd", "--registry", "r", "--compute-dtype", "bfloat16",
+             "--mcd-engine", "pallas"])
+        cfg = _apply_eval_overrides(args, ExperimentConfig())
+        assert cfg.model.compute_dtype == "bfloat16"
+        assert cfg.uq.mcd_engine == "pallas"
+        args = parser.parse_args(
+            ["eval-de", "--registry", "r", "--compute-dtype", "bfloat16"])
+        cfg = _apply_eval_overrides(args, ExperimentConfig())
+        assert cfg.model.compute_dtype == "bfloat16"
+        # No flags -> the config passes through untouched.
+        args = parser.parse_args(["eval-mcd", "--registry", "r"])
+        base = ExperimentConfig()
+        assert _apply_eval_overrides(args, base) is base
+
+    def test_overrides_fold_in_before_the_run_log_opens(self):
+        """The run-dir config snapshot must record the dtype/engine the
+        eval actually ran: the override application has to precede the
+        `_run(...)` bracket in both eval commands (source-order pin)."""
+        from apnea_uq_tpu.cli import stages
+
+        for cmd in (stages.cmd_eval_mcd, stages.cmd_eval_de):
+            src = inspect.getsource(cmd)
+            assert src.index("_apply_eval_overrides") < src.index(
+                "_run(args"), cmd.__name__
+
+
+class TestBootstrapInterpretKernel:
+    """The Poisson-bootstrap kernel body on CPU via injected bits
+    (ops/pallas_bootstrap.poisson_sums_from_bits): the same inverse-CDF
+    count math and HIGHEST-precision count matmul the TPU kernel runs."""
+
+    def test_injected_bits_match_numpy_reference(self, rng):
+        from apnea_uq_tpu.ops.pallas_bootstrap import (
+            _CDF, N_ROWS, poisson_sums_from_bits,
+        )
+
+        v = rng.uniform(size=(N_ROWS, 3000)).astype(np.float32)
+        bits = rng.integers(0, 1 << 24, size=(10, 3000)).astype(np.int32)
+        out = np.asarray(poisson_sums_from_bits(v, bits, tile=1024))
+        icdf = [int(t * (1 << 24)) for t in _CDF]
+        counts = np.zeros_like(bits)
+        for t in icdf:
+            counts += (bits > t).astype(np.int32)
+        ref = counts.astype(np.float64) @ v.T.astype(np.float64)
+        assert out.shape == (10, N_ROWS)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_count_distribution_is_poisson_one(self, rng):
+        """Uniform bits through the shipped inverse CDF produce
+        Poisson(1)-distributed counts (mean and variance ~1) — the
+        statistical contract the estimator rests on."""
+        from apnea_uq_tpu.ops.pallas_bootstrap import _counts_from_bits
+
+        bits = jnp.asarray(
+            rng.integers(0, 1 << 24, size=(64, 4096)), jnp.int32)
+        counts = np.asarray(_counts_from_bits(bits))
+        assert abs(counts.mean() - 1.0) < 0.02
+        assert abs(counts.var() - 1.0) < 0.05
+
+    def test_zero_padding_is_exact(self, rng):
+        from apnea_uq_tpu.ops.pallas_bootstrap import (
+            N_ROWS, poisson_sums_from_bits,
+        )
+
+        v = rng.uniform(size=(N_ROWS, 100)).astype(np.float32)
+        bits = rng.integers(0, 1 << 24, size=(5, 100)).astype(np.int32)
+        # tile > M forces padding; sums must equal the unpadded math.
+        padded = np.asarray(poisson_sums_from_bits(v, bits, tile=256))
+        exact = np.asarray(poisson_sums_from_bits(v, bits, tile=128))
+        np.testing.assert_allclose(padded, exact, rtol=1e-6)
+
+
+class TestPallasKernelOnTPU:
+    def test_mcd_pallas_passes_on_tpu(self, rng):
+        """TPU-only: the hardware-PRNG kernel is deterministic per
+        (key, chunk), pass-stochastic, and its per-window mean prob
+        agrees with the XLA engine within Monte-Carlo error."""
+        if jax.default_backend() != "tpu":
+            pytest.skip("pallas MCD kernel requires TPU")
+        model = _model()
+        variables = init_variables(model, jax.random.key(0))
+        x = jnp.asarray(rng.normal(size=(40, 60, 4)), jnp.float32)
+        key = jax.random.key(3)
+        a = np.asarray(pallas_mcd.mcd_pallas_passes(
+            model, variables, x, key, jnp.int32(0), 64))
+        b = np.asarray(pallas_mcd.mcd_pallas_passes(
+            model, variables, x, key, jnp.int32(0), 64))
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (64, 40)
+        assert np.all((a >= 0) & (a <= 1))
+        assert np.std(a, axis=0).max() > 0  # stochastic across passes
+        xla = np.asarray(mc_dropout_predict(
+            model, variables, x, n_passes=64, batch_size=40, key=key))
+        se = np.sqrt(a.var(axis=0) / 64 + xla.var(axis=0) / 64) + 1e-4
+        assert np.all(np.abs(a.mean(axis=0) - xla.mean(axis=0)) < 5 * se)

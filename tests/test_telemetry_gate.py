@@ -204,6 +204,51 @@ class TestCompare:
         (reg,) = comparison.regressions
         assert reg.name == "mcd_t50_inference_throughput"
 
+    def test_mcd_kernel_ratios_gate_across_proxy_boundary(self, tmp_path):
+        """ISSUE 12: the `mcd_kernel` block's XLA-vs-Pallas and
+        f32-vs-bf16 speedups are backend-INDEPENDENT relative metrics —
+        they survive the proxy-boundary drop and gate like
+        bootstrap.speedup, with higher-is-better direction."""
+        def v2(path, *, proxy, xla_vs_pallas, f32_vs_bf16):
+            doc = {
+                "metric": ("bench_cpu_proxy" if proxy
+                           else "mcd_t50_inference_throughput"),
+                "value": 3 if proxy else 1000.0,
+                "unit": "blocks" if proxy else "windows/sec/chip",
+                "vs_baseline": 0 if proxy else 10.0,
+                "schema": 2, "proxy": proxy,
+                "backend": {"platform": "cpu" if proxy else "tpu"},
+                "blocks": {"mcd_kernel": {"status": "ok", "seconds": 1.0}},
+                "context": {"mcd_kernel": {
+                    "xla_vs_pallas": xla_vs_pallas,
+                    "f32_vs_bf16": f32_vs_bf16,
+                    "pallas_engine": "xla" if proxy else "pallas",
+                }},
+            }
+            with open(path, "w") as f:
+                json.dump(doc, f)
+            return str(path)
+
+        device = v2(tmp_path / "device.json", proxy=False,
+                    xla_vs_pallas=3.0, f32_vs_bf16=1.8)
+        same = v2(tmp_path / "proxy_same.json", proxy=True,
+                  xla_vs_pallas=3.0, f32_vs_bf16=1.8)
+        comparison = compare_mod.compare_paths(device, same)
+        names = {d.name for d in comparison.deltas}
+        # The ratios crossed the proxy boundary instead of being
+        # dropped as backend-bound...
+        assert {"mcd_kernel.xla_vs_pallas",
+                "mcd_kernel.f32_vs_bf16"} <= names
+        assert not any(n.startswith("mcd_kernel")
+                       for n in comparison.skipped_backend_bound)
+        assert not comparison.regressions
+        # ...and a shrunk speedup regresses (higher-is-better ratio).
+        worse = v2(tmp_path / "worse.json", proxy=True,
+                   xla_vs_pallas=1.0, f32_vs_bf16=1.8)
+        regressed = {d.name for d in
+                     compare_mod.compare_paths(device, worse).regressions}
+        assert "mcd_kernel.xla_vs_pallas" in regressed
+
     def test_run_dir_proxy_mode_drops_shape_bound_metrics(self,
                                                           tmp_path):
         """A proxy bench run stamps bench_mode proxy:true into its own

@@ -439,6 +439,35 @@ class TestFusedStats:
         np.testing.assert_array_equal(fused[1], 0.0)
         np.testing.assert_allclose(fused[2], fused[3], rtol=0, atol=1e-7)
 
+    def test_bf16_fused_and_parity_tiers(self, rng):
+        """The blessed low-precision tier (ISSUE 12): under
+        ``compute_dtype='bfloat16'`` the fused reduction still equals
+        ``sufficient_stats`` of the bf16 full stack to <=1e-6 (the
+        stats accumulate f32 regardless of compute dtype), and the bf16
+        stack sits within the documented <=2e-2 tier of the f32 stack
+        (same threefry keys -> identical dropout masks, so elementwise
+        comparison is valid)."""
+        from apnea_uq_tpu.config import ModelConfig
+
+        bf16_model = AlarconCNN1D(ModelConfig(
+            features=(8, 8), kernel_sizes=(5, 3), dropout_rates=(0.3, 0.3),
+            compute_dtype="bfloat16",
+        ))
+        f32_model = _tiny()
+        variables = init_variables(f32_model, jax.random.key(0))
+        x = rng.normal(size=(53, 60, 4)).astype(np.float32)  # wrap-pads
+        key = jax.random.key(13)
+        common = dict(n_passes=5, batch_size=16, key=key)
+        full_bf16 = np.asarray(mc_dropout_predict(
+            bf16_model, variables, x, **common))
+        fused_bf16 = np.asarray(mc_dropout_predict(
+            bf16_model, variables, x, stats=STAT_SPEC, **common))
+        np.testing.assert_allclose(fused_bf16, _stats_of(full_bf16),
+                                   **self.TOL)
+        full_f32 = np.asarray(mc_dropout_predict(
+            f32_model, variables, x, **common))
+        np.testing.assert_allclose(full_bf16, full_f32, rtol=0, atol=2e-2)
+
     def test_record_memory_only_prices_fused_program(self, tmp_path, rng):
         from apnea_uq_tpu import telemetry
         from apnea_uq_tpu.telemetry.runlog import RunLog
