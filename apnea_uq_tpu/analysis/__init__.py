@@ -27,6 +27,7 @@ from apnea_uq_tpu.analysis.stats import (
 from apnea_uq_tpu.analysis.calibration import (
     CalibrationSummary,
     calibration_summary,
+    calibration_summary_from_arrays,
     reliability_bins,
 )
 from apnea_uq_tpu.analysis.windows import (
@@ -50,6 +51,7 @@ __all__ = [
     "window_level_analysis",
     "retention_curve",
     "calibration_summary",
+    "calibration_summary_from_arrays",
     "reliability_bins",
     "CalibrationSummary",
     "WindowAnalysis",

@@ -98,6 +98,24 @@ def calibration_summary(
 ) -> CalibrationSummary:
     """ECE/MCE/Brier plus the reliability table, in one pass."""
     probs, y = _validated(detailed)
+    return calibration_summary_from_arrays(probs, y, num_bins=num_bins)
+
+
+def calibration_summary_from_arrays(
+    probs, y, *, num_bins: int = 15
+) -> CalibrationSummary:
+    """The same summary straight from probability/label vectors — the
+    frame-free entry point the quality-telemetry layer uses (the eval
+    drivers already hold the per-window mean probabilities as arrays;
+    round-tripping them through a DataFrame would buy nothing)."""
+    probs = np.asarray(probs, np.float64).reshape(-1)
+    y = np.asarray(y, np.float64).reshape(-1)
+    if probs.size == 0:
+        raise ValueError("no probabilities to calibrate")
+    if probs.shape != y.shape:
+        raise ValueError(f"probs ({probs.shape[0]}) != labels ({y.shape[0]})")
+    if ((probs < 0) | (probs > 1)).any():
+        raise ValueError("probabilities must lie in [0, 1]")
     bins = _bins_from_arrays(probs, y, num_bins)
     occupied = bins["count"] > 0
     gaps = np.abs(bins.loc[occupied, "gap"].to_numpy())

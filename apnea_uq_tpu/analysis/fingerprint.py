@@ -1,0 +1,312 @@
+"""Per-channel data fingerprints and input-drift scoring (PSI/KS).
+
+The telemetry layer (PRs 2/3/10) observes only *systems* facts; this
+module adds the data half of model-quality observability: a compact
+statistical fingerprint of a window set — per channel: mean/std,
+min/max, histogram, approximate quantiles, NaN rate, flatline rate
+(dead lead) and saturation rate (railed sensor) — computed **streaming**
+over any row-indexable source (a plain ndarray or the sharded store's
+:class:`~apnea_uq_tpu.data.store.ShardedArray`, O(block) host memory).
+
+The fingerprint of the prepared test set is frozen into the registry at
+prepare time as the ``quality_baseline`` artifact; at eval time the
+live windows are re-binned against the **baseline's own histogram
+edges** and scored per channel with PSI (population stability index)
+and the two-sample KS statistic, so a drifted cohort becomes a
+gateable ``drift_fingerprint`` telemetry number instead of a silent
+miscalibration.
+
+Deliberately jax-free (pure NumPy): the fingerprint must be computable
+in ingest/prepare/CLI contexts where no accelerator backend exists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+FINGERPRINT_VERSION = 1
+DEFAULT_NUM_BINS = 32
+DEFAULT_BLOCK_ROWS = 16384
+
+#: Percentiles reported per channel (approximate, histogram-derived).
+QUANTILES = (1, 5, 25, 50, 75, 95, 99)
+
+# Proportion floor for PSI: empty bins would make the log-ratio
+# undefined, and the standard remedy is clipping, not smoothing the
+# whole distribution.
+_PSI_EPS = 1e-6
+
+# A window's channel counts as *saturated* when more than this fraction
+# of its samples sit exactly on the window's own extreme values while
+# the window is not flat — the railed-sensor shape (clipped ADC).
+_SATURATION_FRACTION = 0.5
+
+
+def _iter_blocks(x, block_rows: int):
+    """(start_row, materialized block) over any row-indexable source —
+    the ShardedArray scan primitive when available, plain slicing
+    otherwise.  Each block is O(block_rows)."""
+    iter_blocks = getattr(x, "iter_blocks", None)
+    if iter_blocks is not None:
+        yield from iter_blocks(block_rows)
+        return
+    for lo in range(0, len(x), block_rows):
+        yield lo, np.asarray(x[lo:lo + block_rows])
+
+
+def _derive_edges(x, num_bins: int, block_rows: int) -> List[np.ndarray]:
+    """Per-channel histogram edges from one cheap streaming min/max
+    pass: the observed range widened by half its span (floor 1e-3) so
+    moderate tail growth in a later cohort still lands in interior
+    bins; anything outside clamps into the boundary bins (which is
+    itself drift signal).  A separate pass — not the first block — so
+    the fingerprint is invariant to ``block_rows`` and the in-core and
+    out-of-core prepare paths freeze identical baselines."""
+    n_channels = int(np.shape(x)[-1])
+    lo = np.full(n_channels, np.inf)
+    hi = np.full(n_channels, -np.inf)
+    for _start, block in _iter_blocks(x, block_rows):
+        block = np.asarray(block, np.float64)
+        finite = np.isfinite(block)
+        lo = np.minimum(lo, np.where(finite, block,
+                                     np.inf).min(axis=(0, 1)))
+        hi = np.maximum(hi, np.where(finite, block,
+                                     -np.inf).max(axis=(0, 1)))
+    lo = np.where(np.isfinite(lo), lo, 0.0)
+    hi = np.where(np.isfinite(hi), hi, 0.0)
+    margin = np.maximum((hi - lo) * 0.5, 1e-3)
+    return [
+        np.linspace(lo[c] - margin[c], hi[c] + margin[c], num_bins + 1)
+        for c in range(n_channels)
+    ]
+
+
+def _hist_quantiles(edges: np.ndarray, counts: np.ndarray) -> Dict[str, Optional[float]]:
+    """Approximate percentiles from a histogram: linear interpolation
+    inside the bin where the CDF crosses each target.  Resolution is the
+    bin width — good enough for drift triage, and it keeps the
+    fingerprint one streaming pass."""
+    counts = np.asarray(counts, np.float64)
+    total = counts.sum()
+    out: Dict[str, Optional[float]] = {}
+    if total <= 0:
+        return {f"p{q:02d}": None for q in QUANTILES}
+    cdf = np.cumsum(counts) / total
+    for q in QUANTILES:
+        target = q / 100.0
+        i = min(int(np.searchsorted(cdf, target, side="left")),
+                len(counts) - 1)
+        prev = cdf[i - 1] if i else 0.0
+        width = counts[i] / total
+        frac = 0.0 if width <= 0 else min((target - prev) / width, 1.0)
+        out[f"p{q:02d}"] = float(edges[i] + frac * (edges[i + 1] - edges[i]))
+    return out
+
+
+def compute_fingerprint(
+    x,
+    *,
+    channel_names: Optional[Sequence[str]] = None,
+    num_bins: int = DEFAULT_NUM_BINS,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    edges: Optional[Sequence[np.ndarray]] = None,
+) -> Dict[str, Any]:
+    """Streaming pass(es) over ``x`` (shape (N, T, C)) -> the JSON-able
+    fingerprint document.  ``edges`` pins the per-channel histogram
+    edges (pass a baseline's to make two fingerprints bin-comparable;
+    one pass total); by default a separate cheap min/max pass derives
+    them from the GLOBAL range — never from the first block, which
+    would make the fingerprint depend on ``block_rows`` and break the
+    pinned in-core/out-of-core baseline byte-parity."""
+    shape = tuple(np.shape(x))
+    if len(shape) != 3:
+        raise ValueError(f"expected (rows, steps, channels) windows, got "
+                         f"shape {shape}")
+    n, steps, n_channels = shape
+    if n == 0 or n_channels == 0:
+        raise ValueError(f"cannot fingerprint an empty window set "
+                         f"(shape {shape})")
+    if num_bins < 2:
+        raise ValueError(f"num_bins must be >= 2, got {num_bins}")
+    if channel_names is None:
+        channel_names = [f"ch{i}" for i in range(n_channels)]
+    if len(channel_names) != n_channels:
+        raise ValueError(f"{len(channel_names)} channel names for "
+                         f"{n_channels} channels")
+    if edges is not None:
+        edges = [np.asarray(e, np.float64) for e in edges]
+        if len(edges) != n_channels:
+            raise ValueError(f"{len(edges)} edge arrays for "
+                             f"{n_channels} channels")
+    else:
+        edges = _derive_edges(x, num_bins, block_rows)
+
+    total = np.zeros(n_channels, np.float64)
+    total_sq = np.zeros(n_channels, np.float64)
+    finite_count = np.zeros(n_channels, np.int64)
+    nan_count = np.zeros(n_channels, np.int64)
+    run_min = np.full(n_channels, np.inf)
+    run_max = np.full(n_channels, -np.inf)
+    flat_windows = np.zeros(n_channels, np.int64)
+    saturated_windows = np.zeros(n_channels, np.int64)
+    counts = np.zeros((n_channels, len(edges[0]) - 1), np.int64)
+
+    for _lo, block in _iter_blocks(x, block_rows):
+        block = np.asarray(block, np.float64)
+        finite = np.isfinite(block)
+        nan_count += (~finite).sum(axis=(0, 1))
+        finite_count += finite.sum(axis=(0, 1))
+        safe = np.where(finite, block, 0.0)
+        total += safe.sum(axis=(0, 1))
+        total_sq += (safe * safe).sum(axis=(0, 1))
+        # Per-(window, channel) shape facts over the finite samples.
+        w_min = np.where(finite, block, np.inf).min(axis=1)
+        w_max = np.where(finite, block, -np.inf).max(axis=1)
+        has_finite = finite.any(axis=1)
+        run_min = np.minimum(run_min,
+                             np.where(np.isfinite(w_min), w_min,
+                                      np.inf).min(axis=0))
+        run_max = np.maximum(run_max,
+                             np.where(np.isfinite(w_max), w_max,
+                                      -np.inf).max(axis=0))
+        flat = has_finite & (w_max == w_min)
+        flat_windows += flat.sum(axis=0)
+        railed = (np.isclose(block, w_min[:, None, :])
+                  | np.isclose(block, w_max[:, None, :])) & finite
+        railed_frac = railed.sum(axis=1) / np.maximum(finite.sum(axis=1), 1)
+        saturated_windows += (has_finite & ~flat
+                              & (railed_frac > _SATURATION_FRACTION)
+                              ).sum(axis=0)
+        for c in range(n_channels):
+            vals = block[:, :, c][finite[:, :, c]]
+            if vals.size:
+                clipped = np.clip(vals, edges[c][0], edges[c][-1])
+                counts[c] += np.histogram(clipped, bins=edges[c])[0]
+
+    samples = n * steps
+    channels = []
+    for c in range(n_channels):
+        nf = int(finite_count[c])
+        mean = total[c] / nf if nf else 0.0
+        var = max(total_sq[c] / nf - mean * mean, 0.0) if nf else 0.0
+        channels.append({
+            "name": str(channel_names[c]),
+            "mean": round(float(mean), 9),
+            "std": round(float(np.sqrt(var)), 9),
+            "min": float(run_min[c]) if np.isfinite(run_min[c]) else None,
+            "max": float(run_max[c]) if np.isfinite(run_max[c]) else None,
+            "nan_rate": round(float(nan_count[c] / samples), 9),
+            "flatline_rate": round(float(flat_windows[c] / n), 9),
+            "saturation_rate": round(float(saturated_windows[c] / n), 9),
+            "quantiles": _hist_quantiles(edges[c], counts[c]),
+            "edges": [float(e) for e in edges[c]],
+            "counts": [int(v) for v in counts[c]],
+        })
+    return {
+        "version": FINGERPRINT_VERSION,
+        "rows": int(n),
+        "window_steps": int(steps),
+        "num_bins": int(len(channels[0]["counts"])),
+        "channels": channels,
+    }
+
+
+def _proportions(counts) -> np.ndarray:
+    counts = np.asarray(counts, np.float64)
+    total = counts.sum()
+    if total <= 0:
+        return np.full(counts.shape, 1.0 / len(counts))
+    return counts / total
+
+
+def population_stability_index(baseline_counts, current_counts) -> float:
+    """PSI over two histograms sharing one bin axis: proportions clipped
+    at 1e-6 (the standard remedy for empty bins), sum of
+    ``(p_c - p_b) * ln(p_c / p_b)``.  Rule of thumb: < 0.1 stable,
+    0.1-0.2 moderate shift, > 0.2 significant drift."""
+    b = np.clip(_proportions(baseline_counts), _PSI_EPS, None)
+    c = np.clip(_proportions(current_counts), _PSI_EPS, None)
+    return float(np.sum((c - b) * np.log(c / b)))
+
+
+def ks_statistic(baseline_counts, current_counts) -> float:
+    """Two-sample Kolmogorov–Smirnov statistic from binned counts: max
+    |CDF difference| over the shared bin axis (bin-resolution exact)."""
+    b = np.cumsum(_proportions(baseline_counts))
+    c = np.cumsum(_proportions(current_counts))
+    return float(np.max(np.abs(b - c)))
+
+
+def drift_report(baseline: Dict[str, Any],
+                 current: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-channel PSI/KS/mean-shift of ``current`` against ``baseline``
+    (both :func:`compute_fingerprint` documents over the SAME histogram
+    edges — compute ``current`` with ``edges`` taken from the
+    baseline, or via :func:`score_against_baseline`)."""
+    b_channels = baseline.get("channels") or []
+    c_channels = current.get("channels") or []
+    if len(b_channels) != len(c_channels):
+        raise ValueError(
+            f"channel count changed: baseline has {len(b_channels)}, "
+            f"current has {len(c_channels)} — the fingerprints are not "
+            f"comparable"
+        )
+    channels = []
+    for b, c in zip(b_channels, c_channels):
+        if not np.allclose(b["edges"], c["edges"]):
+            raise ValueError(
+                f"histogram edges differ for channel {b['name']!r}; "
+                f"recompute the current fingerprint with the baseline's "
+                f"edges (score_against_baseline does this)"
+            )
+        denom = float(b["std"]) + 1e-12
+        channels.append({
+            "name": b["name"],
+            "psi": round(population_stability_index(b["counts"],
+                                                    c["counts"]), 6),
+            "ks": round(ks_statistic(b["counts"], c["counts"]), 6),
+            "mean_shift": round(abs(float(c["mean"]) - float(b["mean"]))
+                                / denom, 6),
+            "nan_rate_delta": round(float(c["nan_rate"])
+                                    - float(b["nan_rate"]), 9),
+            "flatline_rate_delta": round(float(c["flatline_rate"])
+                                         - float(b["flatline_rate"]), 9),
+            "saturation_rate_delta": round(float(c["saturation_rate"])
+                                           - float(b["saturation_rate"]),
+                                           9),
+        })
+    worst = max(channels, key=lambda ch: ch["psi"])
+    return {
+        "rows": int(current["rows"]),
+        "baseline_rows": int(baseline["rows"]),
+        "max_psi": max(ch["psi"] for ch in channels),
+        "max_ks": max(ch["ks"] for ch in channels),
+        "max_mean_shift": max(ch["mean_shift"] for ch in channels),
+        "worst_channel": worst["name"],
+        "channels": channels,
+    }
+
+
+def baseline_edges(baseline: Dict[str, Any]) -> List[np.ndarray]:
+    """The per-channel histogram edges frozen in a fingerprint document."""
+    return [np.asarray(ch["edges"], np.float64)
+            for ch in baseline["channels"]]
+
+
+def score_against_baseline(
+    x,
+    baseline: Dict[str, Any],
+    *,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+) -> Dict[str, Any]:
+    """Fingerprint ``x`` on the baseline's own bin axis and score the
+    drift — the one call the eval/feed path makes per test set."""
+    current = compute_fingerprint(
+        x,
+        channel_names=[ch["name"] for ch in baseline["channels"]],
+        block_rows=block_rows,
+        edges=baseline_edges(baseline),
+    )
+    return drift_report(baseline, current)

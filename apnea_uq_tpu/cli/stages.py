@@ -110,6 +110,22 @@ def _baseline_template(config):
     return model, template
 
 
+# The eval test-set labels, defined ONCE beside the loader that names
+# the sets: _emit_drift_fingerprints maps them back to the registry
+# keys prepare froze the per-set quality baselines under, and a rename
+# here renames both sides together.
+UNBALANCED_LABEL = "Unbalanced"
+RUS_LABEL = "Balanced_RUS"
+
+
+def _test_set_registry_keys():
+    """{eval-set label: registry artifact key its windows come from}."""
+    from apnea_uq_tpu.data import registry as reg
+
+    return {UNBALANCED_LABEL: reg.TEST_STD_UNBALANCED,
+            RUS_LABEL: reg.TEST_STD_RUS}
+
+
 def _load_test_sets(registry, *, include_train: bool = False):
     """{label: (x, y, patient_ids|None)} for the unbalanced + RUS sets.
 
@@ -124,11 +140,58 @@ def _load_test_sets(registry, *, include_train: bool = False):
     prepared = load_prepared(registry, include_train=include_train,
                              mmap=True)
     sets = {
-        "Unbalanced": (prepared.x_test, prepared.y_test, prepared.patient_ids_test)
+        UNBALANCED_LABEL: (prepared.x_test, prepared.y_test,
+                           prepared.patient_ids_test)
     }
     if prepared.x_test_rus is not None:
-        sets["Balanced_RUS"] = (prepared.x_test_rus, prepared.y_test_rus, None)
+        sets[RUS_LABEL] = (prepared.x_test_rus, prepared.y_test_rus, None)
     return prepared, sets
+
+
+def _emit_drift_fingerprints(registry, sets, run_log) -> None:
+    """Re-score each eval test set against ITS OWN frozen fingerprint
+    in the ``quality_baseline`` artifact (prepare freezes one per
+    prepared set, keyed by registry artifact key;
+    analysis/fingerprint.py) and emit one ``drift_fingerprint`` event
+    per set — per-channel PSI/KS drift vs the cohort the pipeline was
+    prepared on, so `apnea-uq quality check` can gate a shifted cohort
+    before anyone trusts its calibration.  The RUS set scores against
+    the RUS baseline: its deliberate class re-balance must never read
+    as drift.  Registries predating the baseline simply skip; ANY
+    scoring failure (non-comparable or malformed baseline) is logged,
+    never fatal — telemetry must not break an eval."""
+    from apnea_uq_tpu.data import registry as reg
+
+    if not registry.exists(reg.QUALITY_BASELINE):
+        return
+    from apnea_uq_tpu.analysis import fingerprint as fp_mod
+
+    baseline = registry.load_json(reg.QUALITY_BASELINE)
+    baselines = baseline.get("sets") if isinstance(baseline, dict) else None
+    set_keys = _test_set_registry_keys()
+    for label, (x, _y, _ids) in sets.items():
+        fingerprint = (baselines or {}).get(set_keys.get(label))
+        if fingerprint is None:
+            log(f"drift fingerprint skipped for {label}: no frozen "
+                f"baseline for this set (re-run prepare to freeze one)")
+            continue
+        try:
+            report = fp_mod.score_against_baseline(x, fingerprint)
+        except Exception as e:  # noqa: BLE001 - telemetry never kills an eval
+            log(f"drift fingerprint skipped for {label}: "
+                f"{type(e).__name__}: {e}")
+            continue
+        run_log.event(
+            "drift_fingerprint",
+            label=label,
+            rows=report["rows"],
+            baseline_rows=report["baseline_rows"],
+            max_psi=report["max_psi"],
+            max_ks=report["max_ks"],
+            max_mean_shift=report["max_mean_shift"],
+            worst_channel=report["worst_channel"],
+            channels=report["channels"],
+        )
 
 
 # ---------------------------------------------------------------- stages --
@@ -562,6 +625,7 @@ def cmd_eval_mcd(args, config) -> int:
     with _compile_env(args, config), \
             _run(args, "eval-mcd", config) as run_log:
         _prepared, sets = _load_test_sets(registry)
+        _emit_drift_fingerprints(registry, sets, run_log)
         for i, (label, (x, y, ids)) in enumerate(sets.items()):
             # Trace only the device-heavy evaluation; plots/registry writes
             # would otherwise dominate the XProf host timeline.  The
@@ -606,6 +670,7 @@ def cmd_eval_de(args, config) -> int:
     with _compile_env(args, config), \
             _run(args, "eval-de", config) as run_log:
         _prepared, sets = _load_test_sets(registry)
+        _emit_drift_fingerprints(registry, sets, run_log)
         for label, (x, y, ids) in sets.items():
             with run_log.stage(f"CNN_DE_{label}", snapshot_memory=True), \
                     profile_trace(getattr(args, "profile_dir", None)):
@@ -966,10 +1031,23 @@ def cmd_telemetry_trend(args) -> int:
         atomic_write_text(docs_path, trend_mod.render_trajectory_doc(traj))
         log(f"wrote {docs_path}")
         return 0
-    paths = archived + list(args.sources or [])
+    # Beside the archived captures, sweep <rounds-dir>/runs/ for
+    # telemetry run directories (the registry layout) so quality/eval
+    # history rides the ledger without hand-listing run dirs.  Dedupe
+    # by real path: a --sources run dir that the sweep also finds must
+    # contribute ONE round, not double-count its series.
+    paths = []
+    seen = set()
+    for p in (archived + trend_mod.registry_run_dirs(args.rounds_dir)
+              + list(args.sources or [])):
+        real = os.path.realpath(p)
+        if real not in seen:
+            seen.add(real)
+            paths.append(p)
     if not paths:
         raise SystemExit(
-            "telemetry trend: no BENCH_r*.json rounds found under "
+            "telemetry trend: no BENCH_r*.json rounds or runs/ "
+            "directories found under "
             f"{args.rounds_dir or trend_mod.default_rounds_dir()!r} and no extra "
             "sources given"
         )
@@ -982,6 +1060,46 @@ def cmd_telemetry_trend(args) -> int:
     else:
         log(trend_mod.render_trajectory(traj))
     return 0
+
+
+def cmd_quality_check(args) -> int:
+    """The model-quality gate: drift scores over threshold and (with
+    ``--baseline``) calibration regressions vs a prior run become
+    nonzero exit codes CI can gate on.  Reads only ``events.jsonl``
+    (latest run of an appended log) — no config, never imports jax —
+    and renders findings through the shared lint reporters (text /
+    ``--json`` / ``--format gha``).  The verdict is appended to the
+    checked run's own log as a ``quality_gate`` event.  Exit 0 clean,
+    1 on a failed check, 2 when a source carries no quality telemetry
+    (`telemetry compare`'s usage-error contract: a gate must never
+    report a clean pass over zero metrics)."""
+    from apnea_uq_tpu.lint.report import emit_result, resolve_format
+    from apnea_uq_tpu.telemetry import quality as quality_mod
+
+    try:
+        gate = quality_mod.check_run(
+            args.run_dir,
+            baseline=args.baseline,
+            threshold_pct=args.threshold_pct,
+            psi_threshold=args.psi_threshold,
+            ks_threshold=args.ks_threshold,
+        )
+    except quality_mod.NoQualityTelemetry as e:
+        log(f"apnea-uq quality check: {e}")
+        raise SystemExit(2)
+    except (FileNotFoundError, ValueError, OSError) as e:
+        raise SystemExit(str(e))
+    try:
+        quality_mod.record_gate_event(gate)
+    except OSError as e:
+        # The audit-trail append is best-effort: a read-only run dir
+        # (CI artifact mount) must not cost the user the verdict the
+        # gate just computed.
+        log(f"quality gate verdict not recorded in {args.run_dir}: {e}")
+    emit_result(quality_mod.gate_result(gate), resolve_format(args),
+                subject="check(s)",
+                json_extra={"quality_gate": quality_mod.gate_data(gate)})
+    return 0 if gate.passed else 1
 
 
 def cmd_telemetry_watch(args) -> int:
@@ -1260,7 +1378,11 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                          "directories (e.g. a fresh BENCH_RUN_DIR).")
     pt.add_argument("--rounds-dir", default=None,
                     help="Where the archived BENCH_r*.json rounds live "
-                         "(default: the repo checkout root).")
+                         "(default: the repo checkout root).  Any "
+                         "telemetry run dirs under <rounds-dir>/runs/ "
+                         "(an artifact registry's layout) are swept in "
+                         "too, so quality/eval series ride the ledger "
+                         "without hand-listing run dirs.")
     pt.add_argument("--threshold-pct", type=float, default=5.0,
                     help="Worsening of latest-vs-best past this flags "
                          "the metric REGRESSED (default 5%%).")
@@ -1293,6 +1415,44 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                     help="Run only the bench capture, not the TPU-gated "
                          "pytest step.")
     pw.set_defaults(fn=cmd_telemetry_watch)
+
+    # `quality` is the model-quality twin of the telemetry group: its
+    # subcommands read run directories, take no --config, and never
+    # import jax (the write side — quality_metrics/drift_fingerprint
+    # events — is emitted by the eval stages themselves).
+    p = sub.add_parser("quality",
+                       help="Gate a run's model-quality telemetry: "
+                            "calibration regression and input drift.")
+    qsub = p.add_subparsers(dest="quality_command", required=True)
+    qc = qsub.add_parser(
+        "check",
+        help="Exit 1 when a run's drift_fingerprint scores exceed "
+             "threshold or (with --baseline) its calibration regressed "
+             "vs a prior run; exit 2 when nothing is gateable.")
+    qc.add_argument("run_dir",
+                    help="Telemetry run directory of the eval to gate "
+                         "(quality_metrics + drift_fingerprint events; "
+                         "latest run of an appended log).")
+    qc.add_argument("--baseline", default=None,
+                    help="Prior run directory to gate calibration "
+                         "against: shared-label ECE/MCE/Brier worsening "
+                         "past --threshold-pct is a regression "
+                         "(lower-is-better, no direction flag needed).")
+    qc.add_argument("--threshold-pct", type=float, default=5.0,
+                    help="Allowed calibration worsening vs --baseline "
+                         "before it counts as a regression (default "
+                         "5%%).")
+    qc.add_argument("--psi-threshold", type=float, default=0.2,
+                    help="Max allowed per-set drift max_psi vs the "
+                         "frozen quality_baseline (default 0.2, the "
+                         "standard 'significant shift' PSI bar).")
+    qc.add_argument("--ks-threshold", type=float, default=0.2,
+                    help="Max allowed per-set drift max_ks (two-sample "
+                         "KS statistic; default 0.2).")
+    from apnea_uq_tpu.lint.report import add_format_args
+
+    add_format_args(qc)
+    qc.set_defaults(fn=cmd_quality_check)
 
     # `lint` is jax-free like the telemetry read side: a pure-AST scan
     # (apnea_uq_tpu/lint/) that takes no --config and must stay runnable

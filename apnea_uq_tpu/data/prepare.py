@@ -221,6 +221,68 @@ def save_prepared(
             {"x": prepared.x_test_rus, "y": prepared.y_test_rus},
             config=config,
         )
+    _freeze_quality_baseline(
+        registry,
+        {reg.TEST_STD_UNBALANCED: prepared.x_test,
+         reg.TEST_STD_RUS: prepared.x_test_rus},
+        config,
+    )
+
+
+def _freeze_quality_baseline(registry: ArtifactRegistry, test_sets,
+                             config) -> None:
+    """Freeze the per-channel statistical fingerprint of EACH prepared
+    test set (keyed by its registry artifact key; None entries — a
+    skipped RUS set — are dropped) as the registry's
+    ``quality_baseline`` artifact (JSON, atomic commit like every
+    registry write): the eval stages re-score their live windows
+    against the matching set's fingerprint into ``drift_fingerprint``
+    telemetry, so a drifted cohort is a gateable number instead of a
+    silent miscalibration.  Per-set baselines matter: the RUS set is a
+    *deliberate* class re-balance of the unbalanced cohort, so scoring
+    it against the unbalanced fingerprint would read the designed
+    resampling as drift.  Streaming — values may be memmap-backed
+    :class:`~apnea_uq_tpu.data.store.ShardedArray` sources.
+
+    Re-running prepare RE-FREEZES the baseline (the artifact describes
+    "the cohort this registry was prepared on"), which would otherwise
+    silently absorb a drifted cohort — so when a prior baseline exists,
+    each new set is first scored against it and the drift is logged
+    (fail-soft), leaving an on-record number for the overwrite."""
+    from apnea_uq_tpu.analysis import fingerprint as fp_mod
+    from apnea_uq_tpu.telemetry import log
+
+    fingerprints = {
+        key: fp_mod.compute_fingerprint(x)
+        for key, x in test_sets.items()
+        if x is not None
+    }
+    if registry.exists(reg.QUALITY_BASELINE):
+        try:
+            prior = (registry.load_json(reg.QUALITY_BASELINE)
+                     .get("sets") or {})
+        except Exception:  # noqa: BLE001 - telemetry never breaks prepare
+            prior = {}
+        for key, fingerprint in fingerprints.items():
+            old = prior.get(key)
+            if old is None:
+                continue
+            try:
+                report = fp_mod.drift_report(old, fp_mod.compute_fingerprint(
+                    test_sets[key], edges=fp_mod.baseline_edges(old)))
+            except Exception as e:  # noqa: BLE001 - incomparable prior
+                log(f"quality_baseline re-freeze for {key}: prior "
+                    f"baseline not comparable ({type(e).__name__}: {e})")
+                continue
+            log(f"quality_baseline re-freeze for {key}: drift vs prior "
+                f"baseline max_psi={report['max_psi']:g} "
+                f"max_ks={report['max_ks']:g} "
+                f"(worst channel {report['worst_channel']})")
+    registry.save_json(
+        reg.QUALITY_BASELINE,
+        {"version": 1, "sets": fingerprints},
+        config=config,
+    )
 
 
 def load_prepared(
@@ -438,6 +500,7 @@ def prepare_from_store(
     registry.adopt_array_store(reg.TEST_STD_UNBALANCED, config=config)
 
     # -- RUS-balanced test copy: index selection, streamed gather --------
+    rus_path = None
     if config.rus:
         try:
             keep_idx = undersample_indices(y_test, seed=config.seed)
@@ -454,3 +517,17 @@ def prepare_from_store(
                 })
             writer.finalize()
             registry.adopt_array_store(reg.TEST_STD_RUS, config=config)
+
+    # Freeze the per-set drift baselines off the just-written stores'
+    # mmaps — O(block) like everything else in this path.
+    _freeze_quality_baseline(
+        registry,
+        {
+            reg.TEST_STD_UNBALANCED:
+                store_mod.ArrayStore.open(test_path).read("x"),
+            reg.TEST_STD_RUS: (
+                store_mod.ArrayStore.open(rus_path).read("x")
+                if rus_path is not None else None),
+        },
+        config,
+    )

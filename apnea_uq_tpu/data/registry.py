@@ -35,6 +35,7 @@ METRICS = "metrics"                            # L5 side: aggregates/CIs/classif
 PATIENT_SUMMARY = "patient_summary"            # L6 -> L7: per-patient CSV
 CHECKPOINT = "checkpoint"                      # L3 -> L5: model checkpoints (dir)
 SWEEP = "sweep"                                # L7 side: T/N convergence table
+QUALITY_BASELINE = "quality_baseline"          # L2 -> L5: frozen per-channel data fingerprint (drift scoring)
 
 #: Every canonical artifact key, in pipeline order.  The flow gate
 #: (`apnea-uq flow`, apnea_uq_tpu/flow/) keys its producer->consumer
@@ -42,8 +43,8 @@ SWEEP = "sweep"                                # L7 side: T/N convergence table
 #: so a key added above without a row here fails statically.
 CANONICAL_KEYS = (
     WINDOWS, TRAIN_STD_SMOTE, TEST_STD_UNBALANCED, TEST_STD_RUS,
-    RAW_PREDICTIONS, UQ_STATS, DETAILED_WINDOWS, METRICS,
-    PATIENT_SUMMARY, CHECKPOINT, SWEEP,
+    QUALITY_BASELINE, RAW_PREDICTIONS, UQ_STATS, DETAILED_WINDOWS,
+    METRICS, PATIENT_SUMMARY, CHECKPOINT, SWEEP,
 )
 
 

@@ -21,7 +21,10 @@ The layer every stage reports through (ISSUE 2 tentpole):
 - :mod:`~apnea_uq_tpu.telemetry.watch` — the hardware-watch evidence
   autopilot behind ``apnea-uq telemetry watch``;
 - :mod:`~apnea_uq_tpu.telemetry.trend` — the cross-run perf-trajectory
-  ledger behind ``apnea-uq telemetry trend``.
+  ledger behind ``apnea-uq telemetry trend``;
+- :mod:`~apnea_uq_tpu.telemetry.quality` — the model-quality stream:
+  ``quality_metrics`` emission for the eval drivers and the gate
+  behind ``apnea-uq quality check``.
 
 Only the logging shim is imported eagerly (the CLI needs ``log`` before
 anything heavy loads); everything touching jax resolves lazily via PEP
@@ -67,6 +70,8 @@ _LAZY = {
     "build_trajectory": "trend",
     "render_trajectory": "trend",
     "trajectory_data": "trend",
+    "emit_quality_metrics": "quality",
+    "check_run": "quality",
 }
 
 __all__ = ["log", "get_logger"] + sorted(_LAZY)
@@ -77,7 +82,7 @@ __all__ = ["log", "get_logger"] + sorted(_LAZY)
 # resolves to the module — never to a same-named function inside it).
 _SUBMODULES = frozenset({
     "runlog", "steps", "trace", "summarize", "memory", "profiler",
-    "compare", "watch", "trend", "logging_shim",
+    "compare", "watch", "trend", "quality", "logging_shim",
 })
 
 

@@ -36,6 +36,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 from apnea_uq_tpu.telemetry.runlog import (EVENTS_FILENAME, latest_run,
@@ -127,6 +128,33 @@ def unit_direction(unit: Optional[str]) -> bool:
             or u.endswith("_s") or "byte" in u):
         return False
     return True
+
+
+# Name tokens that mark a metric lower-is-better regardless of unit:
+# calibration error scores (ECE/MCE/Brier) and drift statistics
+# (PSI, KS) are scores where zero is perfect — a candidate could
+# otherwise only ever "improve" by miscalibrating harder.
+_LOWER_BETTER_NAME_TOKENS = frozenset(
+    {"ece", "mce", "brier", "psi", "ks", "drift"})
+
+
+def name_direction(name: Optional[str]) -> Optional[bool]:
+    """Direction inferred from the metric NAME alone: ``ece``/``mce``/
+    ``brier``/``psi``/``ks``/``drift`` appearing as a name token
+    (``quality.CNN_MCD.ece``, ``val_ece``, ``drift.Unbalanced.max_psi``)
+    is lower-is-better without needing ``--metric-direction``; None when
+    the name says nothing and the unit inference should decide."""
+    tokens = re.findall(r"[a-z0-9]+", (name or "").lower())
+    if any(t in _LOWER_BETTER_NAME_TOKENS for t in tokens):
+        return False
+    return None
+
+
+def metric_direction(name: Optional[str], unit: Optional[str]) -> bool:
+    """higher-is-better for a metric, combining the name inference
+    (authoritative when it fires) with the unit inference."""
+    named = name_direction(name)
+    return unit_direction(unit) if named is None else named
 
 
 # Headline records that are payload envelopes, not measurements: the
@@ -248,6 +276,22 @@ def _metrics_from_context(ctx: Any) -> Dict[str, Metric]:
             bound=True)
         put("d2h.bytes_fused", d2h.get("d2h_bytes_fused"), "bytes",
             False, bound=True)
+    qual = ok("quality")
+    if qual:
+        # Model-quality proof block (bench.py bench_quality): fixed-seed
+        # synthetic calibration + drift self/shift scores — backend-
+        # INDEPENDENT (host NumPy at a pinned operating point), so a
+        # quality-tooling regression gates across the CPU-proxy
+        # boundary.  The error scores and the self-drift score are
+        # lower-is-better; the shifted-cohort PSI is the detector's
+        # sensitivity — SHRINKING is the regression, so higher-better.
+        put("quality.ece", qual.get("ece"), "ece", False)
+        put("quality.mce", qual.get("mce"), "mce", False)
+        put("quality.brier", qual.get("brier"), "brier", False)
+        put("quality.self_max_psi", qual.get("self_max_psi"), "psi",
+            False)
+        put("quality.shifted_max_psi", qual.get("shifted_max_psi"),
+            "psi", True)
     return out
 
 
@@ -276,7 +320,8 @@ def _metrics_from_bench_doc(doc: Dict[str, Any]) -> Dict[str, Metric]:
         # The headline value is an absolute device measurement
         # (windows/sec/chip, train wall-clock): backend-bound.
         out[name] = Metric(name, float(d["value"]), unit,
-                           unit_direction(unit), backend_bound=True)
+                           metric_direction(name, unit),
+                           backend_bound=True)
         if isinstance(d.get("vs_baseline"), (int, float)):
             out[f"{name}.vs_baseline"] = Metric(
                 f"{name}.vs_baseline", float(d["vs_baseline"]), "ratio",
@@ -319,7 +364,8 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
             name = e.get("metric") or f"bench.{e.get('role', '?')}"
             unit = e.get("unit")
             out[name] = Metric(name, float(e["value"]), unit,
-                               unit_direction(unit), backend_bound=True)
+                               metric_direction(name, unit),
+                               backend_bound=True)
             if isinstance(e.get("vs_baseline"), (int, float)):
                 out[f"{name}.vs_baseline"] = Metric(
                     f"{name}.vs_baseline", float(e["vs_baseline"]),
@@ -378,6 +424,29 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
                 name = f"audit.{e.get('label', '?')}.bytes_accessed"
                 out[name] = Metric(name, float(e["bytes_accessed"]),
                                    "bytes", False)
+        elif kind == "quality_metrics":
+            # Model-quality scalars of one eval run (telemetry/quality.py
+            # emits them from run_{mcd,de}_analysis): ECE/MCE/Brier per
+            # run label, all lower-is-better by name inference.  Quality
+            # is a property of the MODEL + data, not the backend — these
+            # deliberately stay unbound so they gate across the
+            # CPU-proxy boundary.
+            label = e.get("label", "?")
+            for field in ("ece", "mce", "brier"):
+                if e.get(field) is not None:
+                    name = f"quality.{label}.{field}"
+                    out[name] = Metric(name, float(e[field]), field,
+                                       metric_direction(name, field))
+        elif kind == "drift_fingerprint":
+            # Input-drift scores vs the frozen quality_baseline: PSI/KS
+            # growing is the regression.  Backend-independent like the
+            # quality scalars.
+            label = e.get("label", "?")
+            for field, unit in (("max_psi", "psi"), ("max_ks", "ks")):
+                if e.get(field) is not None:
+                    name = f"drift.{label}.{field}"
+                    out[name] = Metric(name, float(e[field]), unit,
+                                       metric_direction(name, unit))
         elif kind == "compile_event":
             compile_n += 1
             compile_hits += 1 if e.get("hit") else 0
@@ -423,8 +492,8 @@ def load_source(
             raise NoComparableMetrics(
                 f"no comparable metrics in source {path!r}: the run's "
                 f"events carry no bench/eval throughput, d2h, "
-                f"memory-peak, compile-cost, data-load, or "
-                f"program-audit metrics"
+                f"memory-peak, compile-cost, data-load, program-audit, "
+                f"quality, or drift metrics"
             )
         return metrics, {"kind": "run_dir", "proxy": dir_proxy}
     with open(path) as f:

@@ -230,6 +230,24 @@ def start_run(run_dir: str, *, stage: Optional[str] = None,
     return run_log
 
 
+@contextlib.contextmanager
+def append_events(run_dir: str):
+    """Append events to an existing run's log WITHOUT opening a new run:
+    no ``run_started``, and closing writes no ``run_finished`` — so
+    ``latest_run`` keeps the appended events attached to the run they
+    annotate.  The ``quality_gate`` audit-trail seam: a post-hoc verdict
+    about a run belongs in that run's own event stream."""
+    run_log = RunLog(run_dir)
+    try:
+        yield run_log
+    finally:
+        # Only the file handle to release: append_events never joins
+        # the _ACTIVE stack (that is start_run's job).
+        if run_log._fh is not None:
+            run_log._fh.close()
+            run_log._fh = None
+
+
 def read_events(run_dir: str) -> List[Dict[str, Any]]:
     """All events of a run, in append order; [] when no log exists yet.
     Tolerates a truncated final line (a run killed mid-write)."""

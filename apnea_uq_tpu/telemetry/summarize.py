@@ -212,6 +212,64 @@ def _render_ingest(progress: List[Dict[str, Any]]) -> List[str]:
     return [line]
 
 
+def _render_quality(quals: List[Dict[str, Any]]) -> List[str]:
+    """The model-quality table from ``quality_metrics`` events
+    (telemetry/quality.py): calibration scalars + the patient-rollup
+    floor per eval label."""
+    lines = ["quality (calibration + uncertainty):"]
+    for e in quals:
+        line = (
+            f"  {e.get('label', '?')}: ece {_fmt(e.get('ece'), 4)}"
+            f"  mce {_fmt(e.get('mce'), 4)}"
+            f"  brier {_fmt(e.get('brier'), 4)}"
+            f"  ({e.get('n_windows', '?')} windows"
+            + (", fused" if e.get("fused") else "") + ")"
+        )
+        unc = e.get("uncertainty") or {}
+        ent = unc.get("total_pred_entropy") or {}
+        if ent.get("p50") is not None:
+            line += (f"  entropy p50 {_fmt(ent.get('p50'), 4)}"
+                     f" p95 {_fmt(ent.get('p95'), 4)}")
+        pats = e.get("patients")
+        if pats:
+            line += (f"  [{pats.get('n_patients', '?')} patients, "
+                     f"min acc {_fmt(pats.get('accuracy_min'), 3)}]")
+        lines.append(line)
+    return lines
+
+
+def _render_drift(drifts: List[Dict[str, Any]]) -> List[str]:
+    """The input-drift table from ``drift_fingerprint`` events: per-set
+    PSI/KS against the frozen ``quality_baseline`` fingerprint."""
+    lines = ["drift (vs frozen quality_baseline):"]
+    for e in drifts:
+        lines.append(
+            f"  {e.get('label', '?')}: max_psi {_fmt(e.get('max_psi'), 4)}"
+            f"  max_ks {_fmt(e.get('max_ks'), 4)}"
+            f"  mean-shift {_fmt(e.get('max_mean_shift'), 4)}"
+            f"  (worst {e.get('worst_channel', '?')}, "
+            f"{e.get('rows', '?')} rows vs "
+            f"{e.get('baseline_rows', '?')} baseline)"
+        )
+    return lines
+
+
+def _render_quality_gates(gates: List[Dict[str, Any]]) -> List[str]:
+    """The ``quality_gate`` audit trail `apnea-uq quality check`
+    appends to the run it judged."""
+    lines = []
+    for e in gates:
+        verdict = "PASSED" if e.get("passed") else "FAILED"
+        line = (f"quality gate: {verdict} ({e.get('checks', '?')} "
+                f"check(s))")
+        if e.get("baseline"):
+            line += f" vs baseline {e['baseline']}"
+        lines.append(line)
+        for failure in e.get("failures") or []:
+            lines.append(f"  FAILED: {failure}")
+    return lines
+
+
 def _render_bench_blocks(blocks: List[Dict[str, Any]]) -> List[str]:
     """The per-block status trail from ``bench_block`` events (bench.py's
     isolated block runner): one line per block with its outcome, so a
@@ -291,6 +349,15 @@ _BENCH_BLOCK_FIELDS = (
 _INGEST_PROGRESS_FIELDS = (
     "done", "total", "skipped", "rows", "rows_per_s", "bytes_written",
     "rss_bytes")
+_QUALITY_METRICS_FIELDS = (
+    "label", "n_windows", "n_passes", "fused", "num_bins", "ece", "mce",
+    "brier", "uncertainty", "patients")
+_DRIFT_FINGERPRINT_FIELDS = (
+    "label", "rows", "baseline_rows", "max_psi", "max_ks",
+    "max_mean_shift", "worst_channel", "channels")
+_QUALITY_GATE_FIELDS = (
+    "passed", "checks", "failures", "baseline", "threshold_pct",
+    "psi_threshold", "ks_threshold")
 
 
 def _section(events: List[Dict[str, Any]], kind: str,
@@ -383,6 +450,22 @@ def summarize_events(run_dir: str,
                 line += (f" [{'fused' if e['fused'] else 'full-probs'}"
                          f", d2h {_mb(d2h)} MiB]")
             lines.append(line)
+
+    quals = _section(events, "quality_metrics", _QUALITY_METRICS_FIELDS)
+    if quals:
+        lines.append("")
+        lines.extend(_render_quality(quals))
+
+    drifts = _section(events, "drift_fingerprint",
+                      _DRIFT_FINGERPRINT_FIELDS)
+    if drifts:
+        lines.append("")
+        lines.extend(_render_drift(drifts))
+
+    gates = _section(events, "quality_gate", _QUALITY_GATE_FIELDS)
+    if gates:
+        lines.append("")
+        lines.extend(_render_quality_gates(gates))
 
     mems = _section(events, "memory_profile", _MEMORY_PROFILE_FIELDS)
     if mems:
@@ -502,6 +585,11 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
         "evals": section("eval_predict", (
             "label", "method", "n_passes", "n_windows", "predict_s",
             "windows_per_s", "fused", "d2h_bytes")),
+        "quality_metrics": section("quality_metrics",
+                                   _QUALITY_METRICS_FIELDS),
+        "drift_fingerprints": section("drift_fingerprint",
+                                      _DRIFT_FINGERPRINT_FIELDS),
+        "quality_gates": section("quality_gate", _QUALITY_GATE_FIELDS),
         "memory_profiles": section("memory_profile",
                                    _MEMORY_PROFILE_FIELDS),
         "memory_snapshots": section("memory_snapshot",

@@ -335,11 +335,17 @@ def _run_common(
     *,
     stats: Optional[np.ndarray] = None,
     n_passes: Optional[int] = None,
+    run_log=None,
 ) -> UQRunResult:
     """Shared metric/CSV/classification pipeline.  Exactly one of
     ``predictions`` ((K, M) full probabilities) and ``stats`` ((4, M)
     fused sufficient statistics, with ``n_passes`` for provenance) is
-    given; everything downstream of the decomposition is identical."""
+    given; everything downstream of the decomposition is identical.
+    With a ``run_log`` the finished run also emits its
+    ``quality_metrics`` event (telemetry/quality.py): ECE/MCE/Brier,
+    uncertainty-distribution summaries, and the per-patient rollup —
+    all derived from the per-window vectors the decomposition already
+    produced (a fused run never revives the (K, M) stack for this)."""
     if (predictions is None) == (stats is None):
         raise ValueError("pass exactly one of predictions / stats")
     if stats is not None:
@@ -376,7 +382,7 @@ def _run_common(
                 predictions, y_true, patient_ids,
                 threshold=config.decision_threshold,
             )
-    return UQRunResult(
+    result = UQRunResult(
         label=label,
         predictions=predictions,
         evaluation=evaluation,
@@ -388,6 +394,21 @@ def _run_common(
         stats=stats,
         fused=stats is not None,
     )
+    if run_log is not None:
+        from apnea_uq_tpu.telemetry import log
+        from apnea_uq_tpu.telemetry.quality import emit_quality_metrics
+
+        try:
+            emit_quality_metrics(run_log, result)
+        except Exception as e:  # noqa: BLE001 - telemetry never kills an eval
+            # E.g. a NaN that survived imputation lands in mean_pred:
+            # it passes the [0, 1] range check (NaN comparisons are
+            # False) and then detonates inside the binning.  The eval's
+            # RESULTS are already computed — losing them to a quality
+            # telemetry bug would invert the feature's purpose.
+            log(f"quality_metrics emission skipped for {label}: "
+                f"{type(e).__name__}: {e}")
+    return result
 
 
 def run_mcd_analysis(
@@ -517,6 +538,7 @@ def run_mcd_analysis(
         det_probs, predict_seconds, detailed, bootstrap_key,
         stats=fetched if stat_spec is not None else None,
         n_passes=config.mc_passes,
+        run_log=run_log,
     )
 
 
@@ -595,6 +617,7 @@ def run_de_analysis(
         None, predict_seconds, detailed, bootstrap_key,
         stats=fetched if stat_spec is not None else None,
         n_passes=n_members,
+        run_log=run_log,
     )
 
 

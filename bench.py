@@ -59,6 +59,11 @@ and whether the lowered-IR promises still hold),
 BENCH_SKIP_DATA=1 to skip the data-plane context (cold stage-start
 load of the same window set as monolithic .npz vs sharded memmap
 store + one streamed pass — host-only, no device time),
+BENCH_SKIP_QUALITY=1 to skip the quality context (fixed-seed synthetic
+calibration ECE/MCE/Brier + fingerprint drift self/shift scores — the
+model-quality tooling proof, host-only NumPy, so its scalars gate as
+backend-independent metrics across the CPU-proxy boundary;
+BENCH_QUALITY_WINDOWS scales it, default 4096),
 BENCH_DE_CHUNK for its DE chunk size,
 BENCH_WASTE_EPOCHS for the early-stop-waste context's epoch cap (0
 skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
@@ -1176,6 +1181,44 @@ def bench_d2h_accounting(n_windows: int, n_passes: int) -> dict:
     }
 
 
+def bench_quality() -> dict:
+    """Backend-independent model-quality tooling proof: a fixed-seed
+    synthetic calibrated predictor scored with the real calibration
+    engine (`analysis/calibration.py` — ECE is sampling noise, Brier ~
+    E[p(1-p)]), plus the drift fingerprint scored against itself (PSI ~
+    0) and against a deliberately shifted cohort (PSI >> threshold) —
+    so a regression in the quality tooling itself gates round-over-round
+    like any perf number.  Host-only NumPy at a pinned operating point:
+    the scalars are backend-independent and `telemetry compare` gates
+    them across the CPU-proxy boundary."""
+    import numpy as np
+
+    from apnea_uq_tpu.analysis import fingerprint as fp_mod
+    from apnea_uq_tpu.analysis.calibration import \
+        calibration_summary_from_arrays
+
+    n = int(os.environ.get("BENCH_QUALITY_WINDOWS", 4096))
+    rng = np.random.default_rng(0)
+    probs = rng.uniform(0.02, 0.98, n)
+    y = (rng.uniform(size=n) < probs).astype(np.float64)
+    cal = calibration_summary_from_arrays(probs, y, num_bins=15)
+    x = rng.normal(size=(n, 16, 2)).astype(np.float32)
+    baseline = fp_mod.compute_fingerprint(x)
+    self_report = fp_mod.score_against_baseline(x, baseline)
+    shifted_report = fp_mod.score_against_baseline(
+        x * 1.5 + 0.75, baseline)
+    return {
+        "windows": n,
+        "ece": round(cal.ece, 6),
+        "mce": round(cal.mce, 6),
+        "brier": round(cal.brier, 6),
+        "self_max_psi": self_report["max_psi"],
+        "self_max_ks": self_report["max_ks"],
+        "shifted_max_psi": shifted_report["max_psi"],
+        "shifted_max_ks": shifted_report["max_ks"],
+    }
+
+
 def _start_watchdog():
     """Fail loudly instead of hanging the driver's whole budget: the
     tunneled TPU backend can stall indefinitely at device init (observed:
@@ -1275,7 +1318,7 @@ def _run_bench(run_log, proxy: bool) -> dict:
         primary = run("de_train", de_primary, device=True)
         for name in ("mcd", "bootstrap", "streamed", "fused", "mcd_kernel",
                      "compile", "program_audit", "data_plane",
-                     "d2h_accounting"):
+                     "d2h_accounting", "quality"):
             run(name, None, skip=True, reason="BENCH_METRIC=de_train")
     else:
         def mcd():
@@ -1369,6 +1412,12 @@ def _run_bench(run_log, proxy: bool) -> dict:
         d2h_v = run("d2h_accounting",
                     lambda: bench_d2h_accounting(n_windows, n_passes))
         attach("d2h_accounting", "d2h_accounting", d2h_v)
+        quality_v = run(
+            "quality", bench_quality,
+            skip=bool(os.environ.get("BENCH_SKIP_QUALITY")),
+            reason=("BENCH_SKIP_QUALITY"
+                    if os.environ.get("BENCH_SKIP_QUALITY") else None))
+        attach("quality", "quality", quality_v)
 
     n_ok = sum(1 for r in blocks.values() if r.get("status") == "ok")
     headline = primary

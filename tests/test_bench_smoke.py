@@ -100,6 +100,13 @@ def test_readme_smoke_recipe_pins_every_smoke_knob():
         "README lost the `apnea-uq telemetry trend` trajectory-ledger "
         "recipe"
     )
+    # The model-quality gate (ISSUE 13): calibration-regression +
+    # input-drift checking is part of the same jax-free pre-flight
+    # family as lint/flow — the recipe must keep teaching it.
+    assert "apnea-uq quality check" in readme, (
+        "README smoke recipe lost the `apnea-uq quality check` gate; "
+        "the model-quality check is part of the post-eval ritual"
+    )
 
 
 def _smoke_env(progress_file: str, run_dir: str) -> dict:
@@ -222,6 +229,15 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     d2h_ctx = ctx["d2h_accounting"]
     assert d2h_ctx["d2h_bytes_full"] == 4 * 256 * 4
     assert d2h_ctx["d2h_bytes_fused"] == 4 * 256 * 4
+    # Quality block (ISSUE 13): fixed-seed synthetic calibration + drift
+    # tooling proof — a calibrated predictor scores near-zero ECE, the
+    # self-drift is exactly zero, and the injected shift is detected.
+    qual_ctx = ctx["quality"]
+    assert "error" not in qual_ctx, qual_ctx
+    assert 0.0 <= qual_ctx["ece"] < 0.05
+    assert 0.0 < qual_ctx["brier"] < 0.3
+    assert qual_ctx["self_max_psi"] == 0.0
+    assert qual_ctx["shifted_max_psi"] > 0.2
 
     # Result-v2 envelope (ISSUE 11): schema-versioned payload with
     # backend facts and a per-block status map, every block ok on the
@@ -234,7 +250,7 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert {n for n, b in blocks.items() if b["status"] == "ok"} == {
         "mcd", "bootstrap", "streamed", "fused", "mcd_kernel", "de_train",
         "earlystop_waste", "compile", "program_audit", "data_plane",
-        "d2h_accounting"}, blocks
+        "d2h_accounting", "quality"}, blocks
     assert all(b["seconds"] >= 0 for b in blocks.values()), blocks
 
     # The printed line was assembled from the on-disk progress capture:
@@ -364,7 +380,7 @@ def test_bench_cpu_proxy_end_to_end(tmp_path, capsys):
     # >= 3 ok blocks including compile, data-plane, audit (the
     # acceptance floor), plus the arithmetic D2H contract.
     for name in ("compile", "data_plane", "program_audit",
-                 "d2h_accounting"):
+                 "d2h_accounting", "quality"):
         assert statuses[name] == "ok", statuses
     # Device blocks are unavailable, not errors.
     for name in ("mcd", "bootstrap", "streamed", "fused", "de_train"):
@@ -769,6 +785,10 @@ def _stub_blocks(bench_mod, monkeypatch, *, fail=(), values=None):
     monkeypatch.setattr(bench_mod, "bench_d2h_accounting", make(
         "d2h_accounting", v("d2h_accounting", {"d2h_bytes_full": 4096,
                                                "d2h_bytes_fused": 4096})))
+    monkeypatch.setattr(bench_mod, "bench_quality", make(
+        "quality", v("quality", {"ece": 0.01, "brier": 0.16,
+                                 "self_max_psi": 0.0,
+                                 "shifted_max_psi": 2.0})))
 
 
 class TestMainDispatch:
@@ -792,6 +812,7 @@ class TestMainDispatch:
                   "BENCH_SKIP_FUSED", "BENCH_SKIP_MCD_KERNEL",
                   "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
+                  "BENCH_SKIP_QUALITY",
                   "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
             monkeypatch.delenv(k, raising=False)
         _stub_blocks(bench_mod, monkeypatch)
@@ -810,7 +831,8 @@ class TestMainDispatch:
         ok = {n for n, b in out["blocks"].items() if b["status"] == "ok"}
         assert ok == {"mcd", "bootstrap", "streamed", "fused", "mcd_kernel",
                       "de_train", "earlystop_waste", "compile",
-                      "program_audit", "data_plane", "d2h_accounting"}
+                      "program_audit", "data_plane", "d2h_accounting",
+                      "quality"}
         assert out["context"]["bootstrap_b100_m293k"] == {"speedup": 20.0}
         assert (out["secondary"]["context"]["early_stop_waste"]
                 == {"patience": 5})
@@ -863,6 +885,7 @@ class TestBlockIsolation:
                   "BENCH_SKIP_FUSED", "BENCH_SKIP_MCD_KERNEL",
                   "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
+                  "BENCH_SKIP_QUALITY",
                   "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
             monkeypatch.delenv(k, raising=False)
         self.bench_mod = bench_mod
@@ -960,7 +983,8 @@ class TestBlockIsolation:
 
         all_blocks = ("mcd", "de_train", "bootstrap", "streamed", "fused",
                       "mcd_kernel", "earlystop_waste", "compile",
-                      "program_audit", "data_plane", "d2h_accounting")
+                      "program_audit", "data_plane", "d2h_accounting",
+                      "quality")
         _stub_blocks(self.bench_mod, monkeypatch)
         good = self._run_to_file(capsys, "good.json")
         _stub_blocks(self.bench_mod, monkeypatch, fail=all_blocks)

@@ -1,0 +1,145 @@
+"""Per-channel data fingerprints + drift scoring
+(analysis/fingerprint.py): streaming invariance (ndarray vs sharded
+store at odd block sizes), shape-fact rates (NaN/flatline/saturation),
+PSI/KS detection of injected shifts, and the edge-compatibility
+contract behind score_against_baseline."""
+
+import json
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.analysis import fingerprint as fp
+from apnea_uq_tpu.data import store as store_mod
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _windows(rng, n=400, steps=30, channels=3):
+    return rng.normal(size=(n, steps, channels)).astype(np.float32)
+
+
+def test_fingerprint_schema_and_moments(rng):
+    x = _windows(rng)
+    doc = fp.compute_fingerprint(x)
+    assert doc["version"] == fp.FINGERPRINT_VERSION
+    assert doc["rows"] == 400 and doc["window_steps"] == 30
+    assert [c["name"] for c in doc["channels"]] == ["ch0", "ch1", "ch2"]
+    for c, col in zip(doc["channels"], range(3)):
+        vals = x[:, :, col].astype(np.float64)
+        assert c["mean"] == pytest.approx(vals.mean(), abs=1e-6)
+        assert c["std"] == pytest.approx(vals.std(), abs=1e-6)
+        assert c["min"] == pytest.approx(vals.min())
+        assert c["max"] == pytest.approx(vals.max())
+        assert sum(c["counts"]) == vals.size
+        # Histogram-derived quantiles land within a bin width of exact.
+        bin_w = c["edges"][1] - c["edges"][0]
+        assert c["quantiles"]["p50"] == pytest.approx(
+            np.percentile(vals, 50), abs=bin_w)
+        assert c["quantiles"]["p05"] <= c["quantiles"]["p95"]
+
+
+def test_streaming_matches_in_core_bit_for_bit(rng, tmp_path):
+    """The in-core and out-of-core prepare paths must freeze IDENTICAL
+    baselines: fingerprint(ndarray) == fingerprint(sharded store) at an
+    awkward block size, byte-for-byte as JSON."""
+    x = _windows(rng, n=333)
+    store = store_mod.write_store(str(tmp_path / "st"), {"x": x},
+                                  rows_per_shard=57)
+    a = fp.compute_fingerprint(x)
+    b = fp.compute_fingerprint(store.read("x"), block_rows=41)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_nan_flatline_saturation_rates(rng):
+    x = _windows(rng, n=100, steps=20, channels=2)
+    x[0, :, 0] = 2.5                 # flat window on ch0
+    x[1, 5:, 0] = np.nan             # NaNs on ch0
+    # Railed window on ch1: >50% of samples pinned at the extremes.
+    x[2, :, 1] = np.concatenate([np.full(12, 4.0), np.full(4, -4.0),
+                                 rng.normal(size=4)]).astype(np.float32)
+    doc = fp.compute_fingerprint(x)
+    ch0, ch1 = doc["channels"]
+    assert ch0["flatline_rate"] == pytest.approx(1 / 100)
+    assert ch0["nan_rate"] == pytest.approx(15 / (100 * 20))
+    assert ch1["saturation_rate"] == pytest.approx(1 / 100)
+    # A flat window is flat, not saturated.
+    assert ch0["saturation_rate"] == 0.0
+
+
+def test_self_drift_is_zero_and_shift_detected(rng):
+    x = _windows(rng)
+    base = fp.compute_fingerprint(x)
+    self_report = fp.score_against_baseline(x, base)
+    assert self_report["max_psi"] == 0.0
+    assert self_report["max_ks"] == 0.0
+    assert self_report["max_mean_shift"] == 0.0
+    # Shift ONE channel; the report must localize it.
+    shifted = x.copy()
+    shifted[:, :, 1] = shifted[:, :, 1] * 1.8 + 1.0
+    report = fp.score_against_baseline(shifted, base)
+    assert report["worst_channel"] == "ch1"
+    assert report["max_psi"] > 0.2
+    assert report["max_ks"] > 0.2
+    assert report["max_mean_shift"] > 0.5
+    by_name = {c["name"]: c for c in report["channels"]}
+    assert by_name["ch0"]["psi"] < 0.05  # untouched channels stay quiet
+    assert by_name["ch2"]["psi"] < 0.05
+    # New NaNs show up as a rate delta even when the histogram barely
+    # moves (NaNs never land in bins).
+    holey = x.copy()
+    holey[:50, :, 0] = np.nan
+    nan_report = fp.score_against_baseline(holey, base)
+    assert next(c for c in nan_report["channels"]
+                if c["name"] == "ch0")["nan_rate_delta"] > 0.1
+
+
+def test_out_of_range_values_clamp_into_boundary_bins(rng):
+    x = _windows(rng, n=200)
+    base = fp.compute_fingerprint(x)
+    # A cohort far outside the baseline range must still score (clamped
+    # into the edge bins = maximal drift), never crash.
+    report = fp.score_against_baseline(x * 100.0, base)
+    assert report["max_psi"] > 1.0
+
+
+def test_incompatible_fingerprints_raise(rng):
+    x = _windows(rng, channels=3)
+    base = fp.compute_fingerprint(x)
+    with pytest.raises(ValueError, match="channel count"):
+        fp.drift_report(base, fp.compute_fingerprint(x[:, :, :2]))
+    # Same channel count, different edges: not comparable either.
+    other = fp.compute_fingerprint(x * 3.0)
+    with pytest.raises(ValueError, match="edges"):
+        fp.drift_report(base, other)
+
+
+def test_validation_errors(rng):
+    with pytest.raises(ValueError, match="empty"):
+        fp.compute_fingerprint(np.empty((0, 10, 2), np.float32))
+    with pytest.raises(ValueError, match="shape"):
+        fp.compute_fingerprint(np.zeros((5, 10), np.float32))
+    with pytest.raises(ValueError, match="num_bins"):
+        fp.compute_fingerprint(_windows(rng), num_bins=1)
+    with pytest.raises(ValueError, match="channel names"):
+        fp.compute_fingerprint(_windows(rng), channel_names=["a"])
+
+
+def test_psi_and_ks_primitives():
+    even = [25, 25, 25, 25]
+    assert fp.population_stability_index(even, even) == 0.0
+    assert fp.ks_statistic(even, even) == 0.0
+    skewed = [97, 1, 1, 1]
+    assert fp.population_stability_index(even, skewed) > 0.2
+    assert fp.ks_statistic(even, skewed) == pytest.approx(0.72)
+    # PSI tolerates empty bins on either side (clipped, not inf/nan).
+    assert np.isfinite(fp.population_stability_index([0, 100], [100, 0]))
+
+
+def test_fingerprint_is_json_round_trippable(rng):
+    doc = fp.compute_fingerprint(_windows(rng, n=50))
+    again = json.loads(json.dumps(doc))
+    assert fp.drift_report(doc, again)["max_psi"] == 0.0

@@ -314,6 +314,13 @@ def test_package_gate_zero_unsuppressed_findings():
                 # side, but its doc render must stay in the bare-print /
                 # schema scan scope like the rest of the telemetry layer.
                 "apnea_uq_tpu/telemetry/trend.py",
+                # The model-quality stream (ISSUE 13): the quality
+                # event emitter/gate and the drift fingerprint engine —
+                # both emit documented telemetry kinds, so they must
+                # stay inside the schema rule's scan scope.
+                "apnea_uq_tpu/telemetry/quality.py",
+                "apnea_uq_tpu/analysis/fingerprint.py",
+                "apnea_uq_tpu/analysis/calibration.py",
                 "apnea_uq_tpu/telemetry/logging_shim.py",
                 "apnea_uq_tpu/parallel/ensemble.py",
                 "apnea_uq_tpu/uq/predict.py",

@@ -1,0 +1,548 @@
+"""Model-quality observability end to end (ISSUE 13 tentpole):
+the eval stages emit ``quality_metrics`` + ``drift_fingerprint`` events
+through the REAL CLI, `apnea-uq quality check` gates a drifted cohort
+(vs the frozen ``quality_baseline``) and a miscalibrated run (vs a
+healthy baseline run) nonzero, self-comparison is clean, and
+``telemetry compare`` gates ``quality.<label>.ece`` across run dirs —
+including across the CPU-proxy boundary, where quality metrics are
+backend-independent and refuse nothing."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu import telemetry
+from apnea_uq_tpu.cli.main import main
+from apnea_uq_tpu.config import (
+    EnsembleConfig,
+    ExperimentConfig,
+    ModelConfig,
+    PrepareConfig,
+    TrainConfig,
+    UQConfig,
+    _to_jsonable,
+)
+from apnea_uq_tpu.data import WindowSet
+from apnea_uq_tpu.data import registry as reg
+from apnea_uq_tpu.data.registry import ArtifactRegistry
+from apnea_uq_tpu.telemetry import compare as compare_mod
+from apnea_uq_tpu.telemetry import quality as quality_mod
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    """Registry with a frozen quality baseline, an (untrained)
+    checkpoint, and two REAL `apnea-uq eval-mcd` runs: a healthy one on
+    the prepared cohort and a drifted one after the test windows were
+    shifted under the frozen baseline.  Training is skipped — the
+    quality plumbing only needs a restorable checkpoint, and a fresh
+    init is two orders of magnitude cheaper than a fit."""
+    import jax
+
+    from apnea_uq_tpu.models import AlarconCNN1D
+    from apnea_uq_tpu.training import create_train_state, save_state
+
+    root = tmp_path_factory.mktemp("quality")
+    registry_dir = str(root / "registry")
+    rng = np.random.default_rng(0)
+    n, n_patients = 360, 12
+    pids = np.array([f"P{i % n_patients:03d}" for i in range(n)])
+    y = rng.integers(0, 2, n).astype(np.int8)
+    x = rng.normal(size=(n, 60, 4)).astype(np.float32)
+    x[:, :, 0] += (y.astype(np.float32) * 2 - 1)[:, None] * 1.2
+    windows = WindowSet(
+        x=x, y=y, patient_ids=pids,
+        start_time_s=np.arange(n, dtype=np.int32) * 60,
+        channels=("SaO2", "PR", "THOR RES", "ABDO RES"),
+    )
+    ArtifactRegistry(registry_dir).save_arrays(reg.WINDOWS,
+                                               windows.to_arrays())
+    config = ExperimentConfig(
+        model=ModelConfig(features=(3,), kernel_sizes=(3,),
+                          dropout_rates=(0.2,)),
+        train=TrainConfig(batch_size=64, num_epochs=1,
+                          validation_split=0.1, seed=1),
+        ensemble=EnsembleConfig(num_members=2, num_epochs=1,
+                                batch_size=64, seed_base=2025),
+        uq=UQConfig(mc_passes=3, n_bootstrap=8,
+                    inference_batch_size=128),
+        prepare=PrepareConfig(smote=False),
+    )
+    config_path = str(root / "config.json")
+    with open(config_path, "w") as f:
+        json.dump(_to_jsonable(config), f)
+
+    assert main(["prepare", "--registry", registry_dir,
+                 "--config", config_path]) == 0
+    registry = ArtifactRegistry(registry_dir)
+    assert registry.exists(reg.QUALITY_BASELINE)
+
+    model = AlarconCNN1D(config.model)
+    state = create_train_state(model, jax.random.key(0),
+                               learning_rate=config.train.learning_rate)
+    save_state(os.path.join(registry_dir, "checkpoint", "baseline"),
+               state)
+
+    healthy = str(root / "healthy_run")
+    assert main(["eval-mcd", "--registry", registry_dir,
+                 "--config", config_path, "--run-dir", healthy]) == 0
+
+    # Inject a per-channel cohort shift: overwrite the test windows with
+    # a scaled+offset copy while the quality_baseline stays frozen — the
+    # deployed-drift scenario the fingerprint exists to catch.
+    test = registry.load_arrays(reg.TEST_STD_UNBALANCED)
+    registry.save_arrays(
+        reg.TEST_STD_UNBALANCED,
+        {"x": test["x"] * 2.0 + 1.0, "y": test["y"],
+         "patient_ids": test["patient_ids"]},
+    )
+    drifted = str(root / "drifted_run")
+    assert main(["eval-mcd", "--registry", registry_dir,
+                 "--config", config_path, "--run-dir", drifted,
+                 "--no-detailed"]) == 0
+    return {"root": root, "registry": registry_dir,
+            "config": config_path, "healthy": healthy,
+            "drifted": drifted}
+
+
+def _fabricated_run_dir(path, events):
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, telemetry.EVENTS_FILENAME), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+def _quality_run(path, *, ece, proxy=False, windows_per_s=5000.0,
+                 label="CNN_MCD_Unbalanced"):
+    """A fabricated run dir with one quality_metrics event (+ a
+    backend-bound eval throughput, + optional proxy provenance)."""
+    events = [{"seq": 0, "ts": 1.0, "kind": "run_started",
+               "schema_version": 1, "stage": "eval-mcd"}]
+    if proxy:
+        events.append({"seq": 1, "ts": 1.5, "kind": "bench_mode",
+                       "proxy": True, "platform": "cpu"})
+    events += [
+        {"seq": 2, "ts": 2.0, "kind": "eval_predict", "label": label,
+         "windows_per_s": windows_per_s, "fused": True,
+         "d2h_bytes": 4 * 90 * 4},
+        {"seq": 3, "ts": 2.5, "kind": "quality_metrics", "label": label,
+         "n_windows": 90, "n_passes": 3, "fused": True, "num_bins": 15,
+         "ece": ece, "mce": min(1.0, ece * 2), "brier": 0.2 + ece / 4},
+        {"seq": 4, "ts": 3.0, "kind": "run_finished", "status": "ok"},
+    ]
+    return _fabricated_run_dir(path, events)
+
+
+class TestEndToEnd:
+    def test_eval_emits_quality_and_drift_events(self, env):
+        events = telemetry.read_events(env["healthy"])
+        qm = [e for e in events if e["kind"] == "quality_metrics"]
+        drifts = [e for e in events if e["kind"] == "drift_fingerprint"]
+        assert {e["label"] for e in qm} == {"CNN_MCD_Unbalanced",
+                                            "CNN_MCD_Balanced_RUS"}
+        for e in qm:
+            assert 0.0 <= e["ece"] <= 1.0
+            assert 0.0 <= e["brier"] <= 1.0
+            assert e["fused"] is True
+            unc = e["uncertainty"]
+            for key in quality_mod.UNCERTAINTY_KEYS:
+                assert unc[key]["p05"] <= unc[key]["p95"]
+                assert sum(unc[key]["histogram"]["counts"]) \
+                    == e["n_windows"]
+        # The detailed Unbalanced run carries the patient rollup.
+        unb = next(e for e in qm if e["label"] == "CNN_MCD_Unbalanced")
+        assert unb["patients"]["n_patients"] > 1
+        assert 0.0 <= unb["patients"]["accuracy_min"] \
+            <= unb["patients"]["accuracy_mean"] <= 1.0
+        # Drift self-score vs the just-frozen PER-SET baselines: clean
+        # for BOTH sets — the RUS set scores against the RUS baseline,
+        # so its deliberate class re-balance reads as exactly zero
+        # drift, never a false gate failure.
+        assert {e["label"] for e in drifts} == {"Unbalanced",
+                                                "Balanced_RUS"}
+        for e in drifts:
+            assert e["max_psi"] == 0.0, e["label"]
+            assert e["max_ks"] == 0.0, e["label"]
+        unb_drift = next(e for e in drifts if e["label"] == "Unbalanced")
+        assert len(unb_drift["channels"]) == 4
+
+    def test_self_check_and_self_baseline_exit_zero(self, env):
+        assert main(["quality", "check", env["healthy"]]) == 0
+        assert main(["quality", "check", env["healthy"],
+                     "--baseline", env["healthy"]]) == 0
+
+    def test_drifted_cohort_gates_exit_1(self, env, capsys):
+        events = telemetry.read_events(env["drifted"])
+        drift = next(e for e in events
+                     if e["kind"] == "drift_fingerprint"
+                     and e["label"] == "Unbalanced")
+        assert drift["max_psi"] > 0.2
+        # Only the shifted set drifts: the untouched RUS set stays at
+        # its own baseline (the per-set freeze keeps it quiet).
+        rus = next(e for e in events
+                   if e["kind"] == "drift_fingerprint"
+                   and e["label"] == "Balanced_RUS")
+        assert rus["max_psi"] == 0.0
+        assert main(["quality", "check", env["drifted"]]) == 1
+        out = capsys.readouterr().out
+        assert "quality-drift" in out and "max_psi" in out
+
+    def test_disjoint_baseline_labels_still_gate_drift(self, env,
+                                                       tmp_path):
+        """A baseline sharing no quality_metrics label must NOT discard
+        the candidate's drift checks: the drifted run still exits 1 on
+        drift (not 2), matching compare's missing-on-one-side rule."""
+        other = _quality_run(tmp_path / "other_label", ece=0.1,
+                             label="CNN_DE_Unbalanced")
+        assert main(["quality", "check", env["drifted"],
+                     "--baseline", other]) == 1
+
+    def test_quality_emission_failure_never_kills_the_eval(self, env,
+                                                           monkeypatch,
+                                                           tmp_path,
+                                                           capsys):
+        """The quality event is derived AFTER the expensive predict; a
+        bug in its computation (e.g. a NaN that survived imputation
+        detonating in the binning) must degrade to a logged skip, never
+        abort the eval."""
+        from apnea_uq_tpu.telemetry import quality as quality_mod
+
+        def boom(run_log, result, **kw):
+            raise ValueError("synthetic quality emission failure")
+
+        monkeypatch.setattr(quality_mod, "emit_quality_metrics", boom)
+        run_dir = str(tmp_path / "guarded_run")
+        assert main(["eval-mcd", "--registry", env["registry"],
+                     "--config", env["config"], "--run-dir", run_dir,
+                     "--no-detailed"]) == 0
+        out = capsys.readouterr().out
+        assert "quality_metrics emission skipped" in out
+        events = telemetry.read_events(run_dir)
+        assert not any(e["kind"] == "quality_metrics" for e in events)
+        # The eval itself completed and recorded its results.
+        assert any(e["kind"] == "eval_predict" for e in events)
+        assert events[-1]["status"] == "ok"
+
+    def test_malformed_baseline_never_kills_the_eval(self, env,
+                                                     tmp_path, capsys):
+        """A truncated/hand-edited quality_baseline document must be
+        logged and skipped at eval time — not crash before predict."""
+        from apnea_uq_tpu.data.registry import ArtifactRegistry
+
+        registry = ArtifactRegistry(env["registry"])
+        good = registry.load_json(reg.QUALITY_BASELINE)
+        try:
+            registry.save_json(reg.QUALITY_BASELINE,
+                               {"version": 1,
+                                "sets": {reg.TEST_STD_UNBALANCED:
+                                         {"broken": True}}})
+            run_dir = str(tmp_path / "malformed_baseline_run")
+            assert main(["eval-mcd", "--registry", env["registry"],
+                         "--config", env["config"],
+                         "--run-dir", run_dir, "--no-detailed"]) == 0
+            out = capsys.readouterr().out
+            assert "drift fingerprint skipped" in out
+            events = telemetry.read_events(run_dir)
+            assert not any(e["kind"] == "drift_fingerprint"
+                           for e in events)
+            assert any(e["kind"] == "quality_metrics" for e in events)
+        finally:
+            registry.save_json(reg.QUALITY_BASELINE, good)
+
+    def test_miscalibrated_run_vs_healthy_baseline_exits_1(
+            self, env, tmp_path, capsys):
+        """Acceptance (a): a synthetically miscalibrated candidate run
+        gated against the healthy baseline run through the real CLI."""
+        healthy_qm = [e for e in telemetry.read_events(env["healthy"])
+                      if e["kind"] == "quality_metrics"
+                      and e["label"] == "CNN_MCD_Unbalanced"]
+        bad = _quality_run(tmp_path / "bad",
+                           ece=healthy_qm[0]["ece"] * 4 + 0.2)
+        assert main(["quality", "check", bad,
+                     "--baseline", env["healthy"]]) == 1
+        out = capsys.readouterr().out
+        assert "quality-calibration-regression" in out
+        # Without --baseline the drift-free fabricated run has ZERO
+        # gateable checks — exit 2 (usage), never a clean pass over
+        # zero checks (compare's no-comparable-metrics contract).
+        with pytest.raises(SystemExit) as exc:
+            main(["quality", "check", bad])
+        assert exc.value.code == 2
+
+    def test_gate_event_appended_to_checked_run(self, env):
+        before = len([e for e in telemetry.read_events(env["drifted"])
+                      if e["kind"] == "quality_gate"])
+        assert main(["quality", "check", env["drifted"]]) == 1
+        events = telemetry.read_events(env["drifted"])
+        gates = [e for e in events if e["kind"] == "quality_gate"]
+        assert len(gates) == before + 1
+        assert gates[-1]["passed"] is False
+        assert gates[-1]["failures"]
+        # Appended without a new run_started: the latest-run boundary
+        # keeps the verdict attached to the run it judged.
+        latest, _ = telemetry.runlog.latest_run(events)
+        assert any(e["kind"] == "quality_gate" for e in latest)
+
+    def test_check_json_and_gha_formats(self, env, capsys):
+        assert main(["quality", "check", env["drifted"], "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        gate = doc["quality_gate"]
+        assert gate["passed"] is False
+        assert any(c["metric"] == "max_psi" and not c["passed"]
+                   for c in gate["checks"])
+        assert main(["quality", "check", env["drifted"],
+                     "--format", "gha"]) == 1
+        out = capsys.readouterr().out
+        assert "::error" in out and "quality-drift" in out
+
+    def test_no_quality_telemetry_is_exit_2(self, tmp_path, capsys):
+        empty = _fabricated_run_dir(tmp_path / "no_quality", [
+            {"seq": 0, "ts": 1.0, "kind": "run_started",
+             "schema_version": 1, "stage": "train"},
+            {"seq": 1, "ts": 2.0, "kind": "run_finished", "status": "ok"},
+        ])
+        with pytest.raises(SystemExit) as exc:
+            main(["quality", "check", empty])
+        assert exc.value.code == 2
+        assert "no quality_metrics" in capsys.readouterr().out
+        # A missing run dir is a plain usage failure too.
+        with pytest.raises(SystemExit):
+            main(["quality", "check", str(tmp_path / "missing")])
+
+    def test_disjoint_baseline_labels_exit_2(self, env, tmp_path,
+                                             capsys):
+        other = _quality_run(tmp_path / "other", ece=0.1,
+                             label="CNN_DE_Unbalanced")
+        with pytest.raises(SystemExit) as exc:
+            main(["quality", "check", other,
+                  "--baseline", env["healthy"]])
+        assert exc.value.code == 2
+        assert "shares no quality_metrics run label" in \
+            capsys.readouterr().out
+
+    def test_summarize_renders_quality_sections(self, env, capsys):
+        assert main(["telemetry", "summarize", env["drifted"]]) == 0
+        out = capsys.readouterr().out
+        assert "quality (calibration + uncertainty):" in out
+        assert "drift (vs frozen quality_baseline):" in out
+        assert "quality gate: FAILED" in out
+        assert main(["telemetry", "summarize", env["drifted"],
+                     "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["quality_metrics"][0]["ece"] is not None
+        assert doc["drift_fingerprints"][0]["max_psi"] is not None
+        assert doc["quality_gates"][-1]["passed"] is False
+
+
+class TestCompareQuality:
+    def test_compare_gates_quality_ece_between_run_dirs(self, env,
+                                                        tmp_path):
+        """Acceptance: `telemetry compare` gates quality.<label>.ece
+        across two run dirs — the real healthy run vs a fabricated
+        worse one — lower-is-better with no direction flag."""
+        healthy_qm = next(e for e in telemetry.read_events(env["healthy"])
+                          if e["kind"] == "quality_metrics"
+                          and e["label"] == "CNN_MCD_Unbalanced")
+        worse = _quality_run(tmp_path / "worse",
+                             ece=healthy_qm["ece"] * 2 + 0.1)
+        comparison = compare_mod.compare_paths(env["healthy"], worse)
+        regressed = {d.name for d in comparison.regressions}
+        assert "quality.CNN_MCD_Unbalanced.ece" in regressed
+        delta = next(d for d in comparison.deltas
+                     if d.name == "quality.CNN_MCD_Unbalanced.ece")
+        assert not delta.higher_better
+        assert main(["telemetry", "compare", env["healthy"], worse]) == 1
+        # Self-comparison stays clean.
+        assert main(["telemetry", "compare", env["healthy"],
+                     env["healthy"]]) == 0
+
+    def test_quality_metrics_cross_proxy_boundary_unrefused(self,
+                                                            tmp_path):
+        """Acceptance: quality metrics are backend-independent — across
+        the CPU-proxy boundary the backend-bound throughput is dropped
+        but quality.<label>.* refuses NOTHING and still gates."""
+        device = _quality_run(tmp_path / "device", ece=0.05)
+        proxy_same = _quality_run(tmp_path / "proxy", ece=0.05,
+                                  proxy=True, windows_per_s=3.0)
+        comparison = compare_mod.compare_paths(device, proxy_same)
+        assert comparison.candidate_proxy
+        names = {d.name for d in comparison.deltas}
+        assert {"quality.CNN_MCD_Unbalanced.ece",
+                "quality.CNN_MCD_Unbalanced.mce",
+                "quality.CNN_MCD_Unbalanced.brier"} <= names
+        assert not any(n.startswith("quality.")
+                       for n in comparison.skipped_backend_bound)
+        # The backend-bound throughput IS refused...
+        assert ("eval.CNN_MCD_Unbalanced.windows_per_s"
+                in comparison.skipped_backend_bound)
+        assert not comparison.regressions
+        # ...and a miscalibrated proxy round still gates.
+        proxy_worse = _quality_run(tmp_path / "proxy_worse", ece=0.4,
+                                   proxy=True, windows_per_s=3.0)
+        regressed = {d.name for d in compare_mod.compare_paths(
+            device, proxy_worse).regressions}
+        assert "quality.CNN_MCD_Unbalanced.ece" in regressed
+
+    def test_drift_scores_gate_lower_is_better(self, env, tmp_path):
+        comparison = compare_mod.compare_paths(env["healthy"],
+                                               env["drifted"])
+        regressed = {d.name for d in comparison.regressions}
+        assert "drift.Unbalanced.max_psi" in regressed
+
+    def test_trend_rounds_dir_sweeps_registry_runs(self, env, tmp_path,
+                                                   capsys):
+        """ISSUE 13 satellite: --rounds-dir pointed at a registry-like
+        root sweeps <root>/runs/* run dirs, so quality history needs no
+        hand-listed sources."""
+        root = tmp_path / "ledger_root"
+        runs = root / "runs"
+        runs.mkdir(parents=True)
+        _quality_run(runs / "eval-a", ece=0.05)
+        _quality_run(runs / "eval-b", ece=0.06)
+        assert main(["telemetry", "trend", "--rounds-dir", str(root),
+                     "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        labels = [r["label"] for r in doc["rounds"]]
+        assert labels == ["eval-a", "eval-b"]
+        series = {m["name"]: m for m in doc["metrics"]}
+        ece = series["quality.CNN_MCD_Unbalanced.ece"]
+        assert ece["values"] == [0.05, 0.06]
+        assert ece["higher_better"] is False
+
+    def test_trend_runs_sweep_orders_chronologically(self, tmp_path,
+                                                     capsys):
+        """Run dirs sweep in run-START order, not name order: a shared
+        series' 'latest' must be the newest run even when an earlier
+        stage name sorts after it alphabetically."""
+        root = tmp_path / "chrono_root"
+        runs = root / "runs"
+        runs.mkdir(parents=True)
+
+        def run_at(name, ts, ece):
+            _fabricated_run_dir(runs / name, [
+                {"seq": 0, "ts": ts, "kind": "run_started",
+                 "schema_version": 1, "stage": "eval"},
+                {"seq": 1, "ts": ts + 1, "kind": "quality_metrics",
+                 "label": "CNN_MCD_Unbalanced", "ece": ece},
+                {"seq": 2, "ts": ts + 2, "kind": "run_finished",
+                 "status": "ok"},
+            ])
+
+        # Alphabetical order (a-newest, z-oldest) contradicts time
+        # order; the ledger must follow time.
+        run_at("z-oldest", 100.0, 0.05)
+        run_at("a-newest", 900.0, 0.30)
+        assert main(["telemetry", "trend", "--rounds-dir", str(root),
+                     "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert [r["label"] for r in doc["rounds"]] == ["z-oldest",
+                                                       "a-newest"]
+        ece = next(m for m in doc["metrics"]
+                   if m["name"] == "quality.CNN_MCD_Unbalanced.ece")
+        assert ece["latest"] == 0.30 and ece["latest_round"] == "a-newest"
+        assert ece["regressed"] is True  # latest worsened vs best=0.05
+
+        # An APPENDED multi-run log (reused run dir) sorts by its
+        # LATEST run's start — the run whose metrics it contributes —
+        # not its oldest.
+        reused = runs / "b-reused"
+        run_at("b-reused", 50.0, 0.05)
+        with open(os.path.join(reused, telemetry.EVENTS_FILENAME),
+                  "a") as f:
+            for e in ({"seq": 0, "ts": 2000.0, "kind": "run_started",
+                       "schema_version": 1, "stage": "eval"},
+                      {"seq": 1, "ts": 2001.0, "kind": "quality_metrics",
+                       "label": "CNN_MCD_Unbalanced", "ece": 0.4},
+                      {"seq": 2, "ts": 2002.0, "kind": "run_finished",
+                       "status": "ok"}):
+                f.write(json.dumps(e) + "\n")
+        assert main(["telemetry", "trend", "--rounds-dir", str(root),
+                     "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert [r["label"] for r in doc["rounds"]] == [
+            "z-oldest", "a-newest", "b-reused"]
+        ece = next(m for m in doc["metrics"]
+                   if m["name"] == "quality.CNN_MCD_Unbalanced.ece")
+        assert ece["latest"] == 0.4 and ece["latest_round"] == "b-reused"
+
+        # A --sources path the sweep also finds contributes ONE round.
+        assert main(["telemetry", "trend", "--rounds-dir", str(root),
+                     "--json", str(runs / "a-newest")]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert [r["label"] for r in doc["rounds"]].count("a-newest") == 1
+
+    def test_unwritable_run_dir_still_renders_verdict(self, env,
+                                                      monkeypatch,
+                                                      capsys):
+        """The gate-event append is best-effort: a read-only run dir
+        (CI artifact mount) must not cost the user the verdict."""
+        from apnea_uq_tpu.telemetry import quality as quality_mod
+
+        def denied(gate):
+            raise PermissionError("read-only artifact mount")
+
+        monkeypatch.setattr(quality_mod, "record_gate_event", denied)
+        assert main(["quality", "check", env["drifted"]]) == 1
+        out = capsys.readouterr().out
+        assert "verdict not recorded" in out
+        assert "quality-drift" in out  # the findings still rendered
+
+
+def test_refreeze_logs_drift_vs_prior_baseline(tmp_path, capsys):
+    """Re-running prepare re-freezes the baseline by design — but a
+    drifted cohort must not be absorbed SILENTLY: the overwrite first
+    scores the new sets against the prior baseline and logs the PSI."""
+    from apnea_uq_tpu.data.prepare import PreparedDatasets, save_prepared
+
+    rng = np.random.default_rng(5)
+
+    def prepared(shift=0.0):
+        x_test = (rng.normal(size=(60, 30, 2)) + shift).astype(np.float32)
+        return PreparedDatasets(
+            x_train=np.zeros((8, 30, 2), np.float32),
+            y_train=np.zeros(8, np.int8),
+            x_test=x_test,
+            y_test=np.zeros(60, np.int8),
+            patient_ids_test=np.array([f"P{i % 4}" for i in range(60)]),
+            x_test_rus=None, y_test_rus=None,
+        )
+
+    registry = ArtifactRegistry(str(tmp_path / "reg"))
+    save_prepared(prepared(), registry)
+    capsys.readouterr()
+    save_prepared(prepared(shift=5.0), registry)
+    out = capsys.readouterr().out
+    assert "quality_baseline re-freeze" in out
+    assert "max_psi" in out
+    # And the artifact now describes the new cohort.
+    doc = registry.load_json(reg.QUALITY_BASELINE)
+    assert set(doc["sets"]) == {reg.TEST_STD_UNBALANCED}
+
+
+class TestQualityCheckJaxFree:
+    def test_quality_check_runs_with_jax_poisoned(self, tmp_path,
+                                                  capsys):
+        """The read side must work on machines with no usable backend:
+        poison jax/flax in sys.modules and run the real CLI check."""
+        import subprocess
+        import sys
+
+        run_dir = _quality_run(tmp_path / "run", ece=0.05)
+        code = (
+            "import sys\n"
+            "sys.modules['jax'] = None\n"
+            "sys.modules['flax'] = None\n"
+            "from apnea_uq_tpu.cli.main import main\n"
+            f"rc = main(['quality', 'check', {run_dir!r}, "
+            f"'--baseline', {run_dir!r}])\n"
+            "raise SystemExit(rc)\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]

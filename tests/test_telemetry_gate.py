@@ -526,6 +526,51 @@ class TestCompare:
         assert not compare_mod.unit_direction("bytes")
         assert compare_mod.unit_direction(None)  # unknown: higher wins
 
+    def test_name_direction_inference(self):
+        """ISSUE 13 satellite: ece/mce/brier/psi/ks/drift as a metric
+        NAME token gate lower-is-better with no --metric-direction."""
+        for name in ("quality.CNN_MCD_Unbalanced.ece", "val_ece",
+                     "quality.X.mce", "cohort_brier",
+                     "drift.Unbalanced.max_psi", "drift.RUS.max_ks",
+                     "input_drift_score"):
+            assert compare_mod.name_direction(name) is False, name
+        # Existing metric names carry none of the tokens — the unit
+        # inference stays authoritative for them.
+        for name in ("mcd_t50_inference_throughput", "bootstrap.speedup",
+                     "compile.total_s", "data.prepared.load_s",
+                     "audit.mcd_predict_fused.flops",
+                     "eval.CNN_MCD_Unbalanced.d2h_bytes"):
+            assert compare_mod.name_direction(name) is None, name
+        # And substrings never false-trigger: the token must stand
+        # alone ("checksum" contains neither `ks` nor `psi` as tokens).
+        assert compare_mod.name_direction("checksum_verify_s") is None
+        assert compare_mod.name_direction("epsilon_sweep") is None
+        # metric_direction: the name inference WINS over the unit.
+        assert compare_mod.metric_direction("val_ece",
+                                            "windows/sec") is False
+        assert compare_mod.metric_direction("throughput",
+                                            "windows/sec") is True
+
+    def test_quality_named_metric_gates_without_direction_flag(
+            self, tmp_path):
+        """Golden for the name-based direction: a driver-schema capture
+        whose metric is named val_ece (unknown unit) regresses when it
+        RISES, with no --metric-direction flag — the hole the
+        unknown-unit default left for calibration scores."""
+        def ece_json(path, value):
+            with open(path, "w") as f:
+                json.dump({"metric": "val_ece", "value": value,
+                           "unit": "score"}, f)
+            return str(path)
+
+        base = ece_json(tmp_path / "b.json", 0.05)
+        worse = ece_json(tmp_path / "c.json", 0.09)
+        assert main(["telemetry", "compare", base, worse]) == 1
+        assert main(["telemetry", "compare", worse, base]) == 0
+        # An explicit override still wins over the name inference.
+        assert main(["telemetry", "compare", base, worse,
+                     "--metric-direction", "val_ece=higher"]) == 0
+
 
 def _green_probe(timeout_s):
     return True, "ok"
