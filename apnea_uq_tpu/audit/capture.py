@@ -33,6 +33,7 @@ import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 
 from apnea_uq_tpu.compilecache import store as store_mod
 
@@ -117,6 +118,12 @@ class ProgramAudit:
     # check).  Defaulted so synthetic-capture tests predating the field
     # keep constructing.
     bf16_ops: int = 0
+    # collectives' keys -> summed operand bytes (per-shard avals): the
+    # payload one participant contributes per collective, the topology
+    # analysis's cross-host traffic input (apnea_uq_tpu/topo/).
+    # Defaulted like bf16_ops for captures predating the field.
+    collective_payloads: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def const_bytes(self) -> int:
@@ -159,8 +166,20 @@ def _axis_names(params: Dict[str, Any]) -> Tuple[str, ...]:
     return tuple(sorted(str(a) for a in axes))
 
 
-def _scan_jaxpr(closed) -> Tuple[Dict[str, int], List[str]]:
+def _aval_bytes(var) -> int:
+    """Best-effort byte size of one jaxpr atom's aval (0 when the aval
+    carries no static shape/dtype — accounting stays best-effort)."""
+    aval = getattr(var, "aval", None)
+    try:
+        size = int(np.prod(aval.shape)) if aval.shape else 1
+        return size * int(np.dtype(aval.dtype).itemsize)
+    except Exception:  # noqa: BLE001 - abstract/token avals
+        return 0
+
+
+def _scan_jaxpr(closed) -> Tuple[Dict[str, int], Dict[str, int], List[str]]:
     collectives: Dict[str, int] = {}
+    payloads: Dict[str, int] = {}
     callbacks: List[str] = []
     for jaxpr in _iter_jaxprs(closed):
         for eqn in jaxpr.eqns:
@@ -169,9 +188,12 @@ def _scan_jaxpr(closed) -> Tuple[Dict[str, int], List[str]]:
                 canonical = _PRIM_CANONICAL.get(name, name)
                 key = f"{canonical}[{','.join(_axis_names(eqn.params))}]"
                 collectives[key] = collectives.get(key, 0) + 1
+                payloads[key] = payloads.get(key, 0) + sum(
+                    _aval_bytes(v) for v in eqn.invars)
             elif name in CALLBACK_PRIMS or "callback" in name:
                 callbacks.append(name)
-    return dict(sorted(collectives.items())), sorted(callbacks)
+    return (dict(sorted(collectives.items())),
+            dict(sorted(payloads.items())), sorted(callbacks))
 
 
 def _const_records(closed) -> List[Dict[str, Any]]:
@@ -233,7 +255,7 @@ def capture_program(label: str, fn, args: tuple, kwargs: dict, *,
     jitted = jax.jit(wrapper, donate_argnums=donate or ())
     traced = jitted.trace(*specs)
     closed = traced.jaxpr
-    collectives, callbacks = _scan_jaxpr(closed)
+    collectives, payloads, callbacks = _scan_jaxpr(closed)
     consts = _const_records(closed)
     lowered = traced.lower()
     hlo = lowered.as_text()
@@ -254,13 +276,15 @@ def capture_program(label: str, fn, args: tuple, kwargs: dict, *,
     except Exception:  # noqa: BLE001 - accounting is best-effort
         pass
     try:
+        # apnea-lint: disable=single-host-device-enumeration -- the audit is a single-process CPU lowering; the GLOBAL platform/device-count is the fact being recorded
         devices = jax.devices()
         platform, num_devices = devices[0].platform, len(devices)
     except Exception:  # noqa: BLE001 - no backend: facts still form
         platform, num_devices = "unknown", 0
     return ProgramAudit(
         label=label, group=group,
-        collectives=collectives, hlo_collectives=hlo_collectives,
+        collectives=collectives, collective_payloads=payloads,
+        hlo_collectives=hlo_collectives,
         f64_ops=len(_F64_RE.findall(hlo)),
         bf16_accum_reduces=len(_BF16_REDUCE_RE.findall(hlo)),
         bf16_ops=len(_BF16_RE.findall(hlo)),

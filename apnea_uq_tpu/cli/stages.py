@@ -341,11 +341,17 @@ def cmd_train(args, config) -> int:
                 config.train, mesh=mesh, log_fn=log, run_log=run_log,
                 profiler=prof,
             )
-        path = save_state(os.path.join(_ckpt_root(args), "baseline"),
-                          result.state)
-        log(f"saved baseline checkpoint -> {path} "
-            f"(best epoch {result.best_epoch + 1}, "
-            f"stopped_early={result.stopped_early})")
+        from apnea_uq_tpu.utils.multihost import is_primary
+
+        if is_primary():
+            # Process-0-only write (the run-log discipline, enforced by
+            # `apnea-uq topo` unguarded-primary-io): every process holds
+            # the same trained state, one of them persists it.
+            path = save_state(os.path.join(_ckpt_root(args), "baseline"),
+                              result.state)
+            log(f"saved baseline checkpoint -> {path} "
+                f"(best epoch {result.best_epoch + 1}, "
+                f"stopped_early={result.stopped_early})")
         with run_log.stage("evaluate", snapshot_memory=True):
             for label, (x, y, _ids) in sets.items():
                 probs = predict_proba_batched(
@@ -405,13 +411,18 @@ def cmd_train_ensemble(args, config) -> int:
         # (bit-identical to what a fresh larger run would save, so growing
         # N later re-trains nothing).  skip_existing covers the resume
         # corner where a promoted slot's seed is already on disk from an
-        # earlier run.
-        save_ensemble_result(store, result, seed_base=cfg.seed_base,
-                             skip_existing=True)
-        promoted = result.promoted_members
-        extra = (f" (incl. {promoted} promoted padded slots)"
-                 if promoted else "")
-        log(f"saved {result.num_members} members{extra} -> {store.root}")
+        # earlier run.  Process 0 persists (fit_ensemble's host_values
+        # gather hands every process the full member stack).
+        from apnea_uq_tpu.utils.multihost import is_primary
+
+        if is_primary():
+            save_ensemble_result(store, result, seed_base=cfg.seed_base,
+                                 skip_existing=True)
+            promoted = result.promoted_members
+            extra = (f" (incl. {promoted} promoted padded slots)"
+                     if promoted else "")
+            log(f"saved {result.num_members} members{extra} -> "
+                f"{store.root}")
     return 0
 
 
@@ -650,8 +661,14 @@ def cmd_eval_mcd(args, config) -> int:
                               if args.profile else None),
                 )
             _print_run(result)
-            save_run(registry, result, config=uq_config)
-            _emit_plots(args, result)
+            # Artifact writes are primary-only under a multi-process
+            # mesh (the predict results are allgathered, so process 0
+            # holds everything the registry needs).
+            from apnea_uq_tpu.utils.multihost import is_primary
+
+            if is_primary():
+                save_run(registry, result, config=uq_config)
+                _emit_plots(args, result)
     return 0
 
 
@@ -686,8 +703,11 @@ def cmd_eval_de(args, config) -> int:
                               if args.profile else None),
                 )
             _print_run(result)
-            save_run(registry, result, config=uq_config)
-            _emit_plots(args, result)
+            from apnea_uq_tpu.utils.multihost import is_primary
+
+            if is_primary():
+                save_run(registry, result, config=uq_config)
+                _emit_plots(args, result)
     return 0
 
 
@@ -869,8 +889,11 @@ def cmd_sweep(args, config) -> int:
     # Canonical key, not a literal: `apnea-uq flow` flags string-spelled
     # keys as artifact-key-drift (this very line was the true positive).
     key = f"{reg.SWEEP}:{args.method}"
-    # apnea-lint: disable=artifact-never-consumed -- end product: the convergence table is plotted here and read back by analysts, not by a later stage
-    registry.save_table(key, frame)
+    from apnea_uq_tpu.utils.multihost import is_primary
+
+    if is_primary():
+        # apnea-lint: disable=artifact-never-consumed -- end product: the convergence table is plotted here and read back by analysts, not by a later stage
+        registry.save_table(key, frame)
     log(frame.to_string(index=False))
     if args.plot:
         path = plot_convergence(frame, args.plot)
@@ -1000,7 +1023,7 @@ def cmd_telemetry_trend(args) -> int:
 
     from apnea_uq_tpu.telemetry import trend as trend_mod
 
-    archived = trend_mod.repo_rounds(args.rounds_dir)
+    archived = trend_mod.archived_rounds(args.rounds_dir)
     if args.update_docs:
         if args.sources:
             # The doc is byte-pinned against a render from the archived
@@ -1008,15 +1031,16 @@ def cmd_telemetry_trend(args) -> int:
             # the user believe their round made it into the doc.
             raise SystemExit(
                 "telemetry trend --update-docs renders the archived "
-                "BENCH_r*.json rounds only and cannot include extra "
-                f"sources ({args.sources}); archive the capture as "
-                "BENCH_r<N>.json first, or render it ad hoc without "
-                "--update-docs"
+                "BENCH_r*.json / MULTICHIP_r*.json rounds only and "
+                f"cannot include extra sources ({args.sources}); "
+                "archive the capture as BENCH_r<N>.json first, or "
+                "render it ad hoc without --update-docs"
             )
         if not archived:
             raise SystemExit(
-                "telemetry trend --update-docs: no BENCH_r*.json rounds "
-                f"found under {args.rounds_dir or trend_mod.default_rounds_dir()!r}"
+                "telemetry trend --update-docs: no BENCH_r*.json or "
+                "MULTICHIP_r*.json rounds found under "
+                f"{args.rounds_dir or trend_mod.default_rounds_dir()!r}"
             )
         from apnea_uq_tpu.utils.io import atomic_write_text
 
@@ -1046,8 +1070,8 @@ def cmd_telemetry_trend(args) -> int:
             paths.append(p)
     if not paths:
         raise SystemExit(
-            "telemetry trend: no BENCH_r*.json rounds or runs/ "
-            "directories found under "
+            "telemetry trend: no BENCH_r*.json / MULTICHIP_r*.json "
+            "rounds or runs/ directories found under "
             f"{args.rounds_dir or trend_mod.default_rounds_dir()!r} and no extra "
             "sources given"
         )
@@ -1134,6 +1158,77 @@ def cmd_cohort(args, config) -> int:
         log()
         log(format_signal_quality_report(analyze_signal_quality(metadata)))
     return 0
+
+
+def cmd_check(args, config) -> int:
+    """The ``apnea-uq check`` meta-gate: lint + flow + audit + topo in
+    one invocation, merged output, one exit code (0 all clean, 1 any
+    findings, 2 any usage error) — so CI needs one step, not four.
+    Each gate runs with its tier-1 defaults; a gate's usage error is
+    reported and the remaining gates still run, so one broken manifest
+    cannot hide another gate's findings."""
+    import argparse
+
+    # Pin the canonical analysis rig BEFORE any gate touches jax: audit
+    # runs first and would otherwise initialize a 1-device CPU backend,
+    # after which topo's own identical pin (guarded by "jax not yet
+    # imported") can no longer apply and its sweep would see a 1x1
+    # topology with no manifest rows — failing the documented
+    # `JAX_PLATFORMS=cpu apnea-uq check` recipe on a clean tree.
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    from apnea_uq_tpu.audit.cli import cmd_audit
+    from apnea_uq_tpu.audit.manifest import (
+        DEFAULT_MANIFEST_PATH as AUDIT_MANIFEST,
+    )
+    from apnea_uq_tpu.compilecache.zoo import WARM_GROUPS
+    from apnea_uq_tpu.flow.cli import cmd_flow
+    from apnea_uq_tpu.flow.manifest import (
+        DEFAULT_MANIFEST_PATH as FLOW_MANIFEST,
+    )
+    from apnea_uq_tpu.lint.cli import cmd_lint
+    from apnea_uq_tpu.topo.cli import cmd_topo
+    from apnea_uq_tpu.topo.manifest import (
+        DEFAULT_MANIFEST_PATH as TOPO_MANIFEST,
+    )
+
+    fmt = args.format
+    common = dict(paths=None, json=False, format=fmt, rule=[])
+    gates = (
+        ("lint", lambda: cmd_lint(argparse.Namespace(**common))),
+        ("flow", lambda: cmd_flow(argparse.Namespace(
+            **common, manifest=FLOW_MANIFEST, update_manifest=False,
+            update_docs=False, docs=None))),
+        ("audit", lambda: cmd_audit(argparse.Namespace(
+            programs=",".join(WARM_GROUPS), json=False, format=fmt,
+            rule=[], update_manifest=False, manifest=AUDIT_MANIFEST,
+            run_dir=None), config)),
+        ("topo", lambda: cmd_topo(argparse.Namespace(
+            **common, manifest=TOPO_MANIFEST, update_manifest=False,
+            update_docs=False, docs=None, run_dir=None), config)),
+    )
+    codes = {}
+    for name, run in gates:
+        if fmt != "gha":
+            log(f"== apnea-uq {name} ==")
+        try:
+            codes[name] = run()
+        except SystemExit as e:
+            codes[name] = int(e.code or 0)
+    if fmt != "gha":
+        verdicts = ", ".join(
+            f"{name}: {'clean' if rc == 0 else 'FINDINGS' if rc == 1 else 'USAGE ERROR'}"
+            for name, rc in codes.items())
+        log(f"== check: {verdicts} ==")
+    if any(rc == 2 for rc in codes.values()):
+        return 2
+    return 1 if any(rc == 1 for rc in codes.values()) else 0
 
 
 # -------------------------------------------------------------- registry --
@@ -1478,6 +1573,30 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     from apnea_uq_tpu.audit import cli as audit_cli
 
     audit_cli.register(sub, add_config_arg, load_config_fn)
+
+    # `topo` is the fourth rule family (apnea_uq_tpu/topo/): multi-host
+    # topology readiness — AST source rules plus the mesh program
+    # families lowered under simulated topologies on CPU.  Takes
+    # --config like audit; jax imports stay inside the handler (and are
+    # skipped entirely when only source rules are selected).
+    from apnea_uq_tpu.topo import cli as topo_cli
+
+    topo_cli.register(sub, add_config_arg, load_config_fn)
+
+    # `check` runs all four static gates in one invocation with merged
+    # output and a single exit code — the one-step CI recipe
+    # (docs/LINT.md "CI recipe").
+    p = sub.add_parser(
+        "check",
+        help="Run every static gate — lint + flow + audit + topo — "
+             "with merged output; exit 0 all clean, 1 on any finding, "
+             "2 on any usage error.")
+    add_config_arg(p)
+    p.add_argument("--format", choices=("text", "gha"), default="text",
+                   help="Output format; `gha` concatenates the gates' "
+                        "GitHub Actions annotation lines (empty on a "
+                        "clean tree).")
+    p.set_defaults(fn=lambda args: cmd_check(args, load_config_fn(args)))
 
     p = add("demo", cmd_demo,
             "Zero-data synthetic smoke demo of the UQ engine.")

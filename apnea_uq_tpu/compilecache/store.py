@@ -202,6 +202,7 @@ def backend_fingerprint() -> str:
     for one platform/device-kind/device-count must never be offered to
     another."""
     try:
+        # apnea-lint: disable=single-host-device-enumeration -- the store key fingerprints the GLOBAL topology on purpose: a program compiled for one device/process count must never be offered to another
         devices = jax.devices()
         return (f"{devices[0].platform}/{devices[0].device_kind}"
                 f"/d{len(devices)}/p{jax.process_count()}")

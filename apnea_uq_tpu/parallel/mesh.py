@@ -10,8 +10,15 @@ Here the two parallel axes are explicit mesh axes:
   ``psum`` over this axis automatically from sharding propagation, riding
   ICI on real TPU topologies.
 
-On a single chip the mesh is 1x1 and everything degenerates to plain jit;
-tests exercise 8 virtual CPU devices.
+Construction is topology-driven (:mod:`apnea_uq_tpu.parallel.topology`):
+the device list is ordered host-major and the layout solver places the
+``data`` axis within hosts whenever the member bound allows, so the
+per-step gradient all-reduce rides ICI and only the collective-free
+``ensemble`` axis spans hosts.  On a single host (every current rig)
+this degenerates bit-for-bit to the historical flat
+``jax.devices()``-order reshape — pinned by ``tests/test_topo.py`` — and
+on a single chip the mesh is 1x1 and everything degenerates to plain
+jit; tests exercise 8 virtual CPU devices.
 """
 
 from __future__ import annotations
@@ -19,11 +26,22 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apnea_uq_tpu.parallel import topology as topo_mod
 
 AXIS_ENSEMBLE = "ensemble"
 AXIS_DATA = "data"
+
+
+def _spec_and_devices(devices, topology):
+    """Resolve the (spec, host-major devices) pair one of three ways:
+    an explicit simulated ``topology`` over the given/live devices, or
+    detection from the device list / live platform."""
+    if topology is not None:
+        devs = list(devices) if devices is not None else jax.devices()  # apnea-lint: disable=single-host-device-enumeration -- explicit-topology construction spans every process's devices by definition (the spec says which host owns which)
+        return topology, topo_mod.host_major_devices(topology, devs)
+    return topo_mod.detect_topology(devices)
 
 
 def make_mesh(
@@ -31,33 +49,31 @@ def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     *,
     ensemble_axis: int = 0,
+    topology: Optional[topo_mod.TopologySpec] = None,
 ) -> Mesh:
     """Build an ``(ensemble, data)`` mesh over the available devices.
 
     ``ensemble_axis=0`` (auto) picks the largest divisor of the device
-    count that is <= num_members, maximizing concurrent members; remaining
-    devices form the data axis.  Pass an explicit ``ensemble_axis`` to pin
-    the layout (it must divide the device count).
+    count that is <= num_members — preferring layouts whose data axis
+    stays within a host (:func:`topology.solve_layout`) — maximizing
+    concurrent members; remaining devices form the data axis.  Pass an
+    explicit ``ensemble_axis`` to pin the layout (it must divide the
+    device count).  ``topology`` pins a
+    :class:`~apnea_uq_tpu.parallel.topology.TopologySpec` (simulated
+    host boundaries included) instead of detecting one.
     """
-    devs = list(devices) if devices is not None else jax.devices()
-    d = len(devs)
-    if ensemble_axis == 0:
-        e = 1
-        for cand in range(1, d + 1):
-            if d % cand == 0 and cand <= max(num_members, 1):
-                e = cand
-    else:
-        e = ensemble_axis
-        if d % e != 0:
-            raise ValueError(f"ensemble_axis {e} does not divide device count {d}")
-    mesh_devices = np.asarray(devs).reshape(e, d // e)
-    return Mesh(mesh_devices, (AXIS_ENSEMBLE, AXIS_DATA))
+    spec, devs = _spec_and_devices(devices, topology)
+    e, d = topo_mod.solve_layout(spec, num_members,
+                                 ensemble_axis=ensemble_axis)
+    return topo_mod.build_mesh(spec, devs, e, d)
 
 
 def make_mesh_from_config(
     config,
     num_members: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    topology: Optional[topo_mod.TopologySpec] = None,
 ) -> Mesh:
     """Build the mesh a :class:`apnea_uq_tpu.config.MeshConfig` describes.
 
@@ -65,22 +81,11 @@ def make_mesh_from_config(
     the DP factor (ensemble = devices / data); else fully auto (see
     :func:`make_mesh`).
     """
-    devs = list(devices) if devices is not None else jax.devices()
-    e = config.ensemble_axis
-    if e == 0 and config.data_axis > 0:
-        if len(devs) % config.data_axis:
-            raise ValueError(
-                f"data_axis {config.data_axis} does not divide device "
-                f"count {len(devs)}"
-            )
-        e = len(devs) // config.data_axis
-    if config.ensemble_axis > 0 and config.data_axis > 0:
-        if config.ensemble_axis * config.data_axis != len(devs):
-            raise ValueError(
-                f"mesh {config.ensemble_axis}x{config.data_axis} does not "
-                f"match device count {len(devs)}"
-            )
-    return make_mesh(num_members, devs, ensemble_axis=e)
+    spec, devs = _spec_and_devices(devices, topology)
+    e, d = topo_mod.solve_layout(
+        spec, num_members,
+        ensemble_axis=config.ensemble_axis, data_axis=config.data_axis)
+    return topo_mod.build_mesh(spec, devs, e, d)
 
 
 def member_sharding(mesh: Mesh) -> NamedSharding:

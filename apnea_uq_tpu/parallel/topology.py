@@ -1,0 +1,229 @@
+"""Declarative device topology: the single source of truth for meshes.
+
+``parallel/mesh.py`` historically reshaped a flat ``jax.devices()`` list
+with no notion of *hosts* — fine on one chip or one host, silently wrong
+at pod scale, where the fabric is two-tier: ICI within a host's slice,
+DCN between hosts.  A mesh axis that spans hosts pays DCN latency on
+every collective over it, so the layout rule for this codebase is:
+
+- the ``data`` axis (gradient ``psum`` every step) lives WITHIN a host
+  whenever the layout allows, so its all-reduce rides ICI;
+- the ``ensemble`` axis (zero collectives by design — members are
+  independent) is the axis that SPANS hosts, where the wire would hurt.
+
+:class:`TopologySpec` makes that reasoning explicit and testable: hosts
+× local devices per host, plus the per-device HBM budget and the
+cross-host traffic allowance the static topology analysis
+(``apnea-uq topo``, :mod:`apnea_uq_tpu.topo`) gates against.  Mesh
+construction (:func:`build_mesh`) orders devices host-major and reshapes
+``(ensemble, data)`` so data groups are contiguous within-host runs —
+on a single host this degenerates to exactly the historical
+``np.asarray(devices).reshape(e, d)`` (bit-parity pinned by
+``tests/test_topo.py``), so nothing changes until a second host exists.
+
+The spec is also how the analysis *simulates* multi-host layouts on the
+8-virtual-device CPU test rig: ``TopologySpec(hosts=2,
+devices_per_host=4)`` over 8 real CPU devices treats the host-major
+device order as two simulated hosts of four, which is all the static
+cross-host classification needs (jax 0.4.x cannot yet lower through an
+``AbstractMesh`` with a device assignment, so fake-device 2×8 / 4×8
+meshes stay out of reach; the simulated-host partition of the real rig
+is the CPU-checkable projection of the same hazards).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Default per-device HBM budget for simulated topologies: one v5e chip
+# (telemetry.memory.CHIP_HBM_BYTES["TPU v5e"]).  The topo analysis
+# checks compiled per-device peaks against this — canonical audit shapes
+# sit far under it, so a violation means a program's footprint no longer
+# scales with the mesh (e.g. a replicated buffer that should shard).
+DEFAULT_HBM_BYTES = int(16e9)
+
+# Default per-program cross-host traffic allowance: collectives whose
+# device groups span hosts ride DCN; 64 MiB per lowered program is far
+# above anything the current zoo emits (zero) and far below a
+# mistakenly-global all-gather of a window set.
+DEFAULT_CROSS_HOST_BUDGET_BYTES = 64 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologySpec:
+    """hosts × local devices, with the budgets the topo rules enforce."""
+
+    hosts: int
+    devices_per_host: int
+    hbm_bytes_per_device: int = DEFAULT_HBM_BYTES
+    cross_host_budget_bytes: int = DEFAULT_CROSS_HOST_BUDGET_BYTES
+
+    def __post_init__(self):
+        if self.hosts < 1 or self.devices_per_host < 1:
+            raise ValueError(
+                f"topology needs >=1 host and >=1 device/host, got "
+                f"{self.hosts}x{self.devices_per_host}")
+
+    @property
+    def total_devices(self) -> int:
+        return self.hosts * self.devices_per_host
+
+    @property
+    def name(self) -> str:
+        """``2x4`` = 2 hosts × 4 local devices (the manifest row key)."""
+        return f"{self.hosts}x{self.devices_per_host}"
+
+
+def detect_topology(devices: Optional[Sequence] = None,
+                    ) -> Tuple[TopologySpec, List]:
+    """The live platform's topology: devices grouped by
+    ``process_index``, host-major order preserved.  Returns
+    ``(spec, devices_in_host_major_order)``.
+
+    Single-process platforms (every CPU/TPU test rig, one-host slices)
+    come back as ``1 x len(devices)`` with the device order untouched —
+    the bit-parity anchor for :func:`build_mesh`.  Ragged per-host
+    device counts (no JAX platform produces them today) collapse to one
+    logical host rather than guessing a layout.
+    """
+    if devices is None:
+        import jax
+
+        # The global mesh deliberately wants EVERY process's devices;
+        # process-local enumeration is jax.local_devices(), not here.
+        # apnea-lint: disable=single-host-device-enumeration -- detect_topology is the one sanctioned global-enumeration site: it groups the global list by process_index to build the host-aware spec
+        devices = jax.devices()
+    devs = list(devices)
+    by_host: Dict[int, List] = {}
+    for d in devs:
+        by_host.setdefault(int(getattr(d, "process_index", 0)), []).append(d)
+    counts = {len(v) for v in by_host.values()}
+    if len(counts) != 1:
+        return TopologySpec(1, len(devs)), devs
+    local = counts.pop()
+    # Host-major, stable: within a host the platform's own order holds.
+    ordered = [d for host in sorted(by_host) for d in by_host[host]]
+    return TopologySpec(len(by_host), local), ordered
+
+
+def simulated_topologies(total_devices: int,
+                         ) -> Tuple[TopologySpec, ...]:
+    """The canonical simulated sweep over ``total_devices`` real
+    devices: single-host (the parity anchor) plus every power-of-two
+    host split up to 4 hosts.  On the canonical 8-device rig this is
+    1x8, 2x4, 4x2 — the committed ``topo/manifest.json`` rows."""
+    specs = [TopologySpec(1, total_devices)]
+    for hosts in (2, 4):
+        if total_devices % hosts == 0 and total_devices // hosts >= 1 \
+                and hosts <= total_devices:
+            specs.append(TopologySpec(hosts, total_devices // hosts))
+    return tuple(specs)
+
+
+def solve_layout(spec: TopologySpec, num_members: int = 1, *,
+                 ensemble_axis: int = 0, data_axis: int = 0,
+                 ) -> Tuple[int, int]:
+    """The ``(ensemble, data)`` factor sizes for this topology.
+
+    Explicit ``ensemble_axis`` wins; else an explicit ``data_axis``
+    fixes the DP factor; else auto.  Auto maximizes concurrent members
+    (largest divisor of the device count <= ``num_members``) — among
+    layouts whose data axis fits WITHIN a host when any such layout
+    satisfies the member bound, so the gradient ``psum`` rides ICI.
+    When none does (the pure data-parallel ``num_members=1`` mesh on a
+    multi-host topology — the global-batch axis genuinely spans hosts),
+    auto falls back to the historical choice and the topo analysis
+    charges the cross-host traffic instead of refusing the layout.
+    On a single host every divisor is within-host, so auto reduces
+    exactly to the historical behavior.
+    """
+    total = spec.total_devices
+    if ensemble_axis:
+        e = ensemble_axis
+        if total % e != 0:
+            raise ValueError(
+                f"ensemble_axis {e} does not divide device count {total}")
+        if data_axis and e * data_axis != total:
+            raise ValueError(
+                f"mesh {e}x{data_axis} does not match device count {total}")
+        return e, total // e
+    if data_axis:
+        if total % data_axis != 0:
+            raise ValueError(
+                f"data_axis {data_axis} does not divide device count "
+                f"{total}")
+        return total // data_axis, data_axis
+    bound = max(num_members, 1)
+    divisors = [c for c in range(1, total + 1) if total % c == 0]
+    candidates = [c for c in divisors if c <= bound]
+    intra = [c for c in candidates
+             if spec.devices_per_host % (total // c) == 0]
+    e = max(intra) if intra else max(candidates)
+    return e, total // e
+
+
+def host_major_devices(spec: TopologySpec, devices: Sequence) -> List:
+    """``devices`` in host-major order under ``spec``.  A simulated spec
+    partitions the given order into ``hosts`` runs of
+    ``devices_per_host``; live devices re-sort by their real
+    ``process_index`` (stable, so single-host order is untouched)."""
+    devs = list(devices)
+    if len(devs) != spec.total_devices:
+        raise ValueError(
+            f"topology {spec.name} needs {spec.total_devices} devices, "
+            f"got {len(devs)}")
+    if spec.hosts == 1:
+        return devs
+    indices = {int(getattr(d, "process_index", 0)) for d in devs}
+    if len(indices) > 1:
+        devs.sort(key=lambda d: int(getattr(d, "process_index", 0)))
+    return devs
+
+
+def build_mesh(spec: TopologySpec, devices: Sequence, e: int, d: int):
+    """The ``(ensemble, data)`` mesh for this topology: host-major
+    device order reshaped ``(e, d)``, so each data group is a contiguous
+    within-host run whenever ``d`` divides the host's device count —
+    and on one host, exactly the historical flat reshape."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = host_major_devices(spec, devices)
+    if e * d != len(devs):
+        raise ValueError(
+            f"layout {e}x{d} does not cover {len(devs)} devices")
+    from apnea_uq_tpu.parallel import mesh as mesh_mod
+
+    return Mesh(np.asarray(devs).reshape(e, d),
+                (mesh_mod.AXIS_ENSEMBLE, mesh_mod.AXIS_DATA))
+
+
+def axis_spans_hosts(spec: TopologySpec, e: int, d: int,
+                     axis: str) -> bool:
+    """Whether ``axis`` of the ``(e, d)`` layout communicates across
+    hosts under ``spec``.  Data groups are contiguous host-major runs:
+    within one host iff the run fits and aligns (``d`` divides the
+    host's device count).  Ensemble groups stride across the data
+    groups, so they span hosts whenever more than one host exists and
+    the data axis doesn't already cover whole hosts' worth of rows per
+    host... which for this construction reduces to: any second host
+    puts some ensemble group across a host boundary."""
+    if spec.hosts == 1:
+        return False
+    from apnea_uq_tpu.parallel import mesh as mesh_mod
+
+    if axis == mesh_mod.AXIS_DATA:
+        return spec.devices_per_host % d != 0
+    if axis == mesh_mod.AXIS_ENSEMBLE:
+        # Rows (data groups) tile the hosts; the ensemble axis crosses
+        # a host boundary unless every column stays inside one host —
+        # i.e. unless a single host holds the whole mesh.
+        return True
+    return True
+
+
+def axis_sizes(e: int, d: int) -> Dict[str, int]:
+    from apnea_uq_tpu.parallel import mesh as mesh_mod
+
+    return {mesh_mod.AXIS_ENSEMBLE: e, mesh_mod.AXIS_DATA: d}

@@ -424,6 +424,24 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
                 name = f"audit.{e.get('label', '?')}.bytes_accessed"
                 out[name] = Metric(name, float(e["bytes_accessed"]),
                                    "bytes", False)
+        elif kind == "topo_program":
+            # The topology sweep's per-(program, topology) cell
+            # (`apnea-uq topo --run-dir`): modeled cross-host DCN bytes
+            # and the compiled per-device memory estimate, both
+            # lower-is-better.  The cross-host model is structural math
+            # over canonical shapes -> comparable anywhere; the
+            # per-device estimate comes from a backend compile ->
+            # backend-bound like the memory_profile peaks.
+            label = e.get("label", "?")
+            topology = e.get("topology", "?")
+            if e.get("cross_host_bytes") is not None:
+                name = f"topo.{label}.{topology}.cross_host_bytes"
+                out[name] = Metric(name, float(e["cross_host_bytes"]),
+                                   "bytes", False)
+            if e.get("per_device_bytes") is not None:
+                name = f"topo.{label}.{topology}.per_device_bytes"
+                out[name] = Metric(name, float(e["per_device_bytes"]),
+                                   "bytes", False, backend_bound=True)
         elif kind == "quality_metrics":
             # Model-quality scalars of one eval run (telemetry/quality.py
             # emits them from run_{mcd,de}_analysis): ECE/MCE/Brier per
@@ -493,7 +511,7 @@ def load_source(
                 f"no comparable metrics in source {path!r}: the run's "
                 f"events carry no bench/eval throughput, d2h, "
                 f"memory-peak, compile-cost, data-load, program-audit, "
-                f"quality, or drift metrics"
+                f"topology, quality, or drift metrics"
             )
         return metrics, {"kind": "run_dir", "proxy": dir_proxy}
     with open(path) as f:

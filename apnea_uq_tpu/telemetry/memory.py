@@ -74,10 +74,12 @@ def _memo(run_log) -> set:
 def device_hbm_limit(device=None) -> Optional[int]:
     """Per-device HBM capacity in bytes: ``memory_stats()['bytes_limit']``
     when the runtime exposes it, else the public spec for the chip kind,
-    else None (e.g. CPU).  Never raises."""
+    else None (e.g. CPU).  Never raises.  The default device is
+    process-LOCAL: under a multi-process mesh ``jax.devices()[0]`` can
+    be another host's device, whose ``memory_stats()`` raises."""
     try:
         if device is None:
-            device = jax.devices()[0]
+            device = jax.local_devices()[0]
         try:
             stats = device.memory_stats() or {}
         except Exception:  # noqa: BLE001 - tunneled backends may raise
@@ -175,7 +177,9 @@ def record_jit_memory(run_log, label: str, fn, *args,
             if stats is None:
                 return None
             fields = memory_analysis_fields(stats)
-        device = jax.devices()[0]
+        # Process-local on purpose: the profile describes THIS process's
+        # compiled module, and a remote host's device has no stats here.
+        device = jax.local_devices()[0]
         limit = device_hbm_limit(device)
         return run_log.event(
             "memory_profile",
@@ -201,7 +205,8 @@ def snapshot_device_memory(run_log, label: str) -> Optional[Dict[str, Any]]:
     try:
         fields: Dict[str, Any] = {"label": label}
         try:
-            device = jax.devices()[0]
+            # Process-local: memory_stats of a remote device would raise.
+            device = jax.local_devices()[0]
             stats = device.memory_stats() or {}
         except Exception:  # noqa: BLE001 - backend may be unusable
             stats = {}

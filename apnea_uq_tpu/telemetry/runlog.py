@@ -51,6 +51,7 @@ def device_topology() -> Dict[str, Any]:
     try:
         import jax
 
+        # apnea-lint: disable=single-host-device-enumeration -- run_started records the GLOBAL topology (device/process counts) by design; best-effort and guarded
         devices = jax.devices()
         return {
             "platform": devices[0].platform if devices else "unknown",

@@ -1,0 +1,23 @@
+"""Multi-host topology-readiness static analysis (``apnea-uq topo``).
+
+Fourth rule family on the PR-4 lint engine: the hazards that only
+surface at pod scale — host-local device enumeration where process-local
+is required, primary-only I/O left unguarded under multiprocess,
+lockstep collectives inside per-process-divergent branches, cross-host
+collective payloads, per-device HBM overflow under a topology — checked
+statically on the CPU rig, before any multi-host window.
+
+- :mod:`apnea_uq_tpu.topo.capture` — lower the mesh program families
+  under a sweep of simulated topologies (the PR-7 audit seam);
+- :mod:`apnea_uq_tpu.topo.rules` — the source + program rule registry;
+- :mod:`apnea_uq_tpu.topo.manifest` — the per-(label, topology) golden
+  rows and the generated ``docs/TOPOLOGY.md`` render;
+- :mod:`apnea_uq_tpu.topo.cli` — the subcommand (shared reporters,
+  exit 0/1/2, suppression machinery).
+"""
+
+from apnea_uq_tpu.topo.rules import (  # noqa: F401
+    TOPO_RULES,
+    TopoContext,
+    run_topo_rules,
+)

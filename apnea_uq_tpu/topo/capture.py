@@ -1,0 +1,274 @@
+"""Lower the mesh program families under a sweep of simulated topologies.
+
+Rides the PR-7 audit seam: a :class:`~apnea_uq_tpu.audit.capture
+.CaptureStore` is pushed around the real no-dispatch entry points
+(``record_memory_only=True`` predictors, ``compile_only=True`` trainers),
+once per :class:`~apnea_uq_tpu.parallel.topology.TopologySpec` of the
+sweep, each over a mesh built BY that spec — so the captured jaxprs,
+collectives, payload bytes, and compiled per-device memory facts are the
+programs the topology-driven mesh construction would actually dispatch.
+Host boundaries are simulated by the spec over the real (virtual-CPU)
+devices: the cross-host classification is pure layout math
+(:func:`~apnea_uq_tpu.parallel.topology.axis_spans_hosts`), which is all
+the static analysis needs.
+
+The distilled :class:`TopoProgramFacts` are plain data, so the rules
+(:mod:`apnea_uq_tpu.topo.rules`) stay jax-free and tests inject
+violations as synthetic facts — including topologies (2x8, 4x8) larger
+than any CPU rig can lower today.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
+
+if TYPE_CHECKING:  # import-time jax freedom: the parallel package pulls
+    # jax at import, and the topo source rules (and the CLI parser
+    # registration) must stay runnable where jax is unusable — the
+    # topology helpers are imported inside the functions that need them.
+    from apnea_uq_tpu.parallel.topology import TopologySpec
+
+# Canonical sweep shapes: the audit's own smoke shapes — the checked
+# invariants (collectives, payload scaling, per-device footprint vs a
+# fixed budget at these shapes) are structural, so tiny shapes keep the
+# three-topology sweep a CPU-seconds affair.
+TOPO_WINDOWS = 64
+TOPO_WINDOW_SHAPE = (60, 4)
+TOPO_BATCH = 32
+TOPO_PASSES = 4
+TOPO_MEMBERS = 4
+TOPO_TRAIN_BATCH = 16
+
+# The mesh program families the sweep lowers per topology: one fused
+# predict family per UQ method plus both trainer epochs — the programs
+# that actually ride the (ensemble, data) mesh.  tests/test_topo.py
+# pins that every label here exists in the compile-cache zoo, and the
+# manifest-coverage test pins a committed row per (label, topology).
+MESH_FAMILY_LABELS: Tuple[str, ...] = (
+    "mcd_predict_fused",
+    "de_predict_fused",
+    "train_epoch",
+    "val_loss",
+    "ensemble_epoch",
+)
+
+# Collectives whose moved bytes GROW with the axis size (each
+# participant receives every other shard): over a host-spanning axis
+# their wire cost scales with the process count — the "payload scales
+# with process count" hazard class.  Reduce-style collectives move
+# O(payload) regardless of axis size (ring all-reduce).
+GATHER_STYLE_PRIMS = frozenset({
+    "all_gather", "all_to_all", "ppermute", "collective_permute",
+})
+
+
+@dataclasses.dataclass
+class TopoProgramFacts:
+    """One (program, topology) cell of the sweep — jax-free to read."""
+
+    label: str
+    topology: str                    # spec name, e.g. "2x4"
+    mesh_ensemble: int
+    mesh_data: int
+    collectives: Dict[str, int]      # "psum[data]" -> count
+    collective_payloads: Dict[str, int]   # same keys -> operand bytes
+    cross_host: List[str]            # keys whose axes span hosts
+    cross_host_bytes: int            # modeled DCN traffic, see below
+    replication_blowup: int          # max axis-size factor charged
+    per_device_bytes: Optional[int]  # compiled memory-analysis peak
+    hbm_budget_bytes: int
+    cross_host_budget_bytes: int
+
+
+def _collective_axes(key: str) -> Tuple[str, ...]:
+    if "[" not in key:
+        return ()
+    inner = key[key.index("[") + 1:].rstrip("]")
+    return tuple(a for a in inner.split(",") if a)
+
+
+def _prim_of(key: str) -> str:
+    return key.split("[", 1)[0]
+
+
+def distill_facts(program, spec: "TopologySpec", e: int, d: int,
+                  ) -> TopoProgramFacts:
+    """Project one captured :class:`ProgramAudit` onto one topology.
+
+    The cross-host traffic model is first-order and documented:
+    reduce-style collectives over a host-spanning axis charge their
+    payload once (ring all-reduce moves O(payload) per participant);
+    gather-style collectives charge payload x axis size (every
+    participant receives every shard — the replication blowup).
+    """
+    from apnea_uq_tpu.parallel.topology import axis_sizes, axis_spans_hosts
+
+    sizes = axis_sizes(e, d)
+    spans = {axis: axis_spans_hosts(spec, e, d, axis) for axis in sizes}
+    payloads = dict(getattr(program, "collective_payloads", {}) or {})
+    cross: List[str] = []
+    cross_bytes = 0
+    blowup = 1
+    for key in sorted(program.collectives):
+        axes = _collective_axes(key)
+        if not any(spans.get(a, True) for a in axes):
+            continue
+        cross.append(key)
+        payload = int(payloads.get(key, 0))
+        if _prim_of(key) in GATHER_STYLE_PRIMS:
+            factor = 1
+            for a in axes:
+                factor *= sizes.get(a, 1)
+            blowup = max(blowup, factor)
+            cross_bytes += payload * factor
+        else:
+            cross_bytes += payload
+    memory = program.memory_fields or {}
+    peak = memory.get("peak_bytes")
+    return TopoProgramFacts(
+        label=program.label, topology=spec.name,
+        mesh_ensemble=e, mesh_data=d,
+        collectives=dict(program.collectives),
+        collective_payloads=payloads,
+        cross_host=cross, cross_host_bytes=cross_bytes,
+        replication_blowup=blowup,
+        per_device_bytes=int(peak) if peak is not None else None,
+        hbm_budget_bytes=spec.hbm_bytes_per_device,
+        cross_host_budget_bytes=spec.cross_host_budget_bytes,
+    )
+
+
+def capture_topology(config, spec: "TopologySpec",
+                     ) -> Tuple[Dict[str, TopoProgramFacts],
+                                Dict[str, str]]:
+    """Lower the mesh program families over ``spec``'s mesh on the
+    current backend.  Returns ``(facts_by_label, failures)``."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from apnea_uq_tpu.audit.capture import CaptureStore
+    from apnea_uq_tpu.compilecache.store import use_store
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.parallel import fit_ensemble
+    from apnea_uq_tpu.parallel.mesh import make_mesh
+    from apnea_uq_tpu.training import create_train_state, fit
+    from apnea_uq_tpu.uq.predict import (
+        ensemble_predict,
+        mc_dropout_predict,
+        stack_member_variables,
+    )
+    from apnea_uq_tpu.utils import prng
+
+    store = CaptureStore()
+    model = AlarconCNN1D(config.model)
+    variables = init_variables(model, jax.random.key(0))
+    uq = config.uq
+    x_aval = jax.ShapeDtypeStruct((TOPO_WINDOWS,) + TOPO_WINDOW_SHAPE,
+                                  jnp.float32)
+    rng = np.random.default_rng(0)
+    x_train = rng.normal(
+        size=(TOPO_WINDOWS,) + TOPO_WINDOW_SHAPE).astype(np.float32)
+    y_train = (np.arange(TOPO_WINDOWS) % 2).astype(np.int8)
+
+    layouts: Dict[str, Tuple[int, int]] = {}
+
+    def topo_mesh(num_members: int):
+        mesh = make_mesh(num_members=num_members, topology=spec)
+        return mesh, tuple(mesh.devices.shape)
+
+    with use_store(store):
+        store.group = "eval-mcd"
+        mesh, (e, d) = topo_mesh(TOPO_PASSES)
+        layouts["mcd_predict_fused"] = (e, d)
+        mc_dropout_predict(
+            model, variables, x_aval, n_passes=TOPO_PASSES,
+            mode=uq.mcd_mode, batch_size=TOPO_BATCH,
+            key=prng.stochastic_key(config.train.seed), mesh=mesh,
+            record_memory_only=True,
+            stats=("nats", float(uq.entropy_eps)), engine="xla",
+        )
+
+        store.group = "eval-de"
+        members = stack_member_variables([variables] * TOPO_MEMBERS)
+        mesh, (e, d) = topo_mesh(TOPO_MEMBERS)
+        layouts["de_predict_fused"] = (e, d)
+        ensemble_predict(
+            model, members, x_aval, batch_size=TOPO_BATCH, mesh=mesh,
+            record_memory_only=True, stats=("nats", float(uq.entropy_eps)),
+        )
+
+        store.group = "train"
+        mesh, (e, d) = topo_mesh(1)
+        layouts["train_epoch"] = layouts["val_loss"] = (e, d)
+        tcfg = dataclasses.replace(config.train,
+                                   batch_size=TOPO_TRAIN_BATCH,
+                                   streaming=False)
+        state = create_train_state(
+            model, jax.random.key(tcfg.seed),
+            learning_rate=tcfg.learning_rate)
+        fit(model, state, x_train, y_train, tcfg, mesh=mesh,
+            compile_only=True)
+
+        store.group = "train-ensemble"
+        ecfg = dataclasses.replace(
+            config.ensemble, num_members=TOPO_MEMBERS,
+            batch_size=TOPO_TRAIN_BATCH, streaming=False)
+        mesh, (e, d) = topo_mesh(TOPO_MEMBERS)
+        layouts["ensemble_epoch"] = (e, d)
+        fit_ensemble(model, x_train, y_train, ecfg, mesh=mesh,
+                     compile_only=True)
+
+    failures = dict(store.failures)
+    facts: Dict[str, TopoProgramFacts] = {}
+    for label in MESH_FAMILY_LABELS:
+        program = store.captures.get(label)
+        if program is None:
+            if label not in failures:
+                failures[label] = (
+                    "entry point never acquired this label through the "
+                    "program store — mesh-family/driver drift")
+            continue
+        layout = layouts.get(label)
+        if layout is None:
+            # A silent fallback here would attribute the wrong mesh
+            # layout to the program and miscount cross-host traffic —
+            # surface the wiring gap as a capture failure instead.
+            failures[label] = (
+                "label captured but no mesh layout recorded — wire a "
+                "layouts[...] assignment for it in capture_topology")
+            continue
+        facts[label] = distill_facts(program, spec, *layout)
+    return facts, failures
+
+
+def sweep_topologies(config, specs: Optional[Tuple["TopologySpec", ...]]
+                     = None):
+    """Run :func:`capture_topology` per simulated topology of the
+    current rig.  Returns ``(facts, skipped, failures)`` with ``facts``
+    keyed ``(topology name, label)`` and ``skipped`` a list of
+    ``(topology name, reason)`` for specs the rig cannot host."""
+    import jax
+
+    from apnea_uq_tpu.parallel.topology import simulated_topologies
+
+    n = len(jax.devices())  # apnea-lint: disable=single-host-device-enumeration -- the sweep is a single-process analysis tool sizing itself from the whole rig on purpose
+    if specs is None:
+        specs = simulated_topologies(n)
+    facts: Dict[Tuple[str, str], TopoProgramFacts] = {}
+    skipped: List[Tuple[str, str]] = []
+    failures: Dict[str, str] = {}
+    for spec in specs:
+        if spec.total_devices != n:
+            skipped.append(
+                (spec.name, f"needs {spec.total_devices} devices, rig "
+                            f"has {n}"))
+            continue
+        per_label, fail = capture_topology(config, spec)
+        for label, f in per_label.items():
+            facts[(spec.name, label)] = f
+        for label, err in fail.items():
+            failures[f"{spec.name}/{label}"] = err
+    return facts, skipped, failures

@@ -1,0 +1,272 @@
+"""The ``apnea-uq topo`` subcommand.
+
+``apnea-uq topo [paths ...] [--json | --format gha] [--rule NAME ...]
+[--update-manifest] [--update-docs [--docs PATH]] [--run-dir DIR]`` —
+the multi-host topology-readiness gate: AST source rules over the
+package (plus ``bench.py``) AND the simulated-topology program sweep
+(mesh program families lowered on CPU under every topology of the
+canonical rig, nothing dispatched).  Exits 0 when every finding is
+suppressed-with-justification, 1 on unsuppressed findings, 2 on usage
+errors — the lint/audit/flow contract, same reporters, same suppression
+machinery (source findings suppress at the call site, program findings
+at the zoo-registration site in ``compilecache/zoo.py``).
+
+Selecting only source rules (``--rule single-host-device-enumeration``)
+skips the jax-loading sweep entirely, so the source side stays runnable
+anywhere lint runs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict
+
+from apnea_uq_tpu.telemetry import log
+
+
+def topo_program_data(facts) -> Dict[str, Any]:
+    """The per-cell payload of ``topo --json`` AND the ``topo_program``
+    telemetry event — one projection, so the two machine-readable views
+    cannot drift (the audit CLI's pattern)."""
+    return {
+        "label": facts.label,
+        "topology": facts.topology,
+        "mesh_ensemble": facts.mesh_ensemble,
+        "mesh_data": facts.mesh_data,
+        "collectives": sum(facts.collectives.values()),
+        "cross_host_collectives": len(facts.cross_host),
+        "cross_host_bytes": facts.cross_host_bytes,
+        "replication_blowup": facts.replication_blowup,
+        "per_device_bytes": facts.per_device_bytes,
+        "hbm_budget_bytes": facts.hbm_budget_bytes,
+    }
+
+
+def _emit_events(run_log, facts) -> None:
+    for key in sorted(facts):
+        d = topo_program_data(facts[key])
+        run_log.event(
+            "topo_program",
+            label=d["label"], topology=d["topology"],
+            mesh_ensemble=d["mesh_ensemble"], mesh_data=d["mesh_data"],
+            collectives=d["collectives"],
+            cross_host_collectives=d["cross_host_collectives"],
+            cross_host_bytes=d["cross_host_bytes"],
+            replication_blowup=d["replication_blowup"],
+            per_device_bytes=d["per_device_bytes"],
+            hbm_budget_bytes=d["hbm_budget_bytes"],
+        )
+
+
+def cmd_topo(args, config) -> int:
+    from apnea_uq_tpu.audit.manifest import zoo_label_lines
+    from apnea_uq_tpu.lint.cli import default_paths
+    from apnea_uq_tpu.lint.engine import (
+        LintContext, LintResult, apply_suppressions, default_repo_root,
+        load_files,
+    )
+    from apnea_uq_tpu.lint.report import emit_result, resolve_format
+    from apnea_uq_tpu.telemetry.logging_shim import narration_to_stderr
+    from apnea_uq_tpu.topo.manifest import (
+        load_manifest, merge_rows, render_topology_doc, write_manifest,
+    )
+    from apnea_uq_tpu.topo.rules import (
+        RULE_SUBJECTS, TOPO_RULES, TopoContext, run_topo_rules,
+    )
+
+    fmt = resolve_format(args)
+
+    def narrate(message: str) -> None:
+        # In --json mode stdout is one machine-readable document;
+        # progress/skip/manifest lines go to stderr (the audit CLI's
+        # contract) so `topo --json | jq .` parses without stripping.
+        if fmt == "json":
+            with narration_to_stderr():
+                log(message)
+        else:
+            log(message)
+
+    selected = tuple(dict.fromkeys(args.rule)) if args.rule else None
+    unknown = [r for r in (selected or ()) if r not in TOPO_RULES]
+    if unknown:
+        log(f"apnea-uq topo: unknown topo rule(s) {unknown}; "
+            f"available: {sorted(TOPO_RULES)}")
+        raise SystemExit(2)
+    need_programs = (selected is None
+                     or any(RULE_SUBJECTS[r] == "program"
+                            for r in selected))
+
+    paths = args.paths or default_paths()
+    try:
+        repo_root = default_repo_root(paths)
+        files = load_files(paths, repo_root)
+    except (FileNotFoundError, ValueError, SyntaxError) as e:
+        log(f"apnea-uq topo: {e}")
+        raise SystemExit(2)
+    lint_ctx = LintContext(files=files, repo_root=repo_root)
+    by_path = {f.path: f for f in files}
+
+    facts: Dict = {}
+    manifest = None
+    zoo_sf = None
+    if need_programs:
+        # The sweep is lowering-only and needs the canonical rig: pin
+        # CPU + 8 virtual devices before the first jax import (an
+        # already-imported jax, e.g. under the test rig, is left alone).
+        if "jax" not in sys.modules:
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+
+        from apnea_uq_tpu.topo.capture import sweep_topologies
+
+        facts, skipped, failures = sweep_topologies(config)
+        for name, reason in skipped:
+            narrate(f"topo: topology {name} SKIPPED — {reason}")
+        if failures:
+            for key, error in sorted(failures.items()):
+                log(f"topo: capturing {key} FAILED — {error}")
+            raise SystemExit(2)
+        if not facts:
+            log("topo: no topology of the simulated sweep fits this "
+                "rig's device count — run on the canonical 8-device "
+                "CPU rig (JAX_PLATFORMS=cpu with "
+                "--xla_force_host_platform_device_count=8)")
+            raise SystemExit(2)
+
+        manifest = load_manifest(args.manifest)
+        if args.update_manifest:
+            manifest = merge_rows(facts, prior=manifest)
+        elif manifest is None:
+            log(f"topo: no manifest at {args.manifest!r} — run "
+                f"`apnea-uq topo --update-manifest` once to record the "
+                f"golden per-topology rows")
+            raise SystemExit(2)
+
+        zoo_abs, label_lines = zoo_label_lines()
+        zoo_root = default_repo_root([zoo_abs])
+        zoo_sf = load_files([zoo_abs], zoo_root)[0]
+    else:
+        zoo_abs, label_lines = "", {}
+
+    context = TopoContext(
+        lint=lint_ctx, programs=facts, manifest=manifest,
+        zoo_path=(zoo_sf.path if zoo_sf is not None else ""),
+        label_lines=label_lines,
+    )
+    findings = run_topo_rules(context, rules=selected)
+    resolved = []
+    for f in findings:
+        sf = by_path.get(f.path)
+        if sf is None and zoo_sf is not None and f.path == zoo_sf.path:
+            sf = zoo_sf
+        resolved.append(apply_suppressions(f, sf) if sf is not None
+                        else f)
+    result = LintResult(
+        findings=resolved,
+        files_scanned=len(files),
+        rules_run=selected or tuple(sorted(TOPO_RULES)),
+        scanned_paths=tuple(f.path for f in files),
+    )
+
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        if getattr(args, "run_dir", None) and facts:
+            from apnea_uq_tpu.telemetry import start_run
+
+            run_log = stack.enter_context(
+                start_run(args.run_dir, stage="topo", config=config,
+                          argv=sys.argv[1:]))
+            narrate(f"telemetry -> {args.run_dir}")
+            _emit_events(run_log, facts)
+
+        if need_programs and args.update_manifest:
+            if result.unsuppressed:
+                narrate("topo: manifest NOT updated — unsuppressed "
+                        "finding(s) remain; fix (or suppress) them, "
+                        "then re-run --update-manifest")
+            else:
+                # `manifest` already holds the merged rows the rules
+                # just validated — persist exactly those (the audit
+                # CLI's write-after-pass discipline).
+                write_manifest(args.manifest, manifest)
+                narrate(f"manifest -> {args.manifest} "
+                        f"({len(facts)} cell(s) updated)")
+
+        if args.update_docs:
+            rows = load_manifest(args.manifest)
+            if rows is None:
+                narrate("topo: docs NOT updated — no manifest to render "
+                        "(run --update-manifest first)")
+            else:
+                from apnea_uq_tpu.utils.io import atomic_write_text
+
+                docs_path = args.docs or os.path.join(
+                    default_repo_root(paths), "docs", "TOPOLOGY.md")
+                os.makedirs(os.path.dirname(os.path.abspath(docs_path)),
+                            exist_ok=True)
+                atomic_write_text(docs_path, render_topology_doc(rows))
+                narrate(f"topology doc -> {docs_path}")
+
+        emit_result(result, fmt, json_extra={
+            "programs": {
+                f"{label}@{topology}": topo_program_data(
+                    facts[(topology, label)])
+                for topology, label in sorted(facts)
+            },
+        })
+    return 1 if result.unsuppressed else 0
+
+
+def register(sub, add_config_arg, load_config_fn) -> None:
+    """Attach the ``topo`` subcommand to the CLI's subparser registry
+    (same lazy-config wiring as audit)."""
+    from apnea_uq_tpu.lint.report import add_format_args
+    from apnea_uq_tpu.topo.manifest import DEFAULT_MANIFEST_PATH
+
+    p = sub.add_parser(
+        "topo",
+        help="Multi-host topology-readiness gate: AST rules for "
+             "process-local enumeration / primary-only I/O / lockstep "
+             "collective discipline, plus the mesh program families "
+             "lowered under a sweep of simulated topologies on CPU "
+             "(collective sets, cross-host payload, per-device HBM vs "
+             "budget) against the checked-in topo/manifest.json.")
+    add_config_arg(p)
+    p.add_argument("paths", nargs="*", default=None,
+                   help="Files/directories for the source rules; "
+                        "default: the apnea_uq_tpu package plus "
+                        "bench.py beside it.")
+    add_format_args(p)
+    p.add_argument("--rule", action="append", default=[], metavar="NAME",
+                   help="Run only this topo rule (repeatable); default: "
+                        "all — see docs/LINT.md \"Topology rules\".  "
+                        "Selecting only source rules skips the "
+                        "jax-loading topology sweep.")
+    p.add_argument("--manifest", default=DEFAULT_MANIFEST_PATH,
+                   help="Manifest path (default: the in-package golden "
+                        "apnea_uq_tpu/topo/manifest.json).")
+    p.add_argument("--update-manifest", action="store_true",
+                   help="Regenerate the per-(program, topology) rows "
+                        "from the live sweep (stale rows pruned); "
+                        "written only when every rule passes.  "
+                        "Gather-style cross-host collectives still "
+                        "fail: no manifest can bless them.")
+    p.add_argument("--update-docs", action="store_true",
+                   help="Regenerate the generated docs/TOPOLOGY.md "
+                        "from the manifest rows.")
+    p.add_argument("--docs", default=None,
+                   help="With --update-docs: destination path (default "
+                        "<repo>/docs/TOPOLOGY.md).")
+    p.add_argument("--run-dir", default=None,
+                   help="Telemetry run directory: persists one "
+                        "topo_program event per (program, topology) "
+                        "cell (cross-host bytes, per-device memory), "
+                        "gateable by `telemetry compare` as "
+                        "topo.<label>.<topology>.cross_host_bytes.")
+    p.set_defaults(fn=lambda args: cmd_topo(args, load_config_fn(args)))

@@ -1,0 +1,506 @@
+"""The topology rule family: multi-host readiness, checked statically.
+
+Fourth rule family on the lint engine — same :class:`Finding` type,
+severities, suppression mechanism and reporters — covering the hazard
+class that only surfaces under ``jax.distributed`` multi-host meshes,
+which is exactly the hardware this repo rarely holds.  Two subjects:
+
+**Source rules** (AST, anchored at the offending call site):
+
+- ``single-host-device-enumeration`` — ``jax.devices()`` (and its
+  ``[0]`` head-grab) in library code: under multiprocess the global
+  list contains non-addressable remote devices, so "the device" must be
+  ``jax.local_devices()[0]`` and per-process work must enumerate
+  locally.  The deliberate global-enumeration sites (mesh construction,
+  the run-log topology stamp) carry justified suppressions.
+- ``unguarded-primary-io`` — a file/registry write inside a
+  mesh-parallel function with no ``process_index() == 0`` /
+  ``is_primary()`` guard: under multiprocess every process races the
+  same path (the run-log already guards; this generalizes that
+  discipline to checkpoints, registry artifacts, and plots).
+- ``lockstep-collective-discipline`` — ``host_values`` /
+  ``process_allgather`` inside a branch whose condition can diverge per
+  process (process index, filesystem/env state, exception handlers):
+  the processes that skip the branch never join the collective and the
+  ones that enter it hang forever.
+
+**Program rules** (per lowered (program, topology) cell from the
+simulated-topology sweep, anchored at the zoo-registration site like the
+audit rules):
+
+- ``topo-collective-manifest`` — the (collective set, mesh layout) of
+  each mesh-family program under each swept topology must match the
+  checked-in ``topo/manifest.json`` row.
+- ``topo-cross-host-payload`` — gather-style collectives over a
+  host-spanning axis are unconditional violations (their wire cost
+  scales with the process count); reduce-style cross-host traffic is
+  charged against the spec's DCN budget.
+- ``topo-hbm-budget`` — the compiled per-device memory estimate must
+  fit the topology spec's per-device HBM budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from apnea_uq_tpu.lint import astwalk
+from apnea_uq_tpu.lint.engine import (
+    SEVERITIES,
+    Finding,
+    LintContext,
+    Rule,
+)
+from apnea_uq_tpu.topo.capture import GATHER_STYLE_PRIMS, _prim_of
+
+TOPO_RULES: Dict[str, Rule] = {}
+# Which subject each rule checks: "source" rules see the parsed files,
+# "program" rules the per-(label, topology) sweep facts.  The CLI uses
+# this to skip the (jax-loading) sweep when only source rules run.
+RULE_SUBJECTS: Dict[str, str] = {}
+
+
+def register_topo_rule(name: str, severity: str, summary: str, *,
+                       subject: str):
+    """Decorator twin of :func:`apnea_uq_tpu.lint.engine.register_rule`
+    for the topology family; ``subject`` is ``source`` or ``program``."""
+    if severity not in SEVERITIES:
+        raise ValueError(
+            f"severity must be one of {SEVERITIES}, got {severity!r}")
+    if subject not in ("source", "program"):
+        raise ValueError(f"subject must be source|program, got {subject!r}")
+
+    def wrap(fn: Callable[["TopoContext"], Iterable[Finding]]):
+        TOPO_RULES[name] = Rule(name=name, severity=severity,
+                                summary=summary, check=fn)
+        RULE_SUBJECTS[name] = subject
+        return fn
+
+    return wrap
+
+
+@dataclasses.dataclass
+class TopoContext:
+    """Everything a topo rule sees: the parsed in-scope files (source
+    rules) and the simulated-topology sweep facts plus the
+    zoo-registration anchor (program rules).  ``programs`` maps
+    ``(topology name, label)`` to
+    :class:`~apnea_uq_tpu.topo.capture.TopoProgramFacts`; ``manifest``
+    maps label -> topology -> golden row (None = no manifest yet)."""
+
+    lint: Optional[LintContext] = None
+    programs: Dict[Tuple[str, str], Any] = dataclasses.field(
+        default_factory=dict)
+    manifest: Optional[Dict[str, Dict[str, Any]]] = None
+    zoo_path: str = ""
+    label_lines: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def finding(self, rule: str, label: str, message: str) -> Finding:
+        return Finding(
+            rule=rule, severity=TOPO_RULES[rule].severity,
+            path=self.zoo_path, line=self.label_lines.get(label, 1),
+            message=f"{label}: {message}",
+        )
+
+
+# ------------------------------------------------------- source rules --
+
+# The one blessed replacement: process-local enumeration.
+_LOCAL_SPELLING = "jax.local_devices()"
+
+
+@register_topo_rule(
+    "single-host-device-enumeration", "error",
+    "jax.devices() enumerates the GLOBAL device list: under a "
+    "multi-process mesh it contains non-addressable remote devices, so "
+    "per-process work (memory stats, platform probes, local placement) "
+    "must use jax.local_devices() instead",
+    subject="source",
+)
+def check_device_enumeration(context: "TopoContext"
+                             ) -> Iterable[Finding]:
+    for sf in context.lint.files:
+        aliases = astwalk.import_aliases(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astwalk.canonical_call(node, aliases)
+            if name != "jax.devices" or node.args or node.keywords:
+                continue
+            yield Finding(
+                rule="single-host-device-enumeration",
+                severity=TOPO_RULES[
+                    "single-host-device-enumeration"].severity,
+                path=sf.path, line=node.lineno,
+                message=(
+                    "jax.devices() is host-global: under multiprocess "
+                    "its entries include other hosts' devices (a [0] "
+                    "head-grab can land on a non-addressable remote "
+                    f"device) — use {_LOCAL_SPELLING} for process-local "
+                    "work, or suppress with the reason this site "
+                    "genuinely wants the global list"),
+            )
+
+
+# Calls whose terminal name is a write effect when reached under a
+# multi-process mesh: the shared atomic writers, raw writes, and the
+# save_* persistence surface (checkpoints, registry artifacts, plots).
+_WRITE_CALL_NAMES = frozenset({
+    "atomic_write_json", "atomic_write_text", "atomic_write_bytes",
+})
+_WRITE_CALL_PREFIXES = ("save", "adopt_array_store")
+_NP_SAVE = frozenset({"save", "savez", "savez_compressed", "savetxt"})
+_WRITE_MODES = ("w", "a", "x")
+
+# Markers that a function participates in mesh-parallel execution: a
+# mesh is built/bound/passed, shard_map is used, or the distributed
+# runtime / lockstep helpers appear.
+_MESH_MARKERS = frozenset({
+    "make_mesh", "make_mesh_from_config", "shard_map", "host_values",
+    "process_allgather", "build_mesh",
+})
+
+# Guard spellings: a conditional mentioning one of these is the
+# primary-process discipline the rule wants to see.
+_GUARD_MARKERS = ("process_index", "is_primary", "primary")
+
+
+def _terminal_name(call: ast.Call) -> Optional[str]:
+    name = astwalk.call_name(call)
+    return name.split(".")[-1] if name else None
+
+
+def _is_write_call(call: ast.Call) -> bool:
+    name = _terminal_name(call)
+    if name is None:
+        return False
+    if name in _WRITE_CALL_NAMES:
+        return True
+    if name == "to_csv":
+        return True
+    if any(name == p or name.startswith(p + "_")
+           for p in _WRITE_CALL_PREFIXES):
+        # save_config on a fresh path is still multiprocess-racy; the
+        # whole save_* persistence surface counts.
+        return True
+    full = astwalk.call_name(call) or ""
+    if full.split(".")[0] in ("np", "numpy") and name in _NP_SAVE:
+        return True
+    if name == "replace" and (full.startswith("os.")
+                              or full == "replace"):
+        return full.startswith("os.")
+    if isinstance(call.func, ast.Name) and call.func.id == "open":
+        mode = None
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+            mode = call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        return isinstance(mode, str) and any(
+            m in mode for m in _WRITE_MODES)
+    return False
+
+
+def _mesh_parallel(fn: ast.AST) -> bool:
+    """Does this function visibly participate in mesh execution?  A
+    ``mesh`` parameter/local/keyword, a mesh constructor, shard_map, or
+    the distributed helpers."""
+    args = getattr(fn, "args", None)
+    if args is not None:
+        names = [a.arg for a in (args.args + args.kwonlyargs
+                                 + args.posonlyargs)]
+        if "mesh" in names:
+            return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == "mesh":
+            return True
+        if isinstance(node, ast.keyword) and node.arg == "mesh":
+            return True
+        if isinstance(node, ast.Call):
+            name = _terminal_name(node)
+            if name in _MESH_MARKERS:
+                return True
+        if isinstance(node, ast.Attribute) and node.attr == "distributed":
+            return True
+    return False
+
+
+def _guarded(fn: ast.AST, call: ast.Call) -> bool:
+    """Is ``call`` under a primary-process guard?  Either an enclosing
+    ``if`` whose test mentions a guard marker, or an early-return guard
+    (an ``if`` mentioning a marker whose body returns/raises) anywhere
+    above the call in the function body."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        test_src = ast.dump(node.test)
+        if not any(m in test_src for m in _GUARD_MARKERS):
+            continue
+        if any(sub is call for sub in ast.walk(node)):
+            return True
+        returns = any(isinstance(s, (ast.Return, ast.Raise))
+                      for s in node.body)
+        if returns and node.lineno < call.lineno:
+            return True
+    return False
+
+
+@register_topo_rule(
+    "unguarded-primary-io", "error",
+    "a file/registry write inside a mesh-parallel function with no "
+    "process_index()==0 / is_primary() guard: under a multi-process "
+    "mesh every process races the same path (the run-log and compile "
+    "cache already guard; checkpoints, artifacts and plots must too)",
+    subject="source",
+)
+def check_unguarded_primary_io(context: "TopoContext"
+                               ) -> Iterable[Finding]:
+    for sf in context.lint.files:
+        # A write inside a nested function is visited from both the
+        # enclosing and the nested def; one finding per site.
+        reported: set = set()
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if not _mesh_parallel(fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not _is_write_call(node):
+                    continue
+                mark = (sf.path, node.lineno)
+                if mark in reported:
+                    continue
+                if _guarded(fn, node):
+                    continue
+                reported.add(mark)
+                name = _terminal_name(node)
+                yield Finding(
+                    rule="unguarded-primary-io",
+                    severity=TOPO_RULES["unguarded-primary-io"].severity,
+                    path=sf.path, line=node.lineno,
+                    message=(
+                        f"{name}(...) in mesh-parallel `{fn.name}` has "
+                        f"no primary-process guard — under "
+                        f"jax.distributed every process executes this "
+                        f"write against the same path; wrap it in `if "
+                        f"is_primary():` (utils/multihost.py) or "
+                        f"justify why every process must write"),
+                )
+
+
+# Branch-test spellings that can differ per process: the process's own
+# identity, per-host filesystem/env state, anything wall-clock or
+# random, and exception handlers (an error on one host is not an error
+# on all).
+_DIVERGENT_TEST_MARKERS = (
+    "process_index", "process_count", "is_primary", "local_devices",
+    "exists", "isfile", "isdir", "environ", "getenv", "getpid",
+    "random", "perf_counter", "time.time", "monotonic",
+)
+_LOCKSTEP_CALLS = frozenset({
+    "host_values", "_host_values", "_host_predictions",
+    "process_allgather",
+})
+
+
+def _divergent_reason(test: ast.AST) -> Optional[str]:
+    src = ast.dump(test)
+    for marker in _DIVERGENT_TEST_MARKERS:
+        head = marker.split(".")[-1]
+        if f"'{head}'" in src or f"id='{head}'" in src:
+            return head
+    return None
+
+
+@register_topo_rule(
+    "lockstep-collective-discipline", "error",
+    "host_values()/process_allgather() are lockstep collectives under "
+    "a multi-process mesh: calling them inside a branch whose condition "
+    "can diverge per process (process index, filesystem/env state, an "
+    "exception handler) deadlocks the processes that skipped the branch",
+    subject="source",
+)
+def check_lockstep_discipline(context: "TopoContext"
+                              ) -> Iterable[Finding]:
+    severity = TOPO_RULES["lockstep-collective-discipline"].severity
+    for sf in context.lint.files:
+        if sf.path.replace("\\", "/").endswith("utils/multihost.py"):
+            # The helper's own fully-addressable fast path branches on
+            # a property of the GLOBAL array (identical on every
+            # process) — the one sanctioned branch.
+            continue
+        for fn_node, body in astwalk.scopes(sf.tree):
+            if fn_node is None:
+                continue
+            yield from _scan_lockstep(sf, fn_node, severity)
+
+
+def _scan_lockstep(sf, fn: ast.AST, severity: str) -> Iterable[Finding]:
+    def emit(call: ast.Call, why: str) -> Finding:
+        name = _terminal_name(call)
+        return Finding(
+            rule="lockstep-collective-discipline", severity=severity,
+            path=sf.path, line=call.lineno,
+            message=(
+                f"{name}(...) is a lockstep collective, but this call "
+                f"sits in a branch that can diverge per process "
+                f"({why}) — a process that skips it never joins the "
+                f"allgather and the others hang; hoist the collective "
+                f"out of the branch or make the condition provably "
+                f"process-invariant"),
+        )
+
+    def walk(node: ast.AST, divergent: Optional[str]) -> Iterable[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            return
+        if isinstance(node, ast.If):
+            why = _divergent_reason(node.test) or divergent
+            for child in node.body + node.orelse:
+                yield from walk(child, why)
+            return
+        if isinstance(node, ast.Try):
+            for child in node.body + node.orelse + node.finalbody:
+                yield from walk(child, divergent)
+            for handler in node.handlers:
+                for child in handler.body:
+                    yield from walk(child, divergent
+                                    or "exception handler")
+            return
+        if isinstance(node, ast.Call) and divergent:
+            name = _terminal_name(node)
+            if name in _LOCKSTEP_CALLS:
+                yield emit(node, f"condition reads `{divergent}`"
+                           if divergent != "exception handler"
+                           else "an exception handler runs only where "
+                                "the error happened")
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child, divergent)
+
+    for stmt in fn.body:
+        yield from walk(stmt, None)
+
+
+# ------------------------------------------------------ program rules --
+
+@register_topo_rule(
+    "topo-collective-manifest", "error",
+    "each mesh-family program's (collective set, mesh layout) under "
+    "each swept topology must match the checked-in topo/manifest.json "
+    "row — a refactor that grows the collective set or reshapes the "
+    "layout fails CI against a reviewable file",
+    subject="program",
+)
+def check_topo_manifest(context: "TopoContext") -> Iterable[Finding]:
+    if context.manifest is None:
+        return
+    for (topology, label), f in sorted(context.programs.items()):
+        row = (context.manifest.get(label) or {}).get(topology)
+        if row is None:
+            yield context.finding(
+                "topo-collective-manifest", label,
+                f"no manifest row for topology {topology} — run "
+                f"`apnea-uq topo --update-manifest` to record its "
+                f"per-topology budget",
+            )
+            continue
+        captured = {
+            "mesh": {"ensemble": f.mesh_ensemble, "data": f.mesh_data},
+            "collectives": dict(f.collectives),
+            "cross_host": list(f.cross_host),
+        }
+        if captured != {k: row.get(k) for k in captured}:
+            yield context.finding(
+                "topo-collective-manifest", label,
+                f"topology {topology} drift: program lowers with "
+                f"{captured} but the manifest records "
+                f"{ {k: row.get(k) for k in captured} } — an intended "
+                f"change needs `--update-manifest`",
+            )
+
+
+@register_topo_rule(
+    "topo-cross-host-payload", "error",
+    "gather-style collectives over a host-spanning axis scale their "
+    "wire cost with the process count (unconditional violation); "
+    "reduce-style cross-host traffic must fit the topology spec's DCN "
+    "budget",
+    subject="program",
+)
+def check_cross_host_payload(context: "TopoContext") -> Iterable[Finding]:
+    for (topology, label), f in sorted(context.programs.items()):
+        scaling = [k for k in f.cross_host
+                   if _prim_of(k) in GATHER_STYLE_PRIMS]
+        if scaling:
+            yield context.finding(
+                "topo-cross-host-payload", label,
+                f"topology {topology}: gather-style cross-host "
+                f"collective(s) {scaling} replicate "
+                f"{f.replication_blowup}x across hosts — their payload "
+                f"scales with the process count, so no budget can bless "
+                f"them; reduce on-device or keep the gather within a "
+                f"host",
+            )
+        if f.cross_host_bytes > f.cross_host_budget_bytes:
+            yield context.finding(
+                "topo-cross-host-payload", label,
+                f"topology {topology}: {f.cross_host_bytes} cross-host "
+                f"collective bytes exceed the spec's DCN budget "
+                f"{f.cross_host_budget_bytes} (keys {f.cross_host}) — "
+                f"the data axis must stay within hosts so its psum "
+                f"rides ICI",
+            )
+
+
+@register_topo_rule(
+    "topo-hbm-budget", "error",
+    "the compiled per-device memory estimate of each mesh-family "
+    "program must fit the topology spec's per-device HBM budget — a "
+    "replicated buffer that should shard shows up here before any "
+    "multi-host window",
+    subject="program",
+)
+def check_hbm_budget(context: "TopoContext") -> Iterable[Finding]:
+    for (topology, label), f in sorted(context.programs.items()):
+        if f.per_device_bytes is None:
+            continue
+        if f.per_device_bytes > f.hbm_budget_bytes:
+            yield context.finding(
+                "topo-hbm-budget", label,
+                f"topology {topology}: per-device memory estimate "
+                f"{f.per_device_bytes} bytes exceeds the spec's HBM "
+                f"budget {f.hbm_budget_bytes} (mesh "
+                f"{f.mesh_ensemble}x{f.mesh_data}) — shard or stream "
+                f"the overflowing buffers before a device OOM proves "
+                f"it on hardware",
+            )
+
+
+def run_topo_rules(
+    context: TopoContext,
+    *,
+    rules: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run the (selected) topo rules over ``context``; findings come
+    back sorted — suppressions are the caller's job (source findings
+    resolve against their own file, program findings against zoo.py)."""
+    if rules is None:
+        selected = tuple(sorted(TOPO_RULES))
+    else:
+        selected = tuple(dict.fromkeys(rules))
+    unknown = [r for r in selected if r not in TOPO_RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown topo rule(s) {unknown}; "
+            f"available: {sorted(TOPO_RULES)}")
+    findings: List[Finding] = []
+    for name in selected:
+        if RULE_SUBJECTS[name] == "source" and context.lint is None:
+            continue
+        findings.extend(TOPO_RULES[name].check(context))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings

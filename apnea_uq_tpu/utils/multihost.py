@@ -13,6 +13,19 @@ import jax
 import numpy as np
 
 
+def is_primary() -> bool:
+    """True on the process that owns shared-filesystem writes (process
+    0), and everywhere in single-process runs.  The guard every
+    mesh-parallel write site routes through (the run-log's process-0
+    discipline, generalized — enforced by ``apnea-uq topo``'s
+    ``unguarded-primary-io`` rule); never raises, so it is safe before
+    (or without) a usable backend."""
+    try:
+        return jax.process_index() == 0
+    except Exception:  # noqa: BLE001 - no backend => single process
+        return True
+
+
 def host_values(tree):
     """Device pytree -> host NumPy pytree, multi-process safe.
 

@@ -692,6 +692,7 @@ def _backend_facts(proxy: bool) -> dict:
     actually ran against (vs what was requested), so a proxy round can
     never masquerade as a device round."""
     try:
+        # apnea-lint: disable=single-host-device-enumeration -- bench is a single-process driver; the payload stamps the global backend it measured
         dev = jax.devices()[0]
         facts = {"platform": dev.platform, "device_kind": dev.device_kind}
     except Exception as e:  # noqa: BLE001 — facts are best-effort
@@ -1107,7 +1108,7 @@ def bench_mcd() -> dict:
     # temporaries at 32768 windows); the halving loop stays as the
     # correctness net.
     n_naive = n_windows
-    dev = jax.devices()[0]
+    dev = jax.devices()[0]  # apnea-lint: disable=single-host-device-enumeration -- bench is a single-process driver sizing against the one chip it dispatches to
     from apnea_uq_tpu.telemetry.memory import device_hbm_limit
 
     limit = device_hbm_limit(dev)

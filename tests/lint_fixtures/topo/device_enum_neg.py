@@ -1,0 +1,18 @@
+"""Negative fixture: idiomatic process-local enumeration stays clean."""
+import jax
+
+
+def local_head():
+    return jax.local_devices()[0]
+
+
+def backend_filter():
+    return jax.devices("cpu")  # explicit backend probe, not enumeration
+
+
+def method_named_devices(registry):
+    return registry.devices()  # unrelated method, not jax
+
+
+def suppressed_global():
+    return jax.devices()  # apnea-lint: disable=single-host-device-enumeration -- fixture: this site wants the global list

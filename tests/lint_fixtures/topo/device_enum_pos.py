@@ -1,0 +1,15 @@
+"""Positive fixture: single-host-device-enumeration (3 findings)."""
+import jax
+from jax import devices as enumerate_devices
+
+
+def head_grab():
+    return jax.devices()[0]  # finding: [0] can be a remote device
+
+
+def whole_list():
+    return list(jax.devices())  # finding: global enumeration
+
+
+def aliased():
+    return enumerate_devices()  # finding: from-import alias

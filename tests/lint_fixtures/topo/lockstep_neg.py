@@ -1,0 +1,21 @@
+"""Negative fixture: lockstep-legal host_values placements stay clean."""
+from apnea_uq_tpu.utils.multihost import host_values
+
+
+def top_level(tree):
+    return host_values(tree)  # every process executes this identically
+
+
+def config_branch(tree, config):
+    # Config flags are process-invariant: every process parsed the same
+    # ExperimentConfig, so all of them take the same arm.
+    if config.streaming:
+        return host_values(tree)
+    return None
+
+
+def loop_lockstep(chunks):
+    out = []
+    for chunk in chunks:  # same chunk count everywhere: lockstep
+        out.append(host_values(chunk))
+    return out

@@ -1,0 +1,32 @@
+"""Positive fixture: lockstep-collective-discipline (3 findings)."""
+import os
+
+import jax
+
+from apnea_uq_tpu.utils.multihost import host_values
+
+
+def filesystem_branch(tree, path):
+    if os.path.exists(path):            # per-host filesystem state
+        return host_values(tree)        # finding
+    return None
+
+
+def primary_branch(tree):
+    if jax.process_index() == 0:        # by definition divergent
+        return host_values(tree)        # finding
+    return None
+
+
+def error_path(tree):
+    from jax.experimental import multihost_utils
+
+    try:
+        risky(tree)
+    except ValueError:
+        # an error on one host is not an error on all
+        return multihost_utils.process_allgather(tree)  # finding
+
+
+def risky(tree):
+    return tree

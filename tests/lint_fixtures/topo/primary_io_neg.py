@@ -1,0 +1,32 @@
+"""Negative fixture: guarded / non-mesh writes stay clean."""
+import numpy as np
+
+from apnea_uq_tpu.parallel.mesh import make_mesh
+from apnea_uq_tpu.utils.multihost import is_primary
+
+
+def guarded_inline(model, x, registry):
+    mesh = make_mesh(num_members=4)
+    result = model.fit(x, mesh=mesh)
+    if is_primary():
+        registry.save_table("detailed", result.table)
+    return result
+
+
+def guarded_early_return(result, path, mesh):
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    with open(path, "w") as f:
+        f.write(str(result))
+
+
+def host_side_stage(rows, path):
+    # No mesh participation: a pre-mesh ingest writing its artifact.
+    np.save(path, rows)
+
+
+def mesh_reader(path, mesh):
+    with open(path) as f:  # read mode: not a write effect
+        return f.read()

@@ -1,0 +1,18 @@
+"""Positive fixture: unguarded-primary-io (3 findings)."""
+import numpy as np
+
+from apnea_uq_tpu.parallel.mesh import make_mesh
+from apnea_uq_tpu.utils.io import atomic_write_json
+
+
+def train_stage(model, x, registry):
+    mesh = make_mesh(num_members=4)
+    result = model.fit(x, mesh=mesh)
+    registry.save_table("detailed", result.table)   # finding
+    np.save("/tmp/members.npy", result.members)     # finding
+    return result
+
+
+def eval_stage(result, path, mesh):
+    with open(path, "w") as f:                      # finding
+        f.write(str(result))

@@ -13,7 +13,8 @@ REPO = Path(__file__).resolve().parent.parent
 DOCS = [REPO / "README.md", REPO / "docs" / "MIGRATION.md",
         REPO / "docs" / "OBSERVABILITY.md", REPO / "docs" / "LINT.md",
         REPO / "docs" / "PIPELINE.md",
-        REPO / "docs" / "BENCH_TRAJECTORY.md"]
+        REPO / "docs" / "BENCH_TRAJECTORY.md",
+        REPO / "docs" / "TOPOLOGY.md"]
 
 # README "Environment": packages claimed absent at runtime.  The claim
 # rotted once (r2 verdict: sklearn/scipy imports on the prepare and
@@ -258,8 +259,13 @@ def test_bench_trajectory_doc_matches_live_render():
     docs/PIPELINE.md discipline)."""
     from apnea_uq_tpu.telemetry import trend as trend_mod
 
-    paths = trend_mod.repo_rounds(str(REPO))
-    assert paths, "no archived BENCH_r*.json rounds found"
+    paths = trend_mod.archived_rounds(str(REPO))
+    assert paths, "no archived BENCH_r*/MULTICHIP_r* rounds found"
+    # The multichip dryrun twins must be part of the ledger (ISSUE 14
+    # satellite: the mesh history is visible, not skipped).
+    assert any("MULTICHIP" in p for p in paths), (
+        "archived_rounds no longer sweeps MULTICHIP_r*.json"
+    )
     rendered = trend_mod.render_trajectory_doc(
         trend_mod.build_trajectory(
             [trend_mod.load_round(p) for p in paths]))
@@ -270,6 +276,30 @@ def test_bench_trajectory_doc_matches_live_render():
     assert on_disk == rendered, (
         "docs/BENCH_TRAJECTORY.md is stale — regenerate with "
         "`apnea-uq telemetry trend --update-docs`"
+    )
+
+
+def test_topology_doc_matches_manifest_render():
+    """docs/TOPOLOGY.md is *generated* (`apnea-uq topo --update-docs`):
+    it must equal a fresh render from the committed
+    apnea_uq_tpu/topo/manifest.json, byte for byte, so the documented
+    per-topology mesh facts can never drift from the golden rows."""
+    from apnea_uq_tpu.topo.manifest import (
+        GENERATED_MARKER,
+        load_manifest,
+        render_topology_doc,
+    )
+
+    rows = load_manifest()
+    assert rows, "no committed topo manifest"
+    rendered = render_topology_doc(rows)
+    on_disk = (REPO / "docs" / "TOPOLOGY.md").read_text()
+    assert GENERATED_MARKER in on_disk, (
+        "docs/TOPOLOGY.md lost its generated-file marker"
+    )
+    assert on_disk == rendered, (
+        "docs/TOPOLOGY.md is stale — regenerate with "
+        "`apnea-uq topo --update-docs`"
     )
 
 

@@ -331,6 +331,17 @@ def test_package_gate_zero_unsuppressed_findings():
                 "apnea_uq_tpu/audit/programs.py",
                 "apnea_uq_tpu/audit/rules.py",
                 "apnea_uq_tpu/audit/cli.py",
+                # The topology gate (ISSUE 14): the spec-driven mesh
+                # seam and the fourth rule family — the topo CLI emits
+                # the documented topo_program telemetry kind, so it
+                # must stay inside the schema rule's scan scope.
+                "apnea_uq_tpu/parallel/topology.py",
+                "apnea_uq_tpu/parallel/mesh.py",
+                "apnea_uq_tpu/topo/capture.py",
+                "apnea_uq_tpu/topo/rules.py",
+                "apnea_uq_tpu/topo/manifest.py",
+                "apnea_uq_tpu/topo/cli.py",
+                "apnea_uq_tpu/utils/multihost.py",
                 # The out-of-core data plane (ISSUE 9): store shard I/O
                 # and the telemetry-emitting ingest/registry paths.
                 "apnea_uq_tpu/data/store.py",

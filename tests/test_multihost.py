@@ -21,6 +21,62 @@ import numpy as np
 import pytest
 
 
+class TestHostValues:
+    """utils/multihost.py::host_values — both sides of the
+    addressability fork, without needing a second process."""
+
+    def test_fully_addressable_fast_path_converts_in_place(self):
+        import jax.numpy as jnp
+
+        from apnea_uq_tpu.utils import multihost
+
+        tree = {"a": jnp.arange(4.0), "b": (jnp.ones((2, 3)), 5)}
+        out = multihost.host_values(tree)
+        assert isinstance(out["a"], np.ndarray)
+        np.testing.assert_array_equal(out["a"], np.arange(4.0))
+        np.testing.assert_array_equal(out["b"][0], np.ones((2, 3)))
+        # Plain host values ride along untouched (np.asarray of 5).
+        assert out["b"][1] == 5
+
+    def test_non_addressable_tree_routes_through_process_allgather(
+            self, monkeypatch):
+        """A single leaf that is not fully addressable must push the
+        WHOLE tree through ONE tiled process_allgather (lockstep
+        contract), converted to NumPy on the way out."""
+        from jax.experimental import multihost_utils
+
+        from apnea_uq_tpu.utils import multihost
+
+        class ShardedLeaf:
+            is_fully_addressable = False
+
+        calls = []
+
+        def fake_allgather(tree, tiled=False):
+            calls.append((tree, tiled))
+            return {"sharded": np.arange(3.0), "local": np.ones(2)}
+
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            fake_allgather)
+        tree = {"sharded": ShardedLeaf(), "local": np.ones(2)}
+        out = multihost.host_values(tree)
+        assert len(calls) == 1
+        assert calls[0][0] is tree and calls[0][1] is True
+        np.testing.assert_array_equal(out["sharded"], np.arange(3.0))
+        assert isinstance(out["sharded"], np.ndarray)
+
+    def test_leaves_without_the_attribute_count_as_addressable(self):
+        from apnea_uq_tpu.utils import multihost
+
+        out = multihost.host_values({"x": [1.0, 2.0]})
+        np.testing.assert_array_equal(out["x"], np.asarray([1.0, 2.0]))
+
+    def test_is_primary_single_process(self):
+        from apnea_uq_tpu.utils.multihost import is_primary
+
+        assert is_primary() is True
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -1,0 +1,680 @@
+"""Multi-host topology readiness (ISSUE 14): the TopologySpec-driven
+mesh refactor (bit-parity pinned on single-host layouts), the simulated
+topology sweep, the source + program topo rules against fixtures and
+injected violations, the `apnea-uq topo` CLI contract, the committed
+manifest's coverage, and the `apnea-uq check` meta-gate.
+
+The acceptance runs: every injected violation class — unguarded write,
+single-host enumeration, cross-host collective payload over budget,
+per-device HBM overflow at 2x8 — exits 1 through the real CLI anchored
+at a pointable source line, and the clean tree exits 0 with every
+suppression justified.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from apnea_uq_tpu.audit.manifest import zoo_label_lines  # noqa: E402
+from apnea_uq_tpu.compilecache.zoo import GROUP_LABELS  # noqa: E402
+from apnea_uq_tpu.config import (  # noqa: E402
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    save_config,
+)
+from apnea_uq_tpu.lint.engine import (  # noqa: E402
+    LintContext,
+    apply_suppressions,
+    load_files,
+)
+from apnea_uq_tpu.parallel import topology as topo_mod  # noqa: E402
+from apnea_uq_tpu.parallel.mesh import (  # noqa: E402
+    AXIS_DATA,
+    AXIS_ENSEMBLE,
+    make_mesh,
+    make_mesh_from_config,
+)
+from apnea_uq_tpu.topo.capture import (  # noqa: E402
+    MESH_FAMILY_LABELS,
+    TopoProgramFacts,
+    distill_facts,
+)
+from apnea_uq_tpu.topo.manifest import (  # noqa: E402
+    DEFAULT_MANIFEST_PATH,
+    load_manifest,
+    manifest_row,
+    merge_rows,
+    render_topology_doc,
+)
+from apnea_uq_tpu.topo.rules import (  # noqa: E402
+    RULE_SUBJECTS,
+    TOPO_RULES,
+    TopoContext,
+    run_topo_rules,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures", "topo")
+ALL_ZOO_LABELS = {lb for labels in GROUP_LABELS.values() for lb in labels}
+TOPOLOGIES = ("1x8", "2x4", "4x2")
+
+
+@pytest.fixture(scope="module")
+def tiny_config_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("topo_cfg") / "config.json")
+    save_config(ExperimentConfig(model=ModelConfig(
+        features=(8, 12, 8), kernel_sizes=(5, 3, 3),
+        dropout_rates=(0.3, 0.4, 0.5))), path)
+    return path
+
+
+# --------------------------------------------- topology-driven meshes --
+
+class TestTopologySpec:
+    def test_single_host_mesh_is_bit_parity_with_flat_reshape(self):
+        """The acceptance pin: on single-host layouts the new
+        TopologySpec construction is identical to the historical
+        np.asarray(jax.devices()).reshape(e, d)."""
+        devs = jax.devices()
+        d = len(devs)
+        for n in (1, 2, 3, 4, 5, 8, 10):
+            e = 1
+            for cand in range(1, d + 1):
+                if d % cand == 0 and cand <= max(n, 1):
+                    e = cand
+            legacy = np.asarray(devs).reshape(e, d // e)
+            mesh = make_mesh(num_members=n)
+            assert mesh.axis_names == (AXIS_ENSEMBLE, AXIS_DATA)
+            assert (np.asarray(mesh.devices) == legacy).all(), n
+        # Explicit pins reshape identically too.
+        mesh = make_mesh(ensemble_axis=2)
+        assert (np.asarray(mesh.devices)
+                == np.asarray(devs).reshape(2, d // 2)).all()
+
+    def test_mesh_from_config_pins_and_errors(self):
+        assert make_mesh_from_config(
+            MeshConfig(data_axis=4), num_members=8).devices.shape == (2, 4)
+        assert make_mesh_from_config(
+            MeshConfig(ensemble_axis=4, data_axis=2),
+            num_members=1).devices.shape == (4, 2)
+        with pytest.raises(ValueError, match="does not divide"):
+            make_mesh(ensemble_axis=3)
+        with pytest.raises(ValueError, match="does not match"):
+            make_mesh_from_config(MeshConfig(ensemble_axis=4, data_axis=4))
+
+    def test_detect_topology_single_host(self):
+        spec, devs = topo_mod.detect_topology()
+        assert spec.hosts == 1
+        assert spec.devices_per_host == len(jax.devices())
+        assert devs == list(jax.devices())
+
+    def test_solver_prefers_within_host_data_axis(self):
+        spec = topo_mod.TopologySpec(2, 4)
+        # members=4: both (2, 4) and (4, 2) satisfy the bound; only
+        # data<=4-within-host layouts are preferred, largest e wins.
+        assert topo_mod.solve_layout(spec, 4) == (4, 2)
+        # members=8 on 2x4: e=8 gives d=1 (within host) — preferred.
+        assert topo_mod.solve_layout(spec, 8) == (8, 1)
+        # Pure data-parallel falls back to the cross-host layout
+        # rather than refusing it (the analysis charges the traffic).
+        assert topo_mod.solve_layout(spec, 1) == (1, 8)
+
+    def test_axis_spans_hosts_layout_math(self):
+        spec = topo_mod.TopologySpec(2, 4)
+        assert not topo_mod.axis_spans_hosts(spec, 4, 2, AXIS_DATA)
+        assert topo_mod.axis_spans_hosts(spec, 4, 2, AXIS_ENSEMBLE)
+        assert topo_mod.axis_spans_hosts(spec, 1, 8, AXIS_DATA)
+        single = topo_mod.TopologySpec(1, 8)
+        assert not topo_mod.axis_spans_hosts(single, 1, 8, AXIS_DATA)
+        assert not topo_mod.axis_spans_hosts(single, 4, 2, AXIS_ENSEMBLE)
+
+    def test_simulated_topologies_of_the_canonical_rig(self):
+        assert [s.name for s in topo_mod.simulated_topologies(8)] == \
+            list(TOPOLOGIES)
+
+    def test_simulated_mesh_uses_host_major_runs(self):
+        spec = topo_mod.TopologySpec(2, 4)
+        mesh = make_mesh(num_members=4, topology=spec)
+        grid = np.asarray(mesh.devices)
+        assert grid.shape == (4, 2)
+        flat = list(jax.devices())
+        # Data rows are contiguous host-major runs: row i is
+        # devices[2i:2i+2], so every data group sits inside one
+        # simulated host of four.
+        for i in range(4):
+            assert list(grid[i]) == flat[2 * i:2 * i + 2]
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match=">=1 host"):
+            topo_mod.TopologySpec(0, 8)
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            topo_mod.host_major_devices(topo_mod.TopologySpec(2, 8),
+                                        jax.devices())
+
+
+# ------------------------------------------------- source rule fixtures --
+
+SOURCE_RULE_FIXTURES = [
+    ("single-host-device-enumeration", "device_enum_pos.py", 3,
+     "device_enum_neg.py"),
+    ("unguarded-primary-io", "primary_io_pos.py", 3,
+     "primary_io_neg.py"),
+    ("lockstep-collective-discipline", "lockstep_pos.py", 3,
+     "lockstep_neg.py"),
+]
+
+
+def _source_findings(name, rule):
+    files = load_files([os.path.join(FIXTURES, name)], FIXTURES)
+    ctx = TopoContext(lint=LintContext(files=files, repo_root=FIXTURES))
+    return [apply_suppressions(f, files[0])
+            for f in run_topo_rules(ctx, rules=[rule])]
+
+
+@pytest.mark.parametrize("rule,pos,count,neg", SOURCE_RULE_FIXTURES,
+                         ids=[r[0] for r in SOURCE_RULE_FIXTURES])
+def test_source_rule_fixture_pair(rule, pos, count, neg):
+    found = [f for f in _source_findings(pos, rule) if not f.suppressed]
+    assert len(found) == count, [f.render() for f in found]
+    assert all(f.rule == rule for f in found)
+    clean = [f for f in _source_findings(neg, rule) if not f.suppressed]
+    assert not clean, [f.render() for f in clean]
+
+
+def test_registry_ships_exactly_the_documented_rules():
+    assert set(TOPO_RULES) == {
+        "single-host-device-enumeration", "unguarded-primary-io",
+        "lockstep-collective-discipline", "topo-collective-manifest",
+        "topo-cross-host-payload", "topo-hbm-budget",
+    }
+    assert {n for n, s in RULE_SUBJECTS.items() if s == "source"} == {
+        "single-host-device-enumeration", "unguarded-primary-io",
+        "lockstep-collective-discipline",
+    }
+    for rule in TOPO_RULES.values():
+        assert rule.severity in ("error", "warning") and rule.summary
+    with pytest.raises(ValueError, match="unknown topo rule"):
+        run_topo_rules(TopoContext(), rules=["no-such"])
+
+
+# ---------------------------------------------- program rule injections --
+
+def _facts(label="ensemble_epoch", topology="2x4", e=4, d=2,
+           collectives=None, payloads=None, cross=None, cross_bytes=0,
+           blowup=1, per_device=1 << 20,
+           hbm=topo_mod.DEFAULT_HBM_BYTES,
+           dcn=topo_mod.DEFAULT_CROSS_HOST_BUDGET_BYTES):
+    return TopoProgramFacts(
+        label=label, topology=topology, mesh_ensemble=e, mesh_data=d,
+        collectives=dict(collectives or {}),
+        collective_payloads=dict(payloads or {}),
+        cross_host=list(cross or []), cross_host_bytes=cross_bytes,
+        replication_blowup=blowup, per_device_bytes=per_device,
+        hbm_budget_bytes=hbm, cross_host_budget_bytes=dcn,
+    )
+
+
+def _program_context(facts_list, manifest=None):
+    zoo_abs, label_lines = zoo_label_lines()
+    rel = os.path.relpath(zoo_abs, REPO).replace(os.sep, "/")
+    return TopoContext(
+        programs={(f.topology, f.label): f for f in facts_list},
+        manifest=manifest, zoo_path=rel, label_lines=label_lines,
+    )
+
+
+def test_clean_facts_pass_all_program_rules():
+    f = _facts()
+    manifest = {"ensemble_epoch": {"2x4": manifest_row(f)}}
+    assert run_topo_rules(_program_context([f], manifest)) == []
+
+
+def test_missing_and_drifted_manifest_rows_flagged():
+    f = _facts()
+    missing = run_topo_rules(
+        _program_context([f], manifest={}),
+        rules=["topo-collective-manifest"])
+    assert len(missing) == 1 and "no manifest row" in missing[0].message
+    drift_row = manifest_row(_facts(e=2, d=4))
+    drift = run_topo_rules(
+        _program_context([f], {"ensemble_epoch": {"2x4": drift_row}}),
+        rules=["topo-collective-manifest"])
+    assert len(drift) == 1 and "drift" in drift[0].message
+    # The finding anchors at the zoo-registration line.
+    _zoo, lines = zoo_label_lines()
+    assert drift[0].line == lines["ensemble_epoch"] > 1
+    assert drift[0].path.endswith("compilecache/zoo.py")
+
+
+def test_gather_over_hosts_is_unconditional_violation():
+    f = _facts(collectives={"all_gather[ensemble]": 1},
+               payloads={"all_gather[ensemble]": 4096},
+               cross=["all_gather[ensemble]"],
+               cross_bytes=4096 * 4, blowup=4)
+    # Even a manifest blessing the collective set cannot bless the
+    # cross-host gather.
+    manifest = {"ensemble_epoch": {"2x4": manifest_row(f)}}
+    findings = run_topo_rules(_program_context([f], manifest),
+                              rules=["topo-cross-host-payload"])
+    assert len(findings) == 1
+    assert "scales with the process count" in findings[0].message
+
+
+def test_cross_host_payload_over_budget_flagged():
+    f = _facts(collectives={"psum[data]": 1},
+               payloads={"psum[data]": 256 << 20},
+               cross=["psum[data]"], cross_bytes=256 << 20)
+    findings = run_topo_rules(_program_context([f], manifest={}),
+                              rules=["topo-cross-host-payload"])
+    assert len(findings) == 1
+    assert "exceed the spec's DCN budget" in findings[0].message
+    # Under budget: clean.
+    small = _facts(collectives={"psum[data]": 1},
+                   payloads={"psum[data]": 1024},
+                   cross=["psum[data]"], cross_bytes=1024)
+    assert run_topo_rules(_program_context([small], manifest={}),
+                          rules=["topo-cross-host-payload"]) == []
+
+
+def test_hbm_overflow_flagged():
+    f = _facts(topology="2x8", e=4, d=4,
+               per_device=int(20e9), hbm=int(16e9))
+    findings = run_topo_rules(_program_context([f], manifest={}),
+                              rules=["topo-hbm-budget"])
+    assert len(findings) == 1
+    assert "exceeds the spec's HBM budget" in findings[0].message
+    assert "2x8" in findings[0].message
+
+
+def test_distill_facts_classifies_and_models_payloads():
+    """distill_facts turns a captured ProgramAudit into per-topology
+    facts: reduce-style cross-host traffic charges payload once,
+    gather-style scales with the axis size, intra-host traffic charges
+    nothing."""
+    class FakeAudit:
+        label = "ensemble_epoch"
+        collectives = {"psum[data]": 2, "all_gather[ensemble]": 1}
+        collective_payloads = {"psum[data]": 1000,
+                               "all_gather[ensemble]": 64}
+        memory_fields = {"peak_bytes": 123}
+
+    spec = topo_mod.TopologySpec(2, 4)
+    f = distill_facts(FakeAudit(), spec, 4, 2)
+    # data is within-host on (4, 2) over 2x4 -> psum charges nothing;
+    # the ensemble gather spans hosts and scales by e=4.
+    assert f.cross_host == ["all_gather[ensemble]"]
+    assert f.cross_host_bytes == 64 * 4
+    assert f.replication_blowup == 4
+    assert f.per_device_bytes == 123
+    # On a single host nothing crosses.
+    g = distill_facts(FakeAudit(), topo_mod.TopologySpec(1, 8), 4, 2)
+    assert g.cross_host == [] and g.cross_host_bytes == 0
+
+
+def test_manifest_merge_preserves_and_prunes(tmp_path):
+    f1 = _facts(label="ensemble_epoch", topology="1x8")
+    f2 = _facts(label="train_epoch", topology="1x8", e=1, d=8)
+    rows = merge_rows({("1x8", f.label): f for f in (f1, f2)})
+    assert set(rows) == {"ensemble_epoch", "train_epoch"}
+    # Updating one cell preserves the other label's rows; a label that
+    # left the mesh family is pruned.
+    stale = dict(rows)
+    stale["a_label_gone_from_the_family"] = {"1x8": {"mesh": {}}}
+    merged = merge_rows({("2x4", f1.label): f1}, prior=stale)
+    assert set(merged) == {"ensemble_epoch", "train_epoch"}
+    assert set(merged["ensemble_epoch"]) == {"1x8", "2x4"}
+
+
+# ------------------------------------------------ the committed manifest --
+
+def test_checked_in_manifest_covers_every_mesh_family_cell():
+    """The zoo/manifest drift pin: every mesh-family label (all of them
+    real zoo labels) has a committed row for every canonical topology,
+    and the single-host rows carry no cross-host traffic."""
+    manifest = load_manifest(DEFAULT_MANIFEST_PATH)
+    assert manifest is not None
+    assert set(manifest) == set(MESH_FAMILY_LABELS)
+    assert set(MESH_FAMILY_LABELS) <= ALL_ZOO_LABELS
+    for label, topos in manifest.items():
+        assert set(topos) == set(TOPOLOGIES), label
+        for topology, row in topos.items():
+            assert set(row) == {"mesh", "collectives", "cross_host"}
+            e, d = row["mesh"]["ensemble"], row["mesh"]["data"]
+            assert e * d == 8, (label, topology)
+            # The repo-wide invariant as a checked-in fact: no explicit
+            # collectives anywhere in the mesh families today, hence
+            # nothing cross-host — the gate exists for the refactor
+            # that changes that.
+            assert row["collectives"] == {}, (label, topology)
+            assert row["cross_host"] == [], (label, topology)
+
+
+def test_topology_doc_renders_from_manifest():
+    rendered = render_topology_doc(load_manifest(DEFAULT_MANIFEST_PATH))
+    assert "| program | 1x8 | 2x4 | 4x2 |" in rendered
+    for label in MESH_FAMILY_LABELS:
+        assert f"`{label}`" in rendered
+
+
+# ------------------------------------------------------- the CLI contract --
+
+def _patch_sweep(monkeypatch, facts_list, skipped=(), failures=None):
+    monkeypatch.setattr(
+        "apnea_uq_tpu.topo.capture.sweep_topologies",
+        lambda config, specs=None: (
+            {(f.topology, f.label): f for f in facts_list},
+            list(skipped), dict(failures or {})))
+
+
+CLEAN_FIXTURE = os.path.join(FIXTURES, "lockstep_neg.py")
+
+
+def test_cli_injected_violations_exit_1(monkeypatch, capsys, tmp_path,
+                                        tiny_config_path):
+    """The acceptance criterion: each injected violation class fails
+    the real CLI with exit 1, anchored at a pointable source line."""
+    from apnea_uq_tpu.cli.main import main
+
+    _zoo, label_lines = zoo_label_lines()
+    manifest_path = str(tmp_path / "manifest.json")
+
+    # Program-side classes anchor at the zoo-registration site.
+    injections = {
+        "cross-host payload over budget": _facts(
+            label="train_epoch", collectives={"psum[data]": 1},
+            payloads={"psum[data]": 256 << 20}, cross=["psum[data]"],
+            cross_bytes=256 << 20),
+        "per-device HBM overflow at 2x8": _facts(
+            label="ensemble_epoch", topology="2x8", e=4, d=4,
+            per_device=int(20e9), hbm=int(16e9)),
+        "gather scaling with process count": _facts(
+            label="de_predict_fused",
+            collectives={"all_gather[ensemble]": 1},
+            payloads={"all_gather[ensemble]": 4096},
+            cross=["all_gather[ensemble]"], cross_bytes=16384, blowup=4),
+    }
+    for name, facts in injections.items():
+        _patch_sweep(monkeypatch, [facts])
+        # Bless the manifest rows first so only the budget rules fire.
+        rows = merge_rows({(facts.topology, facts.label): facts})
+        from apnea_uq_tpu.topo.manifest import write_manifest
+
+        write_manifest(manifest_path, rows)
+        rc = main(["topo", CLEAN_FIXTURE, "--config", tiny_config_path,
+                   "--manifest", manifest_path])
+        out = capsys.readouterr().out
+        assert rc == 1, f"{name} did not fail the topo gate:\n{out}"
+        anchor = f"compilecache/zoo.py:{label_lines[facts.label]}:"
+        assert anchor in out, (name, out)
+
+    # Source-side classes anchor at the offending call site.
+    for fixture, rule, line in (
+            ("primary_io_pos.py", "unguarded-primary-io", 11),
+            ("device_enum_pos.py", "single-host-device-enumeration", 7)):
+        _patch_sweep(monkeypatch, [_facts()])
+        rows = merge_rows({("2x4", "ensemble_epoch"): _facts()})
+        write_manifest(manifest_path, rows)
+        rc = main(["topo", os.path.join(FIXTURES, fixture),
+                   "--config", tiny_config_path,
+                   "--manifest", manifest_path])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert f"{fixture}:{line}: [{rule}]" in out, out
+
+
+def test_cli_gha_format_and_usage_errors(monkeypatch, capsys, tmp_path,
+                                         tiny_config_path):
+    from apnea_uq_tpu.cli.main import main
+
+    f = _facts(label="ensemble_epoch", topology="2x8",
+               per_device=int(20e9), hbm=int(16e9))
+    _patch_sweep(monkeypatch, [f])
+    manifest_path = str(tmp_path / "manifest.json")
+    from apnea_uq_tpu.topo.manifest import write_manifest
+
+    write_manifest(manifest_path, merge_rows({("2x8", f.label): f}))
+    rc = main(["topo", CLEAN_FIXTURE, "--config", tiny_config_path,
+               "--manifest", manifest_path, "--format", "gha"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    line = next(ln for ln in out.splitlines()
+                if ln.startswith("::error"))
+    assert "title=topo-hbm-budget" in line
+    assert "file=apnea_uq_tpu/compilecache/zoo.py" in line
+
+    with pytest.raises(SystemExit) as exc:
+        main(["topo", "--rule", "no-such-rule",
+              "--config", tiny_config_path])
+    assert exc.value.code == 2
+    assert "unknown topo rule" in capsys.readouterr().out
+
+    # No manifest yet: usage error with guidance.
+    _patch_sweep(monkeypatch, [_facts()])
+    with pytest.raises(SystemExit) as exc:
+        main(["topo", CLEAN_FIXTURE, "--config", tiny_config_path,
+              "--manifest", str(tmp_path / "nope.json")])
+    assert exc.value.code == 2
+    assert "--update-manifest" in capsys.readouterr().out
+
+    # A capture failure is exit 2, never a silent pass.
+    _patch_sweep(monkeypatch, [], failures={"2x4/ensemble_epoch": "boom"})
+    with pytest.raises(SystemExit) as exc:
+        main(["topo", CLEAN_FIXTURE, "--config", tiny_config_path,
+              "--manifest", str(tmp_path / "manifest.json")])
+    assert exc.value.code == 2
+    assert "FAILED" in capsys.readouterr().out
+
+
+def test_cli_source_only_rule_selection_skips_the_sweep(monkeypatch,
+                                                        capsys,
+                                                        tiny_config_path):
+    """--rule with only source rules must not trigger the jax-loading
+    sweep (the lint-anywhere property of the source side)."""
+    from apnea_uq_tpu.cli.main import main
+
+    def boom(config, specs=None):
+        raise AssertionError("sweep ran for a source-only selection")
+
+    monkeypatch.setattr("apnea_uq_tpu.topo.capture.sweep_topologies",
+                        boom)
+    rc = main(["topo", CLEAN_FIXTURE, "--config", tiny_config_path,
+               "--rule", "lockstep-collective-discipline"])
+    assert rc == 0
+    capsys.readouterr()
+
+
+def test_cli_update_manifest_round_trip(monkeypatch, capsys, tmp_path,
+                                        tiny_config_path):
+    from apnea_uq_tpu.cli.main import main
+
+    manifest_path = str(tmp_path / "manifest.json")
+    f = _facts(label="ensemble_epoch", topology="2x4")
+    _patch_sweep(monkeypatch, [f])
+    rc = main(["topo", CLEAN_FIXTURE, "--config", tiny_config_path,
+               "--manifest", manifest_path, "--update-manifest"])
+    assert rc == 0
+    capsys.readouterr()
+    saved = load_manifest(manifest_path)
+    assert saved["ensemble_epoch"]["2x4"] == manifest_row(f)
+    # Clean re-run against the recorded manifest.
+    rc = main(["topo", CLEAN_FIXTURE, "--config", tiny_config_path,
+               "--manifest", manifest_path])
+    assert rc == 0
+    capsys.readouterr()
+    # Drift (layout change) -> exit 1; failed update never mutates.
+    g = _facts(label="ensemble_epoch", topology="2x4", e=2, d=4,
+               per_device=int(20e9), hbm=int(16e9))
+    _patch_sweep(monkeypatch, [g])
+    before = load_manifest(manifest_path)
+    rc = main(["topo", CLEAN_FIXTURE, "--config", tiny_config_path,
+               "--manifest", manifest_path, "--update-manifest"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "NOT updated" in out
+    assert load_manifest(manifest_path) == before
+
+
+def test_cli_update_docs_renders_manifest(monkeypatch, capsys, tmp_path,
+                                          tiny_config_path):
+    from apnea_uq_tpu.cli.main import main
+
+    manifest_path = str(tmp_path / "manifest.json")
+    docs_path = str(tmp_path / "TOPOLOGY.md")
+    f = _facts(label="ensemble_epoch", topology="2x4")
+    _patch_sweep(monkeypatch, [f])
+    rc = main(["topo", CLEAN_FIXTURE, "--config", tiny_config_path,
+               "--manifest", manifest_path, "--update-manifest",
+               "--update-docs", "--docs", docs_path])
+    assert rc == 0
+    capsys.readouterr()
+    text = open(docs_path).read()
+    assert "`ensemble_epoch`" in text
+    assert text == render_topology_doc(load_manifest(manifest_path))
+
+
+# ------------------------------------- the acceptance run: real sweep --
+
+@pytest.fixture(scope="module")
+def real_topo_run(tiny_config_path, tmp_path_factory):
+    """ONE real sweep through the real CLI (source scan over the
+    package + three topologies lowered on the virtual-CPU rig, nothing
+    dispatched), shared by the acceptance assertions below."""
+    import contextlib
+    import io
+
+    from apnea_uq_tpu.cli.main import main
+
+    run_dir = str(tmp_path_factory.mktemp("topo_run") / "run")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["topo", "--config", tiny_config_path, "--json",
+                   "--run-dir", run_dir])
+    return rc, buf.getvalue(), run_dir
+
+
+def test_clean_tree_gate_and_suppression_audit_trail(real_topo_run):
+    """The tier-1 gate: zero unsuppressed findings over the package +
+    bench.py + the full simulated sweep, with every suppression
+    justified and pinned — a NEW suppression must be reviewed here."""
+    rc, out, _run_dir = real_topo_run
+    assert rc == 0, f"topo gate is dirty:\n{out}"
+    doc = json.loads(out[out.index("{"):])
+    assert doc["summary"]["unsuppressed"] == 0
+    suppressed = sorted(
+        (f["path"].replace(os.sep, "/"), f["rule"])
+        for f in doc["findings"] if f["suppressed"]
+    )
+    assert suppressed == [
+        ("apnea_uq_tpu/audit/capture.py",
+         "single-host-device-enumeration"),
+        ("apnea_uq_tpu/compilecache/store.py",
+         "single-host-device-enumeration"),
+        ("apnea_uq_tpu/parallel/mesh.py",
+         "single-host-device-enumeration"),
+        ("apnea_uq_tpu/parallel/topology.py",
+         "single-host-device-enumeration"),
+        ("apnea_uq_tpu/telemetry/runlog.py",
+         "single-host-device-enumeration"),
+        ("apnea_uq_tpu/topo/capture.py",
+         "single-host-device-enumeration"),
+        ("bench.py", "single-host-device-enumeration"),
+        ("bench.py", "single-host-device-enumeration"),
+    ]
+    # All three topologies captured for every mesh-family label.
+    cells = set(doc["programs"])
+    assert cells == {f"{label}@{topo}" for label in MESH_FAMILY_LABELS
+                     for topo in TOPOLOGIES}
+    for cell, facts in doc["programs"].items():
+        assert facts["cross_host_bytes"] == 0, cell
+        assert facts["per_device_bytes"] is not None
+        assert facts["per_device_bytes"] < facts["hbm_budget_bytes"]
+
+
+def test_topo_program_events_and_compare(real_topo_run, tmp_path):
+    """topo --run-dir persists one topo_program event per cell, and
+    telemetry compare gates the cross-host/per-device bytes
+    lower-is-better."""
+    from apnea_uq_tpu.telemetry import compare as compare_mod
+    from apnea_uq_tpu.telemetry.runlog import read_events
+
+    _rc, _out, run_dir = real_topo_run
+    events = [e for e in read_events(run_dir)
+              if e.get("kind") == "topo_program"]
+    assert sorted((e["topology"], e["label"]) for e in events) == sorted(
+        (topo, label) for label in MESH_FAMILY_LABELS
+        for topo in TOPOLOGIES)
+    worse = tmp_path / "worse_run"
+    worse.mkdir()
+    lines = [json.loads(line) for line in
+             open(os.path.join(run_dir, "events.jsonl")) if line.strip()]
+    for e in lines:
+        if e.get("kind") == "topo_program":
+            e["cross_host_bytes"] = e["cross_host_bytes"] + 10_000_000
+            e["per_device_bytes"] = int(e["per_device_bytes"] * 2)
+    with open(worse / "events.jsonl", "w") as f:
+        for e in lines:
+            f.write(json.dumps(e) + "\n")
+    comparison = compare_mod.compare_paths(run_dir, str(worse))
+    regressed = {d.name for d in comparison.regressions}
+    assert "topo.ensemble_epoch.2x4.cross_host_bytes" in regressed
+    assert "topo.train_epoch.1x8.per_device_bytes" in regressed
+
+
+# ------------------------------------------------- the check meta-gate --
+
+def test_check_merges_exit_codes(monkeypatch, capsys, tiny_config_path):
+    """check = lint + flow + audit + topo with one exit code: 0 all
+    clean, 1 any findings, 2 any usage error (and a usage error never
+    hides another gate's findings)."""
+    from apnea_uq_tpu.cli.main import main
+
+    calls = []
+
+    def fake(name, rc, *, raises=False):
+        def run(*a, **k):
+            calls.append(name)
+            if raises:
+                raise SystemExit(rc)
+            return rc
+        return run
+
+    # Patch the sys.modules objects (importlib.import_module), not the
+    # "pkg.mod.attr" string path: cmd_check's lazy from-imports read
+    # sys.modules, and an earlier module-eviction test (test_lint's
+    # jax-poison run) can leave the package ATTRIBUTE pointing at a
+    # different module object than the sys.modules entry.
+    import importlib
+
+    def patch(codes, raises=()):
+        calls.clear()
+        for name, modpath, attr in (
+                ("lint", "apnea_uq_tpu.lint.cli", "cmd_lint"),
+                ("flow", "apnea_uq_tpu.flow.cli", "cmd_flow"),
+                ("audit", "apnea_uq_tpu.audit.cli", "cmd_audit"),
+                ("topo", "apnea_uq_tpu.topo.cli", "cmd_topo")):
+            monkeypatch.setattr(
+                importlib.import_module(modpath), attr,
+                fake(name, codes[name], raises=name in raises))
+
+    all_clean = {"lint": 0, "flow": 0, "audit": 0, "topo": 0}
+    patch(all_clean)
+    assert main(["check", "--config", tiny_config_path]) == 0
+    assert calls == ["lint", "flow", "audit", "topo"]
+    out = capsys.readouterr().out
+    assert "== apnea-uq lint ==" in out and "clean" in out
+
+    patch({**all_clean, "topo": 1})
+    assert main(["check", "--config", tiny_config_path]) == 1
+    assert "FINDINGS" in capsys.readouterr().out
+
+    # A usage error in audit still runs topo, and 2 wins overall.
+    patch({**all_clean, "audit": 2, "topo": 1}, raises=("audit",))
+    assert main(["check", "--config", tiny_config_path]) == 2
+    assert calls == ["lint", "flow", "audit", "topo"]
+    assert "USAGE ERROR" in capsys.readouterr().out

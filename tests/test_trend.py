@@ -210,9 +210,52 @@ class TestTrendCLI:
         text = out.read_text()
         assert trend_mod.GENERATED_MARKER in text
         # Deterministic: a second render is byte-identical (the docs
-        # pin's precondition).
+        # pin's precondition) — over the full archive, multichip
+        # rounds included.
         rounds = [trend_mod.load_round(p)
-                  for p in trend_mod.repo_rounds()]
+                  for p in trend_mod.archived_rounds()]
         again = trend_mod.render_trajectory_doc(
             trend_mod.build_trajectory(rounds))
         assert text == again
+
+
+class TestMultichipRounds:
+    """MULTICHIP_r*.json — the mesh-dryrun twins ride the ledger
+    instead of being invisible (ISSUE 14 satellite)."""
+
+    def test_archived_multichip_rounds_ingest(self):
+        paths = trend_mod.multichip_rounds(REPO)
+        assert [trend_mod.round_label(p) for p in paths][:5] == [
+            "mch01", "mch02", "mch03", "mch04", "mch05"]
+        point = trend_mod.load_round(paths[0])
+        assert point.status == "ok"
+        assert point.metrics["multichip.n_devices"].value == 8.0
+        assert point.metrics["multichip.n_devices"].higher_better
+        assert point.metrics["multichip.mesh_ensemble"].value == 4.0
+        assert point.metrics["multichip.mesh_data"].value == 2.0
+
+    def test_archived_rounds_interleaves_bench_then_multichip(self):
+        labels = [trend_mod.round_label(p)
+                  for p in trend_mod.archived_rounds(REPO)]
+        assert labels[:5] == ["r01", "r02", "r03", "r04", "r05"]
+        assert labels[5:10] == ["mch01", "mch02", "mch03", "mch04",
+                                "mch05"]
+
+    def test_failed_and_skipped_dryruns_are_error_rounds(self, tmp_path):
+        bad = tmp_path / "MULTICHIP_r01.json"
+        bad.write_text(json.dumps({"n_devices": 0, "rc": 1, "ok": False,
+                                   "skipped": False, "tail": "boom"}))
+        point = trend_mod.load_round(str(bad))
+        assert point.status == "error" and "rc=1" in point.detail
+        skipped = tmp_path / "MULTICHIP_r02.json"
+        skipped.write_text(json.dumps({"n_devices": 0, "rc": 0,
+                                       "ok": False, "skipped": True,
+                                       "tail": ""}))
+        point = trend_mod.load_round(str(skipped))
+        assert point.status == "error" and "skipped" in point.detail
+
+    def test_multichip_series_in_cli_trajectory(self, capsys):
+        assert main(["telemetry", "trend"]) == 0
+        text = capsys.readouterr().out
+        assert "mch01[ok]" in text and "mch05[ok]" in text
+        assert "multichip.n_devices (^)" in text
