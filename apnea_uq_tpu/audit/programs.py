@@ -59,10 +59,12 @@ def capture_zoo(config, *, groups: Tuple[str, ...] = WARM_GROUPS,
     from apnea_uq_tpu.training import create_train_state, fit
     from apnea_uq_tpu.training.trainer import predict_proba_batched
     from apnea_uq_tpu.uq.predict import (
+        SERVE_BUCKET_SIZES,
         ensemble_predict,
         ensemble_predict_streaming,
         mc_dropout_predict,
         mc_dropout_predict_streaming,
+        serve_bucket_predict,
         stack_member_variables,
     )
     from apnea_uq_tpu.utils import prng
@@ -129,6 +131,30 @@ def capture_zoo(config, *, groups: Tuple[str, ...] = WARM_GROUPS,
                     ensemble_predict(dmodel, members, x_aval, **common)
                     ensemble_predict_streaming(dmodel, members, x_aval,
                                                **common)
+
+        if "serve" in groups:
+            # The serving bucket ladder (uq/predict.py
+            # SERVE_BUCKET_SIZES): fixed-shape programs, so the audit
+            # lowers them at their REAL bucket sizes — the exact
+            # programs `apnea-uq serve` dispatches — across both
+            # methods and both dtype tiers.
+            store.group = "serve"
+            key = prng.stochastic_key(config.train.seed)
+            serve_members = stack_member_variables(
+                [variables] * AUDIT_MEMBERS)
+            for dmodel in dtype_models:
+                for bucket in SERVE_BUCKET_SIZES:
+                    bucket_aval = jax.ShapeDtypeStruct(
+                        (bucket,) + AUDIT_WINDOW_SHAPE, jnp.float32)
+                    serve_bucket_predict(
+                        dmodel, variables, bucket_aval, method="mcd",
+                        bucket=bucket, n_passes=AUDIT_PASSES, key=key,
+                        record_memory_only=True,
+                    )
+                    serve_bucket_predict(
+                        dmodel, serve_members, bucket_aval, method="de",
+                        bucket=bucket, record_memory_only=True,
+                    )
 
         need_train_data = bool({"train", "train-ensemble"} & set(groups))
         if need_train_data:

@@ -711,6 +711,155 @@ def cmd_eval_de(args, config) -> int:
     return 0
 
 
+def _serving_engine(args, config, run_log):
+    """Build the serving engine a serve/score invocation runs: restore
+    the method's weights (baseline checkpoint for MCD, the ensemble
+    store for DE), validate the requested bucket subset, and hand back
+    an engine bound to the stage's run log."""
+    from apnea_uq_tpu.serving.engine import ServingEngine
+    from apnea_uq_tpu.training import restore_state
+
+    if args.method == "mcd":
+        model, template = _baseline_template(config)
+        state = restore_state(os.path.join(_ckpt_root(args), "baseline"),
+                              template)
+        carrier = state.variables()
+    else:
+        model, carrier = _restore_members(args, config,
+                                          getattr(args, "num_members", 0))
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    return ServingEngine(model, carrier, method=args.method,
+                         uq=config.uq, buckets=buckets, run_log=run_log,
+                         seed=config.train.seed)
+
+
+def cmd_serve(args, config) -> int:
+    """The long-lived online scoring process (ISSUE 15 tentpole): warm
+    the bucket-ladder programs (all `source=store|cache` after
+    `apnea-uq warm-cache` — zero request-path compiles, the PR-6
+    contract extended to serving), then coalesce incoming requests into
+    fixed bucket batches and stream the serving telemetry triple
+    (serve_request / serve_batch / serve_slo) into the run log, where
+    `telemetry compare`/`trend` gate the SLO summary.  ``--out``
+    appends one NDJSON decomposition row per scored window (keyed by
+    request id + window index) — the scoring-API output; without it the
+    run is telemetry-only (the loadgen/bench shape)."""
+    import json as json_mod
+
+    from apnea_uq_tpu.serving import loadgen as loadgen_mod
+    from apnea_uq_tpu.serving.engine import (decomposition_rows,
+                                             serve_requests)
+
+    config = _apply_eval_overrides(args, config)
+    if not args.loadgen and not args.input:
+        raise SystemExit(
+            "serve needs a request source: --loadgen N (synthetic "
+            "load-generated requests) or --input FILE|- (NDJSON request "
+            "lines)"
+        )
+    if args.loadgen and args.input:
+        raise SystemExit(
+            "serve takes ONE request source: --loadgen and --input "
+            "conflict (silently preferring one would score requests "
+            "the operator never asked about)"
+        )
+    with _compile_env(args, config), _run(args, "serve", config) as run_log:
+        engine = _serving_engine(args, config, run_log)
+        with run_log.stage("warm_buckets"):
+            engine.warm()
+        if args.loadgen:
+            requests = loadgen_mod.synthetic_requests(
+                args.loadgen, max_windows=args.request_windows,
+                time_steps=config.model.time_steps,
+                channels=config.model.num_channels,
+                seed=config.train.seed, rate=args.rate,
+            )
+        else:
+            requests = loadgen_mod.ndjson_requests(
+                args.input, time_steps=config.model.time_steps,
+                channels=config.model.num_channels,
+            )
+        out_fh = None
+        if args.out:
+            os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                        exist_ok=True)
+            out_fh = open(args.out, "a", encoding="utf-8")
+
+        def on_result(req, stats, start):
+            if out_fh is None:
+                return
+            decomp = decomposition_rows(stats)
+            for i in range(int(stats.shape[1])):
+                record = {"id": req.request_id, "window": start + i}
+                if req.patient is not None:
+                    record["patient"] = req.patient
+                record.update({k: round(float(v[i]), 6)
+                               for k, v in decomp.items()})
+                out_fh.write(json_mod.dumps(record) + "\n")
+            out_fh.flush()
+
+        if args.input and not args.out:
+            log("serve: no --out given — request scores are not "
+                "persisted (telemetry-only run)")
+        try:
+            with run_log.stage("serve"):
+                summary = serve_requests(
+                    engine, requests, max_wait_s=args.max_wait_ms / 1e3,
+                    slo_every=args.slo_every, on_result=on_result,
+                )
+        finally:
+            if out_fh is not None:
+                out_fh.close()
+
+        def ms(value):
+            return "-" if value is None else f"{value}ms"
+
+        log(f"served {summary['requests']} request(s) / "
+            f"{summary['windows']} window(s) in {summary['batches']} "
+            f"batch(es): p50 {ms(summary['p50_ms'])} p99 "
+            f"{ms(summary['p99_ms'])}, {summary['windows_per_s']} "
+            f"windows/s, pad waste {summary['pad_waste']}")
+    return 0
+
+
+def cmd_score(args, config) -> int:
+    """Sliding-window continuous scoring over a live PSG signal stream
+    (`--stream`): per-patient ring buffers re-window the sample stream
+    with a configurable hop, every window scores through the same
+    bucket programs `serve` dispatches, per-window decompositions
+    append to --out as NDJSON, and the resumable ring state commits
+    atomically under --state-dir after every scored batch (kill -9
+    safe; re-feeding the stream resumes without rescoring)."""
+    from apnea_uq_tpu.serving.stream import StreamScorer, read_sample_lines
+
+    config = _apply_eval_overrides(args, config)
+    if not args.stream:
+        raise SystemExit(
+            "score currently supports --stream only (the continuous "
+            "sliding-window scorer); batch evaluation remains "
+            "eval-mcd/eval-de"
+        )
+    with _compile_env(args, config), _run(args, "score", config) as run_log:
+        engine = _serving_engine(args, config, run_log)
+        with run_log.stage("warm_buckets"):
+            engine.warm()
+        scorer = StreamScorer(
+            engine, state_dir=args.state_dir, out_path=args.out,
+            hop=args.hop, run_log=run_log,
+        )
+        with run_log.stage("score_stream"):
+            summary = scorer.run(
+                read_sample_lines(
+                    args.input, follow=args.follow,
+                    max_idle_s=args.max_idle_secs,
+                ),
+                max_pending_s=args.max_pending_secs,
+            )
+        log(f"scored {summary['windows']} window(s) from "
+            f"{len(scorer.patients)} patient stream(s) -> {args.out}")
+    return 0
+
+
 def cmd_demo(args, config) -> int:
     """Zero-data smoke demo of the UQ engine (reference C12 __main__:
     ``python uq_techniques.py`` ran a synthetic 5x1000 evaluation,
@@ -1350,6 +1499,106 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     _add_plots_arg(p)
     _add_profile_arg(p)
     _add_profile_flag(p)
+
+    # The online serving tier (ISSUE 15): serve = request-path scoring
+    # behind the coalescer's bucket ladder; score = sliding-window
+    # continuous scoring over a live signal stream.  Both dispatch the
+    # zoo's `serve` group programs, so `apnea-uq warm-cache` makes them
+    # start with zero request-path compiles.
+    def _add_serving_args(p) -> None:
+        # jax-free on purpose: the parser must build with jax poisoned
+        # (the ladder constant lives in the host-side coalescer).
+        from apnea_uq_tpu.serving.coalescer import SERVE_BUCKET_SIZES
+
+        p.add_argument("--registry", required=True)
+        p.add_argument("--ckpt-dir", default=None)
+        p.add_argument("--method", choices=("mcd", "de"), default="mcd",
+                       help="UQ method to serve: clean-mode MC-Dropout "
+                            "from the baseline checkpoint (default) or "
+                            "the deterministic Deep Ensemble.")
+        p.add_argument("--num-members", type=int, default=0,
+                       help="With --method de: ensemble members to "
+                            "serve (0 = every checkpointed member, the "
+                            "eval-de contract).  Must match the "
+                            "warm-cache --num-members for warm starts.")
+        p.add_argument("--buckets",
+                       default=",".join(str(b) for b in SERVE_BUCKET_SIZES),
+                       help=f"Comma-separated bucket ladder (subset of "
+                            f"the registered serving buckets "
+                            f"{SERVE_BUCKET_SIZES}; each bucket is a "
+                            f"warm-cache/audit program label).")
+        _add_compute_dtype_arg(p)
+        _add_run_dir_arg(p)
+
+    p = add("serve", cmd_serve,
+            "Long-lived online UQ scoring: coalesced bucket batches "
+            "through AOT-warm fused-stats programs, with SLO telemetry.")
+    _add_serving_args(p)
+    p.add_argument("--loadgen", type=int, default=0, metavar="N",
+                   help="Serve N synthetic load-generated requests "
+                        "(serving/loadgen.py) instead of reading "
+                        "--input, then exit — the bench/acceptance "
+                        "mode.")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="With --loadgen: open-loop arrival rate in "
+                        "requests/sec (0 = as fast as possible).")
+    p.add_argument("--request-windows", type=int, default=4,
+                   help="With --loadgen: max windows per synthetic "
+                        "request (sizes draw uniformly from 1..N).")
+    p.add_argument("--input", default=None,
+                   help="NDJSON request source (- = stdin): one "
+                        "{\"id\", \"windows\": [[[ch]x60]xk]} object "
+                        "per line.")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="Coalescing deadline: a partial batch "
+                        "dispatches once its oldest request has waited "
+                        "this long (the latency/efficiency knob).")
+    p.add_argument("--slo-every", type=int, default=100,
+                   help="Emit a cumulative serve_slo snapshot every N "
+                        "completed requests (the final summary always "
+                        "emits).")
+    p.add_argument("--out", default=None,
+                   help="Append one NDJSON decomposition row per scored "
+                        "window (keyed by request id + window index) — "
+                        "the scoring-API output.  Omitted = telemetry-"
+                        "only run (the loadgen/bench shape).")
+
+    p = add("score", cmd_score,
+            "Continuous sliding-window scoring over a live PSG sample "
+            "stream, with resumable per-patient ring state.")
+    _add_serving_args(p)
+    p.add_argument("--stream", action="store_true",
+                   help="Consume a live per-sample NDJSON stream "
+                        "(required; batch evaluation remains "
+                        "eval-mcd/eval-de).")
+    p.add_argument("--input", required=False, default="-",
+                   help="Sample NDJSON source (- = stdin): one "
+                        "{\"patient\", \"t\", \"v\": [4 floats]} "
+                        "object per line.")
+    p.add_argument("--hop", type=int, default=60,
+                   help="Samples between consecutive window starts "
+                        "(60 = non-overlapping 60-s windows; smaller = "
+                        "overlapping re-windowing).")
+    p.add_argument("--state-dir", required=True,
+                   help="Where the resumable per-patient ring state "
+                        "commits (stream_state.json, atomic per scored "
+                        "batch — kill -9 safe).")
+    p.add_argument("--out", required=True,
+                   help="Per-window decomposition NDJSON results file "
+                        "(appended; windows key on patient+start_t).")
+    p.add_argument("--follow", action="store_true",
+                   help="Keep tailing --input past EOF (file-tail "
+                        "mode) until --max-idle-secs passes with no "
+                        "new samples.")
+    p.add_argument("--max-idle-secs", type=float, default=5.0,
+                   help="With --follow: exit after this long with no "
+                        "stream growth.")
+    p.add_argument("--max-pending-secs", type=float, default=1.0,
+                   help="Score a partial batch once its oldest pending "
+                        "window has waited this long — the live-stream "
+                        "latency/crash-loss bound (a slow feed must not "
+                        "hold admitted samples hostage to a full "
+                        "max-bucket batch).")
 
     p = add("metrics", cmd_metrics,
             "Print a stored evaluation's aggregates/CIs/accuracy.")

@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 # per-label exemption is an inline `# apnea-lint: disable=<program-rule>
 # -- <why>` comment next to the label string.
 WARM_GROUPS: Tuple[str, ...] = (
-    "eval-mcd", "eval-de", "train", "train-ensemble",
+    "eval-mcd", "eval-de", "train", "train-ensemble", "serve",
 )
 
 # Label grammar (uq/predict.py mcd_program_label / de_program_label):
@@ -61,6 +61,19 @@ GROUP_LABELS: Dict[str, Tuple[str, ...]] = {
                 "de_chunk_predict_fused", "de_chunk_predict_fused_bf16"),
     "train": ("train_epoch", "val_loss"),
     "train-ensemble": ("ensemble_epoch",),
+    # The online serving tier's bucket ladder (uq/predict.py
+    # SERVE_BUCKET_SIZES; `apnea-uq serve`): one fused-stats program per
+    # (method, bucket, dtype) cell, grammar
+    # `{mcd|de}_serve_b<bucket>_fused[_bf16]`.  Warmed here so a warm
+    # serve process does ZERO fresh XLA compiles on the request path —
+    # the PR-6 contract extended to serving, pinned by the warm-serve
+    # subprocess acceptance test (tests/test_serving.py).
+    "serve": ("mcd_serve_b16_fused", "mcd_serve_b16_fused_bf16",
+              "mcd_serve_b64_fused", "mcd_serve_b64_fused_bf16",
+              "mcd_serve_b256_fused", "mcd_serve_b256_fused_bf16",
+              "de_serve_b16_fused", "de_serve_b16_fused_bf16",
+              "de_serve_b64_fused", "de_serve_b64_fused_bf16",
+              "de_serve_b256_fused", "de_serve_b256_fused_bf16"),
 }
 
 
@@ -126,10 +139,12 @@ def warm_cache(
     from apnea_uq_tpu.training import create_train_state, fit
     from apnea_uq_tpu.training.trainer import predict_proba_batched
     from apnea_uq_tpu.uq.predict import (
+        SERVE_BUCKET_SIZES,
         ensemble_predict,
         ensemble_predict_streaming,
         mc_dropout_predict,
         mc_dropout_predict_streaming,
+        serve_bucket_predict,
         stack_member_variables,
     )
     from apnea_uq_tpu.utils import prng
@@ -144,14 +159,20 @@ def warm_cache(
     history_base = len(store.history) if store is not None else 0
 
     need_train = bool({"train", "train-ensemble"} & set(groups))
-    prepared = load_prepared(registry, include_train=need_train)
+    # Serving bucket programs have FIXED shapes from the model config
+    # (bucket x time_steps x channels) — a serve-only warm needs no
+    # prepared window sets, so a serving registry can be warmed before
+    # any data pipeline has run.
+    need_prepared = bool(set(groups) - {"serve"})
+    prepared = (load_prepared(registry, include_train=need_train)
+                if need_prepared else None)
     model = AlarconCNN1D(config.model)
     # Fresh-initialized variables are aval-identical to any checkpoint of
     # this model config — values never matter to compilation.
     variables = init_variables(model, jax.random.key(0))
     uq = config.uq
     stat_spec = ("nats", uq.entropy_eps) if uq.fused_reduction else None
-    test_shapes = _test_set_shapes(prepared)
+    test_shapes = _test_set_shapes(prepared) if prepared is not None else []
 
     if "eval-mcd" in groups:
         mesh = make_mesh_from_config(config.mesh, num_members=uq.mc_passes)
@@ -204,6 +225,32 @@ def warm_cache(
                 model, state, prepared.x_train, prepared.y_train,
                 config.train, mesh=make_mesh(num_members=1),
                 run_log=run_log, compile_only=True,
+            )
+
+    if "serve" in groups:
+        # The config-selected serving bucket programs: every ladder
+        # bucket x both methods, under the dtype the config runs.  The
+        # DE member count must match the later `apnea-uq serve
+        # --num-members` exactly as warm-cache's eval-de contract does
+        # (resolve_de_members).  Dispatch discipline matches the serve
+        # process by construction — serve_bucket_predict is the one
+        # entry point both sides call.
+        key = prng.stochastic_key(config.train.seed)
+        n_members = resolve_de_members(num_members, config, ckpt_root)
+        members = stack_member_variables([variables] * n_members)
+        tail = (config.model.time_steps, config.model.num_channels)
+        for bucket in SERVE_BUCKET_SIZES:
+            x_aval = jax.ShapeDtypeStruct((bucket,) + tail, jnp.float32)
+            serve_bucket_predict(
+                model, variables, x_aval, method="mcd", bucket=bucket,
+                n_passes=uq.mc_passes, key=key, base="nats",
+                eps=uq.entropy_eps, run_log=run_log,
+                record_memory_only=True,
+            )
+            serve_bucket_predict(
+                model, members, x_aval, method="de", bucket=bucket,
+                base="nats", eps=uq.entropy_eps, run_log=run_log,
+                record_memory_only=True,
             )
 
     if "train-ensemble" in groups:

@@ -1,0 +1,24 @@
+"""Online UQ serving tier (ISSUE 15): the batch pipeline's request path.
+
+Everything else in the repo is file-mediated batch; this package is the
+long-lived scoring process behind ``apnea-uq serve`` and ``apnea-uq
+score`` — request coalescing into the fixed bucket ladder's fused-stats
+programs (coalescer.py), AOT-warm dispatch + per-batch device timing
+(engine.py), sliding-window continuous scoring over a live PSG signal
+stream with resumable per-patient ring state (stream.py), SLO telemetry
+(slo.py: ``serve_request`` / ``serve_batch`` / ``serve_slo`` events),
+and a load generator (loadgen.py) that drives the loop for the bench's
+``serve`` block and the warm-serve acceptance test.
+
+Import discipline mirrors the telemetry layer: coalescer/slo/loadgen
+are jax-free (pure NumPy host logic); only engine.py (dispatch) and
+stream.py (via the engine it is handed) touch jax.
+"""
+
+from apnea_uq_tpu.serving.coalescer import (  # noqa: F401
+    BatchPlan,
+    BucketLadder,
+    RequestCoalescer,
+    ServeRequest,
+)
+from apnea_uq_tpu.serving.slo import SLOTracker  # noqa: F401

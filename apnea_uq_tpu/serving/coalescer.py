@@ -1,0 +1,211 @@
+"""Request coalescer: dynamic arrivals -> fixed-shape bucket batches.
+
+The serving tier's central trick: incoming 60-s/4-channel window
+requests are batched into a small ladder of FIXED batch-size buckets
+(default 16/64/256, :data:`SERVE_BUCKET_SIZES` below), each padded
+up to its bucket — so every dispatch hits an already-compiled
+fused-stats program and a warm process never traces or compiles on the
+request path.  Rows (windows) are independent in the serving regimes
+(clean-mode MCD / eval-mode DE), so requests pack FIFO into batches and
+split freely at batch boundaries; a request larger than the biggest
+bucket simply spills across several max-bucket batches.
+
+jax-free by construction (pure host bookkeeping over NumPy arrays):
+the engine owns dispatch, this module owns packing, padding accounting
+and queue-wait bookkeeping.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+from typing import Deque, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# The serving tier's fixed batch-size ladder — the ONE canonical
+# definition, living on the jax-free side so the CLI parser and this
+# host-side coalescer read it without touching jax; uq/predict.py
+# imports it and spells the per-bucket program-label grid
+# (SERVE_PROGRAM_LABELS) from it.
+SERVE_BUCKET_SIZES = (16, 64, 256)
+
+_REQUEST_COUNTER = itertools.count()
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One scoring request: ``windows`` is a ``(k, T, C)`` float32 array
+    (k >= 1); ``enqueue_t`` is the arrival clock reading latency is
+    measured from.  ``dispatched``/``done`` track the overflow-spill
+    bookkeeping: a request's rows may span several batches, and the
+    request completes when its LAST row's batch returns."""
+
+    windows: np.ndarray
+    enqueue_t: float
+    request_id: str = ""
+    patient: Optional[str] = None
+    dispatched: int = 0
+    done: int = 0
+    batches: int = 0
+
+    def __post_init__(self):
+        self.windows = np.asarray(self.windows, np.float32)
+        if self.windows.ndim != 3 or self.windows.shape[0] < 1:
+            raise ValueError(
+                f"request windows must be (k>=1, T, C), got shape "
+                f"{self.windows.shape}"
+            )
+        if not self.request_id:
+            self.request_id = f"req-{next(_REQUEST_COUNTER)}"
+
+    @property
+    def rows(self) -> int:
+        return int(self.windows.shape[0])
+
+    @property
+    def complete(self) -> bool:
+        return self.done >= self.rows
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """One coalesced dispatch: FIFO row slices packed into ``bucket``.
+    ``slices`` is ``[(request, start_row, end_row), ...]`` in request
+    order; the engine gathers the rows, zero-pads ``pad_rows`` up to the
+    bucket, dispatches, and hands each request its slice of the result."""
+
+    bucket: int
+    slices: List[Tuple[ServeRequest, int, int]]
+
+    @property
+    def rows(self) -> int:
+        return sum(end - start for _r, start, end in self.slices)
+
+    @property
+    def pad_rows(self) -> int:
+        return self.bucket - self.rows
+
+    @property
+    def pad_waste(self) -> float:
+        """Padded fraction of the dispatched bucket — the coalescing
+        efficiency number ``serve_batch``/``serve_slo`` report and
+        `telemetry compare` gates lower-is-better."""
+        return self.pad_rows / self.bucket
+
+    @property
+    def oldest_enqueue_t(self) -> float:
+        return min(r.enqueue_t for r, _s, _e in self.slices)
+
+    def queue_wait_s(self, now: float) -> float:
+        """Age of the batch's OLDEST row at dispatch time."""
+        return max(0.0, now - self.oldest_enqueue_t)
+
+    def gather(self) -> np.ndarray:
+        """The ``(rows, T, C)`` stack of the planned slices."""
+        return np.concatenate(
+            [r.windows[start:end] for r, start, end in self.slices], axis=0
+        )
+
+
+class BucketLadder:
+    """The fixed batch-size ladder.  Buckets must come from
+    ``SERVE_BUCKET_SIZES`` — each bucket is a registered program label
+    (``{mcd|de}_serve_b<bucket>_fused[_bf16]``), and an unregistered
+    bucket would dispatch a program warm-cache never saw."""
+
+    def __init__(self, buckets: Sequence[int] = SERVE_BUCKET_SIZES):
+        buckets = tuple(sorted({int(b) for b in buckets}))
+        if not buckets:
+            raise ValueError("the bucket ladder cannot be empty")
+        bad = [b for b in buckets if b not in SERVE_BUCKET_SIZES]
+        if bad:
+            raise ValueError(
+                f"bucket(s) {bad} are not registered serving buckets "
+                f"{SERVE_BUCKET_SIZES} (serving/coalescer.py "
+                f"SERVE_BUCKET_SIZES — the ladder is part of the "
+                f"program-label grammar uq/predict.py builds on)"
+            )
+        self.buckets = buckets
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, rows: int) -> int:
+        """Smallest ladder bucket holding ``rows`` (callers cap batches
+        at ``max_bucket``, so a bucket always exists)."""
+        if rows < 1:
+            raise ValueError(f"a batch needs >= 1 row, got {rows}")
+        for bucket in self.buckets:
+            if rows <= bucket:
+                return bucket
+        raise ValueError(
+            f"{rows} rows exceed the largest bucket "
+            f"{self.max_bucket}; split the batch first"
+        )
+
+
+class RequestCoalescer:
+    """FIFO request queue + batch planner.
+
+    ``enqueue`` admits requests; ``drain`` emits :class:`BatchPlan`\\ s.
+    A full ``max_bucket``'s worth of pending rows always drains; a
+    partial tail drains when ``flush=True`` (input exhausted / shutdown)
+    or when its oldest row has waited past ``max_wait_s`` — the
+    latency/efficiency tradeoff knob (`apnea-uq serve --max-wait-ms`)."""
+
+    def __init__(self, ladder: Optional[BucketLadder] = None):
+        self.ladder = ladder or BucketLadder()
+        self._pending: Deque[ServeRequest] = collections.deque()
+        self.pending_rows = 0
+
+    def enqueue(self, request: ServeRequest) -> None:
+        # Fresh requests only: a spilled request's remainder stays at
+        # the deque head inside _build_batch, it is never re-enqueued.
+        self._pending.append(request)
+        self.pending_rows += request.rows
+
+    def _oldest_overdue(self, now: float, max_wait_s: float) -> bool:
+        if not self._pending:
+            return False
+        return (now - self._pending[0].enqueue_t) >= max_wait_s
+
+    def _build_batch(self) -> BatchPlan:
+        """Pack up to ``max_bucket`` rows FIFO.  The boundary request
+        splits (overflow spill): its remaining rows stay at the head of
+        the queue for the next batch — rows are independent windows, so
+        splitting never changes any score."""
+        limit = self.ladder.max_bucket
+        slices: List[Tuple[ServeRequest, int, int]] = []
+        taken = 0
+        while self._pending and taken < limit:
+            req = self._pending[0]
+            start = req.dispatched
+            take = min(req.rows - start, limit - taken)
+            end = start + take
+            slices.append((req, start, end))
+            req.dispatched = end
+            req.batches += 1
+            taken += take
+            if req.dispatched >= req.rows:
+                self._pending.popleft()
+        self.pending_rows -= taken
+        return BatchPlan(bucket=self.ladder.bucket_for(taken),
+                         slices=slices)
+
+    def drain(self, *, now: float, max_wait_s: float = 0.0,
+              flush: bool = False) -> List[BatchPlan]:
+        """Batch plans ready to dispatch at ``now``.  Without ``flush``,
+        only full-ladder batches (>= ``max_bucket`` pending rows) or
+        overdue tails (oldest wait >= ``max_wait_s``) drain — the rest
+        keeps coalescing."""
+        plans: List[BatchPlan] = []
+        while self._pending:
+            if (not flush
+                    and self.pending_rows < self.ladder.max_bucket
+                    and not self._oldest_overdue(now, max_wait_s)):
+                break
+            plans.append(self._build_batch())
+        return plans

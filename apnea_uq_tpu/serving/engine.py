@@ -1,0 +1,302 @@
+"""The serving engine: AOT-warm bucket dispatch + the serve loop.
+
+``ServingEngine`` owns the model/weights and dispatches coalesced
+batches through the fixed bucket ladder's fused-stats programs
+(``uq/predict.py serve_bucket_predict``): each batch zero-pads to its
+bucket, runs ONE already-compiled program, and ships a ``(4, bucket)``
+sufficient-stats block device->host — the per-request payload the
+ROADMAP's serving direction was designed around.  Pad rows are sliced
+off on host; in the serving regimes (clean-mode MCD / eval-mode DE)
+every window's compute is batch-neighbor-independent, so padded scores
+are bit-identical (f32) to unpadded direct dispatch
+(tests/test_serving.py pins it).
+
+``serve_requests`` is the request-path loop `apnea-uq serve` (and the
+bench's ``serve`` block) runs: enqueue -> coalesce -> dispatch ->
+per-request completion, with the serving telemetry triple emitted as it
+happens (``serve_batch`` per dispatch, ``serve_request`` per completed
+request, periodic + final ``serve_slo`` summaries).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterable, Optional, Sequence
+
+import numpy as np
+
+from apnea_uq_tpu.serving.coalescer import (
+    BatchPlan,
+    BucketLadder,
+    RequestCoalescer,
+    ServeRequest,
+)
+from apnea_uq_tpu.serving.slo import SLOTracker
+from apnea_uq_tpu.uq.metrics import (
+    STAT_ALEATORIC,
+    STAT_MEAN,
+    STAT_TOTAL,
+    STAT_VARIANCE,
+)
+
+# How often the serve loop checkpoints a cumulative serve_slo snapshot
+# (every N completed requests); the final summary always emits.
+DEFAULT_SLO_EVERY = 100
+
+
+def decomposition_rows(stats: np.ndarray) -> Dict[str, np.ndarray]:
+    """(4, n) sufficient statistics -> the per-window uncertainty
+    decomposition vectors (host NumPy — n is request-sized here, and
+    mutual information is the one derived row: max(total - aleatoric,
+    0), uq/metrics.py's clamp)."""
+    stats = np.asarray(stats, np.float32)
+    return {
+        "mean_prob": stats[STAT_MEAN],
+        "variance": stats[STAT_VARIANCE],
+        "total_entropy": stats[STAT_TOTAL],
+        "aleatoric_entropy": stats[STAT_ALEATORIC],
+        "mutual_info": np.maximum(
+            stats[STAT_TOTAL] - stats[STAT_ALEATORIC], 0.0),
+    }
+
+
+class ServingEngine:
+    """Long-lived scorer over one model + weight carrier.
+
+    ``method='mcd'`` holds baseline variables and runs ``uq.mc_passes``
+    clean-mode stochastic passes per window (a fresh ``fold_in`` of the
+    root key per dispatched batch — no two batches share dropout
+    noise); ``method='de'`` holds the stacked ensemble members and runs
+    the deterministic member sweep.  ``warm()`` acquires every ladder
+    bucket's program through the program store WITHOUT dispatching, so
+    a warm-cached process front-loads its (zero-compile) acquisitions
+    before the first request arrives.
+    """
+
+    def __init__(self, model, carrier, *, method: str = "mcd", uq,
+                 buckets: Optional[Sequence[int]] = None, run_log=None,
+                 seed: int = 0):
+        from apnea_uq_tpu.uq.predict import as_stacked_members
+        from apnea_uq_tpu.utils import prng
+
+        if method not in ("mcd", "de"):
+            raise ValueError(f"method must be 'mcd' or 'de', got {method!r}")
+        if method == "mcd" and uq.mcd_mode != "clean":
+            raise ValueError(
+                "the serving tier requires UQConfig.mcd_mode='clean': "
+                "parity-mode batch-statistics BN would let a bucket's "
+                "zero-pad rows corrupt real windows"
+            )
+        self.model = model
+        self.method = method
+        self.uq = uq
+        self.carrier = (as_stacked_members(carrier) if method == "de"
+                        else carrier)
+        # `buckets is not None` (not truthiness): an explicitly-empty
+        # sequence must hit BucketLadder's cannot-be-empty error, not
+        # silently fall back to the full ladder the caller tried to
+        # restrict.
+        self.ladder = (BucketLadder(buckets) if buckets is not None
+                       else BucketLadder())
+        self.run_log = run_log
+        self._root_key = prng.stochastic_key(seed)
+        self._dispatches = 0
+        # Per-label acquisition memo (serve_bucket_predict `cache`): the
+        # first touch of each bucket — warm(), normally — pays weight
+        # placement + store acquisition + pricing; every request-path
+        # dispatch after that reuses the program and the already-placed
+        # carrier with zero per-batch acquisition overhead.
+        self._program_cache: Dict[str, Any] = {}
+
+    def _window_tail(self):
+        return (self.model.config.time_steps, self.model.config.num_channels)
+
+    def _predict(self, x, bucket: int, *, record_memory_only: bool = False):
+        import jax
+
+        from apnea_uq_tpu.uq.predict import serve_bucket_predict
+
+        kwargs: Dict[str, Any] = dict(
+            method=self.method, bucket=bucket, base="nats",
+            eps=self.uq.entropy_eps, run_log=self.run_log,
+            record_memory_only=record_memory_only,
+            cache=self._program_cache,
+        )
+        if self.method == "mcd":
+            kwargs["n_passes"] = self.uq.mc_passes
+            # Fresh noise per dispatched batch: the per-batch fold_in is
+            # the serving-tier spelling of the predictors' per-(pass,
+            # chunk) key discipline.
+            kwargs["key"] = jax.random.fold_in(self._root_key,
+                                               self._dispatches)
+        return serve_bucket_predict(self.model, self.carrier, x, **kwargs)
+
+    def warm(self) -> None:
+        """Acquire (and price) every ladder bucket's program with no
+        dispatch — after `apnea-uq warm-cache`, every acquisition here
+        is a ``source=store|cache`` hit and the request path never
+        compiles (the warm-serve acceptance contract)."""
+        tail = self._window_tail()
+        for bucket in self.ladder.buckets:
+            self._predict(np.empty((bucket,) + tail, np.float32), bucket,
+                          record_memory_only=True)
+
+    def score_batch(self, rows: np.ndarray, *, bucket: Optional[int] = None,
+                    queue_wait_s: float = 0.0,
+                    slo: Optional[SLOTracker] = None) -> np.ndarray:
+        """Score ``(n, T, C)`` windows through the smallest fitting
+        bucket: zero-pad to the bucket, dispatch, slice the pad columns
+        off — returns the real rows' ``(4, n)`` sufficient statistics.
+        Emits one ``serve_batch`` event (queue wait, pad waste,
+        dispatch-vs-device time, windows/sec) when a run log is
+        attached."""
+        from apnea_uq_tpu.telemetry.steps import StepMetrics
+        from apnea_uq_tpu.uq.predict import serve_program_label
+
+        rows = np.asarray(rows, np.float32)
+        n = int(rows.shape[0])
+        bucket = self.ladder.bucket_for(n) if bucket is None else int(bucket)
+        padded = rows
+        if n < bucket:
+            padded = np.zeros((bucket,) + rows.shape[1:], np.float32)
+            padded[:n] = rows
+        label = serve_program_label(self.model, method=self.method,
+                                    bucket=bucket)
+        metrics = StepMetrics(self.run_log)
+        stats = metrics.measure(label, lambda: self._predict(padded, bucket),
+                                n_items=n)
+        self._dispatches += 1
+        record = metrics.last
+        out = np.asarray(stats)[:, :n]
+        if self.run_log is not None:
+            self.run_log.event(
+                "serve_batch",
+                label=label,
+                bucket=bucket,
+                rows=n,
+                pad_rows=bucket - n,
+                pad_waste=round((bucket - n) / bucket, 4),
+                queue_wait_s=round(queue_wait_s, 6),
+                dispatch_s=round(record.dispatch_s, 6),
+                device_s=round(record.device_s, 6),
+                windows_per_s=(round(record.items_per_s, 3)
+                               if record.items_per_s is not None else None),
+                retraces=record.retraces,
+                backend_compiles=record.backend_compiles,
+            )
+        if slo is not None:
+            slo.record_batch(bucket=bucket, rows=n, pad_rows=bucket - n,
+                             queue_wait_s=queue_wait_s,
+                             device_s=record.device_s)
+        return out
+
+
+def serve_requests(
+    engine: ServingEngine,
+    requests: Iterable[ServeRequest],
+    *,
+    max_wait_s: float = 0.005,
+    slo_every: int = DEFAULT_SLO_EVERY,
+    slo: Optional[SLOTracker] = None,
+    coalescer: Optional[RequestCoalescer] = None,
+    clock=time.perf_counter,
+    on_result=None,
+) -> Dict[str, Any]:
+    """The request-path loop: pull arrivals, coalesce into bucket
+    batches, dispatch, complete requests.  ``on_result(request, stats,
+    start_row)`` (stats = the ``(4, k)`` block for the request's rows
+    ``start_row:start_row+k`` — a spilled request gets one call per
+    batch its rows landed in) lets callers stream scores out; the
+    returned dict is the final SLO summary, which is also emitted as
+    the closing ``serve_slo`` event.
+
+    The request source is pumped on a daemon thread into a queue so the
+    ``max_wait_s`` coalescing deadline holds even when the source
+    BLOCKS (stdin, a sparse NDJSON tail): an idle poll re-checks the
+    queue for overdue partial batches instead of sitting inside a
+    blocking read — without it, one request on a quiet source would
+    wait for the NEXT arrival, not the deadline.  Dispatch stays on the
+    calling thread; only iteration of ``requests`` moves."""
+    import queue as queue_mod
+    import threading
+
+    run_log = engine.run_log
+    slo = slo or SLOTracker(clock)
+    coalescer = coalescer or RequestCoalescer(engine.ladder)
+    emitted_at = 0
+
+    def dispatch(plan: BatchPlan) -> None:
+        nonlocal emitted_at
+        now = clock()
+        stats = engine.score_batch(
+            plan.gather(), bucket=plan.bucket,
+            queue_wait_s=plan.queue_wait_s(now), slo=slo,
+        )
+        done_t = clock()
+        offset = 0
+        for req, start, end in plan.slices:
+            take = end - start
+            if on_result is not None:
+                on_result(req, stats[:, offset:offset + take], start)
+            offset += take
+            req.done += take
+            if req.complete:
+                latency = done_t - req.enqueue_t
+                slo.record_request(latency_s=latency)
+                if run_log is not None:
+                    run_log.event(
+                        "serve_request",
+                        request_id=req.request_id,
+                        windows=req.rows,
+                        batches=req.batches,
+                        latency_s=round(latency, 6),
+                    )
+                if slo.requests - emitted_at >= max(1, int(slo_every)):
+                    emitted_at = slo.requests
+                    slo.emit(run_log, final=False)
+
+    # Bounded: a fast source (a big NDJSON file, loadgen at rate=0) must
+    # not materialize every pending request's window arrays in memory —
+    # under sustained overload the pump blocks on put() and the source
+    # back-pressures, instead of the process growing without bound.  The
+    # paced load generator keeps the queue far below the bound anyway,
+    # so open-loop arrival measurements are unaffected.
+    fifo: "queue_mod.Queue" = queue_mod.Queue(maxsize=1024)
+    done = object()
+    source_failure: list = []
+
+    def pump() -> None:
+        try:
+            for request in requests:
+                fifo.put(request)
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            source_failure.append(e)
+        finally:
+            fifo.put(done)
+
+    threading.Thread(target=pump, daemon=True,
+                     name="serve-request-pump").start()
+    # Idle poll bounded by the deadline itself: a partial batch is
+    # dispatched at most ~max_wait_s late, never "when the next request
+    # happens to arrive".
+    poll_s = max(min(max_wait_s, 0.05), 0.001)
+    while True:
+        try:
+            item = fifo.get(timeout=poll_s)
+        except queue_mod.Empty:
+            for plan in coalescer.drain(now=clock(), max_wait_s=max_wait_s):
+                dispatch(plan)
+            continue
+        if item is done:
+            if source_failure:
+                # The request source raised (e.g. a malformed NDJSON
+                # request line): the contract is the caller's error,
+                # not a silent drain — re-raise on the serving thread.
+                raise source_failure[0]
+            break
+        coalescer.enqueue(item)
+        for plan in coalescer.drain(now=clock(), max_wait_s=max_wait_s):
+            dispatch(plan)
+    for plan in coalescer.drain(now=clock(), flush=True):
+        dispatch(plan)
+    return slo.emit(run_log, final=True)

@@ -1,0 +1,110 @@
+"""Serving SLO accounting: request latencies, queue waits, pad waste.
+
+The read side of the serving telemetry triple: the serve loop records
+one entry per dispatched batch and one per completed request, and this
+tracker folds them into the ``serve_slo`` summary event — p50/p95/p99
+request latency, achieved windows/sec, mean queue wait, and the padded
+fraction of all dispatched bucket rows.  ``telemetry compare`` gates
+the summary (``serve.p50_ms`` / ``serve.p99_ms`` / ``serve.windows_per_s``
+/ ``serve.queue_wait_mean_s`` backend-bound, ``serve.pad_waste`` as a
+backend-independent relative), and ``telemetry trend`` carries it as a
+series.  jax-free (NumPy percentiles over host lists).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Deque, Dict, Optional
+
+import numpy as np
+
+# Per-sample history kept for the percentile/mean summaries: a
+# long-lived serve process must stay O(1) in memory, so the counters
+# (requests/windows/batches/pad accounting) are exact for the whole
+# session while the latency percentiles and mean queue wait are
+# computed over the most recent window of this many samples — far more
+# than any SLO percentile needs to stabilize.
+HISTORY_WINDOW = 65536
+
+
+class SLOTracker:
+    """Cumulative session accounting.  ``summary()`` is the
+    whole-session-so-far view; periodic ``serve_slo`` events are
+    cumulative snapshots and the ``final=True`` event is the one
+    ``compare``/``trend`` read (the LAST ``serve_slo`` of a run).
+    Counters are session-exact; the latency/queue-wait distributions
+    are over the last :data:`HISTORY_WINDOW` samples (bounded memory
+    for a long-lived process)."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self.t0 = clock()
+        self.requests = 0
+        self.windows = 0
+        self.batches = 0
+        self.bucket_rows = 0
+        self.pad_rows = 0
+        self.latencies_s: Deque[float] = collections.deque(
+            maxlen=HISTORY_WINDOW)
+        self.queue_waits_s: Deque[float] = collections.deque(
+            maxlen=HISTORY_WINDOW)
+        self.device_s = 0.0
+
+    def record_batch(self, *, bucket: int, rows: int, pad_rows: int,
+                     queue_wait_s: float, device_s: float) -> None:
+        self.batches += 1
+        self.windows += rows
+        self.bucket_rows += bucket
+        self.pad_rows += pad_rows
+        self.queue_waits_s.append(float(queue_wait_s))
+        self.device_s += float(device_s)
+
+    def record_request(self, *, latency_s: float) -> None:
+        self.requests += 1
+        self.latencies_s.append(float(latency_s))
+
+    def summary(self, now: Optional[float] = None) -> Dict[str, Any]:
+        now = self._clock() if now is None else now
+        interval = max(now - self.t0, 1e-9)
+        lat = np.asarray(list(self.latencies_s), np.float64)
+        if lat.size:
+            p50, p95, p99 = (round(float(v) * 1e3, 3) for v in
+                             np.percentile(lat, (50.0, 95.0, 99.0)))
+        else:
+            # No completed requests (e.g. the stream scorer, which has
+            # windows but no request latencies): the percentiles are
+            # UNDEFINED, not zero — a 0.0 here would land in `telemetry
+            # compare` as a gateable latency every real serve run
+            # "regresses" against.  None fields are skipped by the
+            # metric extraction.
+            p50 = p95 = p99 = None
+        waits = np.asarray(list(self.queue_waits_s), np.float64)
+        return {
+            "requests": self.requests,
+            "windows": self.windows,
+            "batches": self.batches,
+            "p50_ms": p50,
+            "p95_ms": p95,
+            "p99_ms": p99,
+            "windows_per_s": round(self.windows / interval, 3),
+            "queue_wait_mean_s": (round(float(waits.mean()), 6)
+                                  if waits.size else 0.0),
+            "pad_waste": (round(self.pad_rows / self.bucket_rows, 4)
+                          if self.bucket_rows else 0.0),
+            "device_s": round(self.device_s, 6),
+            "interval_s": round(interval, 6),
+        }
+
+    def emit(self, run_log, *, final: bool = False,
+             patients: Optional[int] = None) -> Dict[str, Any]:
+        """Append one ``serve_slo`` event (cumulative snapshot; the
+        final one is the session summary the gates read)."""
+        summary = self.summary()
+        if run_log is not None:
+            fields = dict(summary)
+            fields["final"] = bool(final)
+            if patients is not None:
+                fields["patients"] = int(patients)
+            run_log.event("serve_slo", **fields)
+        return summary

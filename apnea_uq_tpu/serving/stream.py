@@ -1,0 +1,382 @@
+"""Sliding-window continuous scorer over a live PSG signal stream.
+
+`apnea-uq score --stream` consumes per-sample NDJSON lines — one
+``{"patient": ID, "t": seconds, "v": [<channels> floats]}`` object per
+line, from a file (optionally tailed with ``--follow``) or stdin —
+maintains a per-patient ring buffer of the last ``window`` samples,
+re-windows with a configurable ``hop`` (window k starts at sample
+``k * hop``), and scores each emitted window through the serving
+engine's bucket programs.  Per-window uncertainty decompositions append
+to an NDJSON results file, running per-patient rollups accumulate in
+the state, and the serving telemetry triple lands in the run log.
+
+Crash contract (the ingest-progress pattern, PR 8): the per-patient
+ring state — buffer, sample counter, last-seen timestamp, rollups —
+commits atomically (tmp -> fsync -> os.replace, utils/io.py) after
+every scored batch, so a ``kill -9`` mid-stream leaves a resumable
+snapshot.  On restart the scorer reloads the state and DEDUPES replayed
+input per patient by timestamp (``t <= last_t`` is skipped), so feeding
+the same stream from the beginning continues exactly where the last
+commit left off.  Results are at-least-once: a kill in the gap between
+the results append and the state commit re-scores that one batch —
+windows are keyed by (patient, start_t), so consumers dedupe on the key
+— and never leaves gaps.  MCD duplicates may differ in VALUE (the
+rerun's engine draws fresh per-process dispatch keys), so a dedupe
+keeps whichever row it picks consistently (first wins is fine); DE is
+deterministic and its duplicates are identical.
+
+Scaling note: the snapshot is ONE JSON document covering every patient
+seen, rewritten per scored batch, and patients are never evicted — the
+right shape for the per-process stream counts this tier serves today
+(each commit is O(patients x window) floats).  A deployment fanning
+thousands of concurrent patient streams through one scorer should shard
+the state per patient (write only the patients a batch touched) before
+anything else; the atomic-commit discipline carries over unchanged.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional, TextIO, Tuple
+
+import numpy as np
+
+from apnea_uq_tpu.serving.engine import ServingEngine, decomposition_rows
+from apnea_uq_tpu.serving.slo import SLOTracker
+from apnea_uq_tpu.telemetry import log
+
+STATE_FILENAME = "stream_state.json"
+STATE_VERSION = 1
+
+
+class _PatientState:
+    """Ring buffer + rollup for one patient (host-side, JSON-round-trippable)."""
+
+    def __init__(self, window: int):
+        self.window = window
+        self.buffer: collections.deque = collections.deque(maxlen=window)
+        self.times: collections.deque = collections.deque(maxlen=window)
+        self.samples_seen = 0
+        self.last_t = float("-inf")
+        self.windows_scored = 0
+        self.prob_sum = 0.0
+        self.entropy_sum = 0.0
+
+    def add(self, t: float, values: List[float],
+            hop: int) -> Optional[Tuple[float, np.ndarray]]:
+        """Admit one sample; returns ``(start_t, (window, C) array)``
+        when a window boundary is crossed.  Replayed samples
+        (``t <= last_t``) are ignored — the resume dedupe."""
+        if t <= self.last_t:
+            return None
+        self.last_t = t
+        self.buffer.append(values)
+        self.times.append(t)
+        self.samples_seen += 1
+        if self.samples_seen < self.window:
+            return None
+        if (self.samples_seen - self.window) % hop != 0:
+            return None
+        return (float(self.times[0]),
+                np.asarray(self.buffer, np.float32))
+
+    def rollup(self) -> Dict[str, Any]:
+        n = self.windows_scored
+        return {
+            "windows": n,
+            "mean_prob": round(self.prob_sum / n, 6) if n else None,
+            "mean_total_entropy": (round(self.entropy_sum / n, 6)
+                                   if n else None),
+        }
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "buffer": [list(map(float, row)) for row in self.buffer],
+            "times": [float(t) for t in self.times],
+            "samples_seen": self.samples_seen,
+            "last_t": self.last_t,
+            "windows_scored": self.windows_scored,
+            "prob_sum": self.prob_sum,
+            "entropy_sum": self.entropy_sum,
+        }
+
+    @classmethod
+    def from_json(cls, window: int, doc: Dict[str, Any]) -> "_PatientState":
+        state = cls(window)
+        for row in doc.get("buffer", []):
+            state.buffer.append(list(row))
+        for t in doc.get("times", []):
+            state.times.append(float(t))
+        state.samples_seen = int(doc.get("samples_seen", 0))
+        state.last_t = float(doc.get("last_t", float("-inf")))
+        state.windows_scored = int(doc.get("windows_scored", 0))
+        state.prob_sum = float(doc.get("prob_sum", 0.0))
+        state.entropy_sum = float(doc.get("entropy_sum", 0.0))
+        return state
+
+
+def read_sample_lines(path: str, *, follow: bool = False,
+                      max_idle_s: float = 5.0,
+                      poll_s: float = 0.2) -> Iterator[str]:
+    """Lines from ``path`` (``-`` = stdin).  ``follow`` keeps tailing
+    past EOF — new appended lines stream out as they land — until
+    ``max_idle_s`` passes with no growth (the bounded-exit knob tests
+    and operators both need; a production tail sets it large).  The
+    idle timeout holds for stdin too: ``--follow`` on ``-`` polls with
+    ``select`` instead of blocking forever on a quiet pipe.
+
+    Every elapsed idle poll — stdin in either mode, and file tails
+    under ``follow`` — additionally yields one empty-string HEARTBEAT
+    line: the consumer's loop regains control on a quiet stream (the
+    scorer's time-based pending flush hangs off it) while
+    ``process_line`` treats the blank as a no-op.  Dense streams and
+    non-follow FILE reads never emit one, so batch-exact tests over
+    in-memory or file inputs stay deterministic."""
+    import sys
+
+    if path == "-":
+        # select + raw-fd reads in BOTH stdin modes: selecting on the
+        # buffered text stream would deadlock the classic way (readline
+        # buffers several lines off the fd, select then reports the
+        # drained fd idle while lines sit unread in the Python buffer),
+        # and the idle heartbeats keep the consumer's time-based flush
+        # honest on a live pipe that pauses without closing.  ``follow``
+        # only controls whether prolonged silence EXITS; EOF (closed
+        # pipe) always does, flushing a final unterminated line first.
+        import select
+
+        fd = sys.stdin.fileno()
+        buf = b""
+        idle_since = None
+        while True:
+            ready, _w, _x = select.select([fd], [], [], poll_s)
+            if ready:
+                chunk = os.read(fd, 65536)
+                if not chunk:
+                    if buf:  # final unterminated line
+                        yield buf.decode("utf-8", "replace")
+                    return  # closed pipe: nothing more can ever arrive
+                idle_since = None
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    yield line.decode("utf-8", "replace") + "\n"
+                continue
+            if follow:
+                now = time.monotonic()
+                if idle_since is None:
+                    idle_since = now
+                elif now - idle_since >= max_idle_s:
+                    if buf:  # quiet pipe's unterminated tail
+                        yield buf.decode("utf-8", "replace")
+                    return
+            yield ""  # idle heartbeat: hand control back to the consumer
+        return
+    with open(path, encoding="utf-8") as fh:
+        idle_since = None
+        pending = ""
+        while True:
+            line = fh.readline()
+            if line:
+                idle_since = None
+                # Hold back a partial line (the writer is mid-append and
+                # the newline hasn't landed yet): yielding it now would
+                # split one sample into two bogus lines, both of which
+                # json-fail and silently drop the sample.
+                pending += line
+                if not pending.endswith("\n"):
+                    continue
+                yield pending
+                pending = ""
+                continue
+            if not follow:
+                if pending:
+                    yield pending  # final unterminated line
+                return
+            now = time.monotonic()
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since >= max_idle_s:
+                if pending:
+                    yield pending
+                return
+            time.sleep(poll_s)
+            yield ""  # idle heartbeat: hand control back to the consumer
+
+
+class StreamScorer:
+    """The `score --stream` loop: samples in, scored windows out.
+
+    Windows pending dispatch coalesce until a full max-ladder bucket is
+    ready (or the input drains), then score through
+    ``engine.score_batch`` — the same padded-bucket programs the serve
+    path runs — and append one NDJSON result row per window to
+    ``out_path``.
+    """
+
+    def __init__(self, engine: ServingEngine, *, state_dir: str,
+                 out_path: str, window: Optional[int] = None,
+                 hop: int = 60, run_log=None):
+        self.engine = engine
+        self.window = int(window or engine.model.config.time_steps)
+        if self.window != engine.model.config.time_steps:
+            raise ValueError(
+                f"window must match the model's time_steps "
+                f"({engine.model.config.time_steps}), got {self.window}"
+            )
+        if hop < 1:
+            raise ValueError(f"hop must be >= 1 sample, got {hop}")
+        self.hop = int(hop)
+        self.state_dir = state_dir
+        self.state_path = os.path.join(state_dir, STATE_FILENAME)
+        self.out_path = out_path
+        self.run_log = run_log
+        self.slo = SLOTracker()
+        self.patients: Dict[str, _PatientState] = {}
+        # (patient, start_t, window array, enqueue clock) awaiting dispatch.
+        self._pending: List[Tuple[str, float, np.ndarray, float]] = []
+        self._out_fh: Optional[TextIO] = None
+        self._load_state()
+
+    # -- state ------------------------------------------------------------
+
+    def _load_state(self) -> None:
+        if not os.path.exists(self.state_path):
+            return
+        with open(self.state_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if doc.get("version") != STATE_VERSION:
+            raise ValueError(
+                f"unsupported stream state version {doc.get('version')!r} "
+                f"at {self.state_path}"
+            )
+        if doc.get("window") != self.window or doc.get("hop") != self.hop:
+            raise ValueError(
+                f"stream state at {self.state_path} was written with "
+                f"window={doc.get('window')}/hop={doc.get('hop')}, "
+                f"this run uses window={self.window}/hop={self.hop} — "
+                f"resuming would mis-place every later window"
+            )
+        for pid, pdoc in doc.get("patients", {}).items():
+            self.patients[pid] = _PatientState.from_json(self.window, pdoc)
+
+    def _save_state(self) -> None:
+        from apnea_uq_tpu.utils.io import atomic_write_json
+
+        os.makedirs(self.state_dir, exist_ok=True)
+        atomic_write_json(self.state_path, {
+            "version": STATE_VERSION,
+            "window": self.window,
+            "hop": self.hop,
+            "patients": {pid: p.to_json()
+                         for pid, p in sorted(self.patients.items())},
+        })
+
+    # -- scoring ----------------------------------------------------------
+
+    def _out(self) -> TextIO:
+        if self._out_fh is None:
+            out_dir = os.path.dirname(os.path.abspath(self.out_path))
+            os.makedirs(out_dir, exist_ok=True)
+            self._out_fh = open(self.out_path, "a", encoding="utf-8")
+        return self._out_fh
+
+    def _flush_pending(self) -> None:
+        """Score every pending window in max-bucket chunks, append the
+        result rows, fold the rollups, THEN commit the ring state — the
+        at-least-once ordering (see the module docstring)."""
+        if not self._pending:
+            self._save_state()
+            return
+        out = self._out()
+        while self._pending:
+            chunk = self._pending[:self.engine.ladder.max_bucket]
+            del self._pending[:len(chunk)]
+            rows = np.stack([w for _p, _t, w, _e in chunk])
+            oldest = min(e for _p, _t, _w, e in chunk)
+            stats = self.engine.score_batch(
+                rows, queue_wait_s=max(0.0, time.perf_counter() - oldest),
+                slo=self.slo,
+            )
+            decomp = decomposition_rows(stats)
+            for i, (pid, start_t, _w, _e) in enumerate(chunk):
+                record = {"patient": pid, "start_t": start_t}
+                record.update(
+                    {k: round(float(v[i]), 6) for k, v in decomp.items()}
+                )
+                out.write(json.dumps(record) + "\n")
+                pstate = self.patients[pid]
+                pstate.windows_scored += 1
+                pstate.prob_sum += float(decomp["mean_prob"][i])
+                pstate.entropy_sum += float(decomp["total_entropy"][i])
+            out.flush()
+        self._save_state()
+
+    def process_line(self, line: str) -> int:
+        """Admit one NDJSON sample line; returns how many windows it
+        completed (queued for the next flush).  Malformed lines are
+        logged and skipped — one corrupt sample must not kill a
+        long-lived scorer."""
+        line = line.strip()
+        if not line:
+            return 0
+        try:
+            doc = json.loads(line)
+            pid = str(doc["patient"])
+            t = float(doc["t"])
+            values = [float(v) for v in doc["v"]]
+        except (ValueError, KeyError, TypeError) as e:
+            log(f"stream: skipped malformed sample line "
+                f"({type(e).__name__}: {e})")
+            return 0
+        if len(values) != self.engine.model.config.num_channels:
+            log(f"stream: skipped sample for {pid}: {len(values)} "
+                f"channel(s), model expects "
+                f"{self.engine.model.config.num_channels}")
+            return 0
+        pstate = self.patients.get(pid)
+        if pstate is None:
+            pstate = self.patients[pid] = _PatientState(self.window)
+        emitted = pstate.add(t, values, self.hop)
+        if emitted is None:
+            return 0
+        start_t, window = emitted
+        self._pending.append((pid, start_t, window, time.perf_counter()))
+        return 1
+
+    def run(self, lines: Iterator[str],
+            max_pending_s: float = 1.0) -> Dict[str, Any]:
+        """Consume the stream: score a batch whenever a full max bucket
+        of windows is pending OR the oldest pending window has waited
+        ``max_pending_s`` (the live-stream latency/crash-loss bound — a
+        slow 1 Hz feed must not hold hours of admitted samples hostage
+        to a 256-window batch; ``read_sample_lines`` follow mode emits
+        idle heartbeats so the age check fires on quiet streams too),
+        flush the tail at end of input, and close with the final
+        ``serve_slo`` (carrying the patient count) plus per-patient
+        rollup log lines.  Returns the SLO summary."""
+        try:
+            for line in lines:
+                self.process_line(line)
+                if len(self._pending) >= self.engine.ladder.max_bucket:
+                    self._flush_pending()
+                elif (self._pending
+                      and time.perf_counter() - self._pending[0][3]
+                      >= max_pending_s):
+                    self._flush_pending()
+            self._flush_pending()
+        finally:
+            if self._out_fh is not None:
+                self._out_fh.close()
+                self._out_fh = None
+        summary = self.slo.emit(self.run_log, final=True,
+                                patients=len(self.patients))
+        for pid, pstate in sorted(self.patients.items()):
+            roll = pstate.rollup()
+            log(f"stream rollup {pid}: {roll['windows']} window(s), "
+                f"mean_prob {roll['mean_prob']}, "
+                f"mean_total_entropy {roll['mean_total_entropy']}")
+        return summary

@@ -133,17 +133,25 @@ def unit_direction(unit: Optional[str]) -> bool:
 # Name tokens that mark a metric lower-is-better regardless of unit:
 # calibration error scores (ECE/MCE/Brier) and drift statistics
 # (PSI, KS) are scores where zero is perfect — a candidate could
-# otherwise only ever "improve" by miscalibrating harder.
+# otherwise only ever "improve" by miscalibrating harder.  The serving
+# SLO family (ISSUE 15) rides the same table: latency percentiles
+# (p50/p95/p99), queue waits, and pad waste are all costs — without the
+# tokens, `serve.pad_waste` (unit "ratio") would gate higher-is-better
+# and a coalescer that pads every bucket to 99% waste could only ever
+# "improve".
 _LOWER_BETTER_NAME_TOKENS = frozenset(
-    {"ece", "mce", "brier", "psi", "ks", "drift"})
+    {"ece", "mce", "brier", "psi", "ks", "drift",
+     "p50", "p95", "p99", "latency", "wait", "waste"})
 
 
 def name_direction(name: Optional[str]) -> Optional[bool]:
     """Direction inferred from the metric NAME alone: ``ece``/``mce``/
-    ``brier``/``psi``/``ks``/``drift`` appearing as a name token
-    (``quality.CNN_MCD.ece``, ``val_ece``, ``drift.Unbalanced.max_psi``)
-    is lower-is-better without needing ``--metric-direction``; None when
-    the name says nothing and the unit inference should decide."""
+    ``brier``/``psi``/``ks``/``drift`` — plus the serving SLO tokens
+    ``p50``/``p95``/``p99``/``latency``/``wait``/``waste`` — appearing
+    as a name token (``quality.CNN_MCD.ece``, ``serve.p99_ms``,
+    ``serve.queue_wait_mean_s``) is lower-is-better without needing
+    ``--metric-direction``; None when the name says nothing and the
+    unit inference should decide."""
     tokens = re.findall(r"[a-z0-9]+", (name or "").lower())
     if any(t in _LOWER_BETTER_NAME_TOKENS for t in tokens):
         return False
@@ -276,6 +284,24 @@ def _metrics_from_context(ctx: Any) -> Dict[str, Metric]:
             bound=True)
         put("d2h.bytes_fused", d2h.get("d2h_bytes_fused"), "bytes",
             False, bound=True)
+    serve = ok("serve")
+    if serve:
+        # Online serving SLO block (bench.py bench_serve, ISSUE 15):
+        # the load-generated serve loop's latency percentiles,
+        # throughput, and mean queue wait are absolute numbers of the
+        # backend (and arrival pattern) that produced them -> bound.
+        # pad_waste — the padded fraction of all dispatched bucket rows
+        # — is a pure coalescing-efficiency ratio, backend-independent,
+        # so a coalescer regression gates even across the CPU-proxy
+        # boundary.
+        put("serve.p50_ms", serve.get("p50_ms"), "ms", False, bound=True)
+        put("serve.p95_ms", serve.get("p95_ms"), "ms", False, bound=True)
+        put("serve.p99_ms", serve.get("p99_ms"), "ms", False, bound=True)
+        put("serve.windows_per_s", serve.get("windows_per_s"),
+            "windows/sec", True, bound=True)
+        put("serve.queue_wait_mean_s", serve.get("queue_wait_mean_s"),
+            "seconds", False, bound=True)
+        put("serve.pad_waste", serve.get("pad_waste"), "ratio", False)
     qual = ok("quality")
     if qual:
         # Model-quality proof block (bench.py bench_quality): fixed-seed
@@ -349,7 +375,9 @@ def bench_doc_proxy(doc: Dict[str, Any]) -> bool:
 def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
     """Comparable scalars of one run's event log: bench metric mirrors,
     eval throughput, the compiled-HBM peaks (so a footprint regression
-    gates like a speed regression), and the compile-cost roll-up —
+    gates like a speed regression), the serving SLO summary (the last
+    ``serve_slo`` snapshot of an `apnea-uq serve`/`score` run), and the
+    compile-cost roll-up —
     ``compile.total_s`` (seconds spent acquiring programs,
     lower-is-better) and ``compile.hit_ratio`` (store/cache hits over
     acquisitions, higher-is-better) — so a cold-start regression (a
@@ -465,6 +493,25 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
                     name = f"drift.{label}.{field}"
                     out[name] = Metric(name, float(e[field]), unit,
                                        metric_direction(name, unit))
+        elif kind == "serve_slo":
+            # Online serving SLO snapshot (serving/slo.py, ISSUE 15).
+            # Snapshots are cumulative and the append-order overwrite
+            # means the LAST serve_slo of the run — the session summary
+            # — is the one that gates.  Latency percentiles, throughput,
+            # and queue wait are absolutes of the serving backend ->
+            # backend-bound; pad_waste is the coalescer's efficiency
+            # ratio and gates everywhere.
+            for field, unit, higher, bound in (
+                    ("p50_ms", "ms", False, True),
+                    ("p95_ms", "ms", False, True),
+                    ("p99_ms", "ms", False, True),
+                    ("windows_per_s", "windows/sec", True, True),
+                    ("queue_wait_mean_s", "seconds", False, True),
+                    ("pad_waste", "ratio", False, False)):
+                if e.get(field) is not None:
+                    name = f"serve.{field}"
+                    out[name] = Metric(name, float(e[field]), unit,
+                                       higher, backend_bound=bound)
         elif kind == "compile_event":
             compile_n += 1
             compile_hits += 1 if e.get("hit") else 0
@@ -511,7 +558,7 @@ def load_source(
                 f"no comparable metrics in source {path!r}: the run's "
                 f"events carry no bench/eval throughput, d2h, "
                 f"memory-peak, compile-cost, data-load, program-audit, "
-                f"topology, quality, or drift metrics"
+                f"topology, quality, drift, or serve-SLO metrics"
             )
         return metrics, {"kind": "run_dir", "proxy": dir_proxy}
     with open(path) as f:

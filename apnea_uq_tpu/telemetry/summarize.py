@@ -270,6 +270,30 @@ def _render_quality_gates(gates: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _render_serve_slo(slos: List[Dict[str, Any]]) -> List[str]:
+    """The serving SLO trail from ``serve_slo`` events (serving/slo.py):
+    snapshots are cumulative, so the LAST line — the session summary
+    `telemetry compare` gates — is the one that matters; earlier lines
+    show how the SLO evolved as load arrived."""
+    lines = ["serve slo (cumulative snapshots; last = session summary):"]
+    for e in slos:
+        line = (
+            f"  {e.get('requests', '?')} req / {e.get('windows', '?')} win"
+            f" in {e.get('batches', '?')} batch(es):"
+            f" p50 {_fmt(e.get('p50_ms'), 1)}ms"
+            f" p99 {_fmt(e.get('p99_ms'), 1)}ms"
+            f"  {_fmt(e.get('windows_per_s'), 1)} win/s"
+            f"  wait {_fmt(e.get('queue_wait_mean_s'), 4)}s"
+            f"  pad {_fmt(e.get('pad_waste'), 3)}"
+        )
+        if e.get("patients") is not None:
+            line += f"  [{e['patients']} patients]"
+        if e.get("final"):
+            line += "  (final)"
+        lines.append(line)
+    return lines
+
+
 def _render_bench_blocks(blocks: List[Dict[str, Any]]) -> List[str]:
     """The per-block status trail from ``bench_block`` events (bench.py's
     isolated block runner): one line per block with its outcome, so a
@@ -358,6 +382,10 @@ _DRIFT_FINGERPRINT_FIELDS = (
 _QUALITY_GATE_FIELDS = (
     "passed", "checks", "failures", "baseline", "threshold_pct",
     "psi_threshold", "ks_threshold")
+_SERVE_SLO_FIELDS = (
+    "requests", "windows", "batches", "p50_ms", "p95_ms", "p99_ms",
+    "windows_per_s", "queue_wait_mean_s", "pad_waste", "device_s",
+    "interval_s", "final", "patients")
 
 
 def _section(events: List[Dict[str, Any]], kind: str,
@@ -502,6 +530,11 @@ def summarize_events(run_dir: str,
         lines.append("")
         lines.extend(_render_data_loads(loads))
 
+    slos = _section(events, "serve_slo", _SERVE_SLO_FIELDS)
+    if slos:
+        lines.append("")
+        lines.extend(_render_serve_slo(slos))
+
     bench_blocks = _section(events, "bench_block", _BENCH_BLOCK_FIELDS)
     if bench_blocks:
         lines.append("")
@@ -599,6 +632,7 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
         "compile_events": compile_events,
         "compile": _compile_aggregate(compile_events),
         "data_loads": section("data_load", _DATA_LOAD_FIELDS),
+        "serve_slos": section("serve_slo", _SERVE_SLO_FIELDS),
         "bench_blocks": section("bench_block", _BENCH_BLOCK_FIELDS),
         "ingest_progress": section("ingest_progress",
                                    _INGEST_PROGRESS_FIELDS),

@@ -73,6 +73,27 @@ DE_PROGRAM_LABELS = (
     "de_chunk_predict_fused", "de_chunk_predict_fused_bf16",
 )
 
+# The online serving tier's bucket ladder (apnea_uq_tpu/serving/): every
+# coalesced request batch pads up to one of these fixed window counts, so
+# each dispatch hits an already-compiled fused-stats program and a warm
+# serve process never compiles on the request path.  The ladder constant
+# lives on the jax-free side (serving/coalescer.py — the CLI parser
+# reads it at build time) and the ladder is part of the label grammar —
+# `{mcd|de}_serve_b<bucket>_fused[_bf16]` — so the warm-cache zoo, the
+# audit manifest, and the drift pin all name the bucket programs
+# individually (a bucket that fell out of the store would otherwise pay
+# a silent request-path compile).
+from apnea_uq_tpu.serving.coalescer import SERVE_BUCKET_SIZES  # noqa: E402
+
+SERVE_PROGRAM_LABELS = (
+    "mcd_serve_b16_fused", "mcd_serve_b16_fused_bf16",
+    "mcd_serve_b64_fused", "mcd_serve_b64_fused_bf16",
+    "mcd_serve_b256_fused", "mcd_serve_b256_fused_bf16",
+    "de_serve_b16_fused", "de_serve_b16_fused_bf16",
+    "de_serve_b64_fused", "de_serve_b64_fused_bf16",
+    "de_serve_b256_fused", "de_serve_b256_fused_bf16",
+)
+
 
 def _dtype_tag(model: AlarconCNN1D) -> str:
     return ("_bf16" if jnp.dtype(model.config.compute_dtype) == jnp.bfloat16
@@ -103,6 +124,126 @@ def de_program_label(model: AlarconCNN1D, *, streamed: bool,
     label += _dtype_tag(model)
     assert label in DE_PROGRAM_LABELS, label
     return label
+
+
+def serve_program_label(model: AlarconCNN1D, *, method: str,
+                        bucket: int) -> str:
+    """The serving-tier program label one (method, bucket, dtype) cell
+    prices/stores/dispatches under — `{mcd|de}_serve_b<bucket>_fused`
+    plus the shared ``_bf16`` dtype tag.  Always the fused-stats body
+    (an online request wants the (4, bucket) sufficient-stats D2H
+    payload, never the (K, bucket) stack) and always the XLA engine:
+    the serving tier keeps ONE body per label on every backend, so a
+    CPU audit, a warm-cache, and a TPU serve process name — and get —
+    the same program."""
+    if method not in ("mcd", "de"):
+        raise ValueError(f"method must be 'mcd' or 'de', got {method!r}")
+    label = f"{method}_serve_b{int(bucket)}_fused" + _dtype_tag(model)
+    assert label in SERVE_PROGRAM_LABELS, label
+    return label
+
+
+def serve_bucket_predict(
+    model: AlarconCNN1D,
+    variables,
+    x,
+    *,
+    method: str = "mcd",
+    bucket: int,
+    n_passes: int = 50,
+    key: Optional[jax.Array] = None,
+    base: str = "nats",
+    eps: float = 1e-10,
+    run_log=None,
+    record_memory_only: bool = False,
+    cache: Optional[dict] = None,
+) -> jax.Array:
+    """One coalesced serving bucket through its fused-stats program:
+    ``x`` is EXACTLY ``(bucket, T, C)`` — the request coalescer
+    (serving/coalescer.py) zero-pads up to the bucket, and the caller
+    slices the pad columns back off the returned ``(N_STAT_ROWS,
+    bucket)`` stack.  Pad rows are sound because serving always runs
+    clean-mode MCD (frozen-BN) or eval-mode DE: every window's compute
+    is independent of its batch neighbors, so the real columns are
+    bit-identical (f32) to a direct dispatch of the same program family
+    at the exact row count (pinned by tests/test_serving.py).
+
+    ``method='mcd'`` runs ``n_passes`` stochastic passes under ``key``
+    (clean mode only — parity-mode batch-statistics BN would let pad
+    rows corrupt real windows); ``method='de'`` runs the deterministic
+    ensemble, with ``variables`` any accepted DE-member carrier
+    (:func:`as_stacked_members`).  The acquisition/pricing/dispatch
+    discipline matches the eval predictors: ONE (label, fn, args) tuple
+    drives all three, labels follow :func:`serve_program_label`, and
+    ``record_memory_only=True`` is the warm-cache/audit no-dispatch
+    mode.
+
+    ``cache`` (a caller-owned dict — the ServingEngine passes its own)
+    memoizes the acquisition per label: the first call pays weight
+    placement, store-signature hashing, the compile_event, and the
+    memory record; every later dispatch through the same cache reuses
+    the acquired program and the already-placed carrier, keeping the
+    request-path hot loop free of per-batch host overhead."""
+    bucket = int(bucket)
+    if bucket not in SERVE_BUCKET_SIZES:
+        raise ValueError(
+            f"bucket must be one of {SERVE_BUCKET_SIZES}, got {bucket} — "
+            f"the serving ladder's labels are registered per bucket "
+            f"(compilecache/zoo.py GROUP_LABELS['serve'])"
+        )
+    label = serve_program_label(model, method=method, bucket=bucket)
+    cached = cache.get(label) if cache is not None else None
+    if cached is None:
+        # Canonical weight placement: checkpoint-restored weights come
+        # back COMMITTED (orbax restores onto device 0 with an explicit
+        # SingleDeviceSharding) while warm-cache/audit sign with
+        # fresh-init UNCOMMITTED arrays — and the store signature
+        # includes pinned shardings, so without one shared placement
+        # the warm process and the serve process would key the same
+        # program differently and the request path would silently
+        # re-jit (the warm-serve acceptance test pins this).  The mesh
+        # predictors normalize the same way with their replicated
+        # device_put.
+        place = jax.local_devices()[0]
+        variables = jax.tree.map(lambda a: jax.device_put(a, place),
+                                 variables)
+        if method == "de":
+            variables = as_stacked_members(variables)
+    else:
+        program, variables = cached
+    if record_memory_only:
+        x = jax.ShapeDtypeStruct(
+            (bucket,) + tuple(np.shape(x))[1:], jnp.float32)
+    else:
+        x = jnp.asarray(x, jnp.float32)
+        if x.shape[0] != bucket:
+            raise ValueError(
+                f"bucket program {label} takes exactly {bucket} rows, "
+                f"got {x.shape[0]} — the coalescer must pad to the bucket"
+            )
+    if method == "mcd":
+        if key is None:
+            key = prng.stochastic_key(0)
+        fn = _mcd_stats_jit
+        args = (model, variables, x, key, n_passes, _MCD_MODES["clean"],
+                bucket, base, float(eps), None, "xla")
+    else:
+        fn = _ensemble_stats_jit
+        args = (model, variables, x, bucket, base, float(eps))
+    if cached is None:
+        program = program_store.get_program(label, fn, *args,
+                                            run_log=run_log)
+        if run_log is not None:
+            # Compiled-HBM accounting per bucket program (one
+            # memory_profile event per signature) — free when a program
+            # was acquired.
+            telemetry_memory.record_jit_memory(run_log, label, fn, *args,
+                                               program=program)
+        if cache is not None:
+            cache[label] = (program, variables)
+    if record_memory_only:
+        return None  # warm-cache / audit no-dispatch mode
+    return program(*args) if program is not None else fn(*args)
 
 
 def resolve_mcd_engine(engine: str, mode: str,

@@ -64,6 +64,14 @@ calibration ECE/MCE/Brier + fingerprint drift self/shift scores — the
 model-quality tooling proof, host-only NumPy, so its scalars gate as
 backend-independent metrics across the CPU-proxy boundary;
 BENCH_QUALITY_WINDOWS scales it, default 4096),
+BENCH_SKIP_SERVE=1 to skip the serve context (the online serving tier's
+load-generated SLO proof: AOT-warm the bucket-ladder fused-stats
+programs, drive `serving/loadgen.py` through the request coalescer, and
+record p50/p95/p99 request latency, windows/sec, mean queue wait, and
+pad waste — backend-aware: it runs on whatever backend the capture
+targets, CPU-proxy rounds included, and `telemetry compare` gates only
+the relative pad-waste ratio across the proxy boundary;
+BENCH_SERVE_REQUESTS scales the request count, default 64),
 BENCH_DE_CHUNK for its DE chunk size,
 BENCH_WASTE_EPOCHS for the early-stop-waste context's epoch cap (0
 skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
@@ -1220,6 +1228,34 @@ def bench_quality() -> dict:
     }
 
 
+def bench_serve(run_log, n_passes: int) -> dict:
+    """Online serving tier proof (ISSUE 15): build a ServingEngine over
+    a fresh-initialized model (weight values never matter to a perf
+    block), AOT-warm every bucket-ladder program, then drive the serve
+    loop with the seeded load generator and return the final SLO
+    summary — p50/p95/p99 request latency, windows/sec, mean queue
+    wait, and pad waste.  Backend-aware, not backend-gated: the block
+    runs on whatever backend the capture targets (CPU-proxy rounds
+    included), the serving telemetry triple lands in the bench run dir,
+    and `telemetry compare` marks the absolute latencies backend-bound
+    so only the coalescer's pad-waste ratio gates across the proxy
+    boundary."""
+    from apnea_uq_tpu.config import ModelConfig, UQConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.serving.engine import ServingEngine
+    from apnea_uq_tpu.serving.loadgen import run_loadgen
+
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 64))
+    model = AlarconCNN1D(ModelConfig(compute_dtype=_bench_dtype()))
+    variables = init_variables(model, jax.random.key(0))
+    engine = ServingEngine(
+        model, variables, method="mcd",
+        uq=UQConfig(mc_passes=n_passes), run_log=run_log, seed=0,
+    )
+    engine.warm()
+    return run_loadgen(engine, n_requests, max_windows=4, seed=0)
+
+
 def _start_watchdog():
     """Fail loudly instead of hanging the driver's whole budget: the
     tunneled TPU backend can stall indefinitely at device init (observed:
@@ -1319,7 +1355,7 @@ def _run_bench(run_log, proxy: bool) -> dict:
         primary = run("de_train", de_primary, device=True)
         for name in ("mcd", "bootstrap", "streamed", "fused", "mcd_kernel",
                      "compile", "program_audit", "data_plane",
-                     "d2h_accounting", "quality"):
+                     "d2h_accounting", "quality", "serve"):
             run(name, None, skip=True, reason="BENCH_METRIC=de_train")
     else:
         def mcd():
@@ -1419,6 +1455,12 @@ def _run_bench(run_log, proxy: bool) -> dict:
             reason=("BENCH_SKIP_QUALITY"
                     if os.environ.get("BENCH_SKIP_QUALITY") else None))
         attach("quality", "quality", quality_v)
+        serve_v = run(
+            "serve", lambda: bench_serve(run_log, n_passes),
+            skip=bool(os.environ.get("BENCH_SKIP_SERVE")),
+            reason=("BENCH_SKIP_SERVE"
+                    if os.environ.get("BENCH_SKIP_SERVE") else None))
+        attach("serve", "serve", serve_v)
 
     n_ok = sum(1 for r in blocks.values() if r.get("status") == "ok")
     headline = primary

@@ -238,6 +238,17 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert 0.0 < qual_ctx["brier"] < 0.3
     assert qual_ctx["self_max_psi"] == 0.0
     assert qual_ctx["shifted_max_psi"] > 0.2
+    # Serve block (ISSUE 15): the load-generated serving loop ran for
+    # real — warm bucket programs, coalesced dispatches, and a final
+    # SLO summary with the gateable percentiles/throughput/pad-waste.
+    serve_ctx = ctx["serve"]
+    assert "error" not in serve_ctx, serve_ctx
+    assert serve_ctx["requests"] == 64
+    assert serve_ctx["windows"] >= serve_ctx["requests"]
+    assert serve_ctx["batches"] >= 1
+    assert serve_ctx["p50_ms"] > 0 and serve_ctx["p99_ms"] >= serve_ctx["p50_ms"]
+    assert serve_ctx["windows_per_s"] > 0
+    assert 0.0 <= serve_ctx["pad_waste"] < 1.0
 
     # Result-v2 envelope (ISSUE 11): schema-versioned payload with
     # backend facts and a per-block status map, every block ok on the
@@ -250,7 +261,7 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert {n for n, b in blocks.items() if b["status"] == "ok"} == {
         "mcd", "bootstrap", "streamed", "fused", "mcd_kernel", "de_train",
         "earlystop_waste", "compile", "program_audit", "data_plane",
-        "d2h_accounting", "quality"}, blocks
+        "d2h_accounting", "quality", "serve"}, blocks
     assert all(b["seconds"] >= 0 for b in blocks.values()), blocks
 
     # The printed line was assembled from the on-disk progress capture:
@@ -277,7 +288,10 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     kinds = {e["kind"] for e in events}
     assert {"run_started", "stage_start", "stage_end", "step",
             "ensemble_epoch", "ensemble_fit", "bench_throughput",
-            "bench_metric", "bench_block", "run_finished"} <= kinds, \
+            "bench_metric", "bench_block", "run_finished",
+            # The serving telemetry triple (ISSUE 15): the serve block
+            # streams its batch/request/SLO events into the same run log.
+            "serve_batch", "serve_request", "serve_slo"} <= kinds, \
         sorted(kinds)
     # Every block's outcome is mirrored into the run log as it happens.
     block_events = {e["name"]: e["status"] for e in events
@@ -380,7 +394,7 @@ def test_bench_cpu_proxy_end_to_end(tmp_path, capsys):
     # >= 3 ok blocks including compile, data-plane, audit (the
     # acceptance floor), plus the arithmetic D2H contract.
     for name in ("compile", "data_plane", "program_audit",
-                 "d2h_accounting", "quality"):
+                 "d2h_accounting", "quality", "serve"):
         assert statuses[name] == "ok", statuses
     # Device blocks are unavailable, not errors.
     for name in ("mcd", "bootstrap", "streamed", "fused", "de_train"):
@@ -388,6 +402,11 @@ def test_bench_cpu_proxy_end_to_end(tmp_path, capsys):
     compile_ctx = result["context"]["compile"]
     assert compile_ctx["warm"]["persistent_cache_misses"] == 0
     assert result["context"]["data_plane"]["rows"] == 256  # proxy shapes
+    # The serve block is backend-aware, not backend-gated: the proxy
+    # round still measures the coalescer (its pad_waste gates across
+    # the proxy boundary; the CPU latencies are marked backend-bound).
+    assert result["context"]["serve"]["requests"] == 64
+    assert 0.0 <= result["context"]["serve"]["pad_waste"] < 1.0
 
     # compare: clean against itself, gating a worsened relative metric,
     # and refusing absolute throughput across the proxy boundary.
@@ -789,6 +808,12 @@ def _stub_blocks(bench_mod, monkeypatch, *, fail=(), values=None):
         "quality", v("quality", {"ece": 0.01, "brier": 0.16,
                                  "self_max_psi": 0.0,
                                  "shifted_max_psi": 2.0})))
+    monkeypatch.setattr(bench_mod, "bench_serve", make(
+        "serve", v("serve", {"requests": 64, "windows": 160,
+                             "batches": 1, "p50_ms": 5.0, "p95_ms": 9.0,
+                             "p99_ms": 10.0, "windows_per_s": 2000.0,
+                             "queue_wait_mean_s": 0.001,
+                             "pad_waste": 0.375})))
 
 
 class TestMainDispatch:
@@ -812,7 +837,7 @@ class TestMainDispatch:
                   "BENCH_SKIP_FUSED", "BENCH_SKIP_MCD_KERNEL",
                   "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
-                  "BENCH_SKIP_QUALITY",
+                  "BENCH_SKIP_QUALITY", "BENCH_SKIP_SERVE",
                   "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
             monkeypatch.delenv(k, raising=False)
         _stub_blocks(bench_mod, monkeypatch)
@@ -832,10 +857,25 @@ class TestMainDispatch:
         assert ok == {"mcd", "bootstrap", "streamed", "fused", "mcd_kernel",
                       "de_train", "earlystop_waste", "compile",
                       "program_audit", "data_plane", "d2h_accounting",
-                      "quality"}
+                      "quality", "serve"}
         assert out["context"]["bootstrap_b100_m293k"] == {"speedup": 20.0}
+        assert out["context"]["serve"]["pad_waste"] == 0.375
         assert (out["secondary"]["context"]["early_stop_waste"]
                 == {"patience": 5})
+
+    def test_skip_serve_records_clean_skip(self, monkeypatch, capsys):
+        """ISSUE 15 satellite: BENCH_SKIP_SERVE=1 skips the serve block
+        cleanly — a skipped status with its reason in the v2 envelope,
+        no serve context value, and no serving telemetry emitted."""
+        monkeypatch.setenv("BENCH_SKIP_SERVE", "1")
+        out = self._run(capsys)
+        assert out["blocks"]["serve"] == {"status": "skipped",
+                                          "reason": "BENCH_SKIP_SERVE"}
+        assert out["context"]["serve"] is None
+        from apnea_uq_tpu import telemetry
+
+        events = telemetry.read_events(str(self.tmp_path / "bench_run"))
+        assert not any(e["kind"].startswith("serve_") for e in events)
 
     def test_skip_de_drops_secondary(self, monkeypatch, capsys):
         monkeypatch.setenv("BENCH_SKIP_DE", "1")
@@ -885,7 +925,7 @@ class TestBlockIsolation:
                   "BENCH_SKIP_FUSED", "BENCH_SKIP_MCD_KERNEL",
                   "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
-                  "BENCH_SKIP_QUALITY",
+                  "BENCH_SKIP_QUALITY", "BENCH_SKIP_SERVE",
                   "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
             monkeypatch.delenv(k, raising=False)
         self.bench_mod = bench_mod
@@ -984,7 +1024,7 @@ class TestBlockIsolation:
         all_blocks = ("mcd", "de_train", "bootstrap", "streamed", "fused",
                       "mcd_kernel", "earlystop_waste", "compile",
                       "program_audit", "data_plane", "d2h_accounting",
-                      "quality")
+                      "quality", "serve")
         _stub_blocks(self.bench_mod, monkeypatch)
         good = self._run_to_file(capsys, "good.json")
         _stub_blocks(self.bench_mod, monkeypatch, fail=all_blocks)

@@ -14,7 +14,8 @@ DOCS = [REPO / "README.md", REPO / "docs" / "MIGRATION.md",
         REPO / "docs" / "OBSERVABILITY.md", REPO / "docs" / "LINT.md",
         REPO / "docs" / "PIPELINE.md",
         REPO / "docs" / "BENCH_TRAJECTORY.md",
-        REPO / "docs" / "TOPOLOGY.md"]
+        REPO / "docs" / "TOPOLOGY.md",
+        REPO / "docs" / "SERVING.md"]
 
 # README "Environment": packages claimed absent at runtime.  The claim
 # rotted once (r2 verdict: sklearn/scipy imports on the prepare and

@@ -342,6 +342,16 @@ def test_package_gate_zero_unsuppressed_findings():
                 "apnea_uq_tpu/topo/manifest.py",
                 "apnea_uq_tpu/topo/cli.py",
                 "apnea_uq_tpu/utils/multihost.py",
+                # The online serving tier (ISSUE 15): the engine and the
+                # SLO tracker emit the documented serve_batch /
+                # serve_request / serve_slo kinds, and the stream scorer
+                # is a long-lived writer — all five modules must stay
+                # inside the bare-print / schema scan scope.
+                "apnea_uq_tpu/serving/coalescer.py",
+                "apnea_uq_tpu/serving/engine.py",
+                "apnea_uq_tpu/serving/slo.py",
+                "apnea_uq_tpu/serving/stream.py",
+                "apnea_uq_tpu/serving/loadgen.py",
                 # The out-of-core data plane (ISSUE 9): store shard I/O
                 # and the telemetry-emitting ingest/registry paths.
                 "apnea_uq_tpu/data/store.py",

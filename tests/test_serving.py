@@ -1,0 +1,1106 @@
+"""Online UQ serving tier (ISSUE 15): coalescer packing/overflow/pad
+accounting, SLO bookkeeping, the load generator's pacing, padded-bucket
+bit-parity against direct dispatch, the sliding-window stream scorer's
+re-windowing + kill -9-resumable ring state, the serve-metric compare
+directions (golden ``--json``), and the warm-serve acceptance bar:
+`apnea-uq warm-cache` then `apnea-uq serve` as real subprocesses, the
+serve process acquiring every bucket program from the store with zero
+fresh XLA compiles while a load-generated run records gateable
+``serve_slo`` events.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from apnea_uq_tpu.serving.coalescer import (  # noqa: E402
+    BucketLadder,
+    RequestCoalescer,
+    ServeRequest,
+)
+from apnea_uq_tpu.serving.slo import SLOTracker  # noqa: E402
+from apnea_uq_tpu.uq.predict import (  # noqa: E402
+    SERVE_BUCKET_SIZES,
+    SERVE_PROGRAM_LABELS,
+    serve_program_label,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _req(k, t=0.0, **kw):
+    return ServeRequest(
+        windows=np.zeros((k, 60, 4), np.float32), enqueue_t=t, **kw)
+
+
+# ------------------------------------------------------------ coalescer --
+
+
+class TestBucketLadder:
+    def test_smallest_fitting_bucket(self):
+        ladder = BucketLadder()
+        assert ladder.buckets == SERVE_BUCKET_SIZES
+        for rows, bucket in ((1, 16), (16, 16), (17, 64), (64, 64),
+                             (65, 256), (256, 256)):
+            assert ladder.bucket_for(rows) == bucket
+
+    def test_subset_ladder_sorts_and_validates(self):
+        assert BucketLadder((64, 16)).buckets == (16, 64)
+        with pytest.raises(ValueError, match="not registered"):
+            BucketLadder((16, 32))
+        with pytest.raises(ValueError, match="cannot be empty"):
+            BucketLadder(())
+
+    def test_oversized_batch_and_zero_rows_raise(self):
+        ladder = BucketLadder((16,))
+        with pytest.raises(ValueError, match="exceed the largest bucket"):
+            ladder.bucket_for(17)
+        with pytest.raises(ValueError, match=">= 1 row"):
+            ladder.bucket_for(0)
+
+
+class TestRequestCoalescer:
+    def test_partial_batch_waits_then_flushes(self):
+        c = RequestCoalescer()
+        c.enqueue(_req(3, t=100.0))
+        # Below max bucket and not overdue: keeps coalescing.
+        assert c.drain(now=100.0, max_wait_s=10.0) == []
+        assert c.pending_rows == 3
+        (plan,) = c.drain(now=100.0, flush=True)
+        assert plan.bucket == 16 and plan.rows == 3
+        assert plan.pad_rows == 13
+        assert plan.pad_waste == pytest.approx(13 / 16)
+        assert c.pending_rows == 0
+
+    def test_overdue_tail_dispatches_without_flush(self):
+        c = RequestCoalescer()
+        c.enqueue(_req(2, t=100.0))
+        (plan,) = c.drain(now=100.006, max_wait_s=0.005)
+        assert plan.rows == 2 and plan.bucket == 16
+        assert plan.queue_wait_s(100.006) == pytest.approx(0.006)
+
+    def test_full_bucket_drains_immediately(self):
+        c = RequestCoalescer()
+        for _ in range(4):
+            c.enqueue(_req(64, t=100.0))
+        plans = c.drain(now=100.0, max_wait_s=60.0)
+        assert [p.bucket for p in plans] == [256]
+        assert plans[0].rows == 256 and plans[0].pad_rows == 0
+
+    def test_oversized_request_spills_across_batches(self):
+        """Overflow spill: a request larger than the biggest bucket
+        splits FIFO across several max-bucket batches and completes only
+        when its LAST rows' batch returns."""
+        c = RequestCoalescer()
+        big = _req(600, t=1.0)
+        c.enqueue(big)
+        plans = c.drain(now=1.0, flush=True)
+        assert [p.rows for p in plans] == [256, 256, 88]
+        assert [p.bucket for p in plans] == [256, 256, 256]
+        assert big.batches == 3 and big.dispatched == 600
+        # The slice bookkeeping covers every row exactly once, in order.
+        spans = [(s, e) for p in plans for r, s, e in p.slices if r is big]
+        assert spans == [(0, 256), (256, 512), (512, 600)]
+        big.done = 599
+        assert not big.complete
+        big.done = 600
+        assert big.complete
+
+    def test_boundary_request_splits_and_keeps_fifo_order(self):
+        c = RequestCoalescer()
+        a, b = _req(200, t=1.0), _req(100, t=2.0)
+        c.enqueue(a)
+        c.enqueue(b)
+        plans = c.drain(now=2.0, flush=True)
+        assert [p.rows for p in plans] == [256, 44]
+        # Batch 1: all of a + b's head; batch 2: b's tail.
+        assert [(id(r), s, e) for r, s, e in plans[0].slices] == \
+            [(id(a), 0, 200), (id(b), 0, 56)]
+        assert [(id(r), s, e) for r, s, e in plans[1].slices] == \
+            [(id(b), 56, 100)]
+        assert plans[0].oldest_enqueue_t == 1.0
+        assert plans[1].oldest_enqueue_t == 2.0
+
+    def test_gather_stacks_planned_slices(self):
+        c = RequestCoalescer(BucketLadder((16,)))
+        a = ServeRequest(
+            windows=np.arange(3 * 60 * 4, dtype=np.float32).reshape(
+                3, 60, 4),
+            enqueue_t=0.0)
+        c.enqueue(a)
+        (plan,) = c.drain(now=0.0, flush=True)
+        assert np.array_equal(plan.gather(), a.windows)
+
+    def test_request_validation(self):
+        with pytest.raises(ValueError, match=r"\(k>=1, T, C\)"):
+            ServeRequest(windows=np.zeros((60, 4), np.float32),
+                         enqueue_t=0.0)
+        with pytest.raises(ValueError, match=r"\(k>=1, T, C\)"):
+            ServeRequest(windows=np.zeros((0, 60, 4), np.float32),
+                         enqueue_t=0.0)
+
+
+class TestSLOTracker:
+    def test_summary_percentiles_and_pad_accounting(self):
+        clock_now = [0.0]
+        slo = SLOTracker(lambda: clock_now[0])
+        for ms in (10, 20, 30, 40):
+            slo.record_request(latency_s=ms / 1e3)
+        slo.record_batch(bucket=16, rows=12, pad_rows=4,
+                         queue_wait_s=0.002, device_s=0.05)
+        slo.record_batch(bucket=64, rows=48, pad_rows=16,
+                         queue_wait_s=0.004, device_s=0.15)
+        clock_now[0] = 2.0
+        s = slo.summary()
+        assert s["requests"] == 4 and s["windows"] == 60
+        assert s["batches"] == 2
+        assert s["p50_ms"] == pytest.approx(25.0)
+        assert s["p99_ms"] == pytest.approx(39.7)
+        assert s["windows_per_s"] == pytest.approx(30.0)
+        assert s["queue_wait_mean_s"] == pytest.approx(0.003)
+        assert s["pad_waste"] == pytest.approx(20 / 80)
+        assert s["device_s"] == pytest.approx(0.2)
+
+    def test_empty_tracker_summary_has_undefined_percentiles(self):
+        """No completed requests (the stream-scorer shape) -> the
+        latency percentiles are None, NOT 0.0 — a zero would become a
+        gateable `serve.p50_ms` every real serve run regresses
+        against."""
+        s = SLOTracker(lambda: 1.0).summary()
+        assert s["requests"] == 0
+        assert s["p50_ms"] is None and s["p99_ms"] is None
+        assert s["pad_waste"] == 0.0
+
+    def test_history_is_bounded_counters_stay_exact(self):
+        """Long-lived process contract: the percentile sample history is
+        a bounded window while the session counters stay exact."""
+        from apnea_uq_tpu.serving import slo as slo_mod
+
+        tracker = SLOTracker(lambda: 1.0)
+        n = slo_mod.HISTORY_WINDOW + 50
+        for i in range(n):
+            tracker.record_request(latency_s=0.001 * (i + 1))
+        assert tracker.requests == n
+        assert len(tracker.latencies_s) == slo_mod.HISTORY_WINDOW
+        # The window dropped the OLDEST samples: p50 reflects the tail.
+        assert tracker.summary(now=2.0)["p50_ms"] > 0.05 * 1e3 / 2
+
+    def test_emit_appends_serve_slo_event(self, tmp_path):
+        from apnea_uq_tpu import telemetry
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        run_log = RunLog(str(tmp_path))
+        slo = SLOTracker(lambda: 1.0)
+        slo.record_request(latency_s=0.01)
+        slo.emit(run_log, final=False)
+        slo.emit(run_log, final=True, patients=3)
+        run_log.close()
+        events = [e for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "serve_slo"]
+        assert [e["final"] for e in events] == [False, True]
+        assert events[-1]["patients"] == 3
+        assert events[-1]["requests"] == 1
+
+
+# ------------------------------------------------------------- loadgen --
+
+
+class TestLoadgen:
+    def test_rate_paces_arrivals_open_loop(self):
+        from apnea_uq_tpu.serving.loadgen import synthetic_requests
+
+        now = [0.0]
+        sleeps = []
+
+        def clock():
+            return now[0]
+
+        def sleep(s):
+            sleeps.append(round(s, 6))
+            now[0] += s
+
+        reqs = list(synthetic_requests(
+            4, max_windows=2, seed=0, rate=10.0, clock=clock, sleep=sleep))
+        assert len(reqs) == 4
+        # Request i releases at i/rate on the fake clock — open loop.
+        assert sleeps == [0.1, 0.1, 0.1]
+        assert all(1 <= r.rows <= 2 for r in reqs)
+        # Seeded: the same stream regenerates bit-identically.
+        again = list(synthetic_requests(
+            4, max_windows=2, seed=0, rate=0.0, clock=clock))
+        assert [r.rows for r in again] == [r.rows for r in reqs]
+        assert np.array_equal(again[0].windows, reqs[0].windows)
+
+    def test_ndjson_requests_parse_and_validate(self, tmp_path):
+        from apnea_uq_tpu.serving.loadgen import ndjson_requests
+
+        path = tmp_path / "reqs.ndjson"
+        good = [[[float(c) for c in range(4)] for _t in range(60)]]
+        path.write_text(
+            json.dumps({"id": "r1", "windows": good}) + "\n"
+            + "\n"  # blank lines are skipped
+            + json.dumps({"windows": good, "patient": "P1"}) + "\n")
+        reqs = list(ndjson_requests(str(path)))
+        assert [r.request_id for r in reqs] == ["r1", "req-2"]
+        assert reqs[1].patient == "P1"
+        assert reqs[0].windows.shape == (1, 60, 4)
+        bad = tmp_path / "bad.ndjson"
+        bad.write_text(json.dumps({"windows": [[[0.0] * 4] * 59]}) + "\n")
+        with pytest.raises(ValueError, match="windows must be"):
+            list(ndjson_requests(str(bad)))
+
+
+# --------------------------------------------- engine (tiny model, CPU) --
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """Tiny model + serving engines for both methods (module-scoped so
+    the bucket programs compile once)."""
+    from apnea_uq_tpu.config import ModelConfig, UQConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.uq.predict import stack_member_variables
+
+    model = AlarconCNN1D(ModelConfig(
+        features=(4, 6), kernel_sizes=(3, 3), dropout_rates=(0.2, 0.3)))
+    variables = init_variables(model, jax.random.key(0))
+    return {
+        "model": model,
+        "variables": variables,
+        "members": stack_member_variables([variables] * 3),
+        "uq": UQConfig(mc_passes=3),
+    }
+
+
+def _engine(tiny, method="mcd", buckets=(16,), run_log=None, uq=None):
+    from apnea_uq_tpu.serving.engine import ServingEngine
+
+    carrier = tiny["variables"] if method == "mcd" else tiny["members"]
+    return ServingEngine(tiny["model"], carrier, method=method,
+                         uq=uq or tiny["uq"], buckets=buckets,
+                         run_log=run_log, seed=0)
+
+
+class TestServingEngine:
+    def test_parity_mode_mcd_is_rejected(self, tiny):
+        bad_uq = dataclasses.replace(tiny["uq"], mcd_mode="parity")
+        with pytest.raises(ValueError, match="mcd_mode='clean'"):
+            _engine(tiny, uq=bad_uq)
+
+    def test_empty_bucket_ladder_is_rejected_not_defaulted(self, tiny):
+        """`--buckets ""` parses to an empty tuple: the engine must
+        surface BucketLadder's cannot-be-empty error, never silently
+        serve the full ladder the caller tried to restrict."""
+        with pytest.raises(ValueError, match="cannot be empty"):
+            _engine(tiny, buckets=())
+
+    def test_label_grammar_matches_registry(self, tiny):
+        labels = {
+            serve_program_label(tiny["model"], method=m, bucket=b)
+            for m in ("mcd", "de") for b in SERVE_BUCKET_SIZES
+        }
+        assert labels == {lb for lb in SERVE_PROGRAM_LABELS
+                          if not lb.endswith("_bf16")}
+
+    def test_pad_slice_parity_de_vs_direct_dispatch(self, tiny):
+        """The acceptance bit-parity pin (f32): a padded-bucket DE score
+        equals a direct dispatch of the same windows at their exact row
+        count, bit for bit — pad rows cannot perturb real rows because
+        every window's compute is batch-neighbor-independent in the
+        serving regimes."""
+        from apnea_uq_tpu.uq.predict import _ensemble_stats_jit
+
+        rng = np.random.default_rng(0)
+        x5 = rng.normal(size=(5, 60, 4)).astype(np.float32)
+        eng = _engine(tiny, method="de")
+        padded = np.asarray(eng.score_batch(x5))
+        direct = np.asarray(_ensemble_stats_jit(
+            tiny["model"], tiny["members"], x5, 5, "nats", 1e-10))
+        assert padded.shape == (4, 5)
+        assert np.array_equal(padded, direct)
+
+    def test_pad_slice_parity_mcd_vs_direct_dispatch(self, tiny):
+        """MCD twin: same key, padded bucket vs exact-shape direct
+        dispatch AND vs a full bucket whose tail rows are other real
+        windows — the real columns are bit-identical in both."""
+        from apnea_uq_tpu.serving.engine import ServingEngine
+        from apnea_uq_tpu.uq.predict import (
+            _MCD_MODES,
+            _mcd_stats_jit,
+            serve_bucket_predict,
+        )
+        from apnea_uq_tpu.utils import prng
+
+        rng = np.random.default_rng(1)
+        x5 = rng.normal(size=(5, 60, 4)).astype(np.float32)
+        key = prng.stochastic_key(7)
+        pad = np.zeros((16, 60, 4), np.float32)
+        pad[:5] = x5
+        full = rng.normal(size=(16, 60, 4)).astype(np.float32)
+        full[:5] = x5
+        kw = dict(method="mcd", bucket=16, n_passes=3, key=key)
+        s_pad = np.asarray(serve_bucket_predict(
+            tiny["model"], tiny["variables"], pad, **kw))[:, :5]
+        s_full = np.asarray(serve_bucket_predict(
+            tiny["model"], tiny["variables"], full, **kw))[:, :5]
+        s_direct = np.asarray(_mcd_stats_jit(
+            tiny["model"], tiny["variables"], x5, key, 3,
+            _MCD_MODES["clean"], 5, "nats", 1e-10, None, "xla"))
+        assert np.array_equal(s_pad, s_full)
+        assert np.array_equal(s_pad, s_direct)
+        # And the engine's own dispatch discipline reproduces the same
+        # fold_in stream: a fresh engine's first dispatch uses fold_in 0.
+        eng = ServingEngine(tiny["model"], tiny["variables"], method="mcd",
+                            uq=tiny["uq"], buckets=(16,), seed=11)
+        first = np.asarray(eng.score_batch(x5))
+        eng2 = ServingEngine(tiny["model"], tiny["variables"],
+                             method="mcd", uq=tiny["uq"], buckets=(16,),
+                             seed=11)
+        assert np.array_equal(first, np.asarray(eng2.score_batch(x5)))
+        # Later dispatches fold fresh noise: same rows, different key.
+        assert not np.array_equal(first, np.asarray(eng.score_batch(x5)))
+
+    def test_warm_prices_every_ladder_bucket(self, tiny, tmp_path):
+        from apnea_uq_tpu import telemetry
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        run_log = RunLog(str(tmp_path))
+        eng = _engine(tiny, buckets=(16, 64), run_log=run_log)
+        eng.warm()
+        run_log.close()
+        priced = {e["label"] for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "memory_profile"}
+        assert priced == {"mcd_serve_b16_fused", "mcd_serve_b64_fused"}
+
+    def test_serve_requests_loop_events_and_rollup(self, tiny, tmp_path):
+        """The request-path loop end to end: per-request completion
+        (overflow spill included), the serving telemetry triple, and an
+        SLO summary that adds up."""
+        from apnea_uq_tpu import telemetry
+        from apnea_uq_tpu.serving.engine import serve_requests
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        run_log = RunLog(str(tmp_path))
+        eng = _engine(tiny, run_log=run_log)  # ladder (16,): max bucket 16
+        rng = np.random.default_rng(2)
+        reqs = [ServeRequest(
+            windows=rng.normal(size=(k, 60, 4)).astype(np.float32),
+            enqueue_t=0.0, request_id=f"r{i}")
+            for i, k in enumerate((3, 20, 1))]
+        got = {}
+        summary = serve_requests(
+            eng, iter(reqs), max_wait_s=0.0, slo_every=1,
+            on_result=lambda req, stats, start: got.setdefault(
+                req.request_id, []).append(np.asarray(stats)))
+        assert summary["requests"] == 3 and summary["windows"] == 24
+        run_log.close()
+        events = telemetry.read_events(str(tmp_path))
+        by_kind = {}
+        for e in events:
+            by_kind.setdefault(e["kind"], []).append(e)
+        # r1 (20 rows > max bucket 16) spilled across two batches.
+        req_events = {e["request_id"]: e for e in by_kind["serve_request"]}
+        assert req_events["r1"]["batches"] == 2
+        assert req_events["r1"]["windows"] == 20
+        assert sum(np.concatenate(got["r1"], axis=1).shape[1:2]) == 20
+        batches = by_kind["serve_batch"]
+        assert sum(e["rows"] for e in batches) == 24
+        assert all(e["bucket"] == 16 for e in batches)
+        assert all(e["retraces"] == 0 for e in batches[1:])
+        final = by_kind["serve_slo"][-1]
+        assert final["final"] is True
+        assert final["requests"] == 3 and final["windows"] == 24
+        assert 0.0 <= final["pad_waste"] < 1.0
+        assert final["p99_ms"] >= final["p50_ms"] > 0
+
+    def test_max_wait_deadline_holds_on_quiet_source(self, tiny):
+        """The coalescing deadline must fire on the idle poll, not on
+        the next arrival: a request followed by a long source stall
+        completes within ~max_wait_s, not after the stall."""
+        import time as time_mod
+
+        from apnea_uq_tpu.serving.engine import serve_requests
+
+        eng = _engine(tiny)
+        eng.warm()
+        rng = np.random.default_rng(7)
+        stall_s = 1.0
+
+        def quiet_source():
+            yield ServeRequest(
+                windows=rng.normal(size=(2, 60, 4)).astype(np.float32),
+                enqueue_t=time_mod.perf_counter(), request_id="lone")
+            time_mod.sleep(stall_s)
+
+        t0 = time_mod.perf_counter()
+        latencies = []
+        summary = serve_requests(
+            eng, quiet_source(), max_wait_s=0.02,
+            on_result=lambda req, stats, start: latencies.append(
+                time_mod.perf_counter() - req.enqueue_t))
+        assert summary["requests"] == 1
+        # Scored mid-stall (deadline + dispatch), not at source end.
+        assert latencies[0] < stall_s / 2, latencies
+        # The loop itself still waited for the source to finish.
+        assert time_mod.perf_counter() - t0 >= stall_s
+
+    def test_source_exception_propagates_from_pump(self, tiny):
+        from apnea_uq_tpu.serving.engine import serve_requests
+
+        eng = _engine(tiny)
+
+        def bad_source():
+            yield _req(2, t=0.0)
+            raise ValueError("malformed request line 7")
+
+        with pytest.raises(ValueError, match="malformed request line 7"):
+            serve_requests(eng, bad_source(), max_wait_s=0.0)
+
+
+# ------------------------------------------------------- stream scorer --
+
+
+def _stream_lines(patients, n_samples, channels=4):
+    rng = np.random.default_rng(5)
+    for t in range(n_samples):
+        for pid in patients:
+            yield json.dumps({
+                "patient": pid, "t": float(t),
+                "v": [float(v) for v in rng.normal(size=channels)],
+            })
+
+
+class TestStreamScorer:
+    def _scorer(self, tiny, tmp_path, hop=60, run_log=None):
+        from apnea_uq_tpu.serving.stream import StreamScorer
+
+        return StreamScorer(
+            _engine(tiny, run_log=run_log),
+            state_dir=str(tmp_path / "state"),
+            out_path=str(tmp_path / "out.ndjson"), hop=hop,
+            run_log=run_log)
+
+    def test_hop_rewindowing_counts(self, tiny, tmp_path):
+        scorer = self._scorer(tiny, tmp_path, hop=30)
+        summary = scorer.run(_stream_lines(("p1",), 150))
+        # 150 samples, window 60, hop 30 -> starts at 0/30/60/90: 4.
+        assert summary["windows"] == 4
+        rows = [json.loads(line)
+                for line in open(tmp_path / "out.ndjson")]
+        assert [r["start_t"] for r in rows] == [0.0, 30.0, 60.0, 90.0]
+        assert all(r["patient"] == "p1" for r in rows)
+        for r in rows:
+            assert 0.0 <= r["mean_prob"] <= 1.0
+            assert r["mutual_info"] >= 0.0
+            assert r["total_entropy"] >= r["aleatoric_entropy"] - 1e-6
+
+    def test_malformed_and_wrong_channel_lines_skip(self, tiny, tmp_path):
+        scorer = self._scorer(tiny, tmp_path)
+        lines = list(_stream_lines(("p1",), 60))
+        lines.insert(10, "not json {")
+        lines.insert(20, json.dumps({"patient": "p1", "t": 9.5,
+                                     "v": [1.0, 2.0]}))  # 2 channels
+        lines.insert(30, json.dumps({"no": "fields"}))
+        summary = scorer.run(iter(lines))
+        assert summary["windows"] == 1  # the 60 good samples: one window
+
+    def test_resume_dedupes_replayed_samples(self, tiny, tmp_path):
+        lines = list(_stream_lines(("p1", "p2"), 130))
+        scorer = self._scorer(tiny, tmp_path)
+        first = scorer.run(iter(lines))
+        assert first["windows"] == 4  # 2 windows x 2 patients
+        # Same stream replayed into a FRESH scorer over the same state
+        # dir: every sample is t <= last_t -> no new windows, rollups
+        # keep their counts.
+        resumed = self._scorer(tiny, tmp_path)
+        assert resumed.patients["p1"].windows_scored == 2
+        second = resumed.run(iter(lines))
+        assert second["windows"] == 0
+
+    def test_max_pending_age_flushes_partial_batch(self, tiny, tmp_path):
+        """The live-stream latency bound: a slow feed's pending windows
+        score once the oldest has waited max_pending_s, instead of
+        stalling for a full max bucket."""
+        import time as time_mod
+
+        scorer = self._scorer(tiny, tmp_path)  # ladder (16,)
+        lines = list(_stream_lines(("p1",), 61))  # 2 windows w/ hop 60?
+
+        def slow_lines():
+            # First 60 samples complete window 0; the tail heartbeats
+            # (blank lines, as follow mode emits on idle polls) age the
+            # pending window past the bound.
+            yield from lines[:60]
+            deadline = time_mod.monotonic() + 2.0
+            while time_mod.monotonic() < deadline:
+                if scorer.patients.get("p1") is not None \
+                        and scorer.patients["p1"].windows_scored:
+                    return  # flushed by age — stop the stream
+                yield ""
+                time_mod.sleep(0.02)
+
+        summary = scorer.run(slow_lines(), max_pending_s=0.1)
+        assert summary["windows"] == 1
+        assert scorer.patients["p1"].windows_scored == 1
+
+    def test_state_shape_mismatch_refuses_resume(self, tiny, tmp_path):
+        scorer = self._scorer(tiny, tmp_path, hop=60)
+        scorer.run(_stream_lines(("p1",), 60))
+        with pytest.raises(ValueError, match="window=60/hop=60"):
+            self._scorer(tiny, tmp_path, hop=30)
+
+    def test_file_follow_holds_back_partial_lines(self, tmp_path):
+        """A tailed read racing the writer mid-append must hold the
+        partial line until its newline lands — yielding the fragment
+        would split one sample into two json-failing bogus lines."""
+        import threading
+        import time as time_mod
+
+        path = tmp_path / "tail.ndjson"
+        path.write_text('{"t": 1}\n{"t": ')  # second line mid-append
+
+        def finish_write():
+            time_mod.sleep(0.15)
+            with open(path, "a") as fh:
+                fh.write('2}\n')
+
+        from apnea_uq_tpu.serving.stream import read_sample_lines
+
+        th = threading.Thread(target=finish_write)
+        th.start()
+        lines = list(read_sample_lines(str(path), follow=True,
+                                       max_idle_s=0.5, poll_s=0.05))
+        th.join()
+        # Idle polls interleave empty heartbeat lines (process_line
+        # no-ops); the real lines must come through whole.
+        assert [line.strip() for line in lines if line.strip()] == \
+            ['{"t": 1}', '{"t": 2}']
+
+    def test_stream_run_dir_has_no_gateable_latency_percentiles(
+        self, tiny, tmp_path
+    ):
+        """A score --stream run completes no requests: its serve_slo
+        must not hand compare a 0.0 p50/p99 every real serve run would
+        'regress' against."""
+        from apnea_uq_tpu import telemetry
+        from apnea_uq_tpu.telemetry import compare as compare_mod
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        run_dir = tmp_path / "stream_run"
+        run_log = RunLog(str(run_dir))
+        scorer = self._scorer(tiny, tmp_path, run_log=run_log)
+        scorer.slo = type(scorer.slo)()  # fresh tracker under this log
+        scorer.run(_stream_lines(("p1",), 60))
+        run_log.close()
+        final = [e for e in telemetry.read_events(str(run_dir))
+                 if e["kind"] == "serve_slo"][-1]
+        assert final["p50_ms"] is None and final["p99_ms"] is None
+        metrics = compare_mod._metrics_from_events(
+            telemetry.read_events(str(run_dir)))
+        assert "serve.p50_ms" not in metrics
+        assert "serve.p99_ms" not in metrics
+        assert "serve.windows_per_s" in metrics
+
+    def test_stdin_follow_honors_idle_timeout(self, monkeypatch):
+        """--follow on `-` must exit after max_idle_s of pipe silence
+        (select-polled), not block forever on a quiet stdin."""
+        import sys as sys_mod
+        import time as time_mod
+
+        from apnea_uq_tpu.serving.stream import read_sample_lines
+
+        r, w = os.pipe()
+        reader = os.fdopen(r, encoding="utf-8")
+        try:
+            os.write(w, b'{"a": 1}\n{"b": 2}\n')
+            monkeypatch.setattr(sys_mod, "stdin", reader)
+            t0 = time_mod.monotonic()
+            lines = list(read_sample_lines(
+                "-", follow=True, max_idle_s=0.3, poll_s=0.05))
+            elapsed = time_mod.monotonic() - t0
+            assert [line.strip() for line in lines if line.strip()] == \
+                ['{"a": 1}', '{"b": 2}']
+            assert 0.3 <= elapsed < 5.0  # returned on idle, not EOF
+        finally:
+            os.close(w)
+            reader.close()
+
+    def test_stdin_nonfollow_eof_flushes_partial_and_heartbeats(
+        self, monkeypatch
+    ):
+        """Non-follow stdin reads the raw fd too: a pausing pipe emits
+        heartbeats (the time-based flush stays live) and a closed pipe
+        flushes the final unterminated line."""
+        import sys as sys_mod
+        import threading
+        import time as time_mod
+
+        from apnea_uq_tpu.serving.stream import read_sample_lines
+
+        r, w = os.pipe()
+        reader = os.fdopen(r, encoding="utf-8")
+        try:
+            os.write(w, b'{"a": 1}\n{"tail": ')  # partial, no newline
+
+            def close_later():
+                time_mod.sleep(0.2)
+                os.write(w, b"2}")  # still unterminated...
+                os.close(w)         # ...then EOF
+
+            th = threading.Thread(target=close_later)
+            th.start()
+            monkeypatch.setattr(sys_mod, "stdin", reader)
+            lines = list(read_sample_lines("-", follow=False,
+                                           poll_s=0.05))
+            th.join()
+            real = [line.strip() for line in lines if line.strip()]
+            assert real == ['{"a": 1}', '{"tail": 2}']
+            assert "" in lines  # the pause emitted heartbeats
+        finally:
+            reader.close()
+
+    def test_bad_hop_and_window_rejected(self, tiny, tmp_path):
+        from apnea_uq_tpu.serving.stream import StreamScorer
+
+        with pytest.raises(ValueError, match="hop must be >= 1"):
+            self._scorer(tiny, tmp_path, hop=0)
+        with pytest.raises(ValueError, match="match the model's"):
+            StreamScorer(_engine(tiny), state_dir=str(tmp_path),
+                         out_path=str(tmp_path / "o"), window=30)
+
+    def test_kill9_mid_stream_leaves_resumable_ring_state(self, tmp_path):
+        """The crash contract, with a REAL SIGKILL: a subprocess scorer
+        kills itself -9 right after its second state commit (mid-stream,
+        windows still pending); re-feeding the same stream resumes from
+        the committed ring state and every window ends up scored — no
+        gaps, duplicates only for the at-least-once overlap."""
+        n_samples, hop = 140, 1
+        input_path = tmp_path / "stream.ndjson"
+        input_path.write_text(
+            "\n".join(_stream_lines(("p1",), n_samples)) + "\n")
+        state_dir = tmp_path / "state"
+        out_path = tmp_path / "out.ndjson"
+        script = tmp_path / "killer.py"
+        script.write_text(f"""
+import os, signal, sys
+sys.path.insert(0, {str(REPO)!r})
+import jax
+from apnea_uq_tpu.config import ModelConfig, UQConfig
+from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+from apnea_uq_tpu.serving.engine import ServingEngine
+from apnea_uq_tpu.serving.stream import StreamScorer
+
+model = AlarconCNN1D(ModelConfig(features=(4, 6), kernel_sizes=(3, 3),
+                                 dropout_rates=(0.2, 0.3)))
+variables = init_variables(model, jax.random.key(0))
+engine = ServingEngine(model, variables, method="mcd",
+                       uq=UQConfig(mc_passes=2), buckets=(16,))
+scorer = StreamScorer(engine, state_dir={str(state_dir)!r},
+                      out_path={str(out_path)!r}, hop={hop})
+flushes = [0]
+orig = scorer._flush_pending
+def kill_after_two():
+    orig()
+    flushes[0] += 1
+    if flushes[0] == 2:
+        os.kill(os.getpid(), signal.SIGKILL)
+scorer._flush_pending = kill_after_two
+# max_pending_s pinned huge: the kill point must be exactly the 2nd
+# FULL-bucket flush, not an age-triggered partial one.
+scorer.run(open({str(input_path)!r}), max_pending_s=1e9)
+raise SystemExit("unreachable: the kill must fire mid-stream")
+""")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, str(script)], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == -signal.SIGKILL, (
+            proc.returncode, proc.stderr[-2000:])
+        # The committed snapshot survived the kill and is loadable.
+        state = json.loads(
+            (state_dir / "stream_state.json").read_text())
+        scored_before = state["patients"]["p1"]["windows_scored"]
+        assert state["version"] == 1 and scored_before == 32  # 2 x b16
+        rows_before = sum(1 for _ in open(out_path))
+        assert rows_before >= scored_before
+
+        # Resume IN-PROCESS over the same stream: the ring state picks
+        # up where the last commit left off and the tail gets scored.
+        from apnea_uq_tpu.config import ModelConfig, UQConfig
+        from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+        from apnea_uq_tpu.serving.engine import ServingEngine
+        from apnea_uq_tpu.serving.stream import StreamScorer
+
+        model = AlarconCNN1D(ModelConfig(
+            features=(4, 6), kernel_sizes=(3, 3),
+            dropout_rates=(0.2, 0.3)))
+        engine = ServingEngine(
+            model, init_variables(model, jax.random.key(0)),
+            method="mcd", uq=UQConfig(mc_passes=2), buckets=(16,))
+        scorer = StreamScorer(engine, state_dir=str(state_dir),
+                              out_path=str(out_path), hop=hop)
+        scorer.run(open(input_path))
+        expected = n_samples - 60 + 1  # hop=1 sliding windows
+        assert scorer.patients["p1"].windows_scored == expected
+        starts = {json.loads(line)["start_t"]
+                  for line in open(out_path)}
+        # No gaps: every window start is covered at least once.
+        assert starts == {float(t) for t in range(expected)}
+
+
+# ------------------------------------- compare directions (golden json) --
+
+
+class TestServeMetricGating:
+    def _run_dir(self, path, slo, proxy=False):
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        os.makedirs(path, exist_ok=True)
+        run_log = RunLog(str(path))
+        run_log.event("run_started", schema_version=1)
+        if proxy:
+            run_log.event("bench_mode", proxy=True)
+        run_log.event("serve_slo", **{**slo, "final": True})
+        run_log.event("run_finished", status="ok")
+        run_log.close()
+        return str(path)
+
+    SLO = {"requests": 100, "windows": 250, "batches": 4, "p50_ms": 5.0,
+           "p95_ms": 9.0, "p99_ms": 12.0, "windows_per_s": 5000.0,
+           "queue_wait_mean_s": 0.002, "pad_waste": 0.1}
+
+    def test_directions_and_bounds(self, tmp_path):
+        from apnea_uq_tpu.telemetry import compare as compare_mod
+
+        metrics = compare_mod.load_metrics(
+            self._run_dir(tmp_path / "a", self.SLO))
+        for name in ("serve.p50_ms", "serve.p95_ms", "serve.p99_ms",
+                     "serve.queue_wait_mean_s", "serve.pad_waste"):
+            assert metrics[name].higher_better is False, name
+        assert metrics["serve.windows_per_s"].higher_better is True
+        # Absolute latencies/throughput are backend-bound; the pad-waste
+        # ratio gates everywhere.
+        for name in ("serve.p50_ms", "serve.p95_ms", "serve.p99_ms",
+                     "serve.windows_per_s", "serve.queue_wait_mean_s"):
+            assert metrics[name].backend_bound is True, name
+        assert metrics["serve.pad_waste"].backend_bound is False
+
+    def test_last_snapshot_wins(self, tmp_path):
+        from apnea_uq_tpu.telemetry import compare as compare_mod
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        path = tmp_path / "snap"
+        os.makedirs(path)
+        run_log = RunLog(str(path))
+        run_log.event("run_started", schema_version=1)
+        run_log.event("serve_slo", **{**self.SLO, "p99_ms": 50.0,
+                                      "final": False})
+        run_log.event("serve_slo", **{**self.SLO, "final": True})
+        run_log.close()
+        assert compare_mod.load_metrics(
+            str(path))["serve.p99_ms"].value == 12.0
+
+    def test_gate_fails_on_worsened_latency_golden_json(
+        self, tmp_path, capsys
+    ):
+        from apnea_uq_tpu.cli.main import main as cli_main
+
+        base = self._run_dir(tmp_path / "base", self.SLO)
+        worse = self._run_dir(
+            tmp_path / "worse",
+            {**self.SLO, "p99_ms": 24.0, "windows_per_s": 2000.0})
+        assert cli_main(["telemetry", "compare", base, base]) == 0
+        capsys.readouterr()
+        assert cli_main(["telemetry", "compare", base, worse,
+                         "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        verdicts = {d["name"]: d["regressed"] for d in doc["deltas"]}
+        assert verdicts["serve.p99_ms"] is True
+        assert verdicts["serve.windows_per_s"] is True
+        assert verdicts["serve.pad_waste"] is False
+        assert doc["regressed"] is True
+
+    def test_proxy_boundary_gates_only_pad_waste(self, tmp_path, capsys):
+        """CPU-proxy rounds gate only the relative serving metric: the
+        absolute latencies are refused across the boundary (golden
+        ``--json``)."""
+        from apnea_uq_tpu.cli.main import main as cli_main
+
+        device = self._run_dir(tmp_path / "device", self.SLO)
+        proxy = self._run_dir(
+            tmp_path / "proxy",
+            {**self.SLO, "p99_ms": 9000.0, "pad_waste": 0.5},
+            proxy=True)
+        assert cli_main(["telemetry", "compare", device, proxy,
+                         "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        names = {d["name"] for d in doc["deltas"]}
+        assert names == {"serve.pad_waste"}
+        assert doc["deltas"][0]["regressed"] is True
+        for bound in ("serve.p50_ms", "serve.p99_ms",
+                      "serve.windows_per_s", "serve.queue_wait_mean_s"):
+            assert bound in doc["skipped_backend_bound"]
+
+    def test_bench_context_serve_block_extracts(self, tmp_path):
+        from apnea_uq_tpu.telemetry import compare as compare_mod
+
+        payload = {
+            "metric": "mcd_t50_inference_throughput", "value": 100.0,
+            "unit": "windows/sec/chip", "vs_baseline": 1.0,
+            "schema": 2, "proxy": False,
+            "context": {"serve": dict(self.SLO)},
+        }
+        path = tmp_path / "round.json"
+        path.write_text(json.dumps(payload))
+        metrics = compare_mod.load_metrics(str(path))
+        assert metrics["serve.p99_ms"].value == 12.0
+        assert metrics["serve.p99_ms"].backend_bound is True
+        assert metrics["serve.pad_waste"].backend_bound is False
+
+    def test_trend_carries_serve_series(self, tmp_path):
+        from apnea_uq_tpu.telemetry import trend as trend_mod
+
+        a = self._run_dir(tmp_path / "runs" / "serve-1", self.SLO)
+        b = self._run_dir(tmp_path / "runs" / "serve-2",
+                          {**self.SLO, "pad_waste": 0.3})
+        traj = trend_mod.build_trajectory(
+            [trend_mod.load_round(a), trend_mod.load_round(b)])
+        by_name = {m.name: m for m in traj.metrics}
+        waste = by_name["serve.pad_waste"]
+        assert waste.values == [0.1, 0.3]
+        assert waste.best == 0.1 and waste.latest == 0.3
+        assert waste.regressed  # +200% vs best at lower-is-better
+        assert by_name["serve.p50_ms"].values == [5.0, 5.0]
+
+
+# ------------------------------- warm-serve acceptance (subprocesses) --
+
+
+@pytest.fixture(scope="module")
+def serving_registry(tmp_path_factory):
+    """Tiny registry with a trained baseline checkpoint (in-process CLI,
+    the test_compilecache pattern) for the subprocess acceptance runs."""
+    from apnea_uq_tpu.cli.main import main
+    from apnea_uq_tpu.config import (
+        EnsembleConfig,
+        ExperimentConfig,
+        ModelConfig,
+        PrepareConfig,
+        TrainConfig,
+        UQConfig,
+        _to_jsonable,
+    )
+    from apnea_uq_tpu.data import WindowSet
+    from apnea_uq_tpu.data import registry as reg
+    from apnea_uq_tpu.data.registry import ArtifactRegistry
+
+    root = tmp_path_factory.mktemp("serving_cli")
+    registry_dir = str(root / "registry")
+    rng = np.random.default_rng(0)
+    n = 320
+    y = rng.integers(0, 2, n).astype(np.int8)
+    x = rng.normal(size=(n, 60, 4)).astype(np.float32)
+    x[:, :, 0] += (y.astype(np.float32) * 2 - 1)[:, None] * 1.2
+    windows = WindowSet(
+        x=x, y=y,
+        patient_ids=np.array([f"P{i % 8:03d}" for i in range(n)]),
+        start_time_s=np.arange(n, dtype=np.int32) * 60,
+        channels=("SaO2", "PR", "THOR RES", "ABDO RES"),
+    )
+    ArtifactRegistry(registry_dir).save_arrays(reg.WINDOWS,
+                                               windows.to_arrays())
+    config = ExperimentConfig(
+        model=ModelConfig(features=(4, 6), kernel_sizes=(3, 3),
+                          dropout_rates=(0.2, 0.3)),
+        train=TrainConfig(batch_size=64, num_epochs=1,
+                          validation_split=0.1, seed=1),
+        ensemble=EnsembleConfig(num_members=2, num_epochs=1,
+                                batch_size=64, seed_base=2025),
+        uq=UQConfig(mc_passes=4, n_bootstrap=10,
+                    inference_batch_size=128),
+        prepare=PrepareConfig(smote=False),
+    )
+    config_path = str(root / "config.json")
+    with open(config_path, "w") as f:
+        json.dump(_to_jsonable(config), f)
+    assert main(["prepare", "--registry", registry_dir,
+                 "--config", config_path]) == 0
+    assert main(["train", "--registry", registry_dir,
+                 "--config", config_path]) == 0
+    return {"root": root, "registry": registry_dir, "config": config_path}
+
+
+def _subprocess_env():
+    """Clean serving-subprocess environment: CPU backend, no ambient
+    cache overrides — warm-cache and serve must share the registry's
+    own xla-cache/program-store for the zero-compile contract to mean
+    anything."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_COMPILATION_CACHE_DIR",
+                        "APNEA_UQ_XLA_CACHE_DIR",
+                        "APNEA_UQ_PROGRAM_STORE_DIR",
+                        "APNEA_UQ_SOURCE_VERSION",
+                        "XLA_FLAGS")
+           and not k.startswith("BENCH_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_warm_cache_then_serve_second_process(serving_registry):
+    """ISSUE 15 acceptance: `apnea-uq warm-cache --programs serve` then
+    `apnea-uq serve --loadgen` as real subprocesses — the serve process
+    acquires every bucket program it dispatches from the store/cache
+    with ZERO fresh XLA compiles (the PR-6 contract extended to the
+    request path), and the load-generated run records p50/p99/
+    windows-per-sec `serve_slo` events `telemetry compare` can gate."""
+    from apnea_uq_tpu import telemetry
+    from apnea_uq_tpu.cli.main import main as cli_main
+
+    env = _subprocess_env()
+    registry_dir = serving_registry["registry"]
+    config = serving_registry["config"]
+    warm_dir = str(serving_registry["root"] / "warm_run")
+    proc = subprocess.run(
+        [sys.executable, "-m", "apnea_uq_tpu.cli.main", "warm-cache",
+         "--registry", registry_dir, "--config", config,
+         "--programs", "serve", "--run-dir", warm_dir],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    warm_labels = {e["label"]
+                   for e in telemetry.read_events(warm_dir)
+                   if e["kind"] == "compile_event"}
+    # The config runs f32: every f32 ladder cell, both methods.
+    assert warm_labels == {lb for lb in SERVE_PROGRAM_LABELS
+                           if not lb.endswith("_bf16")}
+
+    serve_dir = str(serving_registry["root"] / "serve_run")
+    proc = subprocess.run(
+        [sys.executable, "-m", "apnea_uq_tpu.cli.main", "serve",
+         "--registry", registry_dir, "--config", config,
+         "--loadgen", "40", "--slo-every", "10",
+         "--run-dir", serve_dir],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    events = telemetry.read_events(serve_dir)
+    compiles = [e for e in events if e["kind"] == "compile_event"]
+    assert compiles, "serve emitted no compile events"
+    for e in compiles:
+        assert e["source"] in ("store", "cache"), e
+        assert e["persistent_cache_misses"] == 0, e
+    # The dispatched batches themselves ran prebuilt executables: zero
+    # compiles, zero retraces on the request path.
+    batches = [e for e in events if e["kind"] == "serve_batch"]
+    assert batches
+    for e in batches:
+        assert e["backend_compiles"] == 0, e
+        assert e["retraces"] == 0, e
+        assert e["label"].startswith("mcd_serve_b")
+    requests = [e for e in events if e["kind"] == "serve_request"]
+    assert len(requests) == 40
+    slos = [e for e in events if e["kind"] == "serve_slo"]
+    assert slos and slos[-1]["final"] is True
+    final = slos[-1]
+    assert final["requests"] == 40
+    assert final["p50_ms"] > 0 and final["p99_ms"] >= final["p50_ms"]
+    assert final["windows_per_s"] > 0
+    assert final["windows"] == sum(e["windows"] for e in requests)
+
+    # ... and the run is gateable: clean against itself, exit 1 when a
+    # copy's final SLO worsens past threshold.
+    assert cli_main(["telemetry", "compare", serve_dir, serve_dir]) == 0
+    worse_dir = serving_registry["root"] / "serve_worse"
+    worse_dir.mkdir()
+    lines = []
+    with open(os.path.join(serve_dir, "events.jsonl")) as fh:
+        for line in fh:
+            e = json.loads(line)
+            if e.get("kind") == "serve_slo" and e.get("final"):
+                e["p99_ms"] = e["p99_ms"] * 3
+                e["windows_per_s"] = e["windows_per_s"] / 2
+            lines.append(json.dumps(e))
+    (worse_dir / "events.jsonl").write_text("\n".join(lines) + "\n")
+    assert cli_main(["telemetry", "compare", serve_dir,
+                     str(worse_dir)]) == 1
+
+
+def test_serve_rejects_conflicting_request_sources(serving_registry,
+                                                   tmp_path):
+    """--loadgen and --input together must error, not silently prefer
+    one — the operator would believe their NDJSON requests were scored."""
+    from apnea_uq_tpu.cli.main import main as cli_main
+
+    with pytest.raises(SystemExit, match="ONE request source"):
+        cli_main([
+            "serve", "--registry", serving_registry["registry"],
+            "--config", serving_registry["config"], "--loadgen", "2",
+            "--input", str(tmp_path / "reqs.ndjson"),
+            "--run-dir", str(tmp_path / "run"),
+        ])
+
+
+def test_serve_out_writes_decomposition_rows(serving_registry, tmp_path):
+    """`apnea-uq serve --out`: the scoring-API output — one NDJSON
+    decomposition row per scored window, keyed by request id + window
+    index (spilled requests included)."""
+    from apnea_uq_tpu.cli.main import main as cli_main
+
+    out = tmp_path / "scores.ndjson"
+    rc = cli_main([
+        "serve", "--registry", serving_registry["registry"],
+        "--config", serving_registry["config"], "--loadgen", "6",
+        "--out", str(out), "--run-dir", str(tmp_path / "run"),
+    ])
+    assert rc == 0
+    rows = [json.loads(line) for line in open(out)]
+    assert {r["id"] for r in rows} == {f"loadgen-{i}" for i in range(6)}
+    by_id = {}
+    for r in rows:
+        by_id.setdefault(r["id"], []).append(r["window"])
+        assert 0.0 <= r["mean_prob"] <= 1.0
+        assert r["mutual_info"] >= 0.0
+    # Every request's windows are covered exactly once, 0..k-1.
+    for rid, windows in by_id.items():
+        assert sorted(windows) == list(range(len(windows))), (rid, windows)
+
+
+def test_score_stream_cli_end_to_end(serving_registry, tmp_path):
+    """`apnea-uq score --stream` through the real CLI: per-sample NDJSON
+    in, per-window decomposition NDJSON out, resumable state committed,
+    and the final serve_slo carrying the patient count."""
+    from apnea_uq_tpu import telemetry
+    from apnea_uq_tpu.cli.main import main as cli_main
+
+    input_path = tmp_path / "samples.ndjson"
+    input_path.write_text(
+        "\n".join(_stream_lines(("pA", "pB"), 70)) + "\n")
+    out_path = tmp_path / "scored.ndjson"
+    state_dir = tmp_path / "state"
+    run_dir = tmp_path / "score_run"
+    rc = cli_main([
+        "score", "--registry", serving_registry["registry"],
+        "--config", serving_registry["config"], "--stream",
+        "--input", str(input_path), "--out", str(out_path),
+        "--state-dir", str(state_dir), "--hop", "60",
+        "--run-dir", str(run_dir),
+    ])
+    assert rc == 0
+    rows = [json.loads(line) for line in open(out_path)]
+    assert {r["patient"] for r in rows} == {"pA", "pB"}
+    assert all(r["start_t"] == 0.0 for r in rows)
+    assert (state_dir / "stream_state.json").exists()
+    slos = [e for e in telemetry.read_events(str(run_dir))
+            if e["kind"] == "serve_slo"]
+    assert slos[-1]["patients"] == 2
+    assert slos[-1]["windows"] == 2
