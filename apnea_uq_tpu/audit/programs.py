@@ -125,12 +125,17 @@ def capture_zoo(config, *, groups: Tuple[str, ...] = WARM_GROUPS,
             mesh = make_mesh_from_config(config.mesh,
                                          num_members=AUDIT_MEMBERS)
             for dmodel in dtype_models:
-                for stats in (None, stat_spec):
-                    common = dict(batch_size=AUDIT_BATCH, mesh=mesh,
-                                  record_memory_only=True, stats=stats)
-                    ensemble_predict(dmodel, members, x_aval, **common)
-                    ensemble_predict_streaming(dmodel, members, x_aval,
-                                               **common)
+                # Engine sweep mirrors eval-mcd: the DE `_pallas` labels
+                # lower their CPU fallback body (resolve_de_engine — the
+                # audit runs off-TPU by design).
+                for engine in ("xla", "pallas"):
+                    for stats in (None, stat_spec):
+                        common = dict(batch_size=AUDIT_BATCH, mesh=mesh,
+                                      record_memory_only=True, stats=stats,
+                                      engine=engine)
+                        ensemble_predict(dmodel, members, x_aval, **common)
+                        ensemble_predict_streaming(dmodel, members, x_aval,
+                                                   **common)
 
         if "serve" in groups:
             # The serving bucket ladder (uq/predict.py
@@ -146,15 +151,17 @@ def capture_zoo(config, *, groups: Tuple[str, ...] = WARM_GROUPS,
                 for bucket in SERVE_BUCKET_SIZES:
                     bucket_aval = jax.ShapeDtypeStruct(
                         (bucket,) + AUDIT_WINDOW_SHAPE, jnp.float32)
-                    serve_bucket_predict(
-                        dmodel, variables, bucket_aval, method="mcd",
-                        bucket=bucket, n_passes=AUDIT_PASSES, key=key,
-                        record_memory_only=True,
-                    )
-                    serve_bucket_predict(
-                        dmodel, serve_members, bucket_aval, method="de",
-                        bucket=bucket, record_memory_only=True,
-                    )
+                    for engine in ("xla", "pallas"):
+                        serve_bucket_predict(
+                            dmodel, variables, bucket_aval, method="mcd",
+                            bucket=bucket, n_passes=AUDIT_PASSES, key=key,
+                            engine=engine, record_memory_only=True,
+                        )
+                        serve_bucket_predict(
+                            dmodel, serve_members, bucket_aval, method="de",
+                            bucket=bucket, engine=engine,
+                            record_memory_only=True,
+                        )
 
         need_train_data = bool({"train", "train-ensemble"} & set(groups))
         if need_train_data:

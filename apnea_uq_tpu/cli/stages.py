@@ -57,9 +57,21 @@ def _compile_env(args, config):
     store under <registry>/program-store (CompileCacheConfig knobs /
     env overrides; APNEA_UQ_COMPILE_CACHE=0 disables).  Identical XLA
     compiles become disk hits across processes, and `apnea-uq
-    warm-cache` can precompile the whole zoo ahead of time."""
-    from apnea_uq_tpu import compilecache
+    warm-cache` can precompile the whole zoo ahead of time.
 
+    Also activates any persisted ``autotune_config`` artifact (ops/
+    autotune.py): every device-heavy stage — warm-cache, the evals, and
+    the serving tier — bakes the SAME measured tile geometry into its
+    kernel-program signatures, so a warm process and a serve process
+    can never key the same program differently."""
+    from apnea_uq_tpu import compilecache
+    from apnea_uq_tpu.ops import autotune
+
+    if getattr(args, "registry", None):
+        activated = autotune.activate_from_registry(_registry(args))
+        if activated:
+            log(f"autotune: tuned tile geometry active for {activated} "
+                f"program label(s)")
     return compilecache.activate(
         config.compilecache, registry_root=getattr(args, "registry", None)
     )
@@ -435,6 +447,9 @@ def cmd_warm_cache(args, config) -> int:
     multi-minute cold-start compiles per process."""
     from apnea_uq_tpu.compilecache import zoo
 
+    # Engine/dtype overrides fold in BEFORE warming so the warmed label
+    # set is exactly what an identically-flagged eval/serve dispatches.
+    config = _apply_eval_overrides(args, config)
     registry = _registry(args)
     groups = tuple(g.strip() for g in args.programs.split(",") if g.strip())
     bad = set(groups) - set(zoo.WARM_GROUPS)
@@ -466,6 +481,49 @@ def cmd_warm_cache(args, config) -> int:
         log(f"warmed {len(warmed)} program(s) ({fresh} freshly compiled, "
             f"{len(warmed) - fresh} already hot) in {total:.1f}s"
             + (f" -> {store.root}" if store.root else ""))
+    return 0
+
+
+def cmd_autotune(args, config) -> int:
+    """Measure the fused-kernel tile grid (ISSUE 16): time every
+    ``window_tile x member_group/pass_group`` cell against the real
+    DE-predict and serve-bucket program families, persist the winning
+    geometry per program label as the registry's ``autotune_config``
+    artifact (atomic JSON beside the program store, stamped with the
+    program store's own backend/jax/source fingerprint), and activate
+    it in-process.  Every later `_compile_env` stage — warm-cache,
+    eval-de, serve — bakes the winners into its program signatures, so
+    tuned geometry flows through the zero-request-path-compile contract
+    unchanged.  Off-TPU the cells time the XLA fallback bodies: the
+    ratios read ~1.0 and the sweep doubles as a plumbing check."""
+    from apnea_uq_tpu.compilecache import zoo
+    from apnea_uq_tpu.data import registry as reg
+    from apnea_uq_tpu.ops import autotune
+
+    registry = _registry(args)
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    tiles = tuple(int(v) for v in args.window_tiles.split(",") if v.strip())
+    groups = tuple(int(v) for v in args.groups.split(",") if v.strip())
+    members = zoo.resolve_de_members(args.num_members, config,
+                                     _ckpt_root(args))
+    with _compile_env(args, config), \
+            _run(args, "autotune", config) as run_log:
+        with run_log.stage("autotune", snapshot_memory=True):
+            document = autotune.run_autotune(
+                model_config=config.model, members=members,
+                n_passes=config.uq.mc_passes, windows=args.windows,
+                chunk=config.uq.inference_batch_size, buckets=buckets,
+                window_tiles=tiles, groups=groups, reps=args.reps,
+                seed=config.train.seed, run_log=run_log,
+            )
+        path = registry.save_json(reg.AUTOTUNE_CONFIG, document)
+        activated = autotune.activate(document)
+        for label, rec in sorted(document["winners"].items()):
+            log(f"  {label}: window_tile={rec['window_tile']} "
+                f"group={rec.get('member_group', rec.get('pass_group'))} "
+                f"best={rec['best_s']:.5f}s "
+                f"({rec['best_vs_default']:.2f}x vs default)")
+        log(f"autotune: {activated} winner(s) -> {path}")
     return 0
 
 
@@ -547,8 +605,9 @@ def _add_compute_dtype_arg(p) -> None:
 
 
 def _apply_eval_overrides(args, config):
-    """Fold the eval-only CLI overrides (--compute-dtype, --mcd-engine)
-    into the ExperimentConfig BEFORE the stage's run log opens, so the
+    """Fold the eval-only CLI overrides (--compute-dtype, --mcd-engine,
+    --de-engine) into the ExperimentConfig BEFORE the stage's run log
+    opens, so the
     run-dir config snapshot records the dtype/engine the eval actually
     ran — a bf16 number must never be attributable to an f32 config."""
     import dataclasses
@@ -562,7 +621,23 @@ def _apply_eval_overrides(args, config):
     if engine:
         config = dataclasses.replace(
             config, uq=dataclasses.replace(config.uq, mcd_engine=engine))
+    de_engine = getattr(args, "de_engine", None)
+    if de_engine:
+        config = dataclasses.replace(
+            config, uq=dataclasses.replace(config.uq, de_engine=de_engine))
     return config
+
+
+def _add_de_engine_arg(p) -> None:
+    p.add_argument("--de-engine", choices=("xla", "pallas"), default=None,
+                   help="Deep-Ensemble predictor engine for this "
+                        "invocation (UQConfig.de_engine): 'pallas' runs "
+                        "the fused member-batched conv->bias->ReLU->BN "
+                        "TPU kernel (ops/pallas_de.py; members replace "
+                        "MC passes, no PRNG), falling back to the "
+                        "default 'xla' member sweep off-TPU / on a "
+                        "mesh.  Tile geometry comes from any persisted "
+                        "`apnea-uq autotune` winners.")
 
 
 def _add_profile_arg(p) -> None:
@@ -1464,6 +1539,42 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                         "= every checkpointed member when an ensemble "
                         "store exists, else the configured "
                         "EnsembleConfig.num_members).")
+    _add_compute_dtype_arg(p)
+    p.add_argument("--mcd-engine", choices=("xla", "pallas"), default=None,
+                   help="Warm the MCD programs under this engine's "
+                        "labels (UQConfig.mcd_engine) — must match the "
+                        "later eval-mcd/serve --mcd-engine for warm "
+                        "starts.")
+    _add_de_engine_arg(p)
+
+    p = add("autotune", cmd_autotune,
+            "Measure fused-kernel tile geometry (window_tile x "
+            "member_group/pass_group) and persist the winners beside "
+            "the program store for warm-cache/serve to bake in.")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--ckpt-dir", default=None)
+    _add_run_dir_arg(p)
+    p.add_argument("--num-members", type=int, default=0,
+                   help="DE members to time with (0 = every checkpointed "
+                        "member when an ensemble store exists, else the "
+                        "configured EnsembleConfig.num_members) — match "
+                        "the warm-cache/eval-de member count.")
+    p.add_argument("--windows", type=int, default=64,
+                   help="Window count of the batch-predict timing point.")
+    from apnea_uq_tpu.serving.coalescer import (
+        SERVE_BUCKET_SIZES as _LADDER,
+    )
+
+    p.add_argument("--buckets", default=",".join(str(b) for b in _LADDER),
+                   help=f"Serving buckets to tune per-bucket kernels for "
+                        f"(subset of {_LADDER}).")
+    p.add_argument("--window-tiles", default="8,16,32",
+                   help="Comma-separated window_tile grid to sweep.")
+    p.add_argument("--groups", default="4,8,16",
+                   help="Comma-separated member_group/pass_group grid to "
+                        "sweep.")
+    p.add_argument("--reps", type=int, default=3,
+                   help="Timing repetitions per cell (best-of).")
 
     p = add("eval-mcd", cmd_eval_mcd, "MC-Dropout UQ analysis on the test sets.")
     p.add_argument("--registry", required=True)
@@ -1496,6 +1607,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     _add_no_detailed_arg(p)
     _add_full_probs_arg(p)
     _add_compute_dtype_arg(p)
+    _add_de_engine_arg(p)
     _add_plots_arg(p)
     _add_profile_arg(p)
     _add_profile_flag(p)
@@ -1528,6 +1640,13 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                             f"{SERVE_BUCKET_SIZES}; each bucket is a "
                             f"warm-cache/audit program label).")
         _add_compute_dtype_arg(p)
+        p.add_argument("--mcd-engine", choices=("xla", "pallas"),
+                       default=None,
+                       help="With --method mcd: serve through this "
+                            "engine's bucket labels (UQConfig."
+                            "mcd_engine) — match the warm-cache "
+                            "--mcd-engine for warm starts.")
+        _add_de_engine_arg(p)
         _add_run_dir_arg(p)
 
     p = add("serve", cmd_serve,

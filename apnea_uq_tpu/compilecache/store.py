@@ -267,6 +267,13 @@ def store_key(label: str, signature: str) -> str:
     """sha256 over every invalidation axis of one stored program."""
     import jaxlib
 
+    # Lazy: ops/autotune.py imports this module at module level for the
+    # fingerprint helpers.  The active tuned-geometry digest is a keying
+    # axis because geometry is a STATIC argument of the kernel program
+    # families — a program stored under one tile geometry must never be
+    # offered to a process that activated another.
+    from apnea_uq_tpu.ops import autotune as autotune_mod
+
     material = json.dumps({
         "label": label,
         "signature": signature,
@@ -274,6 +281,7 @@ def store_key(label: str, signature: str) -> str:
         "jaxlib": jaxlib.__version__,
         "backend": backend_fingerprint(),
         "source": _source_version(),
+        "autotune": autotune_mod.active_digest(),
     }, sort_keys=True)
     return hashlib.sha256(material.encode()).hexdigest()
 

@@ -57,8 +57,13 @@ GROUP_LABELS: Dict[str, Tuple[str, ...]] = {
                  "predict_eval", "predict_eval_bf16"),
     "eval-de": ("de_predict", "de_predict_bf16",
                 "de_predict_fused", "de_predict_fused_bf16",
+                "de_predict_pallas", "de_predict_pallas_bf16",
+                "de_predict_pallas_fused", "de_predict_pallas_fused_bf16",
                 "de_chunk_predict", "de_chunk_predict_bf16",
-                "de_chunk_predict_fused", "de_chunk_predict_fused_bf16"),
+                "de_chunk_predict_fused", "de_chunk_predict_fused_bf16",
+                "de_chunk_predict_pallas", "de_chunk_predict_pallas_bf16",
+                "de_chunk_predict_pallas_fused",
+                "de_chunk_predict_pallas_fused_bf16"),
     "train": ("train_epoch", "val_loss"),
     "train-ensemble": ("ensemble_epoch",),
     # The online serving tier's bucket ladder (uq/predict.py
@@ -71,9 +76,17 @@ GROUP_LABELS: Dict[str, Tuple[str, ...]] = {
     "serve": ("mcd_serve_b16_fused", "mcd_serve_b16_fused_bf16",
               "mcd_serve_b64_fused", "mcd_serve_b64_fused_bf16",
               "mcd_serve_b256_fused", "mcd_serve_b256_fused_bf16",
+              "mcd_serve_b16_pallas_fused", "mcd_serve_b16_pallas_fused_bf16",
+              "mcd_serve_b64_pallas_fused", "mcd_serve_b64_pallas_fused_bf16",
+              "mcd_serve_b256_pallas_fused",
+              "mcd_serve_b256_pallas_fused_bf16",
               "de_serve_b16_fused", "de_serve_b16_fused_bf16",
               "de_serve_b64_fused", "de_serve_b64_fused_bf16",
-              "de_serve_b256_fused", "de_serve_b256_fused_bf16"),
+              "de_serve_b256_fused", "de_serve_b256_fused_bf16",
+              "de_serve_b16_pallas_fused", "de_serve_b16_pallas_fused_bf16",
+              "de_serve_b64_pallas_fused", "de_serve_b64_pallas_fused_bf16",
+              "de_serve_b256_pallas_fused",
+              "de_serve_b256_pallas_fused_bf16"),
 }
 
 
@@ -209,6 +222,7 @@ def warm_cache(
                 model, members, x_aval,
                 batch_size=uq.inference_batch_size, mesh=mesh,
                 run_log=run_log, record_memory_only=True, stats=stat_spec,
+                engine=uq.de_engine,
             )
 
     if "train" in groups:
@@ -244,13 +258,13 @@ def warm_cache(
             serve_bucket_predict(
                 model, variables, x_aval, method="mcd", bucket=bucket,
                 n_passes=uq.mc_passes, key=key, base="nats",
-                eps=uq.entropy_eps, run_log=run_log,
+                eps=uq.entropy_eps, engine=uq.mcd_engine, run_log=run_log,
                 record_memory_only=True,
             )
             serve_bucket_predict(
                 model, members, x_aval, method="de", bucket=bucket,
-                base="nats", eps=uq.entropy_eps, run_log=run_log,
-                record_memory_only=True,
+                base="nats", eps=uq.entropy_eps, engine=uq.de_engine,
+                run_log=run_log, record_memory_only=True,
             )
 
     if "train-ensemble" in groups:

@@ -34,8 +34,12 @@ VALID_COMPUTE_DTYPES = ("float32", "bfloat16")
 
 # MCD predictor engines (UQConfig.mcd_engine): 'xla' is the default
 # vmap-over-keys path; 'pallas' the fused conv->BN->ReLU->dropout TPU
-# kernel (ops/pallas_mcd.py), which falls back to 'xla' off-TPU.
+# kernel (ops/pallas_mcd.py), which falls back to 'xla' off-TPU.  The DE
+# engines (UQConfig.de_engine) share the same vocabulary and fallback
+# contract: 'pallas' is the fused member-batched kernel
+# (ops/pallas_de.py).
 VALID_MCD_ENGINES = ("xla", "pallas")
+VALID_DE_ENGINES = VALID_MCD_ENGINES
 
 
 @dataclass(frozen=True)
@@ -170,6 +174,16 @@ class UQConfig:
     # the kernel math itself is pinned by interpret-mode tests.
     mcd_engine: str = "xla"
     mcd_mode: str = "clean"
+    # DE predictor engine: 'xla' (default) is the vmap-over-members path;
+    # 'pallas' the fused member-batched TPU kernel (ops/pallas_de.py) —
+    # every member's folded weights VMEM-resident per window tile, the
+    # member axis processed in member_group batches, and (under
+    # fused_reduction) the sufficient-stats reduction applied in-kernel.
+    # Off-TPU (and on a mesh) the pallas engine falls back to the XLA
+    # body under the same label — the shared resolve_engine rules
+    # (uq/predict.py).  DE is deterministic, so unlike MCD the two
+    # engines are pinned to agree elementwise by interpret-mode tests.
+    de_engine: str = "xla"
     # Stream MCD / DE window chunks from host memory
     # (mc_dropout_predict_streaming / ensemble_predict_streaming) instead
     # of holding the test set in HBM; identical results to the in-HBM
@@ -206,6 +220,11 @@ class UQConfig:
             raise ValueError(
                 f"UQConfig.mcd_engine must be one of {VALID_MCD_ENGINES}, "
                 f"got {self.mcd_engine!r}"
+            )
+        if self.de_engine not in VALID_DE_ENGINES:
+            raise ValueError(
+                f"UQConfig.de_engine must be one of {VALID_DE_ENGINES}, "
+                f"got {self.de_engine!r}"
             )
 
 

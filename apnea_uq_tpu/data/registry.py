@@ -36,6 +36,7 @@ PATIENT_SUMMARY = "patient_summary"            # L6 -> L7: per-patient CSV
 CHECKPOINT = "checkpoint"                      # L3 -> L5: model checkpoints (dir)
 SWEEP = "sweep"                                # L7 side: T/N convergence table
 QUALITY_BASELINE = "quality_baseline"          # L2 -> L5: frozen per-channel data fingerprint (drift scoring)
+AUTOTUNE_CONFIG = "autotune_config"            # L5 side: measured kernel tile-geometry winners (ops/autotune.py)
 
 #: Every canonical artifact key, in pipeline order.  The flow gate
 #: (`apnea-uq flow`, apnea_uq_tpu/flow/) keys its producer->consumer
@@ -44,7 +45,7 @@ QUALITY_BASELINE = "quality_baseline"          # L2 -> L5: frozen per-channel da
 CANONICAL_KEYS = (
     WINDOWS, TRAIN_STD_SMOTE, TEST_STD_UNBALANCED, TEST_STD_RUS,
     QUALITY_BASELINE, RAW_PREDICTIONS, UQ_STATS, DETAILED_WINDOWS,
-    METRICS, PATIENT_SUMMARY, CHECKPOINT, SWEEP,
+    METRICS, PATIENT_SUMMARY, CHECKPOINT, SWEEP, AUTOTUNE_CONFIG,
 )
 
 

@@ -1,0 +1,320 @@
+"""Measured tile geometry for the fused Pallas kernels: sweep, persist,
+activate.
+
+The MCD and DE kernels (ops/pallas_mcd.py, ops/pallas_de.py) take their
+tile geometry — ``window_tile`` and the pass/member batching factor —
+as keyword arguments with hand-picked defaults.  This module replaces
+the hand-picking with measurement: :func:`run_autotune` times every
+``window_tile x pass_group/member_group`` cell of a small grid against
+the REAL dispatch bodies (the same jitted program families
+uq/predict.py acquires, so off-TPU the cells exercise the XLA fallback
+and the sweep degrades to a ~1.0-ratio plumbing check, exactly like the
+bench ``mcd_kernel`` block's fallback rounds), picks the fastest cell
+per program label, and returns a winners document.
+
+The document persists beside the program store as the registry's
+``autotune_config`` artifact (data/registry.py ``save_json`` — the
+atomic_write_json writer), stamped with the SAME invalidation axes as a
+stored program (backend fingerprint, jax/jaxlib versions, package
+source hash — compilecache/store.py): a winner measured on one chip or
+one code version is never offered to another.  :func:`activate` loads a
+document into process-global state; :func:`tuned_kernel_kwargs` is the
+read side, consulted once per predict/serve call to bake the tuned
+geometry into the program's static signature.  Because
+:func:`active_digest` is itself a ``store_key`` material field, a
+geometry flip can never alias a program stored under the old geometry.
+
+Import discipline: uq/predict.py imports this module at module level,
+so everything here that touches predict, models, serving, or telemetry
+is imported lazily inside :func:`run_autotune` — module level keeps
+only stdlib + jax + the compilecache keying helpers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from apnea_uq_tpu.compilecache import store as cc_store
+
+# The geometry knobs a winner record may carry; anything else in a
+# (possibly hand-edited) document is ignored rather than splatted into
+# a kernel call that would reject it.
+GEOMETRY_PARAMS = ("member_group", "pass_group", "window_tile")
+
+# The kernels' built-in defaults (ops/pallas_mcd.py mcd_pallas_passes /
+# ops/pallas_de.py de_pallas_*): the sweep always times this cell so
+# ``best_vs_default`` is a measured ratio, never a guess.
+DEFAULT_WINDOW_TILE = 16
+DEFAULT_GROUP = 8
+
+# ------------------------------------------------------- active state ----
+
+_ACTIVE: Dict[str, Dict[str, int]] = {}
+_ACTIVE_DIGEST: str = ""
+
+
+def tuned_kernel_kwargs(label: str) -> Tuple[Tuple[str, int], ...]:
+    """The tuned geometry for one program label as a sorted, hashable
+    tuple of (kwarg, value) pairs — ``()`` when nothing is active for
+    the label, so every call site can unconditionally thread the result
+    through its jit static ``geometry`` argument and splat
+    ``**dict(geometry)`` into the kernel entry."""
+    return tuple(sorted(_ACTIVE.get(label, {}).items()))
+
+
+def active_digest() -> str:
+    """Content digest of the active geometry table ('' when empty) — a
+    ``store_key`` material field (compilecache/store.py), so programs
+    stored under one tuned geometry are invalidated by the next."""
+    return _ACTIVE_DIGEST
+
+
+def fingerprint() -> Dict[str, str]:
+    """The staleness axes a winners document is stamped with — the same
+    backend/jax/jaxlib/source material the program store keys on: a
+    mismatch on ANY axis means the measurements no longer describe this
+    process and the document is ignored."""
+    import jaxlib
+
+    return {
+        "backend": cc_store.backend_fingerprint(),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "source": cc_store._source_version(),
+    }
+
+
+def _digest(winners: Dict[str, Any]) -> str:
+    material = json.dumps(winners, sort_keys=True, default=str)
+    return hashlib.sha256(material.encode()).hexdigest()[:16]
+
+
+def deactivate() -> None:
+    """Drop any active tuned geometry (module-global): subsequent calls
+    dispatch the kernels' built-in defaults again."""
+    global _ACTIVE_DIGEST
+    _ACTIVE.clear()
+    _ACTIVE_DIGEST = ""
+
+
+def activate(document: Optional[Dict[str, Any]]) -> int:
+    """Load a winners document into the process-global geometry table.
+
+    Returns the number of labels activated.  A missing/empty document or
+    a :func:`fingerprint` mismatch (different chip, jax version, or
+    package source than the document was measured on) deactivates and
+    returns 0 — stale geometry silently reverts to defaults, mirroring
+    the program store's staleness discipline.
+    """
+    global _ACTIVE_DIGEST
+    deactivate()
+    if not document:
+        return 0
+    if document.get("fingerprint") != fingerprint():
+        return 0
+    for label, record in (document.get("winners") or {}).items():
+        geometry = {
+            name: int(record[name])
+            for name in GEOMETRY_PARAMS
+            if name in record
+        }
+        if geometry:
+            _ACTIVE[str(label)] = geometry
+    if _ACTIVE:
+        _ACTIVE_DIGEST = _digest(
+            {label: _ACTIVE[label] for label in sorted(_ACTIVE)})
+    return len(_ACTIVE)
+
+
+def activate_from_registry(registry) -> int:
+    """Activate the persisted ``autotune_config`` artifact from a data
+    registry (the startup hook: cli/stages.py calls this wherever it
+    builds the compile environment, so warm-cache, serve, and the eval
+    stages all bake the same tuned geometry).  No artifact -> 0, with
+    defaults active."""
+    from apnea_uq_tpu.data import registry as registry_keys
+
+    try:
+        document = registry.load_json(registry_keys.AUTOTUNE_CONFIG)
+    except Exception:  # noqa: BLE001 — absent/corrupt artifact: defaults win
+        deactivate()
+        return 0
+    return activate(document)
+
+
+# ------------------------------------------------------------- sweep ----
+
+def _time_call(fn, args, *, warmup: int, reps: int) -> float:
+    """Best-of-reps wall time of one cell's dispatch (bench.py
+    ``_time`` discipline: warmup pays the compile, reps measure the
+    steady state, block_until_ready fences the async dispatch)."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    best = math.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _grid(window_tiles, groups):
+    """The sweep grid with the kernels' default cell always included."""
+    cells = [(int(w), int(g)) for w in window_tiles for g in groups]
+    if (DEFAULT_WINDOW_TILE, DEFAULT_GROUP) not in cells:
+        cells.append((DEFAULT_WINDOW_TILE, DEFAULT_GROUP))
+    return cells
+
+
+def _geometry(param: str, window_tile: int, group: int):
+    return tuple(sorted({"window_tile": window_tile, param: group}.items()))
+
+
+def run_autotune(
+    *,
+    model_config=None,
+    members: int = 3,
+    n_passes: int = 4,
+    windows: int = 64,
+    chunk: int = 32,
+    buckets: Tuple[int, ...] = (16,),
+    window_tiles: Tuple[int, ...] = (8, 16),
+    groups: Tuple[int, ...] = (4, 8),
+    warmup: int = 1,
+    reps: int = 2,
+    seed: int = 7,
+    run_log=None,
+) -> Dict[str, Any]:
+    """Sweep the fused-kernel tile grid and return a winners document.
+
+    Each (label, window_tile, group) cell is timed in isolation — a
+    raising cell records an error outcome and the sweep continues, the
+    per-cell promotion of the bench block runner's degrade-don't-sink
+    rule.  Targets cover the two DE predict program families
+    (``de_predict_pallas_fused``, ``de_chunk_predict_pallas_fused``)
+    plus the ``{mcd|de}_serve_b<bucket>_pallas_fused`` ladder for every
+    requested bucket, timed through the SAME jitted program families
+    uq/predict.py dispatches (geometry as their static argument), so
+    off-TPU the sweep times the XLA fallback bodies under the pallas
+    labels — cheap, ~1.0 ratios, real plumbing.
+
+    Telemetry: one ``autotune_cell`` event per timed cell and one
+    ``autotune_result`` event per label, carrying the
+    ``best_vs_default`` ratio `telemetry compare`/`trend` arbitrate
+    engine-default flips on.
+    """
+    import numpy as np
+
+    from apnea_uq_tpu.config import ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.uq import predict as predict_mod
+
+    if model_config is None:
+        model_config = ModelConfig()
+    model = AlarconCNN1D(model_config)
+    variables = init_variables(model, jax.random.key(seed))
+    stacked = predict_mod.stack_member_variables([
+        init_variables(model, jax.random.key(seed + i))
+        for i in range(members)
+    ])
+    rng = np.random.default_rng(seed)
+    key = jax.random.key(seed + 1)
+    base, eps = "nats", 1e-10
+
+    def x_of(rows: int):
+        import jax.numpy as jnp
+
+        shape = (rows, model_config.time_steps, model_config.num_channels)
+        return jnp.asarray(rng.normal(size=shape), jnp.float32)
+
+    de_engine = predict_mod.resolve_de_engine("pallas", None)
+    mcd_engine = predict_mod.resolve_mcd_engine("pallas", "clean", None)
+
+    # (label, geometry param, shape, fn, args-builder) per target: the
+    # EXACT jitted families serve_bucket_predict / ensemble_predict
+    # dispatch, with the cell's geometry in the static signature.
+    targets = []
+    x_full, x_chunk = x_of(windows), x_of(chunk)
+    batch = min(int(chunk), int(windows))
+    label = predict_mod.de_program_label(
+        model, streamed=False, engine="pallas", fused=True)
+    targets.append((label, "member_group", tuple(x_full.shape),
+                    predict_mod._ensemble_stats_jit,
+                    lambda geom, x=x_full: (model, stacked, x, batch, base,
+                                            eps, de_engine, geom)))
+    label = predict_mod.de_program_label(
+        model, streamed=True, engine="pallas", fused=True)
+    targets.append((label, "member_group", tuple(x_chunk.shape),
+                    predict_mod._ensemble_chunk_stats_jit,
+                    lambda geom, x=x_chunk: (model, stacked, x, base, eps,
+                                             de_engine, geom)))
+    for bucket in buckets:
+        x_b = x_of(int(bucket))
+        label = predict_mod.serve_program_label(
+            model, method="de", bucket=bucket, engine="pallas")
+        targets.append((label, "member_group", tuple(x_b.shape),
+                        predict_mod._ensemble_stats_jit,
+                        lambda geom, x=x_b, b=int(bucket):
+                        (model, stacked, x, b, base, eps, de_engine, geom)))
+        label = predict_mod.serve_program_label(
+            model, method="mcd", bucket=bucket, engine="pallas")
+        targets.append((label, "pass_group", tuple(x_b.shape),
+                        predict_mod._mcd_stats_jit,
+                        lambda geom, x=x_b, b=int(bucket):
+                        (model, variables, x, key, n_passes,
+                         predict_mod._MCD_MODES["clean"], b, base, eps,
+                         None, mcd_engine, geom)))
+
+    backend = fingerprint()["backend"]
+    cells = _grid(window_tiles, groups)
+    winners: Dict[str, Any] = {}
+    for label, param, shape, fn, make_args in targets:
+        timed: Dict[Tuple[int, int], float] = {}
+        for window_tile, group in cells:
+            status, seconds = "ok", -1.0
+            try:
+                seconds = _time_call(
+                    fn, make_args(_geometry(param, window_tile, group)),
+                    warmup=warmup, reps=reps)
+                timed[(window_tile, group)] = seconds
+            except Exception:  # noqa: BLE001 — one cell must not sink the sweep
+                status = "error"
+            if run_log is not None:
+                run_log.event("autotune_cell", label=label,
+                              shape=list(shape), param=param,
+                              window_tile=window_tile, group=group,
+                              seconds=round(seconds, 5), status=status)
+        if not timed:
+            continue
+        (best_tile, best_group), best_s = min(
+            timed.items(), key=lambda item: item[1])
+        default_s = timed.get((DEFAULT_WINDOW_TILE, DEFAULT_GROUP), best_s)
+        record = {
+            "shape": list(shape),
+            "window_tile": best_tile,
+            param: best_group,
+            "best_s": round(best_s, 5),
+            "default_s": round(default_s, 5),
+            "best_vs_default": round(default_s / best_s, 3) if best_s else 1.0,
+            "backend": backend,
+        }
+        winners[label] = record
+        if run_log is not None:
+            run_log.event("autotune_result", label=label,
+                          shape=list(shape), param=param,
+                          window_tile=best_tile, group=best_group,
+                          best_s=record["best_s"],
+                          default_s=record["default_s"],
+                          best_vs_default=record["best_vs_default"],
+                          backend=backend, cells=len(timed))
+    return {
+        "version": 1,
+        "fingerprint": fingerprint(),
+        "winners": winners,
+    }

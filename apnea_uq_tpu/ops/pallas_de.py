@@ -1,0 +1,364 @@
+"""Fused Deep-Ensemble inference as a Pallas TPU kernel family.
+
+Deep Ensembles are the paper's second UQ family: N independently trained
+members score every window and the (N, M) probability matrix reduces to
+the same four sufficient-statistic rows MCD uses (uq/metrics.py).  The
+XLA path (uq/predict.py ``_ensemble_chunk_jit``) vmaps the member axis,
+which re-streams the window chunk through HBM once per member and keeps
+each member's weights live only for its own pass.  But the members
+differ ONLY by weights — the input tile is loop-invariant N times over —
+which is exactly the invariance the MCD kernel (ops/pallas_mcd.py)
+exploits across MC passes.
+
+This kernel is the member-axis twin of that design.  Per window tile it
+
+- loads the tile and EVERY member's layer operands (conv kernels,
+  biases, the frozen-BatchNorm statistics folded to one per-channel
+  affine each) into VMEM **once**, then runs all members against the
+  resident copies — the windows are read once per tile instead of once
+  per member;
+- processes the member axis in ``member_group``-sized batches (the
+  ``pass_group`` trick from the MCD kernel, with members replacing MC
+  passes — deterministic eval-mode forwards, so no PRNG is involved at
+  all), batching each conv as member-batched shifted MXU matmuls with
+  f32 accumulation;
+- optionally applies the fused sufficient-stats reduction **in-kernel**
+  (the exact :func:`~apnea_uq_tpu.uq.metrics.sufficient_stats` the XLA
+  fused path runs), so a fused-stats program ships (4, tile) rows out of
+  VMEM instead of the (N, tile) probability block.
+
+VMEM budget at the default geometry (``window_tile=16``,
+``member_group=8``): the widest layer (256 ch) holds
+8x16x60x256 f32 ~= 7.9 MB in + ~6.9 MB out of live activations —
+identical to the MCD kernel, since ``member_group`` bounds the live
+batch exactly like ``pass_group`` does.  Resident weights scale with N
+(~3.4 MB of folded operands per member at the reference architecture),
+so the whole-ensemble-resident plan holds to N≈2-3 members at 16 MB;
+beyond that the autotuner (ops/autotune.py) is the arbiter — it sweeps
+``window_tile`` x ``member_group`` and the compiler's own spills show up
+directly in the measured cell times.
+
+Restrictions (uq/predict.py ``resolve_de_engine`` falls back to the XLA
+body, exactly like the MCD kernel's fallback contract):
+
+- single device (``mesh=None``): the kernel is a per-chip program.
+- TPU backend with the pallas TPU package importable.
+
+DE always runs members in eval mode (frozen running-statistics BN, no
+dropout), so there is no parity-mode restriction: the fold is valid for
+every DE program.  Off-TPU the kernel BODY still runs under tier-1:
+:func:`de_forward_with_members` executes the identical tile body under
+``pl.pallas_call(..., interpret=True)`` — DE needs no injected
+randomness, so the interpret twin IS the shipped kernel — compared in
+tests against the eval-mode Flax model and the XLA fused stats at the
+PARITY.md tolerance tiers (f32 <=1e-6-grade, bf16 <=2e-2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+# Default tile geometry: the VMEM budget math in the module docstring.
+# Both are kwargs on the public entry points; `apnea-uq autotune` sweeps
+# them and persists measured winners (ops/autotune.py).
+DEFAULT_WINDOW_TILE = 16
+DEFAULT_MEMBER_GROUP = 8
+
+
+def pallas_de_available() -> bool:
+    """Whether the fused kernel can actually run here (TPU backend with
+    the pallas TPU package importable) — the same gate the MCD and
+    bootstrap kernels' dispatch uses."""
+    return pltpu is not None and jax.default_backend() == "tpu"
+
+
+class MemberOperands(NamedTuple):
+    """One conv block's kernel-resident operands for ALL members, the
+    member axis leading.  BatchNorm enters as a per-(member, channel)
+    affine: DE members run eval mode (running statistics), so
+    (x - mean) * scale/sqrt(var + eps) + bias folds to
+    x * bn_scale + bn_shift outside the kernel — per member, since every
+    member carries its own statistics."""
+
+    kernel: jax.Array    # (n_members, k, c_in, c_out) f32
+    bias: jax.Array      # (n_members, 1, c_out) f32
+    bn_scale: jax.Array  # (n_members, 1, c_out) f32
+    bn_shift: jax.Array  # (n_members, 1, c_out) f32
+
+
+def fold_member_params(
+    model, stacked_variables
+) -> Tuple[List[MemberOperands], jax.Array, jax.Array]:
+    """Member-stacked Flax variable tree -> the kernel's flat operand
+    list: per-block :class:`MemberOperands` plus the dense heads'
+    ((n, c, 1) kernel, (n, 1, 1) bias).  The BN fold is elementwise, so
+    it applies to the stacked leaves directly.  Biases and BN affines
+    ship as (n, 1, c) rows — 1-D trailing operands tile poorly on TPU."""
+    cfg = model.config
+    params = stacked_variables["params"]
+    stats = stacked_variables["batch_stats"]
+    layers = []
+    for i in range(len(cfg.features)):
+        conv = params[f"conv_{i}"]
+        bn = params[f"bn_{i}"]
+        mean = stats[f"bn_{i}"]["mean"].astype(jnp.float32)
+        var = stats[f"bn_{i}"]["var"].astype(jnp.float32)
+        a = bn["scale"].astype(jnp.float32) * jax.lax.rsqrt(
+            var + cfg.bn_epsilon
+        )
+        b = bn["bias"].astype(jnp.float32) - mean * a
+        n = a.shape[0]
+        layers.append(MemberOperands(
+            kernel=conv["kernel"].astype(jnp.float32),
+            bias=conv["bias"].reshape(n, 1, -1).astype(jnp.float32),
+            bn_scale=a.reshape(n, 1, -1),
+            bn_shift=b.reshape(n, 1, -1),
+        ))
+    head = params["head"]
+    n = head["bias"].shape[0]
+    return (layers, head["kernel"].astype(jnp.float32),
+            head["bias"].reshape(n, 1, 1).astype(jnp.float32))
+
+
+def _conv1d_same_members(x: jax.Array, kernel: jax.Array, dtype) -> jax.Array:
+    """SAME-padded 1-D convolution for a member group, as k shifted
+    member-batched MXU matmuls: operands cast to the compute dtype,
+    accumulation pinned f32 (``preferred_element_type``) in every tier.
+    x: (g, n, t, c_in), kernel: (g, k, c_in, c_out) -> (g, n, t, c_out)
+    f32 — the member axis rides the dot_general batch dimension, the
+    member-group analog of the MCD kernel's pass-group matmul."""
+    g, n, t, c_in = x.shape
+    k = kernel.shape[1]
+    left = (k - 1) // 2
+    xp = jnp.pad(x.astype(dtype),
+                 ((0, 0), (0, 0), (left, k - 1 - left), (0, 0)))
+    out = None
+    for j in range(k):
+        xs = jax.lax.slice_in_dim(xp, j, j + t, axis=2)
+        contrib = jax.lax.dot_general(
+            xs.reshape(g, n * t, c_in), kernel[:, j].astype(dtype),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        out = contrib if out is None else out + contrib
+    return out.reshape(g, n, t, -1)
+
+
+def _de_tile_body(x_tile, layers, head_w, head_b, n_members: int,
+                  member_group: int, compute_dtype):
+    """The shared kernel math: (tile_w, t, c) windows -> (n_members,
+    tile_w) probabilities.  Members are processed in ``member_group``
+    batches; each group's activations stay in (VMEM-resident) values
+    across all conv blocks — only the (g, tile_w) probabilities leave.
+    Both the TPU and interpret paths execute this exact body (DE is
+    deterministic, so unlike MCD there is no PRNG seam between them)."""
+    dtype = jnp.dtype(compute_dtype)
+    tile_w, t_steps, _ = x_tile.shape
+    rows = []
+    for g0 in range(0, n_members, member_group):
+        g = min(member_group, n_members - g0)
+        a = jnp.broadcast_to(x_tile[None], (g,) + x_tile.shape)
+        for layer in layers:
+            a = _conv1d_same_members(a, layer.kernel[g0:g0 + g], dtype)
+            a = a + layer.bias[g0:g0 + g][:, None]
+            a = jnp.maximum(a, 0.0)
+            a = (a * layer.bn_scale[g0:g0 + g][:, None]
+                 + layer.bn_shift[g0:g0 + g][:, None])
+        # GAP accumulates f32 like the Flax model (models/cnn1d.py).
+        pooled = jnp.mean(a.astype(jnp.float32), axis=2)
+        logits = jax.lax.dot_general(
+            pooled.astype(dtype), head_w[g0:g0 + g].astype(dtype),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) + head_b[g0:g0 + g]
+        rows.append(jax.nn.sigmoid(logits[..., 0].astype(jnp.float32)))
+    return jnp.concatenate(rows, axis=0)
+
+
+def _split_member_refs(param_refs, n_layers: int):
+    layers = [
+        MemberOperands(*(param_refs[4 * i + j][...] for j in range(4)))
+        for i in range(n_layers)
+    ]
+    head_w = param_refs[4 * n_layers][...]
+    head_b = param_refs[4 * n_layers + 1][...]
+    return layers, head_w, head_b
+
+
+def _member_kernel(x_ref, *refs, n_layers, n_members, member_group,
+                   compute_dtype):
+    """Probability kernel: one (n_members, tile_w) block per tile."""
+    out_ref = refs[-1]
+    layers, head_w, head_b = _split_member_refs(refs[:-1], n_layers)
+    out_ref[...] = _de_tile_body(
+        x_ref[...], layers, head_w, head_b, n_members, member_group,
+        compute_dtype,
+    )
+
+
+def _stats_kernel(x_ref, *refs, n_layers, n_members, member_group,
+                  compute_dtype, base, eps):
+    """Fused-stats kernel: the member probabilities never leave VMEM —
+    the tile reduces straight to the (4, tile_w) sufficient-statistic
+    rows via the SAME ``sufficient_stats`` the XLA fused path runs, so
+    the two engines agree by construction on the formula."""
+    from apnea_uq_tpu.uq.metrics import sufficient_stats
+
+    out_ref = refs[-1]
+    layers, head_w, head_b = _split_member_refs(refs[:-1], n_layers)
+    probs = _de_tile_body(
+        x_ref[...], layers, head_w, head_b, n_members, member_group,
+        compute_dtype,
+    )
+    out_ref[...] = sufficient_stats(probs, base=base, eps=eps)
+
+
+def _pad_axis(a: jax.Array, multiple: int, axis: int) -> jax.Array:
+    n = a.shape[axis]
+    padded = -(-n // multiple) * multiple
+    if padded == n:
+        return a
+    pads = [(0, 0)] * a.ndim
+    pads[axis] = (0, padded - n)
+    return jnp.pad(a, pads)
+
+
+def _member_specs(layers, head_w, head_b):
+    """Whole-array BlockSpecs for the resident operands: every tile maps
+    to block (0, ..) — every member's weights are read once and reused
+    for all window tiles."""
+    specs = []
+    operands = []
+    for layer in layers:
+        for arr in layer:
+            operands.append(arr)
+            specs.append(pl.BlockSpec(
+                arr.shape, lambda j, nd=arr.ndim: (0,) * nd))
+    for arr in (head_w, head_b):
+        operands.append(arr)
+        specs.append(pl.BlockSpec(
+            arr.shape, lambda j, nd=arr.ndim: (0,) * nd))
+    return operands, specs
+
+
+def de_pallas_members(
+    model,
+    stacked_variables: dict,
+    chunk: jax.Array,
+    *,
+    window_tile: int = DEFAULT_WINDOW_TILE,
+    member_group: int = DEFAULT_MEMBER_GROUP,
+    interpret: bool = False,
+) -> jax.Array:
+    """(n_members, bs) eval-mode DE probabilities of ONE window chunk
+    through the fused kernel — the drop-in pallas twin of uq/predict.py's
+    ``_ensemble_chunk_jit`` body (same output contract).  Traceable;
+    call sites gate on :func:`pallas_de_available` (the compiled kernel
+    assumes a TPU backend; ``interpret=True`` runs the same body
+    anywhere).
+
+    Zero-padded windows are exact here the same way the MCD kernel's
+    padding is: eval-mode DE has no cross-window coupling (BN frozen,
+    GAP per window), so padded windows produce padded probability
+    columns that the caller slices off."""
+    cfg = model.config
+    layers, head_w, head_b = fold_member_params(model, stacked_variables)
+    n_members = head_b.shape[0]
+    m = chunk.shape[0]
+    x = _pad_axis(jnp.asarray(chunk, jnp.float32), window_tile, axis=0)
+    operands, specs = _member_specs(layers, head_w, head_b)
+    out = pl.pallas_call(
+        partial(
+            _member_kernel, n_layers=len(layers), n_members=n_members,
+            member_group=member_group, compute_dtype=cfg.compute_dtype,
+        ),
+        grid=(x.shape[0] // window_tile,),
+        in_specs=[
+            pl.BlockSpec((window_tile,) + x.shape[1:],
+                         lambda j: (j, 0, 0)),
+            *specs,
+        ],
+        out_specs=pl.BlockSpec((n_members, window_tile), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((n_members, x.shape[0]),
+                                       jnp.float32),
+        interpret=interpret,
+    )(x, *operands)
+    return out[:, :m]
+
+
+def de_pallas_stats(
+    model,
+    stacked_variables: dict,
+    chunk: jax.Array,
+    *,
+    base: str = "nats",
+    eps: float = 1e-10,
+    window_tile: int = DEFAULT_WINDOW_TILE,
+    member_group: int = DEFAULT_MEMBER_GROUP,
+    interpret: bool = False,
+) -> jax.Array:
+    """(4, bs) per-window sufficient statistics of ONE window chunk with
+    the member reduction fused in-kernel: the (N, tile) probability
+    block reduces to [mean, variance, H[E[p]], E[H[p]]] rows before
+    leaving VMEM — the pallas twin of the XLA fused-stats body
+    (``sufficient_stats`` over ``_ensemble_chunk_jit`` output)."""
+    from apnea_uq_tpu.uq.metrics import N_STAT_ROWS
+
+    cfg = model.config
+    layers, head_w, head_b = fold_member_params(model, stacked_variables)
+    n_members = head_b.shape[0]
+    m = chunk.shape[0]
+    x = _pad_axis(jnp.asarray(chunk, jnp.float32), window_tile, axis=0)
+    operands, specs = _member_specs(layers, head_w, head_b)
+    out = pl.pallas_call(
+        partial(
+            _stats_kernel, n_layers=len(layers), n_members=n_members,
+            member_group=member_group, compute_dtype=cfg.compute_dtype,
+            base=base, eps=float(eps),
+        ),
+        grid=(x.shape[0] // window_tile,),
+        in_specs=[
+            pl.BlockSpec((window_tile,) + x.shape[1:],
+                         lambda j: (j, 0, 0)),
+            *specs,
+        ],
+        out_specs=pl.BlockSpec((N_STAT_ROWS, window_tile),
+                               lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((N_STAT_ROWS, x.shape[0]),
+                                       jnp.float32),
+        interpret=interpret,
+    )(x, *operands)
+    return out[:, :m]
+
+
+def de_forward_with_members(
+    model,
+    stacked_variables: dict,
+    chunk,
+    *,
+    window_tile: int = 8,
+    member_group: int = 4,
+    interpret: bool = True,
+) -> jax.Array:
+    """The kernel body under ``pl.pallas_call(..., interpret=True)`` —
+    tier-1's CPU exercise of the kernel math (the DE analog of
+    ``mcd_forward_with_masks``).  DE is deterministic, so no operand
+    injection is needed: this runs the EXACT shipped body, only in
+    interpret mode and at a small default geometry so ragged tiles and
+    ragged member groups are exercised too.  Returns (n_members, M)
+    probabilities."""
+    return de_pallas_members(
+        model, stacked_variables, jnp.asarray(chunk),
+        window_tile=window_tile, member_group=member_group,
+        interpret=interpret,
+    )

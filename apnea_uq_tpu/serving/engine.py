@@ -90,6 +90,12 @@ class ServingEngine:
         self.model = model
         self.method = method
         self.uq = uq
+        # The REQUESTED engine for every bucket program this process
+        # acquires and dispatches: the method's UQConfig engine knob
+        # (mcd_engine / de_engine), resolved per dispatch through the
+        # shared fallback rules so off-TPU the `_pallas` labels run
+        # their XLA fallback bodies under the same names.
+        self.engine = uq.mcd_engine if method == "mcd" else uq.de_engine
         self.carrier = (as_stacked_members(carrier) if method == "de"
                         else carrier)
         # `buckets is not None` (not truthiness): an explicitly-empty
@@ -118,7 +124,8 @@ class ServingEngine:
 
         kwargs: Dict[str, Any] = dict(
             method=self.method, bucket=bucket, base="nats",
-            eps=self.uq.entropy_eps, run_log=self.run_log,
+            eps=self.uq.entropy_eps, engine=self.engine,
+            run_log=self.run_log,
             record_memory_only=record_memory_only,
             cache=self._program_cache,
         )
@@ -161,7 +168,7 @@ class ServingEngine:
             padded = np.zeros((bucket,) + rows.shape[1:], np.float32)
             padded[:n] = rows
         label = serve_program_label(self.model, method=self.method,
-                                    bucket=bucket)
+                                    bucket=bucket, engine=self.engine)
         metrics = StepMetrics(self.run_log)
         stats = metrics.measure(label, lambda: self._predict(padded, bucket),
                                 n_items=n)

@@ -237,6 +237,22 @@ def _metrics_from_context(ctx: Any) -> Dict[str, Metric]:
             "ratio", True)
         put("mcd_kernel.f32_vs_bf16", kernel.get("f32_vs_bf16"),
             "ratio", True)
+    de_kernel = ok("de_kernel")
+    if de_kernel:
+        # The DE twin of the mcd_kernel ratios: same fixed operating
+        # point, member sweep instead of MC passes — unbound relatives.
+        put("de_kernel.xla_vs_pallas", de_kernel.get("xla_vs_pallas"),
+            "ratio", True)
+        put("de_kernel.f32_vs_bf16", de_kernel.get("f32_vs_bf16"),
+            "ratio", True)
+    autotune = ok("autotune")
+    if autotune:
+        # Best measured default-vs-winner speedup across the swept
+        # labels (ops/autotune.py): ~1.0 on CPU fallback bodies, >1.0
+        # when a non-default tile geometry wins on device — the
+        # relative metric engine-default flips are arbitrated on.
+        put("autotune.best_vs_default", autotune.get("best_vs_default"),
+            "ratio", True)
     fused = ok("fused_reduction")
     if fused:
         put("fused.fused_vs_full", fused.get("fused_vs_full"), "ratio",

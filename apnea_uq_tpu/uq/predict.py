@@ -26,9 +26,10 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apnea_uq_tpu.compilecache import store as program_store
-from apnea_uq_tpu.config import VALID_MCD_ENGINES
+from apnea_uq_tpu.config import VALID_DE_ENGINES, VALID_MCD_ENGINES
 from apnea_uq_tpu.models.cnn1d import AlarconCNN1D, apply_model, predict_proba
-from apnea_uq_tpu.ops import pallas_mcd
+from apnea_uq_tpu.ops import autotune as autotune_mod
+from apnea_uq_tpu.ops import pallas_de, pallas_mcd
 from apnea_uq_tpu.parallel import mesh as mesh_lib
 from apnea_uq_tpu.telemetry import memory as telemetry_memory
 from apnea_uq_tpu.uq.metrics import N_STAT_ROWS, sufficient_stats
@@ -49,13 +50,14 @@ _MCD_MODES = {"clean": "mcd_clean", "parity": "mcd_parity"}
 # for label string constants) all key off these exact strings.  The
 # grammar is base + optional suffixes in fixed order:
 #   mcd[_chunk]_predict[_pallas][_fused][_bf16]
-#   de[_chunk]_predict[_fused][_bf16]
+#   de[_chunk]_predict[_pallas][_fused][_bf16]
 # `_chunk` = the streamed per-chunk program, `_pallas` = the fused
-# ops/pallas_mcd.py engine was REQUESTED (the label tracks the request;
-# off-TPU the same label runs the XLA fallback body, exactly like the
-# bootstrap kernel), `_fused` = on-device sufficient-statistics
-# reduction, `_bf16` = ModelConfig.compute_dtype='bfloat16' (the audit's
-# blessed low-precision tier — audit/rules.py program-dtype-drift).
+# kernel engine was REQUESTED (ops/pallas_mcd.py for MCD,
+# ops/pallas_de.py for DE; the label tracks the request — off-TPU the
+# same label runs the XLA fallback body, exactly like the bootstrap
+# kernel), `_fused` = on-device sufficient-statistics reduction,
+# `_bf16` = ModelConfig.compute_dtype='bfloat16' (the audit's blessed
+# low-precision tier — audit/rules.py program-dtype-drift).
 MCD_PROGRAM_LABELS = (
     "mcd_predict", "mcd_predict_bf16",
     "mcd_predict_fused", "mcd_predict_fused_bf16",
@@ -69,8 +71,12 @@ MCD_PROGRAM_LABELS = (
 DE_PROGRAM_LABELS = (
     "de_predict", "de_predict_bf16",
     "de_predict_fused", "de_predict_fused_bf16",
+    "de_predict_pallas", "de_predict_pallas_bf16",
+    "de_predict_pallas_fused", "de_predict_pallas_fused_bf16",
     "de_chunk_predict", "de_chunk_predict_bf16",
     "de_chunk_predict_fused", "de_chunk_predict_fused_bf16",
+    "de_chunk_predict_pallas", "de_chunk_predict_pallas_bf16",
+    "de_chunk_predict_pallas_fused", "de_chunk_predict_pallas_fused_bf16",
 )
 
 # The online serving tier's bucket ladder (apnea_uq_tpu/serving/): every
@@ -79,19 +85,26 @@ DE_PROGRAM_LABELS = (
 # serve process never compiles on the request path.  The ladder constant
 # lives on the jax-free side (serving/coalescer.py — the CLI parser
 # reads it at build time) and the ladder is part of the label grammar —
-# `{mcd|de}_serve_b<bucket>_fused[_bf16]` — so the warm-cache zoo, the
-# audit manifest, and the drift pin all name the bucket programs
-# individually (a bucket that fell out of the store would otherwise pay
-# a silent request-path compile).
+# `{mcd|de}_serve_b<bucket>[_pallas]_fused[_bf16]` — so the warm-cache
+# zoo, the audit manifest, and the drift pin all name the bucket
+# programs individually (a bucket that fell out of the store would
+# otherwise pay a silent request-path compile).  `_pallas` tracks the
+# REQUESTED serving engine exactly like the eval grammar above.
 from apnea_uq_tpu.serving.coalescer import SERVE_BUCKET_SIZES  # noqa: E402
 
 SERVE_PROGRAM_LABELS = (
     "mcd_serve_b16_fused", "mcd_serve_b16_fused_bf16",
     "mcd_serve_b64_fused", "mcd_serve_b64_fused_bf16",
     "mcd_serve_b256_fused", "mcd_serve_b256_fused_bf16",
+    "mcd_serve_b16_pallas_fused", "mcd_serve_b16_pallas_fused_bf16",
+    "mcd_serve_b64_pallas_fused", "mcd_serve_b64_pallas_fused_bf16",
+    "mcd_serve_b256_pallas_fused", "mcd_serve_b256_pallas_fused_bf16",
     "de_serve_b16_fused", "de_serve_b16_fused_bf16",
     "de_serve_b64_fused", "de_serve_b64_fused_bf16",
     "de_serve_b256_fused", "de_serve_b256_fused_bf16",
+    "de_serve_b16_pallas_fused", "de_serve_b16_pallas_fused_bf16",
+    "de_serve_b64_pallas_fused", "de_serve_b64_pallas_fused_bf16",
+    "de_serve_b256_pallas_fused", "de_serve_b256_pallas_fused_bf16",
 )
 
 
@@ -116,9 +129,15 @@ def mcd_program_label(model: AlarconCNN1D, *, streamed: bool, engine: str,
     return label
 
 
-def de_program_label(model: AlarconCNN1D, *, streamed: bool,
+def de_program_label(model: AlarconCNN1D, *, streamed: bool, engine: str,
                      fused: bool) -> str:
+    """The DE program label a (model config, engine, path) combination
+    prices/stores/dispatches under — same REQUESTED-engine discipline as
+    :func:`mcd_program_label` (off-TPU the `_pallas` label runs the XLA
+    fallback body under the same name)."""
     label = "de_chunk_predict" if streamed else "de_predict"
+    if engine == "pallas":
+        label += "_pallas"
     if fused:
         label += "_fused"
     label += _dtype_tag(model)
@@ -126,19 +145,24 @@ def de_program_label(model: AlarconCNN1D, *, streamed: bool,
     return label
 
 
-def serve_program_label(model: AlarconCNN1D, *, method: str,
-                        bucket: int) -> str:
-    """The serving-tier program label one (method, bucket, dtype) cell
-    prices/stores/dispatches under — `{mcd|de}_serve_b<bucket>_fused`
-    plus the shared ``_bf16`` dtype tag.  Always the fused-stats body
-    (an online request wants the (4, bucket) sufficient-stats D2H
-    payload, never the (K, bucket) stack) and always the XLA engine:
-    the serving tier keeps ONE body per label on every backend, so a
-    CPU audit, a warm-cache, and a TPU serve process name — and get —
-    the same program."""
+def serve_program_label(model: AlarconCNN1D, *, method: str, bucket: int,
+                        engine: str = "xla") -> str:
+    """The serving-tier program label one (method, bucket, engine,
+    dtype) cell prices/stores/dispatches under —
+    `{mcd|de}_serve_b<bucket>[_pallas]_fused` plus the shared ``_bf16``
+    dtype tag.  Always the fused-stats body (an online request wants the
+    (4, bucket) sufficient-stats D2H payload, never the (K, bucket)
+    stack).  ``engine`` follows the REQUESTED-engine discipline of the
+    eval grammar: the `_pallas` label names the fused-kernel request and
+    runs the XLA fallback body under the same name off-TPU, so a CPU
+    audit, a warm-cache, and a TPU serve process name — and get — the
+    same program."""
     if method not in ("mcd", "de"):
         raise ValueError(f"method must be 'mcd' or 'de', got {method!r}")
-    label = f"{method}_serve_b{int(bucket)}_fused" + _dtype_tag(model)
+    label = f"{method}_serve_b{int(bucket)}"
+    if engine == "pallas":
+        label += "_pallas"
+    label += "_fused" + _dtype_tag(model)
     assert label in SERVE_PROGRAM_LABELS, label
     return label
 
@@ -154,6 +178,7 @@ def serve_bucket_predict(
     key: Optional[jax.Array] = None,
     base: str = "nats",
     eps: float = 1e-10,
+    engine: str = "xla",
     run_log=None,
     record_memory_only: bool = False,
     cache: Optional[dict] = None,
@@ -178,6 +203,13 @@ def serve_bucket_predict(
     ``record_memory_only=True`` is the warm-cache/audit no-dispatch
     mode.
 
+    ``engine='pallas'`` (``UQConfig.mcd_engine`` / ``UQConfig.de_engine``
+    by method) requests the fused serving kernel — ops/pallas_mcd.py for
+    MCD buckets, ops/pallas_de.py for DE buckets — under the bucket's
+    `_pallas` label, resolving through the shared fallback rules
+    (:func:`resolve_engine`) and baking any autotuned tile geometry
+    (ops/autotune.py) into the dispatched program.
+
     ``cache`` (a caller-owned dict — the ServingEngine passes its own)
     memoizes the acquisition per label: the first call pays weight
     placement, store-signature hashing, the compile_event, and the
@@ -191,7 +223,9 @@ def serve_bucket_predict(
             f"the serving ladder's labels are registered per bucket "
             f"(compilecache/zoo.py GROUP_LABELS['serve'])"
         )
-    label = serve_program_label(model, method=method, bucket=bucket)
+    label = serve_program_label(model, method=method, bucket=bucket,
+                                engine=engine)
+    geometry = autotune_mod.tuned_kernel_kwargs(label)
     cached = cache.get(label) if cache is not None else None
     if cached is None:
         # Canonical weight placement: checkpoint-restored weights come
@@ -226,10 +260,12 @@ def serve_bucket_predict(
             key = prng.stochastic_key(0)
         fn = _mcd_stats_jit
         args = (model, variables, x, key, n_passes, _MCD_MODES["clean"],
-                bucket, base, float(eps), None, "xla")
+                bucket, base, float(eps), None,
+                resolve_mcd_engine(engine, "clean", None), geometry)
     else:
         fn = _ensemble_stats_jit
-        args = (model, variables, x, bucket, base, float(eps))
+        args = (model, variables, x, bucket, base, float(eps),
+                resolve_de_engine(engine, None), geometry)
     if cached is None:
         program = program_store.get_program(label, fn, *args,
                                             run_log=run_log)
@@ -246,24 +282,49 @@ def serve_bucket_predict(
     return program(*args) if program is not None else fn(*args)
 
 
-def resolve_mcd_engine(engine: str, mode: str,
-                       mesh: Optional[jax.sharding.Mesh]) -> str:
-    """The engine a predict call actually dispatches.  'pallas' resolves
-    to the fused kernel only where the kernel is valid — TPU backend,
-    ``mode='clean'`` (parity mode's BatchNorm batch statistics are
-    whole-chunk reductions, incompatible with independent window tiles),
-    single device — and silently falls back to the XLA body everywhere
-    else, exactly like the bootstrap kernel's off-TPU fallback
-    (ops/pallas_bootstrap.py).  Program LABELS track the requested
-    engine (:func:`mcd_program_label`); only the dispatched body
-    changes."""
+def resolve_engine(engine: str, mode: str,
+                   mesh: Optional[jax.sharding.Mesh], available) -> str:
+    """The ONE fallback-rule table every fused-kernel family resolves
+    through.  'pallas' resolves to the fused kernel only where a kernel
+    is valid — ``mode='clean'`` (parity mode's BatchNorm batch
+    statistics are whole-chunk reductions, incompatible with independent
+    window tiles; DE always passes 'clean' since members run eval mode),
+    single device (``mesh is None`` — the kernels are per-chip
+    programs), and ``available()`` true (TPU backend with the pallas TPU
+    package importable) — and silently falls back to the XLA body
+    everywhere else, exactly like the bootstrap kernel's off-TPU
+    fallback (ops/pallas_bootstrap.py).  Program LABELS track the
+    requested engine (:func:`mcd_program_label` /
+    :func:`de_program_label` / :func:`serve_program_label`); only the
+    dispatched body changes."""
     if engine not in VALID_MCD_ENGINES:
         raise ValueError(
             f"engine must be one of {VALID_MCD_ENGINES}, got {engine!r}")
     if (engine == "pallas" and mode == "clean" and mesh is None
-            and pallas_mcd.pallas_mcd_available()):
+            and available()):
         return "pallas"
     return "xla"
+
+
+def resolve_mcd_engine(engine: str, mode: str,
+                       mesh: Optional[jax.sharding.Mesh]) -> str:
+    """The engine an MCD predict call actually dispatches — the shared
+    :func:`resolve_engine` rules gated on the MCD kernel's availability
+    (ops/pallas_mcd.py)."""
+    return resolve_engine(engine, mode, mesh, pallas_mcd.pallas_mcd_available)
+
+
+def resolve_de_engine(engine: str,
+                      mesh: Optional[jax.sharding.Mesh]) -> str:
+    """The engine a DE predict call actually dispatches — the shared
+    :func:`resolve_engine` rules gated on the DE kernel's availability
+    (ops/pallas_de.py).  DE members always run eval mode (frozen
+    running-statistics BN), so the mode rule is satisfied by
+    construction and only the mesh and backend rules can fall back."""
+    if engine not in VALID_DE_ENGINES:
+        raise ValueError(
+            f"engine must be one of {VALID_DE_ENGINES}, got {engine!r}")
+    return resolve_engine(engine, "clean", mesh, pallas_de.pallas_de_available)
 
 
 def _uq_stats(probs: jax.Array, base: str, eps: float) -> jax.Array:
@@ -337,27 +398,30 @@ def _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh):
 
 
 def _chunk_passes(model, variables, chunk, key, keys, chunk_idx, mode,
-                  mesh, engine):
+                  mesh, engine, geometry=()):
     """ONE chunk's T stochastic passes under the RESOLVED engine: the
     XLA vmap body (:func:`_mcd_passes`) or the fused Pallas kernel
     (ops/pallas_mcd.py, clean-mode single-device TPU only — the
     resolver guarantees it).  The pallas body re-derives its hardware
     seed from (key, chunk_idx), the kernel-side spelling of the XLA
-    path's per-(pass, chunk) fold_in discipline."""
+    path's per-(pass, chunk) fold_in discipline.  ``geometry`` is the
+    label's autotuned tile-geometry kwargs (ops/autotune.py), a static
+    tuple of (name, value) pairs — empty means kernel defaults."""
     if engine == "pallas":
         with jax.named_scope("mcd_pallas"):
             return pallas_mcd.mcd_pallas_passes(
-                model, variables, chunk, key, chunk_idx, keys.shape[0])
+                model, variables, chunk, key, chunk_idx, keys.shape[0],
+                **dict(geometry))
     return _mcd_passes(model, variables, chunk, keys, chunk_idx, mode, mesh)
 
 
 @partial(
     jax.jit,
     static_argnames=("model", "n_passes", "mode", "batch_size", "mesh",
-                     "engine"),
+                     "engine", "geometry"),
 )
 def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None,
-             engine="xla"):
+             engine="xla", geometry=()):
     """With ``mesh``, the T stochastic passes shard over the ``ensemble``
     axis and each chunk's windows over the ``data`` axis, so all devices
     work on every chunk; the computation per (pass, window) is unchanged —
@@ -370,7 +434,7 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None,
         with jax.named_scope("mcd_chunk"):
             chunk, chunk_idx = args
             return _chunk_passes(model, variables, chunk, key, keys,
-                                 chunk_idx, mode, mesh, engine)
+                                 chunk_idx, mode, mesh, engine, geometry)
 
     probs = jax.lax.map(
         one_chunk, (chunks, jnp.arange(chunks.shape[0]))
@@ -380,25 +444,26 @@ def _mcd_jit(model, variables, x, key, n_passes, mode, batch_size, mesh=None,
 
 
 @partial(jax.jit,
-         static_argnames=("model", "n_passes", "mode", "mesh", "engine"))
+         static_argnames=("model", "n_passes", "mode", "mesh", "engine",
+                          "geometry"))
 def _mcd_chunk_jit(model, variables, chunk, key, chunk_idx, n_passes, mode,
-                   mesh=None, engine="xla"):
+                   mesh=None, engine="xla", geometry=()):
     """All T passes of ONE window chunk — the streamed unit of work.
     Same body as the in-HBM path (:func:`_chunk_passes`): split to T keys,
     fold in the chunk index, identical sharding, so streamed and in-HBM
     predictions are identical and a pod's chips all work on every chunk."""
     keys = jax.random.split(key, n_passes)
     return _chunk_passes(model, variables, chunk, key, keys, chunk_idx,
-                         mode, mesh, engine)
+                         mode, mesh, engine, geometry)
 
 
 @partial(
     jax.jit,
     static_argnames=("model", "n_passes", "mode", "batch_size", "base",
-                     "mesh", "engine"),
+                     "mesh", "engine", "geometry"),
 )
 def _mcd_stats_jit(model, variables, x, key, n_passes, mode, batch_size,
-                   base, eps, mesh=None, engine="xla"):
+                   base, eps, mesh=None, engine="xla", geometry=()):
     """Fused in-HBM MCD program: same chunked T-pass body as
     :func:`_mcd_jit` (same keys, same masks, same sharding), but each
     chunk's (T, bs) probabilities collapse on device to the (4, bs)
@@ -414,7 +479,7 @@ def _mcd_stats_jit(model, variables, x, key, n_passes, mode, batch_size,
         with jax.named_scope("mcd_chunk"):
             chunk, chunk_idx = args
             probs = _chunk_passes(model, variables, chunk, key, keys,
-                                  chunk_idx, mode, mesh, engine)
+                                  chunk_idx, mode, mesh, engine, geometry)
             return _constrain(_uq_stats(probs, base, eps), mesh, None,
                               mesh_lib.AXIS_DATA)
 
@@ -427,17 +492,19 @@ def _mcd_stats_jit(model, variables, x, key, n_passes, mode, batch_size,
 
 @partial(
     jax.jit,
-    static_argnames=("model", "n_passes", "mode", "base", "mesh", "engine"),
+    static_argnames=("model", "n_passes", "mode", "base", "mesh", "engine",
+                     "geometry"),
 )
 def _mcd_chunk_stats_jit(model, variables, chunk, key, chunk_idx, n_passes,
-                         mode, base, eps, mesh=None, engine="xla"):
+                         mode, base, eps, mesh=None, engine="xla",
+                         geometry=()):
     """Fused streamed unit of work: all T passes of ONE chunk
     (:func:`_mcd_chunk_jit`'s exact body — same key discipline, same
     sharding) reduced on device to the chunk's (4, bs) sufficient
     statistics, so the per-chunk D2H fetch shrinks from T rows to 4."""
     keys = jax.random.split(key, n_passes)
     probs = _chunk_passes(model, variables, chunk, key, keys, chunk_idx,
-                          mode, mesh, engine)
+                          mode, mesh, engine, geometry)
     return _constrain(_uq_stats(probs, base, eps), mesh, None,
                       mesh_lib.AXIS_DATA)
 
@@ -599,20 +666,23 @@ def mc_dropout_predict_streaming(
             mcd_program_label(model, streamed=True, engine=engine,
                               fused=True),
             _mcd_chunk_stats_jit, N_STAT_ROWS)
+        geometry = autotune_mod.tuned_kernel_kwargs(label)
 
         def chunk_args(chunk, ci):
             return (model, variables, chunk, key, jnp.asarray(ci, jnp.int32),
                     n_passes, _MCD_MODES[mode], base, eps, mesh,
-                    resolved_engine)
+                    resolved_engine, geometry)
     else:
         label, fn, n_rows = (
             mcd_program_label(model, streamed=True, engine=engine,
                               fused=False),
             _mcd_chunk_jit, n_passes)
+        geometry = autotune_mod.tuned_kernel_kwargs(label)
 
         def chunk_args(chunk, ci):
             return (model, variables, chunk, key, jnp.asarray(ci, jnp.int32),
-                    n_passes, _MCD_MODES[mode], mesh, resolved_engine)
+                    n_passes, _MCD_MODES[mode], mesh, resolved_engine,
+                    geometry)
 
     # Abstract chunk at the placement the real streamed chunks land with
     # (sharded over the data axis on a mesh), so the acquired/priced
@@ -743,12 +813,14 @@ def mc_dropout_predict(
         label, fn = (mcd_program_label(model, streamed=False, engine=engine,
                                        fused=True), _mcd_stats_jit)
         args = (model, variables, x, key, n_passes, _MCD_MODES[mode],
-                batch_size, base, float(eps), mesh, resolved_engine)
+                batch_size, base, float(eps), mesh, resolved_engine,
+                autotune_mod.tuned_kernel_kwargs(label))
     else:
         label, fn = (mcd_program_label(model, streamed=False, engine=engine,
                                        fused=False), _mcd_jit)
         args = (model, variables, x, key, n_passes, _MCD_MODES[mode],
-                batch_size, mesh, resolved_engine)
+                batch_size, mesh, resolved_engine,
+                autotune_mod.tuned_kernel_kwargs(label))
     program = program_store.get_program(label, fn, *args, run_log=run_log)
     if run_log is not None:
         # Compiled-HBM accounting (one memory_profile event per program
@@ -784,12 +856,44 @@ def as_stacked_members(member_variables) -> dict:
     return member_variables
 
 
-@partial(jax.jit, static_argnames=("model", "batch_size"))
-def _ensemble_jit(model, stacked_variables, x, batch_size):
+def _de_chunk_probs(model, stacked_variables, chunk, engine, geometry):
+    """ONE chunk's (N, bs) member probabilities under the RESOLVED
+    engine: the XLA member vmap or the fused Pallas kernel
+    (ops/pallas_de.py, single-device TPU only — the resolver guarantees
+    it).  ``geometry`` is the label's autotuned tile-geometry kwargs
+    (ops/autotune.py), a static tuple of (name, value) pairs — empty
+    means kernel defaults."""
+    if engine == "pallas":
+        with jax.named_scope("de_pallas"):
+            return pallas_de.de_pallas_members(
+                model, stacked_variables, chunk, **dict(geometry))
+    return _member_vmap(model, stacked_variables, chunk)
+
+
+def _de_chunk_stats(model, stacked_variables, chunk, base, eps, engine,
+                    geometry):
+    """ONE chunk reduced to its (4, bs) sufficient statistics under the
+    RESOLVED engine.  The pallas body fuses the member reduction
+    IN-KERNEL (ops/pallas_de.py ``de_pallas_stats`` — the (N, tile)
+    probability block never leaves VMEM); the XLA body reduces the vmap
+    output with the same ``sufficient_stats`` formula."""
+    if engine == "pallas":
+        with jax.named_scope("de_pallas"):
+            return pallas_de.de_pallas_stats(
+                model, stacked_variables, chunk, base=base, eps=float(eps),
+                **dict(geometry))
+    return _uq_stats(_member_vmap(model, stacked_variables, chunk), base, eps)
+
+
+@partial(jax.jit,
+         static_argnames=("model", "batch_size", "engine", "geometry"))
+def _ensemble_jit(model, stacked_variables, x, batch_size, engine="xla",
+                  geometry=()):
     chunks, m = _chunk(x, batch_size)
 
     def one_chunk(chunk):
-        return _ensemble_chunk_jit.__wrapped__(model, stacked_variables, chunk)
+        return _de_chunk_probs(model, stacked_variables, chunk, engine,
+                               geometry)
 
     probs = jax.lax.map(one_chunk, chunks)              # (chunks, N, bs)
     n_members = probs.shape[1]
@@ -837,14 +941,22 @@ def _ensemble_shard_map_jit(model, stacked_variables, x, batch_size, mesh):
     return f(stacked_variables, x)[:, :m]
 
 
-@partial(jax.jit, static_argnames=("model",))
-def _ensemble_chunk_jit(model, stacked_variables, chunk):
+def _member_vmap(model, stacked_variables, chunk):
+    """The XLA DE chunk body: eval-mode member forwards vmapped over the
+    stacked member axis — shared by the single-device paths (where the
+    pallas engine is its drop-in twin) and the shard_map mesh blocks."""
     def one_member(member_vars):
         with jax.named_scope("de_member"):
             logits, _ = apply_model(model, member_vars, chunk, mode="eval")
             return predict_proba(logits)
 
     return jax.vmap(one_member)(stacked_variables)  # (N, bs)
+
+
+@partial(jax.jit, static_argnames=("model", "engine", "geometry"))
+def _ensemble_chunk_jit(model, stacked_variables, chunk, engine="xla",
+                        geometry=()):
+    return _de_chunk_probs(model, stacked_variables, chunk, engine, geometry)
 
 
 @partial(jax.jit, static_argnames=("model", "mesh"))
@@ -854,7 +966,7 @@ def _ensemble_chunk_mesh_jit(model, stacked_variables, chunk, mesh):
     each device computes its (member-group x window-slice) block of the
     chunk with purely local math."""
     f = _shard_map(
-        lambda mv, xl: _ensemble_chunk_jit.__wrapped__(model, mv, xl),
+        lambda mv, xl: _member_vmap(model, mv, xl),
         mesh=mesh,
         in_specs=(P(mesh_lib.AXIS_ENSEMBLE), P(mesh_lib.AXIS_DATA)),
         out_specs=P(mesh_lib.AXIS_ENSEMBLE, mesh_lib.AXIS_DATA),
@@ -862,17 +974,20 @@ def _ensemble_chunk_mesh_jit(model, stacked_variables, chunk, mesh):
     return f(stacked_variables, chunk)
 
 
-@partial(jax.jit, static_argnames=("model", "batch_size", "base"))
-def _ensemble_stats_jit(model, stacked_variables, x, batch_size, base, eps):
+@partial(jax.jit, static_argnames=("model", "batch_size", "base", "engine",
+                                   "geometry"))
+def _ensemble_stats_jit(model, stacked_variables, x, batch_size, base, eps,
+                        engine="xla", geometry=()):
     """Fused in-HBM DE program: :func:`_ensemble_jit`'s chunked member
-    vmap with each chunk's (N, bs) probabilities collapsed on device to
-    the (4, bs) sufficient statistics — output (and D2H) is (4, M)."""
+    body with each chunk's (N, bs) probabilities collapsed on device to
+    the (4, bs) sufficient statistics — output (and D2H) is (4, M).
+    Under the pallas engine the reduction fuses in-kernel
+    (:func:`_de_chunk_stats`)."""
     chunks, m = _chunk(x, batch_size)
 
     def one_chunk(chunk):
-        probs = _ensemble_chunk_jit.__wrapped__(model, stacked_variables,
-                                                chunk)
-        return _uq_stats(probs, base, eps)
+        return _de_chunk_stats(model, stacked_variables, chunk, base, eps,
+                               engine, geometry)
 
     stats = jax.lax.map(one_chunk, chunks)              # (chunks, 4, bs)
     stats = jnp.transpose(stats, (1, 0, 2)).reshape(N_STAT_ROWS, -1)
@@ -900,12 +1015,14 @@ def _ensemble_shard_map_stats_jit(model, stacked_variables, x, batch_size,
                       mesh_lib.AXIS_DATA)
 
 
-@partial(jax.jit, static_argnames=("model", "base"))
-def _ensemble_chunk_stats_jit(model, stacked_variables, chunk, base, eps):
-    """Fused streamed DE unit: one chunk through all members
-    (:func:`_ensemble_chunk_jit`), reduced on device to (4, bs)."""
-    probs = _ensemble_chunk_jit.__wrapped__(model, stacked_variables, chunk)
-    return _uq_stats(probs, base, eps)
+@partial(jax.jit, static_argnames=("model", "base", "engine", "geometry"))
+def _ensemble_chunk_stats_jit(model, stacked_variables, chunk, base, eps,
+                              engine="xla", geometry=()):
+    """Fused streamed DE unit: one chunk through all members, reduced on
+    device to (4, bs) — in-kernel under the pallas engine
+    (:func:`_de_chunk_stats`)."""
+    return _de_chunk_stats(model, stacked_variables, chunk, base, eps,
+                           engine, geometry)
 
 
 @partial(jax.jit, static_argnames=("model", "n_members", "base", "mesh"))
@@ -932,6 +1049,7 @@ def ensemble_predict_streaming(
     run_log=None,
     record_memory_only: bool = False,
     stats=None,
+    engine: str = "xla",
 ) -> "np.ndarray":
     """(N, M) deterministic ensemble probabilities with the window set
     streamed from HOST memory (see :func:`mc_dropout_predict_streaming`):
@@ -940,6 +1058,11 @@ def ensemble_predict_streaming(
     compute, and HBM holds O(prefetch x batch_size) windows plus the
     stacked members.  Identical results to :func:`ensemble_predict`
     (deterministic eval mode).
+
+    ``engine='pallas'`` runs each chunk through the fused member-batched
+    ops/pallas_de.py kernel where valid (no mesh, TPU), falling back to
+    the XLA body elsewhere (:func:`resolve_de_engine`); DE is
+    deterministic, so the engines agree elementwise at the f32 tier.
 
     ``stats=(entropy_base, eps)`` switches to the fused reduction: each
     chunk's member probabilities collapse on device to the per-window
@@ -953,6 +1076,7 @@ def ensemble_predict_streaming(
     """
     member_variables = as_stacked_members(member_variables)
     n_members = jax.tree.leaves(member_variables)[0].shape[0]
+    resolved_engine = resolve_de_engine(engine, mesh)
     if stats is not None:
         base, eps = stats
         eps = float(eps)
@@ -970,14 +1094,17 @@ def ensemble_predict_streaming(
     # cannot drift from the executed one.  Full-probs mesh chunks come
     # back with the wrap-padded member rows (sliced off after assembly);
     # fused chunks exclude the duplicates inside the jit.
-    label = de_program_label(model, streamed=True, fused=stats is not None)
+    label = de_program_label(model, streamed=True, engine=engine,
+                             fused=stats is not None)
+    geometry = autotune_mod.tuned_kernel_kwargs(label)
     if mesh is None and stats is None:
         fn, n_rows = _ensemble_chunk_jit, n_members
-        chunk_args = lambda chunk, ci: (model, member_variables, chunk)
+        chunk_args = lambda chunk, ci: (model, member_variables, chunk,
+                                        resolved_engine, geometry)
     elif mesh is None:
         fn, n_rows = _ensemble_chunk_stats_jit, N_STAT_ROWS
         chunk_args = lambda chunk, ci: (model, member_variables, chunk,
-                                        base, eps)
+                                        base, eps, resolved_engine, geometry)
     elif stats is None:
         fn, n_rows = _ensemble_chunk_mesh_jit, n_padded
         chunk_args = lambda chunk, ci: (model, member_variables, chunk, mesh)
@@ -1019,11 +1146,23 @@ def ensemble_predict(
     run_log=None,
     record_memory_only: bool = False,
     stats=None,
+    engine: str = "xla",
 ) -> jax.Array:
     """(N, M) deterministic probabilities from N ensemble members.
     All N members' activations for one chunk are live at once, so the
     footprint scales with ``n_members * batch_size`` rows (see the HBM
     note on :func:`mc_dropout_predict`).
+
+    ``engine='pallas'`` (``UQConfig.de_engine``) runs each chunk through
+    the fused member-batched TPU kernel (ops/pallas_de.py): every
+    member's folded weights load into VMEM once per window tile and the
+    member axis is processed in ``member_group`` batches — with
+    ``stats`` set, the sufficient-stats reduction fuses in-kernel too.
+    Where the kernel is invalid (off-TPU, a mesh) the call silently
+    falls back to the XLA body under the same label
+    (:func:`resolve_de_engine`, the shared :func:`resolve_engine`
+    fallback rules).  DE is deterministic, so the two engines agree
+    elementwise at the f32 tier (PARITY.md "Tolerance tiers").
 
     ``stats=(entropy_base, eps)`` switches to the fused reduction: the
     member probabilities collapse on device to the per-window sufficient
@@ -1041,6 +1180,7 @@ def ensemble_predict(
     so eval-de scales across a pod instead of leaving chips idle.
     """
     member_variables = as_stacked_members(member_variables)
+    resolved_engine = resolve_de_engine(engine, mesh)
     if record_memory_only:
         # Abstract window set for the drivers' pre-timing pass: same
         # program (shape/dtype/sharding), no second whole-set transfer.
@@ -1069,7 +1209,9 @@ def ensemble_predict(
     # ONE (label, fn, args) tuple drives the program-store acquisition,
     # the memory pricing and the dispatch, so the priced/stored program
     # cannot drift from the executed one.
-    label = de_program_label(model, streamed=False, fused=stats is not None)
+    label = de_program_label(model, streamed=False, engine=engine,
+                             fused=stats is not None)
+    geometry = autotune_mod.tuned_kernel_kwargs(label)
     if mesh is not None and stats is not None:
         fn = _ensemble_shard_map_stats_jit
         args = (model, member_variables, x, batch_size, n_members, base,
@@ -1079,10 +1221,12 @@ def ensemble_predict(
         args = (model, member_variables, x, batch_size, mesh)
     elif stats is not None:
         fn = _ensemble_stats_jit
-        args = (model, member_variables, x, batch_size, base, eps)
+        args = (model, member_variables, x, batch_size, base, eps,
+                resolved_engine, geometry)
     else:
         fn = _ensemble_jit
-        args = (model, member_variables, x, batch_size)
+        args = (model, member_variables, x, batch_size, resolved_engine,
+                geometry)
     program = program_store.get_program(label, fn, *args, run_log=run_log)
     if run_log is not None:
         # Compiled-HBM accounting (one memory_profile event per program

@@ -49,6 +49,14 @@ BENCH_SKIP_MCD_KERNEL=1 to skip the mcd_kernel context (XLA-vs-Pallas
 MCD engines and f32-vs-bf16 compute at the fixed smoke operating
 point; its speedup ratios gate as backend-independent relatives
 across the CPU-proxy boundary),
+BENCH_SKIP_DE_KERNEL=1 to skip the de_kernel context (XLA-vs-Pallas
+Deep-Ensemble engines at the same fixed smoke operating point, member
+sweep instead of MC passes; `de_kernel.xla_vs_pallas` gates as a
+backend-independent relative like the mcd_kernel ratios),
+BENCH_SKIP_AUTOTUNE=1 to skip the autotune context (a tiny
+window_tile x member_group/pass_group sweep through the real
+`apnea-uq autotune` harness — winners returned, never persisted;
+`autotune.best_vs_default` gates as a backend-independent relative),
 BENCH_SKIP_COMPILE=1 to skip the compile context (cold-vs-warm process
 start of the MCD hot path through the persistent compile cache + AOT
 program store, measured as two probe subprocesses),
@@ -867,6 +875,100 @@ def bench_mcd_kernel() -> dict:
     return out
 
 
+def bench_de_kernel() -> dict:
+    """Isolated ``de_kernel`` block (ISSUE 16): XLA-vs-Pallas DE engines
+    at the mcd_kernel block's FIXED smoke operating point (256 windows x
+    4 members x chunk 64 — same cheap point on every chip).  The
+    ``de_kernel.xla_vs_pallas`` speedup is a backend-independent
+    relative metric exactly like ``mcd_kernel.xla_vs_pallas``, so
+    `telemetry compare`/`trend` gate it across the CPU-proxy boundary;
+    off-TPU the pallas engine resolves to its XLA fallback
+    (uq/predict.py ``resolve_de_engine``) and the recorded
+    ``pallas_engine`` field names the body that actually ran.  The bf16
+    half runs only when the bench dtype is bf16, mirroring mcd_kernel."""
+    from apnea_uq_tpu.config import ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.uq.predict import (ensemble_predict, resolve_de_engine,
+                                         stack_member_variables)
+
+    n_windows, n_members, chunk = 256, 4, 64
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.normal(size=(n_windows, 60, 4)), jnp.float32)
+
+    def timed(dtype: str, engine: str) -> float:
+        model = AlarconCNN1D(ModelConfig(compute_dtype=dtype))
+        members = stack_member_variables([
+            init_variables(model, jax.random.key(i))
+            for i in range(n_members)
+        ])
+
+        def fn(x):
+            return jnp.sum(ensemble_predict(
+                model, members, x, batch_size=chunk, engine=engine,
+            ))
+
+        return _time(fn, x, reps=3)
+
+    t_xla = timed("float32", "xla")
+    t_pallas = timed("float32", "pallas")
+    out = {
+        "windows": n_windows,
+        "members": n_members,
+        "chunk": chunk,
+        "xla_f32_s": round(t_xla, 4),
+        "pallas_f32_s": round(t_pallas, 4),
+        "xla_vs_pallas": round(t_xla / t_pallas, 3),
+        "pallas_engine": resolve_de_engine("pallas", None),
+    }
+    if _bench_dtype() == "bfloat16":
+        t_bf16 = timed("bfloat16", "xla")
+        out["xla_bf16_s"] = round(t_bf16, 4)
+        out["f32_vs_bf16"] = round(t_xla / t_bf16, 3)
+    return out
+
+
+def bench_autotune(run_log) -> dict:
+    """Isolated ``autotune`` block (ISSUE 16): a small
+    ``window_tile x member_group/pass_group`` sweep through
+    ops/autotune.py ``run_autotune`` — the REAL harness `apnea-uq
+    autotune` runs, at a deliberately tiny operating point (one serving
+    bucket, a 2x2 grid) so the block prices the sweep machinery, not a
+    production tuning session.  Emits the harness's own
+    ``autotune_cell``/``autotune_result`` telemetry into the bench run
+    log, and reports ``autotune.best_vs_default`` — the largest
+    measured default-vs-winner speedup across the swept labels, a
+    backend-independent relative metric (~1.0 on the CPU fallback
+    bodies, where every cell dispatches the same XLA program) that
+    gates across the CPU-proxy boundary like the kernel-block ratios.
+    The winners are returned, NOT persisted: the bench must never
+    install tuned geometry under the production registry's feet."""
+    from apnea_uq_tpu.config import ModelConfig
+    from apnea_uq_tpu.ops.autotune import run_autotune
+
+    config = ModelConfig(features=(8, 16), kernel_sizes=(5, 3),
+                         dropout_rates=(0.1, 0.2))
+    document = run_autotune(
+        model_config=config, members=3, n_passes=4, windows=64, chunk=32,
+        buckets=(16,), window_tiles=(8, 16), groups=(4, 8), reps=2,
+        run_log=run_log,
+    )
+    winners = document["winners"]
+    best_label, best_ratio = None, 1.0
+    for label, record in sorted(winners.items()):
+        if record["best_vs_default"] >= best_ratio:
+            best_label, best_ratio = label, record["best_vs_default"]
+    return {
+        "labels": len(winners),
+        "best_label": best_label,
+        "best_vs_default": round(best_ratio, 3),
+        "winners": {label: {"window_tile": r["window_tile"],
+                            "group": r.get("member_group",
+                                           r.get("pass_group")),
+                            "best_vs_default": r["best_vs_default"]}
+                    for label, r in sorted(winners.items())},
+    }
+
+
 def bench_compile_startup(n_windows: int, n_passes: int, chunk: int) -> dict:
     """Cold-vs-warm process start of the MCD hot path, end to end
     (ISSUE 7): run the compile-cost probe subprocess twice against the
@@ -1354,8 +1456,8 @@ def _run_bench(run_log, proxy: bool) -> dict:
 
         primary = run("de_train", de_primary, device=True)
         for name in ("mcd", "bootstrap", "streamed", "fused", "mcd_kernel",
-                     "compile", "program_audit", "data_plane",
-                     "d2h_accounting", "quality", "serve"):
+                     "de_kernel", "autotune", "compile", "program_audit",
+                     "data_plane", "d2h_accounting", "quality", "serve"):
             run(name, None, skip=True, reason="BENCH_METRIC=de_train")
     else:
         def mcd():
@@ -1400,6 +1502,13 @@ def _run_bench(run_log, proxy: bool) -> dict:
                     if os.environ.get("BENCH_SKIP_MCD_KERNEL") else None),
         )
         attach("mcd_kernel", "mcd_kernel", kernel)
+        de_kernel = run(
+            "de_kernel", bench_de_kernel, device=True,
+            skip=bool(os.environ.get("BENCH_SKIP_DE_KERNEL")),
+            reason=("BENCH_SKIP_DE_KERNEL"
+                    if os.environ.get("BENCH_SKIP_DE_KERNEL") else None),
+        )
+        attach("de_kernel", "de_kernel", de_kernel)
 
         def de():
             result, waste_state = bench_de_train("secondary")
@@ -1461,6 +1570,12 @@ def _run_bench(run_log, proxy: bool) -> dict:
             reason=("BENCH_SKIP_SERVE"
                     if os.environ.get("BENCH_SKIP_SERVE") else None))
         attach("serve", "serve", serve_v)
+        autotune_v = run(
+            "autotune", lambda: bench_autotune(run_log),
+            skip=bool(os.environ.get("BENCH_SKIP_AUTOTUNE")),
+            reason=("BENCH_SKIP_AUTOTUNE"
+                    if os.environ.get("BENCH_SKIP_AUTOTUNE") else None))
+        attach("autotune", "autotune", autotune_v)
 
     n_ok = sum(1 for r in blocks.values() if r.get("status") == "ok")
     headline = primary

@@ -224,6 +224,23 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert kernel_ctx["xla_vs_pallas"] > 0
     assert kernel_ctx["pallas_engine"] == "xla"
     assert "f32_vs_bf16" not in kernel_ctx
+    # DE-kernel block (ISSUE 16): the ensemble twin of the MCD kernel
+    # probe — same fallback contract off-TPU, same bf16 gating.
+    de_kernel_ctx = ctx["de_kernel"]
+    assert "error" not in de_kernel_ctx, de_kernel_ctx
+    assert de_kernel_ctx["xla_f32_s"] > 0
+    assert de_kernel_ctx["pallas_f32_s"] > 0
+    assert de_kernel_ctx["xla_vs_pallas"] > 0
+    assert de_kernel_ctx["pallas_engine"] == "xla"
+    assert "f32_vs_bf16" not in de_kernel_ctx
+    # Autotune block (ISSUE 16): a tiny in-process sweep ran for real —
+    # winners picked per label, nothing persisted by the bench.
+    at_ctx = ctx["autotune"]
+    assert "error" not in at_ctx, at_ctx
+    assert at_ctx["labels"] >= 1
+    assert at_ctx["best_vs_default"] > 0
+    for w in at_ctx["winners"].values():
+        assert w["window_tile"] > 0 and w["group"] > 0
     # D2H-accounting block (ISSUE 11): the arithmetic transfer contract
     # at the run's shapes, present even when no device ran.
     d2h_ctx = ctx["d2h_accounting"]
@@ -259,7 +276,8 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert result["backend"]["requested"] == "cpu"
     blocks = result["blocks"]
     assert {n for n, b in blocks.items() if b["status"] == "ok"} == {
-        "mcd", "bootstrap", "streamed", "fused", "mcd_kernel", "de_train",
+        "mcd", "bootstrap", "streamed", "fused", "mcd_kernel", "de_kernel",
+        "autotune", "de_train",
         "earlystop_waste", "compile", "program_audit", "data_plane",
         "d2h_accounting", "quality", "serve"}, blocks
     assert all(b["seconds"] >= 0 for b in blocks.values()), blocks
@@ -291,7 +309,10 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
             "bench_metric", "bench_block", "run_finished",
             # The serving telemetry triple (ISSUE 15): the serve block
             # streams its batch/request/SLO events into the same run log.
-            "serve_batch", "serve_request", "serve_slo"} <= kinds, \
+            "serve_batch", "serve_request", "serve_slo",
+            # The autotune sweep (ISSUE 16): per-cell timings and the
+            # per-label winner verdicts land in the same run log.
+            "autotune_cell", "autotune_result"} <= kinds, \
         sorted(kinds)
     # Every block's outcome is mirrored into the run log as it happens.
     block_events = {e["name"]: e["status"] for e in events
@@ -788,6 +809,14 @@ def _stub_blocks(bench_mod, monkeypatch, *, fail=(), values=None):
         "mcd_kernel", v("mcd_kernel", {"xla_vs_pallas": 1.0,
                                        "f32_vs_bf16": 1.5,
                                        "pallas_engine": "xla"})))
+    monkeypatch.setattr(bench_mod, "bench_de_kernel", make(
+        "de_kernel", v("de_kernel", {"xla_vs_pallas": 1.0,
+                                     "f32_vs_bf16": 1.4,
+                                     "pallas_engine": "xla"})))
+    monkeypatch.setattr(bench_mod, "bench_autotune", make(
+        "autotune", v("autotune", {"labels": 4,
+                                   "best_label": "de_serve_b16_pallas_fused",
+                                   "best_vs_default": 1.0})))
     monkeypatch.setattr(bench_mod, "bench_de_earlystop_waste", make(
         "earlystop_waste", v("earlystop_waste", {"patience": 5})))
     monkeypatch.setattr(bench_mod, "bench_compile_startup", make(
@@ -835,6 +864,7 @@ class TestMainDispatch:
         # test (the same sanitization the subprocess smoke test does).
         for k in ("BENCH_METRIC", "BENCH_SKIP_DE", "BENCH_SKIP_STREAMED",
                   "BENCH_SKIP_FUSED", "BENCH_SKIP_MCD_KERNEL",
+                  "BENCH_SKIP_DE_KERNEL", "BENCH_SKIP_AUTOTUNE",
                   "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
                   "BENCH_SKIP_QUALITY", "BENCH_SKIP_SERVE",
@@ -855,11 +885,14 @@ class TestMainDispatch:
         assert out["schema"] == 2 and out["proxy"] is False
         ok = {n for n, b in out["blocks"].items() if b["status"] == "ok"}
         assert ok == {"mcd", "bootstrap", "streamed", "fused", "mcd_kernel",
-                      "de_train", "earlystop_waste", "compile",
+                      "de_kernel", "autotune", "de_train",
+                      "earlystop_waste", "compile",
                       "program_audit", "data_plane", "d2h_accounting",
                       "quality", "serve"}
         assert out["context"]["bootstrap_b100_m293k"] == {"speedup": 20.0}
         assert out["context"]["serve"]["pad_waste"] == 0.375
+        assert out["context"]["de_kernel"]["xla_vs_pallas"] == 1.0
+        assert out["context"]["autotune"]["best_vs_default"] == 1.0
         assert (out["secondary"]["context"]["early_stop_waste"]
                 == {"patience": 5})
 
@@ -876,6 +909,22 @@ class TestMainDispatch:
 
         events = telemetry.read_events(str(self.tmp_path / "bench_run"))
         assert not any(e["kind"].startswith("serve_") for e in events)
+
+    def test_skip_de_kernel_records_clean_skip(self, monkeypatch, capsys):
+        monkeypatch.setenv("BENCH_SKIP_DE_KERNEL", "1")
+        out = self._run(capsys)
+        assert out["blocks"]["de_kernel"] == {
+            "status": "skipped", "reason": "BENCH_SKIP_DE_KERNEL"}
+        assert out["context"]["de_kernel"] is None
+        assert out["blocks"]["autotune"]["status"] == "ok"
+
+    def test_skip_autotune_records_clean_skip(self, monkeypatch, capsys):
+        monkeypatch.setenv("BENCH_SKIP_AUTOTUNE", "1")
+        out = self._run(capsys)
+        assert out["blocks"]["autotune"] == {
+            "status": "skipped", "reason": "BENCH_SKIP_AUTOTUNE"}
+        assert out["context"]["autotune"] is None
+        assert out["blocks"]["de_kernel"]["status"] == "ok"
 
     def test_skip_de_drops_secondary(self, monkeypatch, capsys):
         monkeypatch.setenv("BENCH_SKIP_DE", "1")
@@ -923,6 +972,7 @@ class TestBlockIsolation:
         monkeypatch.setenv("BENCH_RUN_DIR", str(tmp_path / "bench_run"))
         for k in ("BENCH_METRIC", "BENCH_SKIP_DE", "BENCH_SKIP_STREAMED",
                   "BENCH_SKIP_FUSED", "BENCH_SKIP_MCD_KERNEL",
+                  "BENCH_SKIP_DE_KERNEL", "BENCH_SKIP_AUTOTUNE",
                   "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
                   "BENCH_SKIP_QUALITY", "BENCH_SKIP_SERVE",
@@ -1022,7 +1072,8 @@ class TestBlockIsolation:
         from apnea_uq_tpu.cli.main import main as cli_main
 
         all_blocks = ("mcd", "de_train", "bootstrap", "streamed", "fused",
-                      "mcd_kernel", "earlystop_waste", "compile",
+                      "mcd_kernel", "de_kernel", "autotune",
+                      "earlystop_waste", "compile",
                       "program_audit", "data_plane", "d2h_accounting",
                       "quality", "serve")
         _stub_blocks(self.bench_mod, monkeypatch)

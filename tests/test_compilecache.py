@@ -339,14 +339,14 @@ def _driver_labels():
     uq/predict.py spells its full MCD/DE label grids as literal tuples
     precisely so this scrape sees them).  Suffix grammar:
     [_pallas][_fused][_bf16] in that order (ISSUE 12); the serving
-    bucket ladder (ISSUE 15) adds `{mcd|de}_serve_b<bucket>_fused
-    [_bf16]` — one fixed-shape program per (method, bucket, dtype)
-    cell, spelled literally in SERVE_PROGRAM_LABELS so a bucket added
-    to the ladder without a zoo/manifest row fails here."""
+    bucket ladder (ISSUE 15) adds `{mcd|de}_serve_b<bucket>[_pallas]
+    _fused[_bf16]` — one fixed-shape program per (method, bucket,
+    engine, dtype) cell, spelled literally in SERVE_PROGRAM_LABELS so a
+    bucket added to the ladder without a zoo/manifest row fails here."""
     label_re = re.compile(
         r"^(?:(?:mcd|de)_(?:chunk_)?predict(?:_pallas)?(?:_fused)?"
         r"(?:_bf16)?"
-        r"|(?:mcd|de)_serve_b\d+_fused(?:_bf16)?"
+        r"|(?:mcd|de)_serve_b\d+(?:_pallas)?_fused(?:_bf16)?"
         r"|train_epoch|val_loss|ensemble_epoch|predict_eval(?:_bf16)?)$")
     found = set()
     for rel in ("apnea_uq_tpu/uq/predict.py",

@@ -352,6 +352,13 @@ def test_package_gate_zero_unsuppressed_findings():
                 "apnea_uq_tpu/serving/slo.py",
                 "apnea_uq_tpu/serving/stream.py",
                 "apnea_uq_tpu/serving/loadgen.py",
+                # The Pallas DE kernel + autotune harness (ISSUE 16):
+                # the kernel bodies and the winner-persisting sweep —
+                # autotune emits the documented autotune_cell /
+                # autotune_result kinds, so both must stay inside the
+                # bare-print / schema scan scope.
+                "apnea_uq_tpu/ops/pallas_de.py",
+                "apnea_uq_tpu/ops/autotune.py",
                 # The out-of-core data plane (ISSUE 9): store shard I/O
                 # and the telemetry-emitting ingest/registry paths.
                 "apnea_uq_tpu/data/store.py",

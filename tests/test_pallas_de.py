@@ -1,0 +1,404 @@
+"""Fused Pallas DE kernel + autotune harness (ISSUE 16): interpret-mode
+kernel-body tests (DE is deterministic, so the interpret twin IS the
+shipped body — tier-1's CPU exercise of the kernel MATH, not just the
+XLA fallback), engine resolution + fallback bit-identity on every DE
+program family, the extended label grammar, `de_engine` config/CLI
+plumbing, and the autotune measure→persist→activate lifecycle.
+
+The compiled kernel itself needs a TPU; everything here runs on CPU.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apnea_uq_tpu.config import ModelConfig, UQConfig  # noqa: E402
+from apnea_uq_tpu.models import AlarconCNN1D, init_variables  # noqa: E402
+from apnea_uq_tpu.models.cnn1d import apply_model, predict_proba  # noqa: E402
+from apnea_uq_tpu.ops import autotune, pallas_de  # noqa: E402
+from apnea_uq_tpu.uq.metrics import sufficient_stats  # noqa: E402
+from apnea_uq_tpu.uq.predict import (  # noqa: E402
+    DE_PROGRAM_LABELS,
+    SERVE_PROGRAM_LABELS,
+    de_program_label,
+    ensemble_predict,
+    ensemble_predict_streaming,
+    resolve_de_engine,
+    serve_bucket_predict,
+    serve_program_label,
+    stack_member_variables,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The documented tolerance tiers (PARITY.md "Tolerance tiers").
+F32_TOL = dict(rtol=0, atol=1e-6)
+BF16_TOL = dict(rtol=0, atol=2e-2)
+
+
+def _model(dtype="float32", features=(6, 8), kernels=(5, 3)):
+    return AlarconCNN1D(ModelConfig(
+        features=features, kernel_sizes=kernels,
+        dropout_rates=(0.3, 0.4), compute_dtype=dtype,
+    ))
+
+
+def _members(model, n, seed=0):
+    return stack_member_variables(
+        [init_variables(model, jax.random.key(seed + i)) for i in range(n)])
+
+
+def _eval_reference(model, stacked, x):
+    """Per-member eval-mode probabilities through the real Flax forward
+    (NOT the kernel's shifted-matmul decomposition)."""
+    xj = jnp.asarray(x, jnp.float32)
+
+    def one(variables):
+        return predict_proba(apply_model(model, variables, xj,
+                                         mode="eval")[0])
+
+    return np.stack([
+        np.asarray(one(jax.tree.map(lambda a: a[i], stacked)))
+        for i in range(jax.tree.leaves(stacked)[0].shape[0])
+    ])
+
+
+@pytest.fixture(autouse=True)
+def _defaults_active():
+    """Every test starts and ends with NO tuned geometry active — the
+    activation table is process-global state."""
+    autotune.deactivate()
+    yield
+    autotune.deactivate()
+
+
+class TestInterpretKernel:
+    """The kernel BODY under pl.pallas_call(interpret=True) — identical
+    `_de_tile_body` to the TPU path; DE needs no injected randomness,
+    so this is the exact shipped kernel at CPU-runnable geometry."""
+
+    def test_members_match_eval_mode_flax(self, rng):
+        model = _model()
+        stacked = _members(model, 3)
+        x = rng.normal(size=(11, 60, 4)).astype(np.float32)  # ragged tile
+        probs = np.asarray(pallas_de.de_forward_with_members(
+            model, stacked, x))  # tile 8, group 4 -> ragged member group
+        ref = _eval_reference(model, stacked, x)
+        assert probs.shape == (3, 11)
+        np.testing.assert_allclose(probs, ref, **F32_TOL)
+
+    def test_ragged_tiles_and_member_groups(self, rng):
+        """5 members at member_group=2 (ragged last group) across 13
+        windows at window_tile=4 (ragged last tile)."""
+        model = _model()
+        stacked = _members(model, 5, seed=3)
+        x = rng.normal(size=(13, 60, 4)).astype(np.float32)
+        probs = np.asarray(pallas_de.de_forward_with_members(
+            model, stacked, x, window_tile=4, member_group=2))
+        ref = _eval_reference(model, stacked, x)
+        assert probs.shape == (5, 13)
+        np.testing.assert_allclose(probs, ref, **F32_TOL)
+
+    def test_fused_stats_match_xla_fused(self, rng):
+        """The in-kernel sufficient-stats reduction vs the XLA fused
+        path's formula applied to the member probabilities — the two
+        engines share `sufficient_stats`, so they agree by
+        construction; this pins the plumbing."""
+        model = _model()
+        stacked = _members(model, 4, seed=5)
+        x = rng.normal(size=(10, 60, 4)).astype(np.float32)
+        stats = np.asarray(pallas_de.de_pallas_stats(
+            model, stacked, jnp.asarray(x), window_tile=8, member_group=4,
+            interpret=True))
+        probs = _eval_reference(model, stacked, x)
+        ref = np.asarray(sufficient_stats(jnp.asarray(probs)))
+        assert stats.shape == (4, 10)
+        np.testing.assert_allclose(stats, ref, **F32_TOL)
+        # ... and against the production XLA fused program end to end.
+        xla = np.asarray(ensemble_predict(
+            model, stacked, x, batch_size=8, stats=("nats", 1e-10)))
+        np.testing.assert_allclose(stats, xla, **F32_TOL)
+
+    def test_bf16_tier_against_f32_reference(self, rng):
+        """compute_dtype='bfloat16' through the kernel body stays within
+        the documented bf16 tier (<=2e-2) of the f32 reference — the
+        conv matmuls run bf16, GAP/stats accumulation stays f32."""
+        model = _model("bfloat16")
+        f32_model = _model()
+        stacked = _members(f32_model, 3, seed=7)
+        x = rng.normal(size=(9, 60, 4)).astype(np.float32)
+        bf16 = np.asarray(pallas_de.de_forward_with_members(
+            model, stacked, x))
+        ref = _eval_reference(f32_model, stacked, x)
+        np.testing.assert_allclose(bf16, ref, **BF16_TOL)
+
+
+class TestEngineResolution:
+    """resolve_de_engine: the pallas engine is requested per call but
+    dispatches only where the kernel is valid; everywhere else the XLA
+    body runs under the SAME (pallas-suffixed) label — the shared
+    resolve_engine fallback contract."""
+
+    def test_off_tpu_resolves_to_xla(self):
+        assert jax.default_backend() != "tpu"  # the CPU test rig
+        assert resolve_de_engine("pallas", None) == "xla"
+        assert resolve_de_engine("xla", None) == "xla"
+
+    def test_mesh_resolves_to_xla(self, monkeypatch):
+        # Even with the kernel nominally available, a mesh must fall
+        # back: the kernel is a per-chip program.
+        monkeypatch.setattr(pallas_de, "pallas_de_available", lambda: True)
+        from apnea_uq_tpu.parallel import make_mesh
+
+        assert resolve_de_engine("pallas", None) == "pallas"
+        assert resolve_de_engine(
+            "pallas", make_mesh(num_members=4)) == "xla"
+
+    def test_unknown_engine_rejected(self):
+        with pytest.raises(ValueError, match="engine"):
+            resolve_de_engine("bogus", None)
+
+    def test_fallback_is_bit_identical_on_every_family(self, rng):
+        """Off-TPU, engine='pallas' must produce EXACTLY the XLA path's
+        results on all four DE program families — the fallback is the
+        same body, so toggling the engine off-TPU never changes
+        predictions (only the program label)."""
+        model = _model()
+        stacked = _members(model, 3)
+        x = rng.normal(size=(21, 60, 4)).astype(np.float32)
+        for stats in (None, ("nats", 1e-10)):
+            ref = np.asarray(ensemble_predict(
+                model, stacked, x, batch_size=8, stats=stats))
+            pal = np.asarray(ensemble_predict(
+                model, stacked, x, batch_size=8, stats=stats,
+                engine="pallas"))
+            np.testing.assert_array_equal(ref, pal)
+            streamed = np.asarray(ensemble_predict_streaming(
+                model, stacked, x, batch_size=8, stats=stats,
+                engine="pallas"))
+            np.testing.assert_array_equal(ref, streamed)
+
+    def test_serve_bucket_fallback_is_bit_identical(self, rng):
+        model = _model()
+        stacked = _members(model, 3)
+        x = rng.normal(size=(16, 60, 4)).astype(np.float32)
+        ref = np.asarray(serve_bucket_predict(
+            model, stacked, x, method="de", bucket=16))
+        pal = np.asarray(serve_bucket_predict(
+            model, stacked, x, method="de", bucket=16, engine="pallas"))
+        np.testing.assert_array_equal(ref, pal)
+
+
+class TestLabelsAndConfig:
+    def test_label_grammar(self):
+        f32 = _model()
+        bf16 = _model("bfloat16")
+        assert de_program_label(f32, streamed=False, engine="pallas",
+                                fused=True) == "de_predict_pallas_fused"
+        assert de_program_label(bf16, streamed=True, engine="pallas",
+                                fused=False) == "de_chunk_predict_pallas_bf16"
+        assert serve_program_label(f32, method="de", bucket=64,
+                                   engine="pallas") == \
+            "de_serve_b64_pallas_fused"
+        assert serve_program_label(bf16, method="mcd", bucket=16,
+                                   engine="pallas") == \
+            "mcd_serve_b16_pallas_fused_bf16"
+        assert serve_program_label(f32, method="de", bucket=256) == \
+            "de_serve_b256_fused"
+
+    def test_label_tables_cover_the_grammar(self):
+        """16 DE labels (streamed x engine x fused x dtype) — the same
+        grid as MCD since ISSUE 16 — and 24 serve labels (method x
+        bucket x engine x dtype), no duplicates."""
+        assert len(set(DE_PROGRAM_LABELS)) == 16
+        assert len(set(SERVE_PROGRAM_LABELS)) == 24
+        assert len([l for l in SERVE_PROGRAM_LABELS if "_pallas" in l]) == 12
+
+    def test_de_engine_validated_at_config_load(self):
+        with pytest.raises(ValueError, match="de_engine"):
+            UQConfig(de_engine="mosaic")
+        UQConfig(de_engine="pallas")
+
+    def test_config_json_round_trips_de_engine(self, tmp_path):
+        from apnea_uq_tpu.config import (ExperimentConfig, load_config,
+                                         save_config)
+
+        cfg = ExperimentConfig(uq=UQConfig(de_engine="pallas"))
+        path = str(tmp_path / "config.json")
+        save_config(cfg, path)
+        assert load_config(path).uq.de_engine == "pallas"
+
+    def test_eval_cli_flag_parses_and_overrides(self):
+        from apnea_uq_tpu.cli.main import build_parser
+        from apnea_uq_tpu.cli.stages import _apply_eval_overrides
+        from apnea_uq_tpu.config import ExperimentConfig
+
+        parser = build_parser()
+        args = parser.parse_args(
+            ["eval-de", "--registry", "r", "--de-engine", "pallas"])
+        cfg = _apply_eval_overrides(args, ExperimentConfig())
+        assert cfg.uq.de_engine == "pallas"
+        # warm-cache and serve accept the same engine overrides, so the
+        # warmed label set equals what an identically-flagged eval/serve
+        # process dispatches.
+        for cmd in ("warm-cache", "serve"):
+            args = parser.parse_args(
+                [cmd, "--registry", "r", "--de-engine", "pallas",
+                 "--mcd-engine", "pallas"])
+            cfg = _apply_eval_overrides(args, ExperimentConfig())
+            assert cfg.uq.de_engine == "pallas"
+            assert cfg.uq.mcd_engine == "pallas"
+
+    def test_autotune_cli_registered_with_defaults(self):
+        from apnea_uq_tpu.cli.main import build_parser
+
+        args = build_parser().parse_args(
+            ["autotune", "--registry", "r", "--window-tiles", "8,16",
+             "--groups", "4,8"])
+        assert args.window_tiles == "8,16"
+        assert args.reps == 3
+
+    def test_readme_recipe_flags_parse(self):
+        """The README's autotune + de-engine recipe is flag-guarded:
+        the flags it teaches must exist and parse."""
+        readme = open(os.path.join(REPO, "README.md")).read()
+        assert "--de-engine pallas" in readme
+        assert "apnea-uq autotune" in readme
+        from apnea_uq_tpu.cli.main import build_parser
+
+        build_parser().parse_args(
+            ["eval-de", "--registry", "r", "--compute-dtype", "bfloat16",
+             "--de-engine", "pallas"])
+
+
+class TestAutotune:
+    """ops/autotune.py: the sweep measures isolated cells, the winners
+    document activates only under a matching fingerprint, and the
+    active geometry feeds both the jit static args and the program-store
+    key."""
+
+    def _doc(self, winners=None):
+        return {
+            "version": 1,
+            "fingerprint": autotune.fingerprint(),
+            "winners": winners if winners is not None else {
+                "de_predict_pallas_fused": {
+                    "window_tile": 32, "member_group": 4,
+                    "best_s": 0.01, "default_s": 0.02,
+                    "best_vs_default": 2.0,
+                },
+            },
+        }
+
+    def test_activate_and_tuned_kwargs_round_trip(self):
+        assert autotune.tuned_kernel_kwargs("de_predict_pallas_fused") == ()
+        assert autotune.active_digest() == ""
+        assert autotune.activate(self._doc()) == 1
+        assert autotune.tuned_kernel_kwargs("de_predict_pallas_fused") == (
+            ("member_group", 4), ("window_tile", 32))
+        assert autotune.active_digest() != ""
+        # Labels without a winner keep defaults.
+        assert autotune.tuned_kernel_kwargs("mcd_predict_pallas_fused") == ()
+        autotune.deactivate()
+        assert autotune.tuned_kernel_kwargs("de_predict_pallas_fused") == ()
+
+    def test_stale_fingerprint_deactivates(self):
+        doc = self._doc()
+        doc["fingerprint"] = dict(doc["fingerprint"], source="deadbeef")
+        assert autotune.activate(doc) == 0
+        assert autotune.active_digest() == ""
+        assert autotune.activate(None) == 0
+
+    def test_non_geometry_keys_never_activate(self):
+        """Only GEOMETRY_PARAMS feed the static jit signature — timing
+        fields in the record must not leak into kernel kwargs."""
+        assert autotune.activate(self._doc()) == 1
+        kwargs = dict(autotune.tuned_kernel_kwargs("de_predict_pallas_fused"))
+        assert set(kwargs) <= set(autotune.GEOMETRY_PARAMS)
+
+    def test_registry_round_trip_and_staleness(self, tmp_path):
+        from apnea_uq_tpu.data import registry as reg
+        from apnea_uq_tpu.data.registry import ArtifactRegistry
+
+        registry = ArtifactRegistry(str(tmp_path / "r"))
+        # No artifact -> defaults, no error.
+        assert autotune.activate_from_registry(registry) == 0
+        registry.save_json(reg.AUTOTUNE_CONFIG, self._doc())
+        assert autotune.activate_from_registry(registry) == 1
+        assert autotune.tuned_kernel_kwargs("de_predict_pallas_fused") != ()
+        # A stale persisted document (other source fingerprint) reverts
+        # to defaults on activation — the store's staleness discipline.
+        doc = self._doc()
+        doc["fingerprint"]["jax"] = "0.0.0"
+        registry.save_json(reg.AUTOTUNE_CONFIG, doc)
+        assert autotune.activate_from_registry(registry) == 0
+        assert autotune.tuned_kernel_kwargs("de_predict_pallas_fused") == ()
+
+    def test_active_digest_keys_the_program_store(self):
+        """Geometry is a static argument of the kernel programs, so the
+        store key MUST fold the active winner digest — a program stored
+        under one tile geometry must never be offered to a process that
+        activated another."""
+        from apnea_uq_tpu.compilecache.store import store_key
+
+        base = store_key("de_predict_pallas_fused", "sig")
+        assert autotune.activate(self._doc()) == 1
+        tuned = store_key("de_predict_pallas_fused", "sig")
+        assert tuned != base
+        autotune.deactivate()
+        assert store_key("de_predict_pallas_fused", "sig") == base
+
+    def test_run_autotune_sweeps_and_reports(self):
+        """A tiny CPU sweep end to end: every target label gets a
+        winner record with the default cell always timed (so
+        best_vs_default exists), cells are isolated, and the telemetry
+        pair is emitted per cell / per label."""
+        events = []
+
+        class Log:
+            def event(self, kind, **fields):
+                events.append({"kind": kind, **fields})
+
+        config = ModelConfig(features=(4, 6), kernel_sizes=(3, 3),
+                             dropout_rates=(0.1, 0.2))
+        doc = autotune.run_autotune(
+            model_config=config, members=3, n_passes=2, windows=16,
+            chunk=8, buckets=(16,), window_tiles=(8,), groups=(4,),
+            warmup=1, reps=1, run_log=Log(),
+        )
+        assert doc["version"] == 1
+        assert doc["fingerprint"] == autotune.fingerprint()
+        winners = doc["winners"]
+        assert set(winners) == {
+            "de_predict_pallas_fused", "de_chunk_predict_pallas_fused",
+            "de_serve_b16_pallas_fused", "mcd_serve_b16_pallas_fused",
+        }
+        for label, record in winners.items():
+            assert record["best_s"] > 0 and record["default_s"] > 0
+            assert record["best_vs_default"] > 0
+            assert record["window_tile"] > 0
+            param = "pass_group" if label.startswith("mcd") else \
+                "member_group"
+            assert record[param] > 0
+        by_kind = {}
+        for e in events:
+            by_kind.setdefault(e["kind"], []).append(e)
+        assert len(by_kind["autotune_result"]) == len(winners)
+        # The grid was (8,)x(4,) plus the always-timed default cell.
+        assert all(e["cells"] == 2 for e in by_kind["autotune_result"])
+        assert all(c["status"] in ("ok", "error")
+                   for c in by_kind["autotune_cell"])
+        assert len(by_kind["autotune_cell"]) == 2 * len(winners)
+        # The document activates on the machine that measured it.
+        assert autotune.activate(doc) == len(winners)
+
+    def test_default_geometry_constants_match_kernels(self):
+        """The sweep's default cell is the kernels' shipped default —
+        otherwise best_vs_default would compare against a geometry no
+        un-tuned process runs."""
+        assert autotune.DEFAULT_WINDOW_TILE == pallas_de.DEFAULT_WINDOW_TILE
+        assert autotune.DEFAULT_GROUP == pallas_de.DEFAULT_MEMBER_GROUP

@@ -204,18 +204,18 @@ class TestLabelsAndConfig:
         assert mcd_program_label(bf16, streamed=True, engine="pallas",
                                  fused=True) == \
             "mcd_chunk_predict_pallas_fused_bf16"
-        assert de_program_label(bf16, streamed=False, fused=True) == \
-            "de_predict_fused_bf16"
-        assert de_program_label(f32, streamed=True, fused=False) == \
-            "de_chunk_predict"
+        assert de_program_label(bf16, streamed=False, engine="xla",
+                                fused=True) == "de_predict_fused_bf16"
+        assert de_program_label(f32, streamed=True, engine="xla",
+                                fused=False) == "de_chunk_predict"
 
     def test_label_tables_cover_the_grammar(self):
-        """16 MCD labels (streamed x engine x fused x dtype) and 8 DE
-        labels (streamed x fused x dtype), no duplicates — and every
-        combination the builders can emit is in its table (the builders
-        assert membership on every call)."""
+        """16 MCD labels and 16 DE labels (streamed x engine x fused x
+        dtype — the DE grid gained its engine axis in ISSUE 16), no
+        duplicates — and every combination the builders can emit is in
+        its table (the builders assert membership on every call)."""
         assert len(set(MCD_PROGRAM_LABELS)) == 16
-        assert len(set(DE_PROGRAM_LABELS)) == 8
+        assert len(set(DE_PROGRAM_LABELS)) == 16
         for streamed in (False, True):
             for engine in ("xla", "pallas"):
                 for fused in (False, True):
@@ -223,7 +223,7 @@ class TestLabelsAndConfig:
                         mcd_program_label(model, streamed=streamed,
                                           engine=engine, fused=fused)
                         de_program_label(model, streamed=streamed,
-                                         fused=fused)
+                                         engine=engine, fused=fused)
 
     def test_compute_dtype_validated_at_config_load(self):
         with pytest.raises(ValueError, match="compute_dtype"):

@@ -192,6 +192,76 @@ def test_low_precision_and_engine_parity_tiers_everywhere(shape, mode, seed):
     np.testing.assert_array_equal(pallas_f32, full_f32)
 
 
+@settings(max_examples=8, deadline=None)
+@given(
+    shape=st.sampled_from(_FUSED_SHAPES),
+    bn=st.sampled_from(["init", "randomized"]),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_de_kernel_parity_tiers_everywhere(shape, bn, seed):
+    """ISSUE 16 satellite: the DE kernel body (interpret mode — the
+    exact shipped tile body, ops/pallas_de.py) over the same awkward
+    shapes as the fused sweep, under BOTH BatchNorm parameterizations:
+    'init' running statistics (mean 0 / var 1 — the fold degenerates to
+    scale/bias) and 'randomized' statistics (a nontrivial per-member
+    frozen-BN affine fold).  f32 kernel probabilities within <=1e-6 of
+    the per-member eval-mode Flax forward, the XLA fused-stats program
+    within <=1e-6 of `sufficient_stats` over those probabilities, and
+    the bf16 kernel body within the documented <=2e-2 tier."""
+    import jax
+    import jax.numpy as jnp
+
+    from apnea_uq_tpu.config import ModelConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.models.cnn1d import apply_model, predict_proba
+    from apnea_uq_tpu.ops import pallas_de
+    from apnea_uq_tpu.uq import ensemble_predict, sufficient_stats
+    from apnea_uq_tpu.uq.predict import stack_member_variables
+
+    m, batch_size, k = shape  # k doubles as the member count here
+    arch = dict(features=(4,), kernel_sizes=(3,), dropout_rates=(0.3,))
+    model = AlarconCNN1D(ModelConfig(**arch))
+    bf16_model = AlarconCNN1D(ModelConfig(**arch,
+                                          compute_dtype="bfloat16"))
+    rng = np.random.default_rng(seed)
+    stacked = stack_member_variables([
+        init_variables(model, jax.random.key(i)) for i in range(k)
+    ])
+    if bn == "randomized":
+        stacked = dict(stacked, batch_stats={
+            name: {
+                "mean": jnp.asarray(
+                    rng.uniform(-1.0, 1.0, size=d["mean"].shape),
+                    jnp.float32),
+                # Variance stays positive: the fold takes rsqrt of it.
+                "var": jnp.asarray(
+                    rng.uniform(0.25, 2.0, size=d["var"].shape),
+                    jnp.float32),
+            }
+            for name, d in stacked["batch_stats"].items()
+        })
+    x = rng.normal(size=(m, 60, 4)).astype(np.float32)
+    probs = np.asarray(pallas_de.de_forward_with_members(
+        model, stacked, x, window_tile=4, member_group=2))
+    ref = np.stack([
+        np.asarray(predict_proba(apply_model(
+            model, jax.tree.map(lambda a: a[i], stacked),
+            jnp.asarray(x), mode="eval")[0]))
+        for i in range(k)
+    ])
+    assert probs.shape == (k, m)
+    np.testing.assert_allclose(probs, ref, rtol=0, atol=1e-6)
+    fused = np.asarray(ensemble_predict(
+        model, stacked, x, batch_size=batch_size, stats=("nats", 1e-10)))
+    np.testing.assert_allclose(
+        fused, np.asarray(sufficient_stats(jnp.asarray(probs))),
+        rtol=0, atol=1e-6,
+    )
+    bf16 = np.asarray(pallas_de.de_forward_with_members(
+        bf16_model, stacked, x, window_tile=4, member_group=2))
+    np.testing.assert_allclose(bf16, ref, rtol=0, atol=2e-2)
+
+
 @settings(max_examples=40, deadline=None)
 @given(
     n_groups=st.integers(2, 60),

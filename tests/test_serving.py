@@ -304,8 +304,10 @@ class TestServingEngine:
 
     def test_label_grammar_matches_registry(self, tiny):
         labels = {
-            serve_program_label(tiny["model"], method=m, bucket=b)
+            serve_program_label(tiny["model"], method=m, bucket=b,
+                                engine=engine)
             for m in ("mcd", "de") for b in SERVE_BUCKET_SIZES
+            for engine in ("xla", "pallas")
         }
         assert labels == {lb for lb in SERVE_PROGRAM_LABELS
                           if not lb.endswith("_bf16")}
@@ -980,9 +982,13 @@ def test_warm_cache_then_serve_second_process(serving_registry):
     warm_labels = {e["label"]
                    for e in telemetry.read_events(warm_dir)
                    if e["kind"] == "compile_event"}
-    # The config runs f32: every f32 ladder cell, both methods.
+    # The config runs f32 with the default xla engines: every f32 xla
+    # ladder cell, both methods — `_pallas` cells warm only under an
+    # engine-flagged warm-cache (`--mcd-engine/--de-engine pallas`),
+    # exactly like `_bf16` cells under a bf16 config.
     assert warm_labels == {lb for lb in SERVE_PROGRAM_LABELS
-                           if not lb.endswith("_bf16")}
+                           if not lb.endswith("_bf16")
+                           and "_pallas" not in lb}
 
     serve_dir = str(serving_registry["root"] / "serve_run")
     proc = subprocess.run(
