@@ -295,6 +295,225 @@ def baseline_edges(baseline: Dict[str, Any]) -> List[np.ndarray]:
             for ch in baseline["channels"]]
 
 
+ROLLING_STATE_VERSION = 1
+
+
+class RollingFingerprint:
+    """Online fingerprint accumulator on a baseline's **frozen** edges.
+
+    The batch fingerprint above is one pass over a materialized window
+    set; the serving tier needs the same statistics *online*, one scored
+    window at a time, with bounded memory and a recency bias.  This
+    variant keeps O(channels x bins) state — decayed histogram counts
+    plus decayed moment/shape sums — binned on the baseline's own
+    histogram edges, so :func:`drift_report` can score it against the
+    frozen ``quality_baseline`` directly (PSI/KS numbers comparable to
+    the eval-time ``drift_fingerprint`` events).
+
+    ``half_life`` (in windows) sets the exponential decay: after that
+    many further windows an observation's weight has halved, so the
+    fingerprint tracks *recent* traffic and a resolved upstream incident
+    ages out instead of polluting the score forever.  ``None`` disables
+    decay (cumulative counts — the all-traffic view).
+
+    The full state round-trips through :meth:`to_json` /
+    :meth:`from_json` (plain JSON scalars/lists), which is how it rides
+    the stream scorer's atomic ``stream_state.json`` snapshot: a kill -9
+    resume restores the rolling window instead of resetting the verdict.
+    Jax-free like the rest of the module — update cost is a handful of
+    numpy reductions per window batch, never a compile.
+    """
+
+    def __init__(self, baseline: Dict[str, Any], *,
+                 half_life: Optional[float] = None):
+        names = [ch["name"] for ch in baseline.get("channels") or []]
+        if not names:
+            raise ValueError("baseline fingerprint has no channels")
+        self.channel_names = names
+        self.edges = baseline_edges(baseline)
+        if half_life is not None and half_life <= 0:
+            raise ValueError(f"half_life must be positive, got {half_life}")
+        self.half_life = None if half_life is None else float(half_life)
+        self._decay = (1.0 if half_life is None
+                       else float(0.5 ** (1.0 / half_life)))
+        c, b = len(names), len(self.edges[0]) - 1
+        self.counts = np.zeros((c, b), np.float64)
+        self.sum = np.zeros(c, np.float64)
+        self.sumsq = np.zeros(c, np.float64)
+        self.finite_w = np.zeros(c, np.float64)
+        self.nan_w = np.zeros(c, np.float64)
+        self.flat_w = np.zeros(c, np.float64)
+        self.sat_w = np.zeros(c, np.float64)
+        self.run_min = np.full(c, np.inf)
+        self.run_max = np.full(c, -np.inf)
+        self.window_w = 0.0   # decayed effective window count
+        self.seen = 0         # total windows ever ingested (no decay)
+        self.steps: Optional[int] = None
+
+    def update(self, windows) -> None:
+        """Fold a window — shape (T, C) — or a batch (N, T, C) into the
+        rolling state.  An n-window batch fades the prior state by
+        ``decay**n`` and enters at full weight: relative recency INSIDE
+        one fold is not modeled (folds are a handful of windows against
+        a half-life of thousands), but n windows always advance the
+        clock by n regardless of how they were batched."""
+        block = np.asarray(windows, np.float64)
+        if block.ndim == 2:
+            block = block[None]
+        if block.ndim != 3 or block.shape[-1] != len(self.channel_names):
+            raise ValueError(
+                f"expected (T, {len(self.channel_names)}) or "
+                f"(N, T, {len(self.channel_names)}) windows, got shape "
+                f"{block.shape}")
+        n, steps, _c = block.shape
+        if n == 0:
+            return
+        if self.steps is None:
+            self.steps = int(steps)
+        if self._decay != 1.0:
+            fade = self._decay ** n
+            self.counts *= fade
+            self.sum *= fade
+            self.sumsq *= fade
+            self.finite_w *= fade
+            self.nan_w *= fade
+            self.flat_w *= fade
+            self.sat_w *= fade
+            self.window_w *= fade
+        finite = np.isfinite(block)
+        self.nan_w += (~finite).sum(axis=(0, 1))
+        self.finite_w += finite.sum(axis=(0, 1))
+        safe = np.where(finite, block, 0.0)
+        self.sum += safe.sum(axis=(0, 1))
+        self.sumsq += (safe * safe).sum(axis=(0, 1))
+        w_min = np.where(finite, block, np.inf).min(axis=1)
+        w_max = np.where(finite, block, -np.inf).max(axis=1)
+        has_finite = finite.any(axis=1)
+        self.run_min = np.minimum(
+            self.run_min,
+            np.where(np.isfinite(w_min), w_min, np.inf).min(axis=0))
+        self.run_max = np.maximum(
+            self.run_max,
+            np.where(np.isfinite(w_max), w_max, -np.inf).max(axis=0))
+        flat = has_finite & (w_max == w_min)
+        self.flat_w += flat.sum(axis=0)
+        railed = (np.isclose(block, w_min[:, None, :])
+                  | np.isclose(block, w_max[:, None, :])) & finite
+        railed_frac = railed.sum(axis=1) / np.maximum(
+            finite.sum(axis=1), 1)
+        self.sat_w += (has_finite & ~flat
+                       & (railed_frac > _SATURATION_FRACTION)).sum(axis=0)
+        for c in range(len(self.channel_names)):
+            vals = block[:, :, c][finite[:, :, c]]
+            if vals.size:
+                clipped = np.clip(vals, self.edges[c][0],
+                                  self.edges[c][-1])
+                self.counts[c] += np.histogram(clipped,
+                                               bins=self.edges[c])[0]
+        self.window_w += float(n)
+        self.seen += int(n)
+
+    def fingerprint(self) -> Dict[str, Any]:
+        """The rolling state as a fingerprint document — same shape as
+        :func:`compute_fingerprint`'s, so :func:`drift_report` accepts
+        it as the ``current`` side against the frozen baseline."""
+        if self.seen == 0:
+            raise ValueError("rolling fingerprint has seen no windows")
+        channels = []
+        for c, name in enumerate(self.channel_names):
+            wf = self.finite_w[c]
+            mean = self.sum[c] / wf if wf > 0 else 0.0
+            var = (max(self.sumsq[c] / wf - mean * mean, 0.0)
+                   if wf > 0 else 0.0)
+            samples_w = wf + self.nan_w[c]
+            channels.append({
+                "name": name,
+                "mean": round(float(mean), 9),
+                "std": round(float(np.sqrt(var)), 9),
+                "min": (float(self.run_min[c])
+                        if np.isfinite(self.run_min[c]) else None),
+                "max": (float(self.run_max[c])
+                        if np.isfinite(self.run_max[c]) else None),
+                "nan_rate": round(float(self.nan_w[c] / samples_w), 9)
+                if samples_w > 0 else 0.0,
+                "flatline_rate": round(
+                    float(self.flat_w[c] / self.window_w), 9)
+                if self.window_w > 0 else 0.0,
+                "saturation_rate": round(
+                    float(self.sat_w[c] / self.window_w), 9)
+                if self.window_w > 0 else 0.0,
+                "quantiles": _hist_quantiles(self.edges[c],
+                                             self.counts[c]),
+                "edges": [float(e) for e in self.edges[c]],
+                "counts": [float(v) for v in self.counts[c]],
+            })
+        return {
+            "version": FINGERPRINT_VERSION,
+            "rows": max(int(round(self.window_w)), 1),
+            "window_steps": int(self.steps or 0),
+            "num_bins": int(self.counts.shape[1]),
+            "channels": channels,
+        }
+
+    def score(self, baseline: Dict[str, Any]) -> Dict[str, Any]:
+        """:func:`drift_report` of the rolling state vs ``baseline`` —
+        valid because the state accumulated on the baseline's edges."""
+        return drift_report(baseline, self.fingerprint())
+
+    def to_json(self) -> Dict[str, Any]:
+        """The complete rolling state as plain JSON scalars/lists."""
+        return {
+            "version": ROLLING_STATE_VERSION,
+            "half_life": self.half_life,
+            "channel_names": list(self.channel_names),
+            "edges": [[float(e) for e in ed] for ed in self.edges],
+            "counts": [[float(v) for v in row] for row in self.counts],
+            "sum": [float(v) for v in self.sum],
+            "sumsq": [float(v) for v in self.sumsq],
+            "finite_w": [float(v) for v in self.finite_w],
+            "nan_w": [float(v) for v in self.nan_w],
+            "flat_w": [float(v) for v in self.flat_w],
+            "sat_w": [float(v) for v in self.sat_w],
+            "min": [float(v) if np.isfinite(v) else None
+                    for v in self.run_min],
+            "max": [float(v) if np.isfinite(v) else None
+                    for v in self.run_max],
+            "window_w": float(self.window_w),
+            "seen": int(self.seen),
+            "steps": self.steps,
+        }
+
+    @classmethod
+    def from_json(cls, doc: Dict[str, Any]) -> "RollingFingerprint":
+        version = doc.get("version")
+        if version != ROLLING_STATE_VERSION:
+            raise ValueError(
+                f"rolling fingerprint state version {version!r} != "
+                f"{ROLLING_STATE_VERSION}")
+        self = cls.__new__(cls)
+        self.channel_names = list(doc["channel_names"])
+        self.edges = [np.asarray(e, np.float64) for e in doc["edges"]]
+        self.half_life = (None if doc.get("half_life") is None
+                          else float(doc["half_life"]))
+        self._decay = (1.0 if self.half_life is None
+                       else float(0.5 ** (1.0 / self.half_life)))
+        self.counts = np.asarray(doc["counts"], np.float64)
+        self.sum = np.asarray(doc["sum"], np.float64)
+        self.sumsq = np.asarray(doc["sumsq"], np.float64)
+        self.finite_w = np.asarray(doc["finite_w"], np.float64)
+        self.nan_w = np.asarray(doc["nan_w"], np.float64)
+        self.flat_w = np.asarray(doc["flat_w"], np.float64)
+        self.sat_w = np.asarray(doc["sat_w"], np.float64)
+        self.run_min = np.asarray(
+            [np.inf if v is None else v for v in doc["min"]], np.float64)
+        self.run_max = np.asarray(
+            [-np.inf if v is None else v for v in doc["max"]], np.float64)
+        self.window_w = float(doc["window_w"])
+        self.seen = int(doc["seen"])
+        self.steps = None if doc.get("steps") is None else int(doc["steps"])
+        return self
+
+
 def score_against_baseline(
     x,
     baseline: Dict[str, Any],

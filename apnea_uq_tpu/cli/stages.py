@@ -808,6 +808,22 @@ def _serving_engine(args, config, run_log):
                          seed=config.train.seed)
 
 
+def _drift_monitor(args, run_log):
+    """The online drift monitor of a ``--drift-check`` serve/score
+    invocation (None without the flag): baseline from the registry's
+    frozen ``quality_baseline``, re-score cadence from ``--drift-every``.
+    Host-side NumPy end to end — building it compiles nothing."""
+    if not getattr(args, "drift_check", False):
+        return None
+    from apnea_uq_tpu.serving.drift import DriftMonitor
+
+    baseline = DriftMonitor.baseline_from_registry(_registry(args))
+    kwargs = {}
+    if getattr(args, "drift_every", None):
+        kwargs["score_every"] = args.drift_every
+    return DriftMonitor(baseline, run_log=run_log, **kwargs)
+
+
 def cmd_serve(args, config) -> int:
     """The long-lived online scoring process (ISSUE 15 tentpole): warm
     the bucket-ladder programs (all `source=store|cache` after
@@ -838,8 +854,14 @@ def cmd_serve(args, config) -> int:
             "conflict (silently preferring one would score requests "
             "the operator never asked about)"
         )
+    if args.drift_after is not None and not args.loadgen:
+        raise SystemExit(
+            "--drift-after shifts the synthetic loadgen cohort and "
+            "needs --loadgen N (real --input traffic drifts on its own)"
+        )
     with _compile_env(args, config), _run(args, "serve", config) as run_log:
         engine = _serving_engine(args, config, run_log)
+        drift = _drift_monitor(args, run_log)
         with run_log.stage("warm_buckets"):
             engine.warm()
         if args.loadgen:
@@ -848,6 +870,7 @@ def cmd_serve(args, config) -> int:
                 time_steps=config.model.time_steps,
                 channels=config.model.num_channels,
                 seed=config.train.seed, rate=args.rate,
+                drift_after=args.drift_after,
             )
         else:
             requests = loadgen_mod.ndjson_requests(
@@ -881,6 +904,7 @@ def cmd_serve(args, config) -> int:
                 summary = serve_requests(
                     engine, requests, max_wait_s=args.max_wait_ms / 1e3,
                     slo_every=args.slo_every, on_result=on_result,
+                    drift=drift, trace_every=args.trace_every,
                 )
         finally:
             if out_fh is not None:
@@ -894,6 +918,10 @@ def cmd_serve(args, config) -> int:
             f"batch(es): p50 {ms(summary['p50_ms'])} p99 "
             f"{ms(summary['p99_ms'])}, {summary['windows_per_s']} "
             f"windows/s, pad waste {summary['pad_waste']}")
+        if drift is not None:
+            for tenant, verdict in drift.verdicts().items():
+                log(f"serve drift [{tenant}]: {verdict} over "
+                    f"{drift.windows_seen(tenant)} window(s)")
     return 0
 
 
@@ -916,11 +944,12 @@ def cmd_score(args, config) -> int:
         )
     with _compile_env(args, config), _run(args, "score", config) as run_log:
         engine = _serving_engine(args, config, run_log)
+        drift = _drift_monitor(args, run_log)
         with run_log.stage("warm_buckets"):
             engine.warm()
         scorer = StreamScorer(
             engine, state_dir=args.state_dir, out_path=args.out,
-            hop=args.hop, run_log=run_log,
+            hop=args.hop, run_log=run_log, drift=drift,
         )
         with run_log.stage("score_stream"):
             summary = scorer.run(
@@ -1619,8 +1648,10 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     # start with zero request-path compiles.
     def _add_serving_args(p) -> None:
         # jax-free on purpose: the parser must build with jax poisoned
-        # (the ladder constant lives in the host-side coalescer).
+        # (the ladder constant lives in the host-side coalescer, the
+        # drift cadence in the NumPy-only drift monitor).
         from apnea_uq_tpu.serving.coalescer import SERVE_BUCKET_SIZES
+        from apnea_uq_tpu.serving.drift import DEFAULT_SCORE_EVERY
 
         p.add_argument("--registry", required=True)
         p.add_argument("--ckpt-dir", default=None)
@@ -1646,6 +1677,21 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                             "engine's bucket labels (UQConfig."
                             "mcd_engine) — match the warm-cache "
                             "--mcd-engine for warm starts.")
+        p.add_argument("--drift-check", action="store_true",
+                       help="Online input-drift detection (ISSUE 17): "
+                            "keep one rolling fingerprint per "
+                            "stream/tenant on the frozen "
+                            "quality_baseline's histogram edges and "
+                            "emit gateable serve_drift verdicts "
+                            "(host-side NumPy — zero extra request-path "
+                            "compiles; `apnea-uq quality check "
+                            "<run-dir>` gates them).")
+        p.add_argument("--drift-every", type=int, default=None,
+                       metavar="N",
+                       help=f"With --drift-check: re-score a tenant's "
+                            f"rolling fingerprint against the baseline "
+                            f"every N folded windows (default "
+                            f"{DEFAULT_SCORE_EVERY}).")
         _add_de_engine_arg(p)
         _add_run_dir_arg(p)
 
@@ -1664,6 +1710,18 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--request-windows", type=int, default=4,
                    help="With --loadgen: max windows per synthetic "
                         "request (sizes draw uniformly from 1..N).")
+    p.add_argument("--drift-after", type=int, default=None, metavar="N",
+                   help="With --loadgen: apply a per-channel mean/scale "
+                        "shift to every request from the N-th on — the "
+                        "seeded way to exercise --drift-check (the "
+                        "first N requests score PSI ~ 0, the shifted "
+                        "cohort flips the serve_drift verdict).")
+    p.add_argument("--trace-every", type=int, default=0, metavar="N",
+                   help="Sample every N-th completed request into a "
+                        "serve_trace span event: the enqueue -> "
+                        "coalesce -> dispatch -> D2H -> respond "
+                        "waterfall with bucket/pad attribution "
+                        "(0 = off).")
     p.add_argument("--input", default=None,
                    help="NDJSON request source (- = stdin): one "
                         "{\"id\", \"windows\": [[[ch]x60]xk]} object "
@@ -1890,12 +1948,15 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     qc = qsub.add_parser(
         "check",
         help="Exit 1 when a run's drift_fingerprint scores exceed "
-             "threshold or (with --baseline) its calibration regressed "
-             "vs a prior run; exit 2 when nothing is gateable.")
+             "threshold, a serve run's serve_drift verdicts drifted, "
+             "or (with --baseline) its calibration regressed vs a "
+             "prior run; exit 2 when nothing is gateable.")
     qc.add_argument("run_dir",
                     help="Telemetry run directory of the eval to gate "
                          "(quality_metrics + drift_fingerprint events; "
-                         "latest run of an appended log).")
+                         "latest run of an appended log), or a serve/"
+                         "score run directory whose --drift-check "
+                         "emitted serve_drift verdicts.")
     qc.add_argument("--baseline", default=None,
                     help="Prior run directory to gate calibration "
                          "against: shared-label ECE/MCE/Brier worsening "

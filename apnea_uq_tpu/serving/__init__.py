@@ -7,12 +7,14 @@ programs (coalescer.py), AOT-warm dispatch + per-batch device timing
 (engine.py), sliding-window continuous scoring over a live PSG signal
 stream with resumable per-patient ring state (stream.py), SLO telemetry
 (slo.py: ``serve_request`` / ``serve_batch`` / ``serve_slo`` events),
+online input-drift scoring against the frozen quality baseline
+(drift.py: per-tenant rolling fingerprints, ``serve_drift`` events),
 and a load generator (loadgen.py) that drives the loop for the bench's
 ``serve`` block and the warm-serve acceptance test.
 
-Import discipline mirrors the telemetry layer: coalescer/slo/loadgen
-are jax-free (pure NumPy host logic); only engine.py (dispatch) and
-stream.py (via the engine it is handed) touch jax.
+Import discipline mirrors the telemetry layer: coalescer/slo/drift/
+loadgen are jax-free (pure NumPy host logic); only engine.py (dispatch)
+and stream.py (via the engine it is handed) touch jax.
 """
 
 from apnea_uq_tpu.serving.coalescer import (  # noqa: F401
@@ -21,4 +23,5 @@ from apnea_uq_tpu.serving.coalescer import (  # noqa: F401
     RequestCoalescer,
     ServeRequest,
 )
+from apnea_uq_tpu.serving.drift import DriftMonitor  # noqa: F401
 from apnea_uq_tpu.serving.slo import SLOTracker  # noqa: F401

@@ -32,6 +32,7 @@ import numpy as np
 SERVE_BUCKET_SIZES = (16, 64, 256)
 
 _REQUEST_COUNTER = itertools.count()
+_SPAN_COUNTER = itertools.count()
 
 
 @dataclasses.dataclass
@@ -40,7 +41,13 @@ class ServeRequest:
     (k >= 1); ``enqueue_t`` is the arrival clock reading latency is
     measured from.  ``dispatched``/``done`` track the overflow-spill
     bookkeeping: a request's rows may span several batches, and the
-    request completes when its LAST row's batch returns."""
+    request completes when its LAST row's batch returns.
+
+    ``span_id`` names the request's trace span (auto-assigned); the
+    ``trace_*`` fields are the per-request waterfall accumulators the
+    serve loop folds batch attribution into (engine.py) and the sampled
+    ``serve_trace`` event reports — host bookkeeping only, they never
+    affect scoring."""
 
     windows: np.ndarray
     enqueue_t: float
@@ -49,6 +56,17 @@ class ServeRequest:
     dispatched: int = 0
     done: int = 0
     batches: int = 0
+    span_id: str = ""
+    # Span-trace accumulators (ISSUE 17): first-dispatch clock reading,
+    # summed host-dispatch / device(+D2H) attribution across the
+    # request's batches, total pad rows it rode with, largest bucket
+    # touched, and the last program label that scored it.
+    first_dispatch_t: Optional[float] = None
+    trace_dispatch_s: float = 0.0
+    trace_device_s: float = 0.0
+    trace_pad_rows: int = 0
+    trace_bucket: int = 0
+    trace_label: str = ""
 
     def __post_init__(self):
         self.windows = np.asarray(self.windows, np.float32)
@@ -59,6 +77,8 @@ class ServeRequest:
             )
         if not self.request_id:
             self.request_id = f"req-{next(_REQUEST_COUNTER)}"
+        if not self.span_id:
+            self.span_id = f"span-{next(_SPAN_COUNTER)}"
 
     @property
     def rows(self) -> int:

@@ -1,0 +1,278 @@
+"""Online input-drift detection on the serving path (ISSUE 17).
+
+The eval stages score drift once per batch eval (telemetry/quality.py,
+vs the frozen ``quality_baseline``); this module moves the same PSI/KS
+machinery onto the request path.  A :class:`DriftMonitor` keeps one
+:class:`~apnea_uq_tpu.analysis.fingerprint.RollingFingerprint` per
+stream/tenant, fed from every scored window, and re-scores it against
+the frozen baseline every ``score_every`` windows — emitting a
+``serve_drift`` telemetry event with an ok/warn/drift verdict, so a
+cohort shift in live traffic becomes a gateable number minutes after it
+starts instead of at the next batch eval.
+
+All scoring is host-side NumPy on the baseline's frozen histogram
+edges: the monitor adds **zero** request-path compiles (the warm-serve
+acceptance pin in tests/test_serving.py keeps holding).  Jax-free like
+coalescer/slo/loadgen — importable wherever the read side runs.
+
+Thresholds follow the PSI rule of thumb (fingerprint.py): warn at
+moderate shift, drift at significant shift; ``tenant_thresholds`` lets
+one noisy tenant run looser (or a critical one tighter) without moving
+the fleet-wide default.  The monitor's complete state round-trips
+through :meth:`DriftMonitor.to_json` / :meth:`DriftMonitor.from_json`,
+which is how it rides the stream scorer's atomic ``stream_state.json``
+snapshot: ring state and drift state commit in the SAME snapshot, so a
+kill -9 resume keeps the rolling window (no verdict reset) and replayed
+windows fold in exactly once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from apnea_uq_tpu.analysis.fingerprint import RollingFingerprint
+
+DRIFT_STATE_VERSION = 1
+
+#: Re-score cadence: windows folded into a tenant's rolling fingerprint
+#: between ``serve_drift`` emissions.
+DEFAULT_SCORE_EVERY = 256
+
+#: Rolling-window recency: observation weight halves every this many
+#: windows, so a resolved upstream incident ages out of the score.
+DEFAULT_HALF_LIFE = 4096.0
+
+# PSI/KS verdict thresholds (the fingerprint module's rule of thumb:
+# < 0.1 stable, 0.1-0.2 moderate, > 0.2 significant drift).
+DEFAULT_WARN_PSI = 0.1
+DEFAULT_DRIFT_PSI = 0.2
+DEFAULT_WARN_KS = 0.1
+DEFAULT_DRIFT_KS = 0.2
+
+_THRESHOLD_KEYS = ("warn_psi", "drift_psi", "warn_ks", "drift_ks")
+
+#: The default tenant for traffic that carries no stream/patient
+#: attribution (e.g. anonymous loadgen requests).
+DEFAULT_TENANT = "default"
+
+
+class DriftMonitor:
+    """Per-tenant rolling drift scoring against a frozen baseline.
+
+    ``baseline`` is one fingerprint document (a set entry of the
+    registry's ``quality_baseline`` artifact — see
+    :meth:`baseline_from_registry`).  Feed every scored window through
+    :meth:`observe`; every ``score_every`` windows per tenant the
+    monitor re-bins nothing (the rolling state already lives on the
+    baseline's edges) and emits one ``serve_drift`` event through
+    ``run_log`` with the verdict.
+    """
+
+    def __init__(self, baseline: Dict[str, Any], *,
+                 score_every: int = DEFAULT_SCORE_EVERY,
+                 half_life: Optional[float] = DEFAULT_HALF_LIFE,
+                 warn_psi: float = DEFAULT_WARN_PSI,
+                 drift_psi: float = DEFAULT_DRIFT_PSI,
+                 warn_ks: float = DEFAULT_WARN_KS,
+                 drift_ks: float = DEFAULT_DRIFT_KS,
+                 tenant_thresholds: Optional[Dict[str, Dict[str, float]]]
+                 = None,
+                 run_log=None):
+        if score_every < 1:
+            raise ValueError(f"score_every must be >= 1, got {score_every}")
+        self.baseline = baseline
+        self.score_every = int(score_every)
+        self.half_life = half_life
+        self.thresholds = {"warn_psi": float(warn_psi),
+                           "drift_psi": float(drift_psi),
+                           "warn_ks": float(warn_ks),
+                           "drift_ks": float(drift_ks)}
+        self.tenant_thresholds = {
+            str(tenant): {k: float(v) for k, v in (overrides or {}).items()
+                          if k in _THRESHOLD_KEYS}
+            for tenant, overrides in (tenant_thresholds or {}).items()
+        }
+        self.run_log = run_log
+        # tenant -> {"rolling": RollingFingerprint, "since": int,
+        #            "verdict": str|None}
+        self._tenants: Dict[str, Dict[str, Any]] = {}
+
+    @classmethod
+    def baseline_from_registry(cls, registry) -> Dict[str, Any]:
+        """The serving-side baseline fingerprint: the unbalanced
+        test-set entry frozen into ``quality_baseline`` at prepare time
+        (falling back to any frozen set when the cohort had no
+        unbalanced split).  Imported lazily so the module stays
+        importable with no registry on the path."""
+        from apnea_uq_tpu.data import registry as reg
+
+        doc = registry.load_json(reg.QUALITY_BASELINE)
+        sets = doc.get("sets") or {}
+        fingerprint = sets.get(reg.TEST_STD_UNBALANCED)
+        if fingerprint is None and sets:
+            fingerprint = sets[sorted(sets)[0]]
+        if not fingerprint or not fingerprint.get("channels"):
+            raise ValueError(
+                "quality_baseline carries no usable fingerprint — "
+                "re-run `apnea-uq prepare` to freeze one")
+        return fingerprint
+
+    def _thresholds_for(self, tenant: str) -> Dict[str, float]:
+        merged = dict(self.thresholds)
+        merged.update(self.tenant_thresholds.get(tenant, {}))
+        return merged
+
+    def _state_for(self, tenant: str) -> Dict[str, Any]:
+        state = self._tenants.get(tenant)
+        if state is None:
+            state = {
+                "rolling": RollingFingerprint(self.baseline,
+                                              half_life=self.half_life),
+                "since": 0,
+                "verdict": None,
+            }
+            self._tenants[tenant] = state
+        return state
+
+    def observe(self, windows, *,
+                tenant: str = DEFAULT_TENANT) -> Optional[Dict[str, Any]]:
+        """Fold a window batch — (T, C) or (N, T, C) — into ``tenant``'s
+        rolling fingerprint; returns the fresh verdict document when the
+        fold crossed the re-score cadence, None otherwise."""
+        state = self._state_for(str(tenant))
+        rolling = state["rolling"]
+        before = rolling.seen
+        rolling.update(windows)
+        state["since"] += rolling.seen - before
+        if state["since"] >= self.score_every:
+            return self.score_tenant(str(tenant))
+        return None
+
+    def score_tenant(self, tenant: str, *,
+                     final: bool = False) -> Optional[Dict[str, Any]]:
+        """Score one tenant's rolling fingerprint against the baseline
+        now, emit the ``serve_drift`` event, and return the verdict
+        document (None when the tenant has seen no windows)."""
+        state = self._tenants.get(tenant)
+        if state is None or state["rolling"].seen == 0:
+            return None
+        report = state["rolling"].score(self.baseline)
+        limits = self._thresholds_for(tenant)
+        if (report["max_psi"] >= limits["drift_psi"]
+                or report["max_ks"] >= limits["drift_ks"]):
+            verdict = "drift"
+        elif (report["max_psi"] >= limits["warn_psi"]
+                or report["max_ks"] >= limits["warn_ks"]):
+            verdict = "warn"
+        else:
+            verdict = "ok"
+        state["since"] = 0
+        state["verdict"] = verdict
+        doc = {
+            "tenant": tenant,
+            "verdict": verdict,
+            "windows": int(state["rolling"].seen),
+            "max_psi": report["max_psi"],
+            "max_ks": report["max_ks"],
+            "max_mean_shift": report["max_mean_shift"],
+            "worst_channel": report["worst_channel"],
+            "warn_psi": limits["warn_psi"],
+            "drift_psi": limits["drift_psi"],
+            "warn_ks": limits["warn_ks"],
+            "drift_ks": limits["drift_ks"],
+            "final": bool(final),
+        }
+        if self.run_log is not None:
+            self.run_log.event(
+                "serve_drift",
+                tenant=doc["tenant"], verdict=doc["verdict"],
+                windows=doc["windows"], max_psi=doc["max_psi"],
+                max_ks=doc["max_ks"],
+                max_mean_shift=doc["max_mean_shift"],
+                worst_channel=doc["worst_channel"],
+                warn_psi=doc["warn_psi"], drift_psi=doc["drift_psi"],
+                warn_ks=doc["warn_ks"], drift_ks=doc["drift_ks"],
+                final=doc["final"],
+            )
+        return doc
+
+    def flush(self) -> Dict[str, Dict[str, Any]]:
+        """Final scores for every tenant that accumulated windows since
+        its last emission (shutdown path: the tail shorter than one
+        cadence still lands a verdict).  Returns tenant -> verdict doc
+        of the emitted scores."""
+        out = {}
+        for tenant in sorted(self._tenants):
+            if self._tenants[tenant]["since"] > 0:
+                doc = self.score_tenant(tenant, final=True)
+                if doc is not None:
+                    out[tenant] = doc
+        return out
+
+    def verdicts(self) -> Dict[str, Optional[str]]:
+        """tenant -> latest verdict (None before the first score)."""
+        return {tenant: state["verdict"]
+                for tenant, state in sorted(self._tenants.items())}
+
+    def windows_seen(self, tenant: str = DEFAULT_TENANT) -> int:
+        state = self._tenants.get(tenant)
+        return 0 if state is None else int(state["rolling"].seen)
+
+    def to_json(self) -> Dict[str, Any]:
+        """The monitor's complete per-tenant state as plain JSON — the
+        payload that rides ``stream_state.json``'s atomic snapshot.  The
+        baseline itself is NOT serialized (it is frozen in the registry;
+        the restore path reloads it and hands it to
+        :meth:`from_json`)."""
+        return {
+            "version": DRIFT_STATE_VERSION,
+            "score_every": self.score_every,
+            "half_life": self.half_life,
+            "thresholds": dict(self.thresholds),
+            "tenant_thresholds": {t: dict(v) for t, v in
+                                  self.tenant_thresholds.items()},
+            "tenants": {
+                tenant: {
+                    "rolling": state["rolling"].to_json(),
+                    "since": int(state["since"]),
+                    "verdict": state["verdict"],
+                }
+                for tenant, state in self._tenants.items()
+            },
+        }
+
+    def restore(self, doc: Dict[str, Any]) -> None:
+        """Adopt the per-tenant rolling state of a persisted snapshot
+        while keeping THIS monitor's configuration (cadence, thresholds,
+        baseline, run log) — the resume path: new flags win, the rolling
+        windows survive."""
+        restored = DriftMonitor.from_json(doc, baseline=self.baseline,
+                                          run_log=self.run_log)
+        self._tenants = restored._tenants
+
+    @classmethod
+    def from_json(cls, doc: Dict[str, Any], *, baseline: Dict[str, Any],
+                  run_log=None) -> "DriftMonitor":
+        version = doc.get("version")
+        if version != DRIFT_STATE_VERSION:
+            raise ValueError(f"drift state version {version!r} != "
+                             f"{DRIFT_STATE_VERSION}")
+        thresholds = doc.get("thresholds") or {}
+        self = cls(
+            baseline,
+            score_every=int(doc.get("score_every", DEFAULT_SCORE_EVERY)),
+            half_life=doc.get("half_life"),
+            warn_psi=thresholds.get("warn_psi", DEFAULT_WARN_PSI),
+            drift_psi=thresholds.get("drift_psi", DEFAULT_DRIFT_PSI),
+            warn_ks=thresholds.get("warn_ks", DEFAULT_WARN_KS),
+            drift_ks=thresholds.get("drift_ks", DEFAULT_DRIFT_KS),
+            tenant_thresholds=doc.get("tenant_thresholds"),
+            run_log=run_log,
+        )
+        for tenant, state in (doc.get("tenants") or {}).items():
+            self._tenants[str(tenant)] = {
+                "rolling": RollingFingerprint.from_json(state["rolling"]),
+                "since": int(state.get("since", 0)),
+                "verdict": state.get("verdict"),
+            }
+        return self

@@ -107,6 +107,11 @@ class ServingEngine:
         self.run_log = run_log
         self._root_key = prng.stochastic_key(seed)
         self._dispatches = 0
+        # Attribution of the most recent score_batch dispatch (label,
+        # bucket, dispatch/device seconds) — the serve loop reads it to
+        # fold per-batch timing into request trace spans without
+        # re-measuring anything.
+        self.last_batch: Optional[Dict[str, Any]] = None
         # Per-label acquisition memo (serve_bucket_predict `cache`): the
         # first touch of each bucket — warm(), normally — pays weight
         # placement + store acquisition + pricing; every request-path
@@ -175,6 +180,14 @@ class ServingEngine:
         self._dispatches += 1
         record = metrics.last
         out = np.asarray(stats)[:, :n]
+        self.last_batch = {
+            "label": label,
+            "bucket": bucket,
+            "rows": n,
+            "pad_rows": bucket - n,
+            "dispatch_s": record.dispatch_s,
+            "device_s": record.device_s,
+        }
         if self.run_log is not None:
             self.run_log.event(
                 "serve_batch",
@@ -208,6 +221,8 @@ def serve_requests(
     coalescer: Optional[RequestCoalescer] = None,
     clock=time.perf_counter,
     on_result=None,
+    trace_every: int = 0,
+    drift=None,
 ) -> Dict[str, Any]:
     """The request-path loop: pull arrivals, coalesce into bucket
     batches, dispatch, complete requests.  ``on_result(request, stats,
@@ -216,6 +231,22 @@ def serve_requests(
     batch its rows landed in) lets callers stream scores out; the
     returned dict is the final SLO summary, which is also emitted as
     the closing ``serve_slo`` event.
+
+    ``trace_every=N`` (0 = off) samples one completed request in N and
+    emits its ``serve_trace`` span waterfall: ``queue_s`` (enqueue ->
+    coalesce -> first dispatch), ``service_s`` (first dispatch -> last
+    batch scored, decomposed into summed host ``dispatch_s`` and
+    ``device_s``/derived ``d2h_s`` attribution), ``respond_s`` (result
+    fan-out after the last score).  ``queue_s + service_s`` equals the
+    ``latency_s`` that ``serve_request``/``serve_slo`` report, exactly —
+    the waterfall is a decomposition of the SLO number, not a parallel
+    measurement.
+
+    ``drift`` (a :class:`~apnea_uq_tpu.serving.drift.DriftMonitor`)
+    folds every dispatched window into the per-tenant rolling
+    fingerprint at dispatch time (tenant = the request's ``patient``,
+    anonymous traffic pools under the default tenant) — host-side numpy
+    on frozen edges, zero extra compiles on the request path.
 
     The request source is pumped on a daemon thread into a queue so the
     ``max_wait_s`` coalescing deadline holds even when the source
@@ -227,22 +258,37 @@ def serve_requests(
     import queue as queue_mod
     import threading
 
+    from apnea_uq_tpu.serving.drift import DEFAULT_TENANT
+
     run_log = engine.run_log
     slo = slo or SLOTracker(clock)
     coalescer = coalescer or RequestCoalescer(engine.ladder)
     emitted_at = 0
+    completed = 0
 
     def dispatch(plan: BatchPlan) -> None:
-        nonlocal emitted_at
+        nonlocal emitted_at, completed
         now = clock()
+        for req, start, end in plan.slices:
+            if req.first_dispatch_t is None:
+                req.first_dispatch_t = now
+            if drift is not None:
+                drift.observe(req.windows[start:end],
+                              tenant=req.patient or DEFAULT_TENANT)
         stats = engine.score_batch(
             plan.gather(), bucket=plan.bucket,
             queue_wait_s=plan.queue_wait_s(now), slo=slo,
         )
         done_t = clock()
+        batch = engine.last_batch or {}
         offset = 0
         for req, start, end in plan.slices:
             take = end - start
+            req.trace_dispatch_s += float(batch.get("dispatch_s", 0.0))
+            req.trace_device_s += float(batch.get("device_s", 0.0))
+            req.trace_pad_rows += plan.pad_rows
+            req.trace_bucket = max(req.trace_bucket, plan.bucket)
+            req.trace_label = str(batch.get("label", ""))
             if on_result is not None:
                 on_result(req, stats[:, offset:offset + take], start)
             offset += take
@@ -258,6 +304,29 @@ def serve_requests(
                         batches=req.batches,
                         latency_s=round(latency, 6),
                     )
+                if (run_log is not None and trace_every > 0
+                        and completed % int(trace_every) == 0):
+                    queue_s = req.first_dispatch_t - req.enqueue_t
+                    service_s = done_t - req.first_dispatch_t
+                    run_log.event(
+                        "serve_trace",
+                        span_id=req.span_id,
+                        request_id=req.request_id,
+                        windows=req.rows,
+                        batches=req.batches,
+                        bucket=req.trace_bucket,
+                        pad_rows=req.trace_pad_rows,
+                        label=req.trace_label,
+                        queue_s=round(queue_s, 6),
+                        service_s=round(service_s, 6),
+                        dispatch_s=round(req.trace_dispatch_s, 6),
+                        device_s=round(req.trace_device_s, 6),
+                        d2h_s=round(max(req.trace_device_s
+                                        - req.trace_dispatch_s, 0.0), 6),
+                        respond_s=round(clock() - done_t, 6),
+                        latency_s=round(latency, 6),
+                    )
+                completed += 1
                 if slo.requests - emitted_at >= max(1, int(slo_every)):
                     emitted_at = slo.requests
                     slo.emit(run_log, final=False)
@@ -306,4 +375,8 @@ def serve_requests(
             dispatch(plan)
     for plan in coalescer.drain(now=clock(), flush=True):
         dispatch(plan)
+    if drift is not None:
+        # The tail shorter than one re-score cadence still lands a
+        # final verdict per tenant before the summary closes the run.
+        drift.flush()
     return slo.emit(run_log, final=True)

@@ -20,6 +20,14 @@ import numpy as np
 from apnea_uq_tpu.serving.coalescer import ServeRequest
 
 
+# The injected cohort shift of --drift-after traffic: a per-channel
+# scale + offset big enough that a few hundred shifted windows push the
+# rolling PSI far past the 0.2 drift threshold on the standardized
+# baseline, yet tame enough that scoring stays numerically boring.
+DRIFT_SCALE = 2.0
+DRIFT_SHIFT = 1.5
+
+
 def synthetic_requests(
     n_requests: int,
     *,
@@ -28,6 +36,7 @@ def synthetic_requests(
     channels: int = 4,
     seed: int = 0,
     rate: float = 0.0,
+    drift_after: Optional[int] = None,
     clock=time.perf_counter,
     sleep=time.sleep,
 ) -> Iterator[ServeRequest]:
@@ -35,11 +44,19 @@ def synthetic_requests(
     standardized-shaped windows each.  With ``rate > 0``, request ``i``
     is released no earlier than ``i / rate`` seconds after the first —
     an open-loop arrival process, so a slow scorer accumulates queue
-    wait instead of silently back-pressuring the generator."""
+    wait instead of silently back-pressuring the generator.
+
+    ``drift_after=N`` applies a per-channel mean/scale shift
+    (``x * DRIFT_SCALE + DRIFT_SHIFT``) to every window from request N
+    on — the seeded way to exercise the online-drift path: the first N
+    requests score PSI ~ 0 against a standardized baseline, the shifted
+    cohort flips the ``serve_drift`` verdict."""
     if n_requests < 1:
         raise ValueError(f"n_requests must be >= 1, got {n_requests}")
     if max_windows < 1:
         raise ValueError(f"max_windows must be >= 1, got {max_windows}")
+    if drift_after is not None and drift_after < 0:
+        raise ValueError(f"drift_after must be >= 0, got {drift_after}")
     rng = np.random.default_rng(seed)
     t0 = clock()
     for i in range(n_requests):
@@ -51,6 +68,8 @@ def synthetic_requests(
         k = int(rng.integers(1, max_windows + 1))
         windows = rng.normal(size=(k, time_steps, channels)).astype(
             np.float32)
+        if drift_after is not None and i >= drift_after:
+            windows = windows * DRIFT_SCALE + DRIFT_SHIFT
         yield ServeRequest(windows=windows, enqueue_t=clock(),
                            request_id=f"loadgen-{i}")
 
@@ -97,17 +116,25 @@ def run_loadgen(
     rate: float = 0.0,
     max_wait_s: float = 0.005,
     slo_every: Optional[int] = None,
+    drift_after: Optional[int] = None,
+    drift=None,
+    trace_every: int = 0,
 ):
     """Drive ``engine`` with the synthetic stream; returns the final
-    SLO summary dict (also emitted as the closing ``serve_slo``)."""
+    SLO summary dict (also emitted as the closing ``serve_slo``).
+    ``drift_after``/``drift``/``trace_every`` thread the ISSUE 17
+    observability knobs through: injected post-N cohort shift, the
+    online drift monitor fed at dispatch, and 1-in-N span tracing."""
     from apnea_uq_tpu.serving.engine import DEFAULT_SLO_EVERY, serve_requests
 
     cfg = engine.model.config
     requests = synthetic_requests(
         n_requests, max_windows=max_windows, time_steps=cfg.time_steps,
         channels=cfg.num_channels, seed=seed, rate=rate,
+        drift_after=drift_after,
     )
     return serve_requests(
         engine, requests, max_wait_s=max_wait_s,
         slo_every=slo_every or DEFAULT_SLO_EVERY,
+        drift=drift, trace_every=trace_every,
     )

@@ -27,6 +27,11 @@ import numpy as np
 # than any SLO percentile needs to stabilize.
 HISTORY_WINDOW = 65536
 
+# Per-bucket service-time history: smaller than the global window (the
+# ladder has at most a handful of buckets, and per-bucket percentiles
+# stabilize long before this).
+BUCKET_HISTORY_WINDOW = 8192
+
 
 class SLOTracker:
     """Cumulative session accounting.  ``summary()`` is the
@@ -50,6 +55,10 @@ class SLOTracker:
         self.queue_waits_s: Deque[float] = collections.deque(
             maxlen=HISTORY_WINDOW)
         self.device_s = 0.0
+        # Per-bucket breakdown (ISSUE 17 satellite): exact counters plus
+        # a bounded per-bucket device-service-time history, so a
+        # saturated 256-bucket cannot hide behind a healthy global p95.
+        self._buckets: Dict[int, Dict[str, Any]] = {}
 
     def record_batch(self, *, bucket: int, rows: int, pad_rows: int,
                      queue_wait_s: float, device_s: float) -> None:
@@ -59,6 +68,16 @@ class SLOTracker:
         self.pad_rows += pad_rows
         self.queue_waits_s.append(float(queue_wait_s))
         self.device_s += float(device_s)
+        per = self._buckets.get(int(bucket))
+        if per is None:
+            per = {"batches": 0, "windows": 0, "pad_rows": 0,
+                   "device_ms": collections.deque(
+                       maxlen=BUCKET_HISTORY_WINDOW)}
+            self._buckets[int(bucket)] = per
+        per["batches"] += 1
+        per["windows"] += rows
+        per["pad_rows"] += pad_rows
+        per["device_ms"].append(float(device_s) * 1e3)
 
     def record_request(self, *, latency_s: float) -> None:
         self.requests += 1
@@ -94,7 +113,34 @@ class SLOTracker:
                           if self.bucket_rows else 0.0),
             "device_s": round(self.device_s, 6),
             "interval_s": round(interval, 6),
+            "buckets": self._bucket_summary(),
         }
+
+    def _bucket_summary(self) -> Dict[str, Dict[str, Any]]:
+        """Per-bucket-size breakdown: batch/window/pad counters plus
+        p50/p95/p99 of the bucket's device service time (ms).  Keys are
+        stringified bucket sizes (JSON object keys)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for bucket in sorted(self._buckets):
+            per = self._buckets[bucket]
+            times = np.asarray(list(per["device_ms"]), np.float64)
+            if times.size:
+                p50, p95, p99 = (round(float(v), 3) for v in
+                                 np.percentile(times, (50.0, 95.0, 99.0)))
+            else:
+                p50 = p95 = p99 = None
+            dispatched = per["batches"] * bucket
+            out[str(bucket)] = {
+                "batches": per["batches"],
+                "windows": per["windows"],
+                "pad_rows": per["pad_rows"],
+                "pad_waste": (round(per["pad_rows"] / dispatched, 4)
+                              if dispatched else 0.0),
+                "p50_ms": p50,
+                "p95_ms": p95,
+                "p99_ms": p99,
+            }
+        return out
 
     def emit(self, run_log, *, final: bool = False,
              patients: Optional[int] = None) -> Dict[str, Any]:

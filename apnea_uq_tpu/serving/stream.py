@@ -219,7 +219,7 @@ class StreamScorer:
 
     def __init__(self, engine: ServingEngine, *, state_dir: str,
                  out_path: str, window: Optional[int] = None,
-                 hop: int = 60, run_log=None):
+                 hop: int = 60, run_log=None, drift=None):
         self.engine = engine
         self.window = int(window or engine.model.config.time_steps)
         if self.window != engine.model.config.time_steps:
@@ -235,6 +235,12 @@ class StreamScorer:
         self.out_path = out_path
         self.run_log = run_log
         self.slo = SLOTracker()
+        # Optional online drift monitor (serving/drift.py): every scored
+        # window folds into the patient's rolling fingerprint BEFORE the
+        # state commit, and the monitor's state rides the same atomic
+        # snapshot — ring state and drift window revert (or survive)
+        # together, so replayed windows fold in exactly once.
+        self.drift = drift
         self.patients: Dict[str, _PatientState] = {}
         # (patient, start_t, window array, enqueue clock) awaiting dispatch.
         self._pending: List[Tuple[str, float, np.ndarray, float]] = []
@@ -262,18 +268,27 @@ class StreamScorer:
             )
         for pid, pdoc in doc.get("patients", {}).items():
             self.patients[pid] = _PatientState.from_json(self.window, pdoc)
+        # Drift state is an OPTIONAL key (same STATE_VERSION): older
+        # snapshots — and runs without --drift-check — simply lack it,
+        # and a restored monitor keeps its rolling window instead of
+        # resetting the verdict on every restart.
+        if self.drift is not None and doc.get("drift"):
+            self.drift.restore(doc["drift"])
 
     def _save_state(self) -> None:
         from apnea_uq_tpu.utils.io import atomic_write_json
 
         os.makedirs(self.state_dir, exist_ok=True)
-        atomic_write_json(self.state_path, {
+        state = {
             "version": STATE_VERSION,
             "window": self.window,
             "hop": self.hop,
             "patients": {pid: p.to_json()
                          for pid, p in sorted(self.patients.items())},
-        })
+        }
+        if self.drift is not None:
+            state["drift"] = self.drift.to_json()
+        atomic_write_json(self.state_path, state)
 
     # -- scoring ----------------------------------------------------------
 
@@ -297,6 +312,12 @@ class StreamScorer:
             del self._pending[:len(chunk)]
             rows = np.stack([w for _p, _t, w, _e in chunk])
             oldest = min(e for _p, _t, _w, e in chunk)
+            if self.drift is not None:
+                # Fold before the state commit below: the rolling
+                # fingerprint and the ring state revert together on a
+                # crash, so a replayed window is never double-counted.
+                for pid, _t, w, _e in chunk:
+                    self.drift.observe(w, tenant=pid)
             stats = self.engine.score_batch(
                 rows, queue_wait_s=max(0.0, time.perf_counter() - oldest),
                 slo=self.slo,
@@ -372,6 +393,11 @@ class StreamScorer:
             if self._out_fh is not None:
                 self._out_fh.close()
                 self._out_fh = None
+        if self.drift is not None:
+            # Score the sub-cadence tail so every tenant closes with a
+            # verdict, then persist the post-flush monitor state.
+            if self.drift.flush():
+                self._save_state()
         summary = self.slo.emit(self.run_log, final=True,
                                 patients=len(self.patients))
         for pid, pstate in sorted(self.patients.items()):

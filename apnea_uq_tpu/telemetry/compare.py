@@ -509,6 +509,20 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
                     name = f"drift.{label}.{field}"
                     out[name] = Metric(name, float(e[field]), unit,
                                        metric_direction(name, unit))
+        elif kind == "serve_drift":
+            # Online drift verdicts of the serving path (serving/drift.py,
+            # ISSUE 17): the rolling-fingerprint PSI/KS per tenant, scored
+            # against the same frozen quality_baseline as the batch-eval
+            # drift_fingerprint events.  Input drift is a property of the
+            # TRAFFIC, not the backend -> unbound, gates across the
+            # CPU-proxy boundary; append-order overwrite keeps each
+            # tenant's LAST (usually final=True) score.
+            tenant = e.get("tenant", "?")
+            for field, unit in (("max_psi", "psi"), ("max_ks", "ks")):
+                if e.get(field) is not None:
+                    name = f"serve_drift.{tenant}.{field}"
+                    out[name] = Metric(name, float(e[field]), unit,
+                                       metric_direction(name, unit))
         elif kind == "serve_slo":
             # Online serving SLO snapshot (serving/slo.py, ISSUE 15).
             # Snapshots are cumulative and the append-order overwrite
@@ -574,7 +588,8 @@ def load_source(
                 f"no comparable metrics in source {path!r}: the run's "
                 f"events carry no bench/eval throughput, d2h, "
                 f"memory-peak, compile-cost, data-load, program-audit, "
-                f"topology, quality, drift, or serve-SLO metrics"
+                f"topology, quality, drift, serve-drift, or serve-SLO "
+                f"metrics"
             )
         return metrics, {"kind": "run_dir", "proxy": dir_proxy}
     with open(path) as f:

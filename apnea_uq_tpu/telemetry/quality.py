@@ -20,7 +20,11 @@ first-class, gateable stream:
   <run-dir> [--baseline PRIOR]``: drift scores over threshold and
   calibration regressions vs a prior run become findings rendered
   through the shared lint reporters (text/``--json``/``--format gha``),
-  exit 1 on failure, exit 2 when a source carries no quality telemetry
+  exit 1 on failure, exit 2 when a source carries no quality telemetry.
+  Serve run directories gate too (ISSUE 17): the online ``serve_drift``
+  verdicts emitted by ``serving/drift.py`` are checked per tenant
+  against the thresholds each event was scored with, so a drifted
+  serve session exits 1 with no jax anywhere on the path
   (the ``telemetry compare`` usage-error contract — a gate must never
   report a clean pass over zero metrics).  The verdict is appended to
   the checked run's own event log as a ``quality_gate`` event, so the
@@ -57,9 +61,9 @@ _SUMMARY_HIST_BINS = 16
 
 class NoQualityTelemetry(ValueError):
     """A source parsed cleanly but carries no ``quality_metrics`` /
-    ``drift_fingerprint`` events (or a baseline shares no run label with
-    the candidate): nothing is gateable, which is a usage error (exit
-    2), never a clean pass."""
+    ``drift_fingerprint`` / ``serve_drift`` events (or a baseline shares
+    no run label with the candidate): nothing is gateable, which is a
+    usage error (exit 2), never a clean pass."""
 
 
 # ---------------------------------------------------------- write side --
@@ -159,10 +163,13 @@ def emit_quality_metrics(run_log, result, *, num_bins: int = 15):
 
 def quality_events(
     run_dir: str,
-) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
-    """(quality_metrics events, drift_fingerprint events) of the latest
-    run in ``run_dir`` — the same run-boundary rule summarize/compare
-    use."""
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]],
+           List[Dict[str, Any]]]:
+    """(quality_metrics, drift_fingerprint, serve_drift events) of the
+    latest run in ``run_dir`` — the same run-boundary rule
+    summarize/compare use.  The third element is how a serve run
+    directory becomes gateable: its online per-tenant drift verdicts
+    stand in where a batch eval would have emitted fingerprints."""
     events = read_events(run_dir)
     if not events:
         raise FileNotFoundError(
@@ -173,6 +180,7 @@ def quality_events(
     return (
         [e for e in events if e.get("kind") == "quality_metrics"],
         [e for e in events if e.get("kind") == "drift_fingerprint"],
+        [e for e in events if e.get("kind") == "serve_drift"],
     )
 
 
@@ -181,8 +189,8 @@ class QualityCheck:
     """One gate decision: a drift score against its threshold, or a
     calibration scalar against its baseline-run value."""
 
-    kind: str                       # "drift" | "calibration"
-    label: str                      # run label / test-set label
+    kind: str                       # "drift" | "serve_drift" | "calibration"
+    label: str                      # run label / test-set label / tenant
     metric: str                     # max_psi, max_ks, ece, mce, brier
     value: float
     passed: bool
@@ -192,9 +200,11 @@ class QualityCheck:
     detail: str = ""
 
     def message(self) -> str:
-        if self.kind == "drift":
+        if self.kind in ("drift", "serve_drift"):
             verdict = "within" if self.passed else "over"
-            text = (f"drift {self.metric}={self.value:g} {verdict} "
+            prefix = ("serve drift" if self.kind == "serve_drift"
+                      else "drift")
+            text = (f"{prefix} {self.metric}={self.value:g} {verdict} "
                     f"threshold {self.limit:g} for {self.label}")
         else:
             delta = ("n/a" if self.delta_pct is None
@@ -242,13 +252,21 @@ def check_run(
     Calibration: with ``baseline`` (a prior run directory), every
     shared-label ``quality_metrics`` event's ECE/MCE/Brier against the
     prior value — a lower-is-better worsening past ``threshold_pct`` is
-    a regression.  Self-comparison is a clean pass by construction."""
-    qm, drifts = quality_events(run_dir)
-    if not qm and not drifts:
+    a regression.  Self-comparison is a clean pass by construction.
+
+    Serve runs: each tenant's LAST ``serve_drift`` event (append order —
+    usually the ``final=True`` shutdown flush) gates ``max_psi`` /
+    ``max_ks`` against the drift thresholds the event itself was scored
+    with (falling back to the CLI thresholds for pre-threshold-field
+    logs), so a per-tenant override gates with the override and a
+    drifted serve session exits 1."""
+    qm, drifts, serve_drifts = quality_events(run_dir)
+    if not qm and not drifts and not serve_drifts:
         raise NoQualityTelemetry(
-            f"no quality_metrics or drift_fingerprint events in "
-            f"{run_dir!r} — was the eval run with a quality-aware "
-            f"build, and does the registry carry a quality_baseline?"
+            f"no quality_metrics, drift_fingerprint, or serve_drift "
+            f"events in {run_dir!r} — was the eval run with a "
+            f"quality-aware build (or the serve run with --drift-check), "
+            f"and does the registry carry a quality_baseline?"
         )
     checks: List[QualityCheck] = []
     for e in drifts:
@@ -264,8 +282,32 @@ def check_run(
                 detail=(f"worst channel {e.get('worst_channel')}"
                         if e.get("worst_channel") else ""),
             ))
+    # Serve-path drift: the monitor emits >= as the drift verdict, so
+    # the gate fails at value >= limit (not >) — the gate and the
+    # emitted verdict can never disagree about the same event.
+    last_by_tenant: Dict[str, Dict[str, Any]] = {}
+    for e in serve_drifts:
+        last_by_tenant[str(e.get("tenant", "?"))] = e
+    for tenant in sorted(last_by_tenant):
+        e = last_by_tenant[tenant]
+        for metric, key, fallback in (("max_psi", "drift_psi",
+                                       psi_threshold),
+                                      ("max_ks", "drift_ks",
+                                       ks_threshold)):
+            value = e.get(metric)
+            if value is None:
+                continue
+            limit = e.get(key)
+            limit = fallback if limit is None else limit
+            checks.append(QualityCheck(
+                kind="serve_drift", label=f"tenant {tenant}",
+                metric=metric, value=float(value), limit=float(limit),
+                passed=float(value) < float(limit),
+                detail=(f"worst channel {e.get('worst_channel')}"
+                        if e.get("worst_channel") else ""),
+            ))
     if baseline is not None:
-        base_qm, _base_drifts = quality_events(baseline)
+        base_qm, _base_drifts, _base_serve = quality_events(baseline)
         base_by_label = {e.get("label"): e for e in base_qm}
         shared = [e for e in qm if e.get("label") in base_by_label]
         if not shared and not checks:
@@ -280,7 +322,8 @@ def check_run(
                 f"label with {run_dir!r} (baseline labels: "
                 f"{sorted(base_by_label)}, candidate labels: "
                 f"{sorted(e.get('label') for e in qm)}), and the "
-                f"candidate carries no drift_fingerprint events"
+                f"candidate carries no drift_fingerprint or "
+                f"serve_drift events"
             )
         for e in shared:
             b = base_by_label[e.get("label")]
@@ -309,8 +352,9 @@ def check_run(
         # report a clean pass over zero checks.
         raise NoQualityTelemetry(
             f"nothing gateable in {run_dir!r}: the run carries "
-            f"quality_metrics but no drift_fingerprint events, and no "
-            f"--baseline run was given to gate calibration against"
+            f"quality_metrics but no drift_fingerprint or serve_drift "
+            f"events, and no --baseline run was given to gate "
+            f"calibration against"
         )
     return QualityGate(
         run_dir=run_dir, baseline_path=baseline,
@@ -341,6 +385,7 @@ def gate_findings(gate: QualityGate):
     from apnea_uq_tpu.lint.engine import Finding
 
     rule_by_kind = {"drift": "quality-drift",
+                    "serve_drift": "quality-serve-drift",
                     "calibration": "quality-calibration-regression"}
     return [
         Finding(rule=rule_by_kind[c.kind], severity="error",
@@ -357,7 +402,8 @@ def gate_result(gate: QualityGate):
     return LintResult(
         findings=gate_findings(gate),
         files_scanned=len(gate.checks),
-        rules_run=("quality-calibration-regression", "quality-drift"),
+        rules_run=("quality-calibration-regression", "quality-drift",
+                   "quality-serve-drift"),
         scanned_paths=(gate.run_dir,),
     )
 

@@ -291,6 +291,64 @@ def _render_serve_slo(slos: List[Dict[str, Any]]) -> List[str]:
         if e.get("final"):
             line += "  (final)"
         lines.append(line)
+    # Per-bucket breakdown of the final snapshot (ISSUE 17 satellite):
+    # one row per ladder bucket, so a saturated 256-bucket is visible
+    # next to a healthy global p95.
+    buckets = (slos[-1].get("buckets") or {}) if slos else {}
+    if buckets:
+        lines.append("  per-bucket (final snapshot):")
+        for size in sorted(buckets, key=lambda s: int(s)):
+            b = buckets[size]
+            lines.append(
+                f"    b{size}: {b.get('batches', '?')} batch(es) / "
+                f"{b.get('windows', '?')} win"
+                f"  p50 {_fmt(b.get('p50_ms'), 1)}ms"
+                f"  p95 {_fmt(b.get('p95_ms'), 1)}ms"
+                f"  p99 {_fmt(b.get('p99_ms'), 1)}ms"
+                f"  pad {_fmt(b.get('pad_waste'), 3)}"
+            )
+    return lines
+
+
+def _render_serve_drift(drifts: List[Dict[str, Any]]) -> List[str]:
+    """The online drift trail from ``serve_drift`` events
+    (serving/drift.py): one line per re-score, per tenant, against the
+    frozen quality_baseline — the LAST line per tenant is the verdict
+    `apnea-uq quality check` gates on a serve run dir."""
+    lines = ["serve drift (online, vs frozen quality_baseline):"]
+    for e in drifts:
+        line = (
+            f"  {e.get('tenant', '?')}: {str(e.get('verdict', '?')).upper()}"
+            f"  max_psi {_fmt(e.get('max_psi'), 4)}"
+            f"  max_ks {_fmt(e.get('max_ks'), 4)}"
+            f"  mean-shift {_fmt(e.get('max_mean_shift'), 4)}"
+            f"  (worst {e.get('worst_channel', '?')}, "
+            f"{e.get('windows', '?')} windows)"
+        )
+        if e.get("final"):
+            line += "  (final)"
+        lines.append(line)
+    return lines
+
+
+def _render_serve_trace(traces: List[Dict[str, Any]]) -> List[str]:
+    """The sampled span waterfalls from ``serve_trace`` events: one
+    enqueue -> coalesce -> dispatch -> D2H -> respond decomposition per
+    traced request (queue_s + service_s = the SLO latency, exactly)."""
+    lines = ["serve traces (sampled request waterfalls):"]
+    for e in traces:
+        lines.append(
+            f"  {e.get('span_id', '?')} [{e.get('request_id', '?')}]"
+            f" {e.get('windows', '?')} win / {e.get('batches', '?')}"
+            f" batch(es) b{e.get('bucket', '?')}"
+            f" pad {e.get('pad_rows', '?')}:"
+            f" queue {_fmt(e.get('queue_s'), 4)}s"
+            f" -> dispatch {_fmt(e.get('dispatch_s'), 4)}s"
+            f" -> d2h {_fmt(e.get('d2h_s'), 4)}s"
+            f" -> respond {_fmt(e.get('respond_s'), 4)}s"
+            f"  (latency {_fmt(e.get('latency_s'), 4)}s,"
+            f" {e.get('label', '?')})"
+        )
     return lines
 
 
@@ -385,7 +443,15 @@ _QUALITY_GATE_FIELDS = (
 _SERVE_SLO_FIELDS = (
     "requests", "windows", "batches", "p50_ms", "p95_ms", "p99_ms",
     "windows_per_s", "queue_wait_mean_s", "pad_waste", "device_s",
-    "interval_s", "final", "patients")
+    "interval_s", "final", "patients", "buckets")
+_SERVE_DRIFT_FIELDS = (
+    "tenant", "verdict", "windows", "max_psi", "max_ks",
+    "max_mean_shift", "worst_channel", "warn_psi", "drift_psi",
+    "warn_ks", "drift_ks", "final")
+_SERVE_TRACE_FIELDS = (
+    "span_id", "request_id", "windows", "batches", "bucket", "pad_rows",
+    "label", "queue_s", "service_s", "dispatch_s", "device_s", "d2h_s",
+    "respond_s", "latency_s")
 
 
 def _section(events: List[Dict[str, Any]], kind: str,
@@ -535,6 +601,16 @@ def summarize_events(run_dir: str,
         lines.append("")
         lines.extend(_render_serve_slo(slos))
 
+    serve_drifts = _section(events, "serve_drift", _SERVE_DRIFT_FIELDS)
+    if serve_drifts:
+        lines.append("")
+        lines.extend(_render_serve_drift(serve_drifts))
+
+    traces = _section(events, "serve_trace", _SERVE_TRACE_FIELDS)
+    if traces:
+        lines.append("")
+        lines.extend(_render_serve_trace(traces))
+
     bench_blocks = _section(events, "bench_block", _BENCH_BLOCK_FIELDS)
     if bench_blocks:
         lines.append("")
@@ -633,6 +709,8 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
         "compile": _compile_aggregate(compile_events),
         "data_loads": section("data_load", _DATA_LOAD_FIELDS),
         "serve_slos": section("serve_slo", _SERVE_SLO_FIELDS),
+        "serve_drifts": section("serve_drift", _SERVE_DRIFT_FIELDS),
+        "serve_traces": section("serve_trace", _SERVE_TRACE_FIELDS),
         "bench_blocks": section("bench_block", _BENCH_BLOCK_FIELDS),
         "ingest_progress": section("ingest_progress",
                                    _INGEST_PROGRESS_FIELDS),

@@ -79,7 +79,10 @@ record p50/p95/p99 request latency, windows/sec, mean queue wait, and
 pad waste — backend-aware: it runs on whatever backend the capture
 targets, CPU-proxy rounds included, and `telemetry compare` gates only
 the relative pad-waste ratio across the proxy boundary;
-BENCH_SERVE_REQUESTS scales the request count, default 64),
+BENCH_SERVE_REQUESTS scales the request count, default 64;
+BENCH_SERVE_DRIFT_AFTER moves the built-in online-drift cohort shift —
+the loadgen traffic shifts scale/offset from that request on and the
+serve_drift verdict must flip, default halfway, -1 disables),
 BENCH_DE_CHUNK for its DE chunk size,
 BENCH_WASTE_EPOCHS for the early-stop-waste context's epoch cap (0
 skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
@@ -1341,21 +1344,49 @@ def bench_serve(run_log, n_passes: int) -> dict:
     included), the serving telemetry triple lands in the bench run dir,
     and `telemetry compare` marks the absolute latencies backend-bound
     so only the coalescer's pad-waste ratio gates across the proxy
-    boundary."""
+    boundary.
+
+    The block also exercises the online-drift path (ISSUE 17): a
+    DriftMonitor scores the loadgen traffic against a seeded
+    standard-normal baseline (the loadgen's own distribution, so the
+    unshifted half scores PSI ~ 0) while ``--drift-after``-style cohort
+    shift kicks in halfway (BENCH_SERVE_DRIFT_AFTER overrides; -1
+    disables) — the final summary carries the flipped verdict, proving
+    drift detection works end to end at bench cadence."""
+    import numpy as np
+
+    from apnea_uq_tpu.analysis.fingerprint import compute_fingerprint
     from apnea_uq_tpu.config import ModelConfig, UQConfig
     from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.serving.drift import DriftMonitor
     from apnea_uq_tpu.serving.engine import ServingEngine
     from apnea_uq_tpu.serving.loadgen import run_loadgen
 
     n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 64))
-    model = AlarconCNN1D(ModelConfig(compute_dtype=_bench_dtype()))
+    drift_after = int(os.environ.get("BENCH_SERVE_DRIFT_AFTER",
+                                     n_requests // 2))
+    cfg = ModelConfig(compute_dtype=_bench_dtype())
+    model = AlarconCNN1D(cfg)
     variables = init_variables(model, jax.random.key(0))
     engine = ServingEngine(
         model, variables, method="mcd",
         uq=UQConfig(mc_passes=n_passes), run_log=run_log, seed=0,
     )
     engine.warm()
-    return run_loadgen(engine, n_requests, max_windows=4, seed=0)
+    drift = None
+    if drift_after >= 0:
+        baseline = compute_fingerprint(
+            np.random.default_rng(7).normal(
+                size=(2048, cfg.time_steps, cfg.num_channels)
+            ).astype(np.float32))
+        drift = DriftMonitor(baseline, score_every=64, run_log=run_log)
+    summary = run_loadgen(engine, n_requests, max_windows=4, seed=0,
+                          drift_after=drift_after if drift_after >= 0
+                          else None,
+                          drift=drift)
+    if drift is not None:
+        summary["drift_verdicts"] = drift.verdicts()
+    return summary
 
 
 def _start_watchdog():

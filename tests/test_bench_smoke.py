@@ -266,6 +266,16 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert serve_ctx["p50_ms"] > 0 and serve_ctx["p99_ms"] >= serve_ctx["p50_ms"]
     assert serve_ctx["windows_per_s"] > 0
     assert 0.0 <= serve_ctx["pad_waste"] < 1.0
+    # Online drift (ISSUE 17): the bench's loadgen cohort shifts halfway
+    # through (BENCH_SERVE_DRIFT_AFTER default), and the monitor's final
+    # verdict — scored against the seeded standard-normal baseline —
+    # flips to "drift" online, proving detection end to end.
+    assert serve_ctx["drift_verdicts"] == {"default": "drift"}, serve_ctx
+    # Per-bucket SLO breakdown (ISSUE 17 satellite): the summary keys
+    # every dispatched bucket size with its own percentiles + pad share.
+    assert serve_ctx["buckets"], serve_ctx
+    for per in serve_ctx["buckets"].values():
+        assert per["batches"] >= 1 and per["p50_ms"] is not None
 
     # Result-v2 envelope (ISSUE 11): schema-versioned payload with
     # backend facts and a per-block status map, every block ok on the
@@ -310,6 +320,9 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
             # The serving telemetry triple (ISSUE 15): the serve block
             # streams its batch/request/SLO events into the same run log.
             "serve_batch", "serve_request", "serve_slo",
+            # The online-drift verdicts (ISSUE 17): the shifted loadgen
+            # cohort lands gateable serve_drift events beside them.
+            "serve_drift",
             # The autotune sweep (ISSUE 16): per-cell timings and the
             # per-label winner verdicts land in the same run log.
             "autotune_cell", "autotune_result"} <= kinds, \

@@ -143,3 +143,114 @@ def test_fingerprint_is_json_round_trippable(rng):
     doc = fp.compute_fingerprint(_windows(rng, n=50))
     again = json.loads(json.dumps(doc))
     assert fp.drift_report(doc, again)["max_psi"] == 0.0
+
+# ---------------------------------------------------------------------------
+# RollingFingerprint (ISSUE 17): the online accumulator the serving-path
+# drift monitor folds every scored window into.
+
+
+def test_rolling_matches_batch_scoring_without_decay(rng):
+    """With decay off, folding the whole cohort window-by-window must
+    score exactly like the batch path (same frozen edges, same counts)."""
+    x = _windows(rng, n=300)
+    base = fp.compute_fingerprint(x)
+    rolling = fp.RollingFingerprint(base)
+    for w in x:
+        rolling.update(w)                      # one (T, C) window at a time
+    batch_report = fp.score_against_baseline(x, base)
+    rolling_report = rolling.score(base)
+    assert rolling.seen == 300
+    assert rolling_report["max_psi"] == pytest.approx(
+        batch_report["max_psi"], abs=1e-9)
+    assert rolling_report["max_ks"] == pytest.approx(
+        batch_report["max_ks"], abs=1e-9)
+    # Self-traffic scores quiet; a shifted cohort must not.
+    assert rolling_report["max_psi"] < 0.05
+    shifted = fp.RollingFingerprint(base)
+    shifted.update(x * 2.0 + 1.5)
+    report = shifted.score(base)
+    assert report["max_psi"] > 0.2 and report["max_ks"] > 0.2
+
+
+def test_rolling_batch_fold_decays_prior_state_exactly(rng):
+    """An n-window batch fold fades the PRIOR state by exactly decay**n
+    and adds the new windows at full weight (recency inside one fold is
+    not modeled — folds are tiny next to any real half-life)."""
+    x = _windows(rng, n=64)
+    base = fp.compute_fingerprint(x)
+    r = fp.RollingFingerprint(base, half_life=16.0)
+    r.update(x[:32])
+    prior = r.counts.copy()
+    fresh = fp.RollingFingerprint(base, half_life=16.0)
+    fresh.update(x[32:])                       # raw histogram, no prior
+    r.update(x[32:])
+    np.testing.assert_allclose(
+        r.counts, prior * 0.5 ** (32 / 16.0) + fresh.counts, rtol=1e-12)
+    assert r.seen == 64
+    assert r.window_w == pytest.approx(
+        fresh.window_w + 32 * 0.5 ** (32 / 16.0))
+
+
+def test_rolling_decay_ages_out_an_incident(rng):
+    """A drifted burst must fade once clean traffic resumes: the score
+    right after the burst is high, and far lower after 8 half-lives of
+    clean windows (recency bias), while the cumulative no-decay variant
+    stays polluted."""
+    x = _windows(rng, n=1200)
+    base = fp.compute_fingerprint(x)
+    decayed = fp.RollingFingerprint(base, half_life=50.0)
+    cumulative = fp.RollingFingerprint(base)
+    burst = x[:200] * 2.0 + 1.5
+    for r in (decayed, cumulative):
+        r.update(burst)
+    during = decayed.score(base)["max_psi"]
+    for r in (decayed, cumulative):
+        r.update(x[200:600])                   # 400 clean = 8 half-lives
+    after = decayed.score(base)["max_psi"]
+    assert during > 0.2
+    assert after < during / 3
+    assert cumulative.score(base)["max_psi"] > after
+
+
+def test_rolling_state_round_trips_through_json(rng):
+    """to_json/from_json must reproduce the exact scoring state (the
+    stream scorer persists it inside stream_state.json): same report
+    before and after, and updates keep agreeing afterwards."""
+    x = _windows(rng, n=120)
+    base = fp.compute_fingerprint(x)
+    rolling = fp.RollingFingerprint(base, half_life=64.0)
+    rolling.update(x[:80])
+    doc = json.loads(json.dumps(rolling.to_json()))   # via real JSON
+    restored = fp.RollingFingerprint.from_json(doc)
+    assert restored.seen == rolling.seen
+    assert json.dumps(restored.score(base), sort_keys=True) == \
+        json.dumps(rolling.score(base), sort_keys=True)
+    rolling.update(x[80:])
+    restored.update(x[80:])
+    assert json.dumps(restored.score(base), sort_keys=True) == \
+        json.dumps(rolling.score(base), sort_keys=True)
+    with pytest.raises(ValueError, match="version"):
+        fp.RollingFingerprint.from_json({**doc, "version": 999})
+
+
+def test_rolling_validation_and_shape_rates(rng):
+    x = _windows(rng, n=40, steps=20, channels=2)
+    base = fp.compute_fingerprint(x)
+    with pytest.raises(ValueError, match="half_life"):
+        fp.RollingFingerprint(base, half_life=0.0)
+    rolling = fp.RollingFingerprint(base)
+    with pytest.raises(ValueError, match="no windows"):
+        rolling.fingerprint()
+    with pytest.raises(ValueError, match="shape"):
+        rolling.update(np.zeros((5, 20, 3), np.float32))
+    # NaN / flatline windows land in the same rate fields the batch
+    # fingerprint computes.
+    dirty = x.copy()
+    dirty[0, :, 0] = 3.25                       # flat window on ch0
+    dirty[1, 10:, 0] = np.nan
+    rolling.update(dirty)
+    doc = rolling.fingerprint()
+    ch0 = doc["channels"][0]
+    assert ch0["flatline_rate"] == pytest.approx(1 / 40)
+    assert ch0["nan_rate"] == pytest.approx(10 / (40 * 20))
+    assert doc["rows"] == 40

@@ -352,6 +352,10 @@ def test_package_gate_zero_unsuppressed_findings():
                 "apnea_uq_tpu/serving/slo.py",
                 "apnea_uq_tpu/serving/stream.py",
                 "apnea_uq_tpu/serving/loadgen.py",
+                # The online drift monitor (ISSUE 17): emits the
+                # documented serve_drift kind with literal kwargs — the
+                # schema rule must keep scanning it.
+                "apnea_uq_tpu/serving/drift.py",
                 # The Pallas DE kernel + autotune harness (ISSUE 16):
                 # the kernel bodies and the winner-persisting sweep —
                 # autotune emits the documented autotune_cell /

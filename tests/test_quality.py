@@ -337,6 +337,120 @@ class TestEndToEnd:
         assert doc["quality_gates"][-1]["passed"] is False
 
 
+def _serve_drift_run(path, drift_events):
+    """A fabricated serve run dir: run boundary + the given
+    ``serve_drift`` event payloads (seq/ts/kind filled in)."""
+    events = [{"seq": 0, "ts": 1.0, "kind": "run_started",
+               "schema_version": 1, "stage": "serve"}]
+    for i, payload in enumerate(drift_events):
+        events.append({"seq": i + 1, "ts": 2.0 + i,
+                       "kind": "serve_drift", **payload})
+    events.append({"seq": len(events), "ts": 99.0,
+                   "kind": "run_finished", "status": "ok"})
+    return _fabricated_run_dir(path, events)
+
+
+def _drift_event(*, tenant="default", max_psi, max_ks=0.0,
+                 verdict="ok", final=True, **overrides):
+    doc = {"tenant": tenant, "verdict": verdict, "windows": 256,
+           "max_psi": max_psi, "max_ks": max_ks,
+           "max_mean_shift": max_psi, "worst_channel": "ch1",
+           "warn_psi": 0.1, "drift_psi": 0.2, "warn_ks": 0.1,
+           "drift_ks": 0.2, "final": final}
+    doc.update(overrides)
+    return doc
+
+
+class TestServeRunGating:
+    """ISSUE 17 read side: `apnea-uq quality check` accepts a SERVE run
+    directory — the online per-tenant ``serve_drift`` verdicts gate in
+    place of batch-eval fingerprints, jax-free, same exit-code
+    contract."""
+
+    def test_drifted_serve_session_exits_1(self, tmp_path, capsys):
+        run = _serve_drift_run(tmp_path / "drifted", [
+            _drift_event(max_psi=0.85, max_ks=0.4, verdict="drift"),
+        ])
+        assert main(["quality", "check", run]) == 1
+        out = capsys.readouterr().out
+        assert "quality-serve-drift" in out
+        assert "tenant default" in out
+        # The drifted verdict landed in the run's own audit trail.
+        gates = [e for e in telemetry.read_events(run)
+                 if e["kind"] == "quality_gate"]
+        assert gates[-1]["passed"] is False
+
+    def test_clean_serve_session_exits_0(self, tmp_path):
+        run = _serve_drift_run(tmp_path / "clean", [
+            _drift_event(max_psi=0.02, max_ks=0.01, verdict="ok"),
+        ])
+        assert main(["quality", "check", run]) == 0
+
+    def test_last_event_per_tenant_wins(self, tmp_path):
+        """The gate reads each tenant's LAST event (append order): an
+        early drifted re-score followed by a clean final flush is a
+        recovered session, not a failure — and vice versa."""
+        recovered = _serve_drift_run(tmp_path / "recovered", [
+            _drift_event(max_psi=0.9, verdict="drift", final=False),
+            _drift_event(max_psi=0.03, verdict="ok"),
+        ])
+        assert main(["quality", "check", recovered]) == 0
+        worsened = _serve_drift_run(tmp_path / "worsened", [
+            _drift_event(max_psi=0.03, verdict="ok", final=False),
+            _drift_event(max_psi=0.9, verdict="drift"),
+        ])
+        assert main(["quality", "check", worsened]) == 1
+
+    def test_event_thresholds_beat_cli_fallbacks(self, tmp_path):
+        """Each event self-describes the thresholds it was scored with
+        (per-tenant overrides included): the gate uses THOSE, so it can
+        never disagree with the emitted verdict.  The CLI thresholds
+        apply only to pre-threshold-field logs."""
+        # A tight tenant: drift_psi 0.05 fails a PSI the CLI default
+        # (0.2) would wave through.
+        tight = _serve_drift_run(tmp_path / "tight", [
+            _drift_event(max_psi=0.15, verdict="drift", drift_psi=0.05),
+        ])
+        assert main(["quality", "check", tight]) == 1
+        # A loose tenant: drift_psi 0.5 passes a PSI the CLI default
+        # would fail.
+        loose = _serve_drift_run(tmp_path / "loose", [
+            _drift_event(max_psi=0.3, verdict="ok", drift_psi=0.5),
+        ])
+        assert main(["quality", "check", loose]) == 0
+        # No threshold fields on the event: the CLI flag is the bar.
+        legacy = _serve_drift_run(tmp_path / "legacy", [
+            {"tenant": "default", "verdict": "ok", "windows": 64,
+             "max_psi": 0.15, "max_ks": 0.05, "final": True},
+        ])
+        assert main(["quality", "check", legacy]) == 0
+        assert main(["quality", "check", legacy,
+                     "--psi-threshold", "0.1"]) == 1
+
+    def test_gate_boundary_matches_monitor_verdict(self, tmp_path):
+        """value == drift threshold IS drift (the monitor's >= rule):
+        the gate must fail it too, not pass on a strict <."""
+        run = _serve_drift_run(tmp_path / "boundary", [
+            _drift_event(max_psi=0.2, verdict="drift"),
+        ])
+        assert main(["quality", "check", run]) == 1
+
+    def test_multi_tenant_worst_tenant_gates(self, tmp_path, capsys):
+        run = _serve_drift_run(tmp_path / "tenants", [
+            _drift_event(tenant="icu-3", max_psi=0.02, verdict="ok"),
+            _drift_event(tenant="ward-b", max_psi=0.7, max_ks=0.5,
+                         verdict="drift"),
+        ])
+        assert main(["quality", "check", run, "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        checks = doc["quality_gate"]["checks"]
+        by_label = {}
+        for c in checks:
+            by_label.setdefault(c["label"], []).append(c["passed"])
+        assert all(by_label["tenant icu-3"])
+        assert not all(by_label["tenant ward-b"])
+
+
 class TestCompareQuality:
     def test_compare_gates_quality_ece_between_run_dirs(self, env,
                                                         tmp_path):

@@ -7,6 +7,15 @@ directions (golden ``--json``), and the warm-serve acceptance bar:
 serve process acquiring every bucket program from the store with zero
 fresh XLA compiles while a load-generated run records gateable
 ``serve_slo`` events.
+
+Plus the ISSUE 17 observability tier: the per-tenant online
+``DriftMonitor`` (cadence, verdicts, threshold overrides, JSON state),
+drift state riding the kill -9-safe stream snapshot without
+double-counting replayed windows, per-bucket SLO breakdowns,
+``serve_drift`` metric extraction/gating in ``telemetry compare``, and
+the end-to-end ``--drift-check``/``--trace-every`` acceptance: verdict
+flip under ``--drift-after``, exact span-waterfall decomposition, and
+the jax-free ``quality check`` exit codes on serve run dirs.
 """
 
 import dataclasses
@@ -209,6 +218,33 @@ class TestSLOTracker:
         assert events[-1]["patients"] == 3
         assert events[-1]["requests"] == 1
 
+    def test_per_bucket_breakdown(self):
+        """ISSUE 17 satellite: the summary carries a per-bucket-size
+        breakdown (batches/windows/pad + device-time percentiles) so a
+        saturated 256-bucket cannot hide behind a healthy global p95."""
+        slo = SLOTracker(lambda: 1.0)
+        for device_s in (0.010, 0.020, 0.030):
+            slo.record_batch(bucket=16, rows=12, pad_rows=4,
+                             queue_wait_s=0.001, device_s=device_s)
+        slo.record_batch(bucket=256, rows=200, pad_rows=56,
+                         queue_wait_s=0.002, device_s=0.5)
+        buckets = slo.summary(now=2.0)["buckets"]
+        assert set(buckets) == {"16", "256"}  # JSON-object string keys
+        b16 = buckets["16"]
+        assert b16["batches"] == 3 and b16["windows"] == 36
+        assert b16["pad_rows"] == 12
+        assert b16["pad_waste"] == pytest.approx(12 / 48)
+        assert b16["p50_ms"] == pytest.approx(20.0)
+        assert b16["p99_ms"] <= 30.0
+        b256 = buckets["256"]
+        assert b256["pad_waste"] == pytest.approx(56 / 256, abs=1e-4)
+        assert b256["p50_ms"] == pytest.approx(500.0)
+        # The global rollup still adds up across buckets.
+        s = slo.summary(now=2.0)
+        assert s["batches"] == 4 and s["windows"] == 236
+        assert s["pad_waste"] == pytest.approx((12 + 56) / (48 + 256),
+                                               abs=1e-4)
+
 
 # ------------------------------------------------------------- loadgen --
 
@@ -256,6 +292,131 @@ class TestLoadgen:
         bad.write_text(json.dumps({"windows": [[[0.0] * 4] * 59]}) + "\n")
         with pytest.raises(ValueError, match="windows must be"):
             list(ndjson_requests(str(bad)))
+
+
+# ------------------------------------------------------ drift monitor --
+
+
+class TestDriftMonitor:
+    """serving/drift.py (ISSUE 17 tentpole): per-tenant rolling drift
+    scoring on the request path — cadence, verdicts, tenant threshold
+    overrides, and the JSON state that rides the stream snapshot."""
+
+    def _baseline(self, rng, n=400):
+        from apnea_uq_tpu.analysis import fingerprint as fp
+
+        return fp.compute_fingerprint(
+            rng.normal(size=(n, 60, 4)).astype(np.float32))
+
+    def test_cadence_verdicts_and_events(self, tmp_path):
+        from apnea_uq_tpu import telemetry
+        from apnea_uq_tpu.serving.drift import DriftMonitor
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        rng = np.random.default_rng(3)
+        base = self._baseline(rng)
+        run_log = RunLog(str(tmp_path))
+        mon = DriftMonitor(base, score_every=50, run_log=run_log)
+        clean = rng.normal(size=(120, 60, 4)).astype(np.float32)
+        # Below the cadence: fold, no event.  At >= 50 windows: a
+        # verdict document comes back and the event lands.
+        assert mon.observe(clean[:20]) is None
+        assert mon.observe(clean[20:40]) is None
+        doc = mon.observe(clean[40:80])
+        assert doc is not None and doc["verdict"] == "ok"
+        assert doc["tenant"] == "default" and doc["final"] is False
+        assert mon.verdicts() == {"default": "ok"}
+        # A shifted tenant drifts independently of the clean one.
+        shifted = clean * 2.0 + 1.5
+        out = [mon.observe(shifted[i:i + 25], tenant="p9")
+               for i in range(0, 100, 25)]
+        drifted = [d for d in out if d is not None]
+        assert drifted and all(d["verdict"] == "drift" for d in drifted)
+        assert drifted[-1]["max_psi"] >= 0.2
+        assert mon.verdicts()["p9"] == "drift"
+        # flush(): only sub-cadence tails emit, as final=True.
+        mon.observe(clean[80:90])
+        mon.observe(shifted[100:110], tenant="p9")
+        flushed = mon.flush()
+        assert set(flushed) == {"default", "p9"}
+        assert all(d["final"] for d in flushed.values())
+        run_log.close()
+        events = [e for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "serve_drift"]
+        assert len(events) == len(drifted) + 1 + len(flushed)
+        for e in events:
+            # Every event self-describes the thresholds it was scored
+            # with — what `quality check` gates a serve run dir on.
+            assert e["drift_psi"] == 0.2 and e["warn_psi"] == 0.1
+            assert e["verdict"] in ("ok", "warn", "drift")
+
+    def test_tenant_thresholds_override_fleet_default(self):
+        from apnea_uq_tpu.serving.drift import DriftMonitor
+
+        rng = np.random.default_rng(4)
+        base = self._baseline(rng)
+        shifted = (rng.normal(size=(64, 60, 4)) * 2.0 + 1.5).astype(
+            np.float32)
+        mon = DriftMonitor(
+            base, score_every=64,
+            tenant_thresholds={"noisy": {"drift_psi": 50.0,
+                                         "warn_psi": 40.0,
+                                         "drift_ks": 5.0,
+                                         "warn_ks": 4.0}})
+        strict = mon.observe(shifted, tenant="default")
+        loose = mon.observe(shifted, tenant="noisy")
+        assert strict["verdict"] == "drift"
+        assert loose["verdict"] == "ok"
+        assert loose["drift_psi"] == 50.0  # the event carries its bar
+
+    def test_warn_band_between_thresholds(self):
+        from apnea_uq_tpu.serving.drift import DriftMonitor
+
+        rng = np.random.default_rng(5)
+        base = self._baseline(rng, n=800)
+        mon = DriftMonitor(base, score_every=400)
+        # A mild shift: past warn, under drift (thresholds are the
+        # PSI rule of thumb, 0.1 / 0.2).
+        mild = (rng.normal(size=(400, 60, 4)) * 1.0 + 0.35).astype(
+            np.float32)
+        doc = mon.observe(mild)
+        assert doc["verdict"] == "warn", doc
+        assert 0.1 <= max(doc["max_psi"], doc["max_ks"]) < 0.2
+
+    def test_state_round_trips_and_restore_keeps_new_config(self):
+        from apnea_uq_tpu.serving.drift import DriftMonitor
+
+        rng = np.random.default_rng(6)
+        base = self._baseline(rng)
+        mon = DriftMonitor(base, score_every=500, half_life=128.0)
+        mon.observe(rng.normal(size=(70, 60, 4)).astype(np.float32))
+        mon.observe((rng.normal(size=(30, 60, 4)) * 2.0).astype(
+            np.float32), tenant="pX")
+        doc = json.loads(json.dumps(mon.to_json()))  # via real JSON
+        twin = DriftMonitor.from_json(doc, baseline=base)
+        assert twin.windows_seen() == 70
+        assert twin.windows_seen("pX") == 30
+        assert json.dumps(twin.score_tenant("pX"), sort_keys=True) == \
+            json.dumps(mon.score_tenant("pX"), sort_keys=True)
+        # restore(): the resume path adopts the persisted rolling
+        # windows but keeps THIS monitor's flags (new cadence wins).
+        fresh = DriftMonitor(base, score_every=10)
+        fresh.restore(doc)
+        assert fresh.score_every == 10
+        assert fresh.windows_seen() == 70
+        with pytest.raises(ValueError, match="version"):
+            DriftMonitor.from_json({**doc, "version": 99}, baseline=base)
+
+    def test_validation(self):
+        from apnea_uq_tpu.serving.drift import DriftMonitor
+
+        base = self._baseline(np.random.default_rng(0))
+        with pytest.raises(ValueError, match="score_every"):
+            DriftMonitor(base, score_every=0)
+        mon = DriftMonitor(base)
+        assert mon.score_tenant("never-seen") is None
+        assert mon.flush() == {}
+        assert mon.windows_seen() == 0
 
 
 # --------------------------------------------- engine (tiny model, CPU) --
@@ -760,6 +921,112 @@ raise SystemExit("unreachable: the kill must fire mid-stream")
         assert starts == {float(t) for t in range(expected)}
 
 
+    def test_kill9_drift_state_rides_snapshot_no_double_count(
+        self, tmp_path
+    ):
+        """ISSUE 17 satellite: the online drift monitor's rolling
+        fingerprint rides the SAME atomic stream-state snapshot as the
+        ring state.  A SIGKILL right after the second commit leaves a
+        snapshot whose drift window equals exactly the scored windows;
+        the resume restores it and re-feeding the whole stream folds
+        every window exactly ONCE (seen == windows_scored at the end —
+        a replayed window never double-counts)."""
+        n_samples, hop = 140, 1
+        input_path = tmp_path / "stream.ndjson"
+        input_path.write_text(
+            "\n".join(_stream_lines(("p1",), n_samples)) + "\n")
+        state_dir = tmp_path / "state"
+        out_path = tmp_path / "out.ndjson"
+        script = tmp_path / "killer.py"
+        script.write_text(f"""
+import os, signal, sys
+import numpy as np
+sys.path.insert(0, {str(REPO)!r})
+import jax
+from apnea_uq_tpu.analysis import fingerprint as fp
+from apnea_uq_tpu.config import ModelConfig, UQConfig
+from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+from apnea_uq_tpu.serving.drift import DriftMonitor
+from apnea_uq_tpu.serving.engine import ServingEngine
+from apnea_uq_tpu.serving.stream import StreamScorer
+
+model = AlarconCNN1D(ModelConfig(features=(4, 6), kernel_sizes=(3, 3),
+                                 dropout_rates=(0.2, 0.3)))
+variables = init_variables(model, jax.random.key(0))
+engine = ServingEngine(model, variables, method="mcd",
+                       uq=UQConfig(mc_passes=2), buckets=(16,))
+baseline = fp.compute_fingerprint(np.random.default_rng(1).normal(
+    size=(512, 60, 4)).astype(np.float32))
+drift = DriftMonitor(baseline, score_every=10_000)
+scorer = StreamScorer(engine, state_dir={str(state_dir)!r},
+                      out_path={str(out_path)!r}, hop={hop},
+                      drift=drift)
+flushes = [0]
+orig = scorer._flush_pending
+def kill_after_two():
+    orig()
+    flushes[0] += 1
+    if flushes[0] == 2:
+        os.kill(os.getpid(), signal.SIGKILL)
+scorer._flush_pending = kill_after_two
+scorer.run(open({str(input_path)!r}), max_pending_s=1e9)
+raise SystemExit("unreachable: the kill must fire mid-stream")
+""")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, str(script)], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == -signal.SIGKILL, (
+            proc.returncode, proc.stderr[-2000:])
+        state = json.loads((state_dir / "stream_state.json").read_text())
+        # The drift payload is IN the snapshot (same atomic commit),
+        # the schema version did not bump (older snapshots stay
+        # loadable: the key is optional), and the committed rolling
+        # window equals exactly the committed scored-window count.
+        assert state["version"] == 1
+        scored_before = state["patients"]["p1"]["windows_scored"]
+        assert scored_before == 32  # 2 x b16, like the ring-state twin
+        rolling = state["drift"]["tenants"]["p1"]["rolling"]
+        assert rolling["seen"] == scored_before
+
+        # Resume with a FRESH monitor: the scorer restores the
+        # persisted rolling window (not a verdict reset) and the full
+        # replay folds every window exactly once.
+        from apnea_uq_tpu.analysis import fingerprint as fp
+        from apnea_uq_tpu.config import ModelConfig, UQConfig
+        from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+        from apnea_uq_tpu.serving.drift import DriftMonitor
+        from apnea_uq_tpu.serving.engine import ServingEngine
+        from apnea_uq_tpu.serving.stream import StreamScorer
+
+        model = AlarconCNN1D(ModelConfig(
+            features=(4, 6), kernel_sizes=(3, 3),
+            dropout_rates=(0.2, 0.3)))
+        engine = ServingEngine(
+            model, init_variables(model, jax.random.key(0)),
+            method="mcd", uq=UQConfig(mc_passes=2), buckets=(16,))
+        baseline = fp.compute_fingerprint(np.random.default_rng(1).normal(
+            size=(512, 60, 4)).astype(np.float32))
+        drift = DriftMonitor(baseline, score_every=10_000)
+        scorer = StreamScorer(engine, state_dir=str(state_dir),
+                              out_path=str(out_path), hop=hop,
+                              drift=drift)
+        assert drift.windows_seen("p1") == scored_before  # restored
+        scorer.run(open(input_path))
+        expected = n_samples - 60 + 1
+        assert scorer.patients["p1"].windows_scored == expected
+        # The drift contract: exactly one fold per scored window —
+        # replayed samples were deduped BEFORE the monitor saw them.
+        assert drift.windows_seen("p1") == expected
+        # The end-of-stream flush landed a verdict for the tenant (the
+        # hop=1 replay re-counts 140 distinct samples ~35x each, so the
+        # PSI itself is sampling-noise-dominated — the e2e loadgen test
+        # owns the ok/drift flip assertions).
+        assert drift.verdicts()["p1"] is not None
+
+
 # ------------------------------------- compare directions (golden json) --
 
 
@@ -868,6 +1135,59 @@ class TestServeMetricGating:
         assert metrics["serve.p99_ms"].value == 12.0
         assert metrics["serve.p99_ms"].backend_bound is True
         assert metrics["serve.pad_waste"].backend_bound is False
+
+    def test_serve_drift_metrics_gate_lower_better_unbound(
+        self, tmp_path, capsys
+    ):
+        """ISSUE 17: `serve_drift.<tenant>.max_psi/max_ks` extract as
+        lower-is-better, backend-UNBOUND metrics (drift is a traffic
+        property, not a backend one — it crosses the CPU-proxy
+        boundary), last event per tenant wins, and a drift worsening
+        gates compare nonzero."""
+        from apnea_uq_tpu.cli.main import main as cli_main
+        from apnea_uq_tpu.telemetry import compare as compare_mod
+        from apnea_uq_tpu.telemetry.runlog import RunLog
+
+        def drift_run(path, *, max_psi, max_ks, proxy=False):
+            os.makedirs(path, exist_ok=True)
+            run_log = RunLog(str(path))
+            run_log.event("run_started", schema_version=1)
+            if proxy:
+                run_log.event("bench_mode", proxy=True)
+            run_log.event("serve_drift", tenant="default", verdict="ok",
+                          windows=128, max_psi=max_psi / 2,
+                          max_ks=max_ks / 2, max_mean_shift=0.0,
+                          worst_channel="ch0", warn_psi=0.1,
+                          drift_psi=0.2, warn_ks=0.1, drift_ks=0.2,
+                          final=False)
+            run_log.event("serve_drift", tenant="default", verdict="ok",
+                          windows=256, max_psi=max_psi, max_ks=max_ks,
+                          max_mean_shift=0.0, worst_channel="ch0",
+                          warn_psi=0.1, drift_psi=0.2, warn_ks=0.1,
+                          drift_ks=0.2, final=True)
+            run_log.event("run_finished", status="ok")
+            run_log.close()
+            return str(path)
+
+        clean = drift_run(tmp_path / "clean", max_psi=0.02, max_ks=0.01)
+        metrics = compare_mod.load_metrics(clean)
+        psi = metrics["serve_drift.default.max_psi"]
+        assert psi.value == 0.02  # the LAST (final) event, not the first
+        assert psi.higher_better is False
+        assert psi.backend_bound is False
+        assert metrics["serve_drift.default.max_ks"].value == 0.01
+        # A drift worsening regresses — even across the proxy boundary,
+        # where backend-bound latencies are refused.
+        drifted = drift_run(tmp_path / "drifted", max_psi=0.6,
+                            max_ks=0.4, proxy=True)
+        assert cli_main(["telemetry", "compare", clean, drifted,
+                         "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        verdicts = {d["name"]: d["regressed"] for d in doc["deltas"]}
+        assert verdicts["serve_drift.default.max_psi"] is True
+        assert verdicts["serve_drift.default.max_ks"] is True
+        assert "serve_drift.default.max_psi" not in \
+            doc["skipped_backend_bound"]
 
     def test_trend_carries_serve_series(self, tmp_path):
         from apnea_uq_tpu.telemetry import trend as trend_mod
@@ -1110,3 +1430,155 @@ def test_score_stream_cli_end_to_end(serving_registry, tmp_path):
             if e["kind"] == "serve_slo"]
     assert slos[-1]["patients"] == 2
     assert slos[-1]["windows"] == 2
+
+
+# ------------------------- online drift + tracing acceptance (ISSUE 17) --
+
+
+def test_serve_drift_check_traces_and_quality_gate(serving_registry,
+                                                   tmp_path, capsys):
+    """ISSUE 17 acceptance, through the real CLI as subprocesses:
+
+    - `serve --loadgen --drift-check --drift-after N` flips the online
+      ``serve_drift`` verdict mid-session (first re-score of the clean
+      cohort is ok, the shifted cohort drifts) with ZERO request-path
+      compiles — drift scoring is host-side numpy on frozen edges;
+    - sampled ``serve_trace`` spans decompose the SLO latency exactly
+      (queue_s + service_s == the serve_request latency_s);
+    - `apnea-uq quality check <serve-run-dir>` gates the session: the
+      drifted run exits 1 (jax poisoned — the read side never imports
+      it), a clean run exits 0;
+    - `telemetry summarize` renders the drift trail, the trace
+      waterfalls, and the per-bucket SLO breakdown.
+    """
+    import shutil
+
+    from apnea_uq_tpu import telemetry
+    from apnea_uq_tpu.analysis import fingerprint as fp
+    from apnea_uq_tpu.cli.main import main as cli_main
+    from apnea_uq_tpu.data import registry as reg
+    from apnea_uq_tpu.data.registry import ArtifactRegistry
+
+    # A registry copy whose frozen quality_baseline matches the loadgen
+    # traffic distribution (standardized normal): the unshifted half of
+    # the session must score quiet, so the verdict flip is the SHIFT'S
+    # doing, not a baseline mismatch.
+    registry_dir = str(tmp_path / "registry")
+    shutil.copytree(serving_registry["registry"], registry_dir)
+    registry = ArtifactRegistry(registry_dir)
+    doc = registry.load_json(reg.QUALITY_BASELINE)
+    normal_fp = fp.compute_fingerprint(
+        np.random.default_rng(11).normal(size=(1024, 60, 4)).astype(
+            np.float32))
+    doc["sets"] = {name: normal_fp for name in doc["sets"]}
+    registry.save_json(reg.QUALITY_BASELINE, doc)
+
+    env = _subprocess_env()
+    config = serving_registry["config"]
+    drift_dir = str(tmp_path / "drift_run")
+    proc = subprocess.run(
+        [sys.executable, "-m", "apnea_uq_tpu.cli.main", "serve",
+         "--registry", registry_dir, "--config", config,
+         "--loadgen", "80", "--request-windows", "2",
+         "--drift-check", "--drift-every", "32", "--drift-after", "40",
+         "--trace-every", "5", "--slo-every", "40",
+         "--run-dir", drift_dir],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    events = telemetry.read_events(drift_dir)
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["kind"], []).append(e)
+
+    # --- the verdict flip, online: clean cohort ok, shifted drifts.
+    drifts = by_kind["serve_drift"]
+    assert all(e["tenant"] == "default" for e in drifts)
+    assert drifts[0]["verdict"] == "ok", drifts[0]
+    assert drifts[0]["max_psi"] < 0.1
+    assert drifts[-1]["verdict"] == "drift", drifts[-1]
+    assert drifts[-1]["max_psi"] >= drifts[-1]["drift_psi"]
+    assert drifts[-1]["worst_channel"]
+    assert drifts[-1]["windows"] <= sum(
+        e["windows"] for e in by_kind["serve_request"])
+
+    # --- zero request-path compiles, drift + tracing on: every
+    # dispatched batch ran an executable warmed at startup.
+    batches = by_kind["serve_batch"]
+    assert batches
+    for e in batches:
+        assert e["backend_compiles"] == 0, e
+        assert e["retraces"] == 0, e
+
+    # --- sampled span waterfalls: 1-in-5 of 80 completed requests,
+    # unique span ids, and an exact decomposition of the SLO latency.
+    traces = by_kind["serve_trace"]
+    assert len(traces) == 16
+    assert len({t["span_id"] for t in traces}) == len(traces)
+    req_by_id = {e["request_id"]: e for e in by_kind["serve_request"]}
+    for t in traces:
+        request = req_by_id[t["request_id"]]
+        assert t["windows"] == request["windows"]
+        assert t["batches"] == request["batches"]
+        assert t["latency_s"] == request["latency_s"]
+        # queue (enqueue -> first dispatch) + service (first dispatch ->
+        # last score) IS the latency — a decomposition, not a parallel
+        # measurement (each leg rounded to 1e-6 independently).
+        assert t["queue_s"] + t["service_s"] == \
+            pytest.approx(t["latency_s"], abs=3e-6)
+        assert t["queue_s"] >= 0 and t["service_s"] >= 0
+        assert t["d2h_s"] >= 0 and t["respond_s"] >= 0
+        assert t["bucket"] in SERVE_BUCKET_SIZES
+        assert t["pad_rows"] >= 0
+        assert t["label"].startswith("mcd_serve_b")
+
+    # --- the per-bucket SLO breakdown rode the final snapshot.
+    final_slo = by_kind["serve_slo"][-1]
+    assert final_slo["final"] is True
+    assert final_slo["buckets"]
+    assert sum(b["windows"] for b in final_slo["buckets"].values()) \
+        == final_slo["windows"]
+
+    # --- the gate: a drifted serve session is exit 1, jax-free (the
+    # read side runs with jax poisoned out of sys.modules).
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "sys.modules['flax'] = None\n"
+        "from apnea_uq_tpu.cli.main import main\n"
+        f"raise SystemExit(main(['quality', 'check', {drift_dir!r}]))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, (proc.returncode, proc.stderr[-2000:])
+    assert "quality-serve-drift" in proc.stdout
+
+    # --- summarize renders the new observability surfaces.
+    assert cli_main(["telemetry", "summarize", drift_dir]) == 0
+    out = capsys.readouterr().out
+    assert "serve drift (online, vs frozen quality_baseline):" in out
+    assert "serve traces (sampled request waterfalls):" in out
+    assert "per-bucket (final snapshot):" in out
+    assert cli_main(["telemetry", "summarize", drift_dir, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["serve_drifts"][-1]["verdict"] == "drift"
+    assert doc["serve_traces"][0]["span_id"]
+
+    # --- and a clean session (no shift) closes ok and gates exit 0.
+    clean_dir = str(tmp_path / "clean_run")
+    proc = subprocess.run(
+        [sys.executable, "-m", "apnea_uq_tpu.cli.main", "serve",
+         "--registry", registry_dir, "--config", config,
+         "--loadgen", "40", "--request-windows", "2",
+         "--drift-check", "--drift-every", "32",
+         "--run-dir", clean_dir],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    clean_drifts = [e for e in telemetry.read_events(clean_dir)
+                    if e["kind"] == "serve_drift"]
+    assert clean_drifts and clean_drifts[-1]["verdict"] == "ok"
+    assert cli_main(["quality", "check", clean_dir]) == 0
+    capsys.readouterr()
