@@ -870,7 +870,7 @@ def cmd_serve(args, config) -> int:
                 time_steps=config.model.time_steps,
                 channels=config.model.num_channels,
                 seed=config.train.seed, rate=args.rate,
-                drift_after=args.drift_after,
+                arrival=args.arrival, drift_after=args.drift_after,
             )
         else:
             requests = loadgen_mod.ndjson_requests(
@@ -1204,15 +1204,65 @@ def cmd_telemetry_summarize(args) -> int:
     import json
 
     from apnea_uq_tpu.telemetry import summarize_data, summarize_run
+    from apnea_uq_tpu.telemetry.summarize import (
+        summarize_all_runs_data,
+        summarize_all_runs_text,
+    )
 
     try:
-        if args.json:
+        if getattr(args, "all_runs", False):
+            if args.json:
+                log(json.dumps(summarize_all_runs_data(args.run_dir),
+                               indent=2))
+            else:
+                log(summarize_all_runs_text(args.run_dir))
+        elif args.json:
             log(json.dumps(summarize_data(args.run_dir), indent=2))
         else:
             log(summarize_run(args.run_dir))
     except FileNotFoundError as e:
         raise SystemExit(str(e))
     return 0
+
+
+def cmd_telemetry_fleet(args) -> int:
+    """Cross-replica SLO rollup (ISSUE 18): merge N serve replica run
+    dirs' final serve_slo digests into fleet p50/p95/p99 + throughput
+    overall and per bucket, render the per-replica attribution table
+    (outlier flagged at ``--spread-threshold`` times the median replica
+    p99), and roll up serve_drift verdicts per tenant (worst wins).
+    ``--out DIR`` persists the rollup as a ``fleet_rollup`` event +
+    registry artifact so `telemetry compare`/`trend` gate it like any
+    run dir.  Findings ride the shared lint reporters (text / ``--json``
+    / ``--format gha``).  Exit 0 clean, 1 on an outlier or drifted
+    tenant, 2 when a source carries no fleet telemetry — never a clean
+    pass over zero replicas.  Needs no config and never imports jax."""
+    from apnea_uq_tpu.lint.report import emit_result, resolve_format
+    from apnea_uq_tpu.telemetry import fleet as fleet_mod
+
+    try:
+        rollup = fleet_mod.build_rollup(
+            args.run_dirs, spread_threshold=args.spread_threshold)
+    except fleet_mod.NoFleetTelemetry as e:
+        log(f"apnea-uq telemetry fleet: {e}")
+        raise SystemExit(2)
+    except (FileNotFoundError, ValueError, OSError) as e:
+        raise SystemExit(str(e))
+    if args.out:
+        try:
+            fleet_mod.record_rollup(rollup, args.out)
+            log(f"fleet rollup -> {args.out}")
+        except OSError as e:
+            # Best-effort like the quality gate's audit append: a
+            # read-only destination must not cost the user the rollup.
+            log(f"fleet rollup not recorded in {args.out}: {e}")
+    fmt = resolve_format(args)
+    if fmt == "text":
+        log(fleet_mod.render_fleet(rollup))
+    emit_result(fleet_mod.fleet_result(rollup), fmt,
+                subject="replica(s)",
+                json_extra={"fleet_rollup": fleet_mod.rollup_data(rollup)})
+    return 1 if fleet_mod.fleet_findings(rollup) else 0
 
 
 def cmd_telemetry_compare(args) -> int:
@@ -1707,6 +1757,14 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     p.add_argument("--rate", type=float, default=0.0,
                    help="With --loadgen: open-loop arrival rate in "
                         "requests/sec (0 = as fast as possible).")
+    p.add_argument("--arrival", choices=("uniform", "poisson"),
+                   default="uniform",
+                   help="With --loadgen and --rate: arrival schedule — "
+                        "'uniform' paces at a fixed i/rate cadence, "
+                        "'poisson' draws seeded exponential gaps of "
+                        "mean 1/rate (the bursty process capacity "
+                        "sweeps use; payloads are identical either "
+                        "way).")
     p.add_argument("--request-windows", type=int, default=4,
                    help="With --loadgen: max windows per synthetic "
                         "request (sizes draw uniformly from 1..N).")
@@ -1860,7 +1918,38 @@ def register(sub, add_config_arg, load_config_fn) -> None:
     ps.add_argument("--json", action="store_true",
                     help="Emit the summary machine-readable (the same "
                          "fields as the rendered tables).")
+    ps.add_argument("--all-runs", action="store_true",
+                    help="Render every run of an appended multi-run log "
+                         "(default: only the latest renders, which "
+                         "hides replica restarts); with --json the "
+                         "payload becomes {run_count, runs: [...]}.")
     ps.set_defaults(fn=cmd_telemetry_summarize)
+
+    pf = tsub.add_parser(
+        "fleet",
+        help="Cross-replica SLO rollup: merge N serve run dirs' "
+             "digest-carrying serve_slo events into fleet p50/p95/p99 "
+             "+ throughput, flag the outlier replica, roll up "
+             "serve_drift per tenant; exits 1 on an outlier or "
+             "drifted tenant.")
+    pf.add_argument("run_dirs", nargs="+", metavar="run_dir",
+                    help="Serve replica run directories (each the "
+                         "--run-dir of one `apnea-uq serve` process; "
+                         "latest run of an appended log).")
+    pf.add_argument("--spread-threshold", type=float, default=2.0,
+                    help="Flag a replica as the fleet outlier when its "
+                         "p99 is at least this many times the "
+                         "replica-median p99 (default 2.0).")
+    pf.add_argument("--out", default=None, metavar="DIR",
+                    help="Persist the rollup into DIR as a fleet_rollup "
+                         "event + registry artifact — a run-dir source "
+                         "`telemetry compare` gates (fleet.p99_ms, "
+                         "fleet.windows_per_s, fleet.imbalance_ratio) "
+                         "and `telemetry trend` ingests.")
+    from apnea_uq_tpu.lint.report import add_format_args as _fleet_fmt
+
+    _fleet_fmt(pf)
+    pf.set_defaults(fn=cmd_telemetry_fleet)
 
     pc = tsub.add_parser(
         "compare",

@@ -37,6 +37,7 @@ CHECKPOINT = "checkpoint"                      # L3 -> L5: model checkpoints (di
 SWEEP = "sweep"                                # L7 side: T/N convergence table
 QUALITY_BASELINE = "quality_baseline"          # L2 -> L5: frozen per-channel data fingerprint (drift scoring)
 AUTOTUNE_CONFIG = "autotune_config"            # L5 side: measured kernel tile-geometry winners (ops/autotune.py)
+FLEET_ROLLUP = "fleet_rollup"                  # serve side: cross-replica SLO rollup (telemetry/fleet.py)
 
 #: Every canonical artifact key, in pipeline order.  The flow gate
 #: (`apnea-uq flow`, apnea_uq_tpu/flow/) keys its producer->consumer
@@ -46,6 +47,7 @@ CANONICAL_KEYS = (
     WINDOWS, TRAIN_STD_SMOTE, TEST_STD_UNBALANCED, TEST_STD_RUS,
     QUALITY_BASELINE, RAW_PREDICTIONS, UQ_STATS, DETAILED_WINDOWS,
     METRICS, PATIENT_SUMMARY, CHECKPOINT, SWEEP, AUTOTUNE_CONFIG,
+    FLEET_ROLLUP,
 )
 
 

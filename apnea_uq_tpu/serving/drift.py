@@ -183,8 +183,11 @@ class DriftMonitor:
             "final": bool(final),
         }
         if self.run_log is not None:
+            from apnea_uq_tpu.telemetry.runlog import replica_id
+
             self.run_log.event(
                 "serve_drift",
+                replica_id=replica_id(),
                 tenant=doc["tenant"], verdict=doc["verdict"],
                 windows=doc["windows"], max_psi=doc["max_psi"],
                 max_ks=doc["max_ks"],

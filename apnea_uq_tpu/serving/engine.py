@@ -189,8 +189,11 @@ class ServingEngine:
             "device_s": record.device_s,
         }
         if self.run_log is not None:
+            from apnea_uq_tpu.telemetry.runlog import replica_id
+
             self.run_log.event(
                 "serve_batch",
+                replica_id=replica_id(),
                 label=label,
                 bucket=bucket,
                 rows=n,
@@ -259,6 +262,7 @@ def serve_requests(
     import threading
 
     from apnea_uq_tpu.serving.drift import DEFAULT_TENANT
+    from apnea_uq_tpu.telemetry.runlog import replica_id as _replica_id
 
     run_log = engine.run_log
     slo = slo or SLOTracker(clock)
@@ -299,6 +303,7 @@ def serve_requests(
                 if run_log is not None:
                     run_log.event(
                         "serve_request",
+                        replica_id=_replica_id(),
                         request_id=req.request_id,
                         windows=req.rows,
                         batches=req.batches,
@@ -310,6 +315,7 @@ def serve_requests(
                     service_s = done_t - req.first_dispatch_t
                     run_log.event(
                         "serve_trace",
+                        replica_id=_replica_id(),
                         span_id=req.span_id,
                         request_id=req.request_id,
                         windows=req.rows,

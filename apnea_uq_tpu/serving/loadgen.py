@@ -28,6 +28,9 @@ DRIFT_SCALE = 2.0
 DRIFT_SHIFT = 1.5
 
 
+ARRIVAL_MODES = ("uniform", "poisson")
+
+
 def synthetic_requests(
     n_requests: int,
     *,
@@ -36,15 +39,23 @@ def synthetic_requests(
     channels: int = 4,
     seed: int = 0,
     rate: float = 0.0,
+    arrival: str = "uniform",
     drift_after: Optional[int] = None,
     clock=time.perf_counter,
     sleep=time.sleep,
 ) -> Iterator[ServeRequest]:
     """Yield ``n_requests`` seeded synthetic requests of 1..max_windows
     standardized-shaped windows each.  With ``rate > 0``, request ``i``
-    is released no earlier than ``i / rate`` seconds after the first —
+    is released no earlier than its scheduled offset after the first —
     an open-loop arrival process, so a slow scorer accumulates queue
-    wait instead of silently back-pressuring the generator.
+    wait instead of silently back-pressuring the generator.  ``arrival``
+    picks the schedule: ``uniform`` (default) releases at the fixed
+    cadence ``i / rate``; ``poisson`` draws seeded exponential
+    inter-arrival gaps of mean ``1 / rate`` (a memoryless Poisson
+    process — the burstiness a capacity sweep needs to find the real
+    knee, since evenly-spaced arrivals flatter the coalescer).  The gap
+    stream uses its own rng, so the window payloads are bit-identical
+    across arrival modes for a given ``seed``.
 
     ``drift_after=N`` applies a per-channel mean/scale shift
     (``x * DRIFT_SCALE + DRIFT_SHIFT``) to every window from request N
@@ -57,11 +68,23 @@ def synthetic_requests(
         raise ValueError(f"max_windows must be >= 1, got {max_windows}")
     if drift_after is not None and drift_after < 0:
         raise ValueError(f"drift_after must be >= 0, got {drift_after}")
+    if arrival not in ARRIVAL_MODES:
+        raise ValueError(
+            f"arrival must be one of {ARRIVAL_MODES}, got {arrival!r}")
     rng = np.random.default_rng(seed)
+    # Arrival gaps come from a DISTINCT seeded stream: switching uniform
+    # <-> poisson must never perturb the request payloads.
+    gap_rng = np.random.default_rng((seed, 0xA221))
+    offset = 0.0
     t0 = clock()
     for i in range(n_requests):
         if rate > 0:
-            target = t0 + i / rate
+            if arrival == "poisson":
+                if i > 0:
+                    offset += float(gap_rng.exponential(1.0 / rate))
+            else:
+                offset = i / rate
+            target = t0 + offset
             delay = target - clock()
             if delay > 0:
                 sleep(delay)
@@ -114,6 +137,7 @@ def run_loadgen(
     max_windows: int = 4,
     seed: int = 0,
     rate: float = 0.0,
+    arrival: str = "uniform",
     max_wait_s: float = 0.005,
     slo_every: Optional[int] = None,
     drift_after: Optional[int] = None,
@@ -124,13 +148,14 @@ def run_loadgen(
     SLO summary dict (also emitted as the closing ``serve_slo``).
     ``drift_after``/``drift``/``trace_every`` thread the ISSUE 17
     observability knobs through: injected post-N cohort shift, the
-    online drift monitor fed at dispatch, and 1-in-N span tracing."""
+    online drift monitor fed at dispatch, and 1-in-N span tracing;
+    ``arrival`` picks the pacing schedule (see synthetic_requests)."""
     from apnea_uq_tpu.serving.engine import DEFAULT_SLO_EVERY, serve_requests
 
     cfg = engine.model.config
     requests = synthetic_requests(
         n_requests, max_windows=max_windows, time_steps=cfg.time_steps,
-        channels=cfg.num_channels, seed=seed, rate=rate,
+        channels=cfg.num_channels, seed=seed, rate=rate, arrival=arrival,
         drift_after=drift_after,
     )
     return serve_requests(

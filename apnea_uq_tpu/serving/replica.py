@@ -1,0 +1,125 @@
+"""One serve replica as a subprocess.
+
+The worker the fleet-scale paths fork K times: bench.py's capacity
+sweep and the fleet acceptance test launch
+``python -m apnea_uq_tpu.serving.replica --run-dir <dir> ...`` per
+replica, each building a ServingEngine over a fresh-initialized model
+(weight values never matter to a perf harness), AOT-warming the bucket
+ladder, and driving the seeded load generator.  Every replica's
+telemetry lands in its own run dir; ``apnea-uq telemetry fleet`` merges
+them afterwards.
+
+Sharing the warm program store: the parent points every replica at ONE
+store/cache pair via ``APNEA_UQ_PROGRAM_STORE_DIR`` /
+``APNEA_UQ_XLA_CACHE_DIR`` (the compilecache env overrides), so after
+the first replica (or a parent pre-warm) pays the compiles, the rest
+acquire ``source=store`` hits and the fleet's request paths never
+compile — the multi-replica spelling of the warm-serve contract.
+
+``--slow-ms`` injects a fixed per-dispatch sleep in front of
+``score_batch`` — the seeded way to manufacture one degraded replica so
+the fleet rollup's imbalance/outlier gate has something real to catch
+(acceptance-test harness, not a production knob).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Optional, Sequence
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m apnea_uq_tpu.serving.replica",
+        description="One load-generated serve replica (fleet harness "
+                    "worker).",
+    )
+    parser.add_argument("--run-dir", required=True,
+                        help="Telemetry run directory this replica "
+                             "writes (one per replica; merge with "
+                             "`apnea-uq telemetry fleet`).")
+    parser.add_argument("--requests", type=int, default=64,
+                        help="Synthetic requests to serve.")
+    parser.add_argument("--rate", type=float, default=0.0,
+                        help="Per-replica offered arrival rate in "
+                             "requests/sec (0 = as fast as possible).")
+    parser.add_argument("--arrival", choices=("uniform", "poisson"),
+                        default="poisson",
+                        help="Arrival schedule (loadgen semantics; "
+                             "capacity sweeps default to the bursty "
+                             "poisson process).")
+    parser.add_argument("--max-windows", type=int, default=4,
+                        help="Max windows per synthetic request.")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="Loadgen payload/arrival seed (give each "
+                             "replica its own so the fleet's traffic "
+                             "isn't K copies of one stream).")
+    parser.add_argument("--passes", type=int, default=4,
+                        help="MC-dropout passes per window.")
+    parser.add_argument("--slo-every", type=int, default=0,
+                        help="Emit a serve_slo snapshot every N "
+                             "requests (0 = engine default).")
+    parser.add_argument("--slow-ms", type=float, default=0.0,
+                        help="Inject an N-ms sleep per dispatched "
+                             "batch — the degraded-replica fixture for "
+                             "outlier-detection tests.")
+    return parser
+
+
+def run_replica(argv: Optional[Sequence[str]] = None) -> dict:
+    """Serve the configured synthetic stream; returns the final SLO
+    summary dict (also emitted as the closing ``serve_slo`` in the
+    replica's run dir)."""
+    args = build_parser().parse_args(argv)
+
+    from apnea_uq_tpu import compilecache
+    from apnea_uq_tpu.config import ModelConfig, UQConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+    from apnea_uq_tpu.serving.engine import ServingEngine
+    from apnea_uq_tpu.serving.loadgen import run_loadgen
+    from apnea_uq_tpu.telemetry.runlog import start_run
+
+    import jax
+
+    cfg = ModelConfig()
+    model = AlarconCNN1D(cfg)
+    variables = init_variables(model, jax.random.key(0))
+    with compilecache.activate(None), \
+            start_run(args.run_dir, stage="serve-replica") as run_log:
+        engine = ServingEngine(
+            model, variables, method="mcd",
+            uq=UQConfig(mc_passes=args.passes), run_log=run_log,
+            seed=args.seed,
+        )
+        engine.warm()
+        if args.slow_ms > 0:
+            inner = engine.score_batch
+
+            def slowed(rows, **kwargs):
+                time.sleep(args.slow_ms / 1e3)
+                return inner(rows, **kwargs)
+
+            engine.score_batch = slowed
+        summary = run_loadgen(
+            engine, args.requests, max_windows=args.max_windows,
+            seed=args.seed, rate=args.rate, arrival=args.arrival,
+            slo_every=args.slo_every or None,
+        )
+    return summary
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    summary = run_replica(argv)
+    from apnea_uq_tpu.telemetry import log
+
+    log(f"replica done: {summary.get('requests')} request(s), "
+        f"p99 {summary.get('p99_ms')}ms, "
+        f"{summary.get('windows_per_s')} windows/s "
+        f"-> {os.environ.get('APNEA_UQ_REPLICA_ID', 'auto id')}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -8,7 +8,12 @@ fraction of all dispatched bucket rows.  ``telemetry compare`` gates
 the summary (``serve.p50_ms`` / ``serve.p99_ms`` / ``serve.windows_per_s``
 / ``serve.queue_wait_mean_s`` backend-bound, ``serve.pad_waste`` as a
 backend-independent relative), and ``telemetry trend`` carries it as a
-series.  jax-free (NumPy percentiles over host lists).
+series.  Alongside the bounded raw history the tracker feeds a
+mergeable log-spaced histogram digest (telemetry/digest.py) — overall
+request latency plus per-bucket device time — serialized onto every
+``serve_slo`` event, so ``telemetry fleet`` can reconstruct
+cross-replica percentiles from event streams alone.  jax-free (NumPy
+percentiles over host lists).
 """
 
 from __future__ import annotations
@@ -18,6 +23,8 @@ import time
 from typing import Any, Deque, Dict, Optional
 
 import numpy as np
+
+from apnea_uq_tpu.telemetry.digest import LatencyDigest
 
 # Per-sample history kept for the percentile/mean summaries: a
 # long-lived serve process must stay O(1) in memory, so the counters
@@ -55,6 +62,11 @@ class SLOTracker:
         self.queue_waits_s: Deque[float] = collections.deque(
             maxlen=HISTORY_WINDOW)
         self.device_s = 0.0
+        # The mergeable twin of the bounded history: exact-count
+        # log-spaced digests (session-lifetime, O(bins) memory), the
+        # only latency representation that survives cross-replica
+        # aggregation.
+        self.latency_digest = LatencyDigest(unit="s")
         # Per-bucket breakdown (ISSUE 17 satellite): exact counters plus
         # a bounded per-bucket device-service-time history, so a
         # saturated 256-bucket cannot hide behind a healthy global p95.
@@ -72,16 +84,19 @@ class SLOTracker:
         if per is None:
             per = {"batches": 0, "windows": 0, "pad_rows": 0,
                    "device_ms": collections.deque(
-                       maxlen=BUCKET_HISTORY_WINDOW)}
+                       maxlen=BUCKET_HISTORY_WINDOW),
+                   "digest": LatencyDigest(unit="ms")}
             self._buckets[int(bucket)] = per
         per["batches"] += 1
         per["windows"] += rows
         per["pad_rows"] += pad_rows
         per["device_ms"].append(float(device_s) * 1e3)
+        per["digest"].add(float(device_s) * 1e3)
 
     def record_request(self, *, latency_s: float) -> None:
         self.requests += 1
         self.latencies_s.append(float(latency_s))
+        self.latency_digest.add(float(latency_s))
 
     def summary(self, now: Optional[float] = None) -> Dict[str, Any]:
         now = self._clock() if now is None else now
@@ -113,6 +128,7 @@ class SLOTracker:
                           if self.bucket_rows else 0.0),
             "device_s": round(self.device_s, 6),
             "interval_s": round(interval, 6),
+            "digest": self.latency_digest.to_payload(),
             "buckets": self._bucket_summary(),
         }
 
@@ -139,6 +155,7 @@ class SLOTracker:
                 "p50_ms": p50,
                 "p95_ms": p95,
                 "p99_ms": p99,
+                "digest": per["digest"].to_payload(),
             }
         return out
 
@@ -146,10 +163,13 @@ class SLOTracker:
              patients: Optional[int] = None) -> Dict[str, Any]:
         """Append one ``serve_slo`` event (cumulative snapshot; the
         final one is the session summary the gates read)."""
+        from apnea_uq_tpu.telemetry.runlog import replica_id
+
         summary = self.summary()
         if run_log is not None:
             fields = dict(summary)
             fields["final"] = bool(final)
+            fields["replica_id"] = replica_id()
             if patients is not None:
                 fields["patients"] = int(patients)
             run_log.event("serve_slo", **fields)

@@ -24,7 +24,12 @@ The layer every stage reports through (ISSUE 2 tentpole):
   ledger behind ``apnea-uq telemetry trend``;
 - :mod:`~apnea_uq_tpu.telemetry.quality` — the model-quality stream:
   ``quality_metrics`` emission for the eval drivers and the gate
-  behind ``apnea-uq quality check``.
+  behind ``apnea-uq quality check``;
+- :mod:`~apnea_uq_tpu.telemetry.digest` — the mergeable log-spaced
+  latency histogram every ``serve_slo`` event carries (fleet
+  percentiles from event streams alone);
+- :mod:`~apnea_uq_tpu.telemetry.fleet` — the cross-replica SLO
+  aggregator behind ``apnea-uq telemetry fleet``.
 
 Only the logging shim is imported eagerly (the CLI needs ``log`` before
 anything heavy loads); everything touching jax resolves lazily via PEP
@@ -72,6 +77,11 @@ _LAZY = {
     "trajectory_data": "trend",
     "emit_quality_metrics": "quality",
     "check_run": "quality",
+    "LatencyDigest": "digest",
+    "merge_payloads": "digest",
+    "replica_id": "runlog",
+    "build_rollup": "fleet",
+    "render_fleet": "fleet",
 }
 
 __all__ = ["log", "get_logger"] + sorted(_LAZY)
@@ -82,7 +92,8 @@ __all__ = ["log", "get_logger"] + sorted(_LAZY)
 # resolves to the module — never to a same-named function inside it).
 _SUBMODULES = frozenset({
     "runlog", "steps", "trace", "summarize", "memory", "profiler",
-    "compare", "watch", "trend", "quality", "logging_shim",
+    "compare", "watch", "trend", "quality", "logging_shim", "digest",
+    "fleet",
 })
 
 

@@ -318,6 +318,25 @@ def _metrics_from_context(ctx: Any) -> Dict[str, Metric]:
         put("serve.queue_wait_mean_s", serve.get("queue_wait_mean_s"),
             "seconds", False, bound=True)
         put("serve.pad_waste", serve.get("pad_waste"), "ratio", False)
+    capacity = ok("capacity")
+    if capacity:
+        # Capacity/saturation sweep (bench.py bench_capacity, ISSUE 18):
+        # the knee — the first offered rate where the fleet stops
+        # keeping up (achieved/offered < threshold or p99 over budget)
+        # — and the peak achieved throughput are absolutes of the
+        # backend + replica count -> bound.  The achieved/offered ratio
+        # at the lowest offered rate is a pure keeping-up relative:
+        # every backend must hold ~1.0 at its own easiest cell, so it
+        # gates across the proxy boundary.
+        put("capacity.knee_offered_rps", capacity.get("knee_offered_rps"),
+            "req/sec", True, bound=True)
+        put("capacity.peak_windows_per_s",
+            capacity.get("peak_windows_per_s"), "windows/sec", True,
+            bound=True)
+        cells = capacity.get("cells") or []
+        if cells and isinstance(cells[0], dict):
+            put("capacity.base_achieved_ratio",
+                cells[0].get("achieved_ratio"), "ratio", True)
     qual = ok("quality")
     if qual:
         # Model-quality proof block (bench.py bench_quality): fixed-seed
@@ -542,6 +561,28 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
                     name = f"serve.{field}"
                     out[name] = Metric(name, float(e[field]), unit,
                                        higher, backend_bound=bound)
+        elif kind == "fleet_rollup":
+            # Cross-replica SLO rollup (telemetry/fleet.py, ISSUE 18):
+            # digest-merged fleet percentiles and summed throughput are
+            # absolutes of the serving backend -> bound; pad_waste and
+            # the imbalance ratio (max/median replica p99 — a pure
+            # load-balance property) gate across the proxy boundary.
+            # imbalance_ratio needs the explicit direction: its "ratio"
+            # unit would otherwise infer higher-is-better, and no
+            # lower-better name token matches it.
+            for field, unit, higher, bound in (
+                    ("p50_ms", "ms", False, True),
+                    ("p95_ms", "ms", False, True),
+                    ("p99_ms", "ms", False, True),
+                    ("windows_per_s", "windows/sec", True, True),
+                    ("requests_per_s", "req/sec", True, True),
+                    ("queue_wait_mean_s", "seconds", False, True),
+                    ("pad_waste", "ratio", False, False),
+                    ("imbalance_ratio", "ratio", False, False)):
+                if e.get(field) is not None:
+                    name = f"fleet.{field}"
+                    out[name] = Metric(name, float(e[field]), unit,
+                                       higher, backend_bound=bound)
         elif kind == "compile_event":
             compile_n += 1
             compile_hits += 1 if e.get("hit") else 0
@@ -588,8 +629,8 @@ def load_source(
                 f"no comparable metrics in source {path!r}: the run's "
                 f"events carry no bench/eval throughput, d2h, "
                 f"memory-peak, compile-cost, data-load, program-audit, "
-                f"topology, quality, drift, serve-drift, or serve-SLO "
-                f"metrics"
+                f"topology, quality, drift, serve-drift, serve-SLO, or "
+                f"fleet-rollup metrics"
             )
         return metrics, {"kind": "run_dir", "proxy": dir_proxy}
     with open(path) as f:

@@ -1,0 +1,247 @@
+"""Mergeable log-spaced latency histogram digest (the fleet seam).
+
+``SLOTracker``'s percentiles run over a bounded raw-sample history, which
+is the right write-side answer for one process but cannot be combined
+across replicas: percentiles do not add.  This digest is the mergeable
+twin — a fixed ladder of log-spaced bins with EXACT integer counts, so
+
+- merging N replicas' digests is bin-wise integer addition (associative,
+  commutative, lossless: the merged digest equals the digest of the
+  pooled samples), and
+- any percentile of the merged digest is within a documented
+  multiplicative bound of the same percentile over the pooled raw
+  samples.
+
+**The error bound.**  Bin ``i`` covers ``[LO * R**i, LO * R**(i+1))``
+with ``R = 10 ** (1 / BINS_PER_DECADE)``; a bin's representative value
+is its geometric midpoint ``LO * R**(i + 0.5)``, so every sample in
+range is reproduced within a multiplicative factor of ``sqrt(R)``.
+:meth:`LatencyDigest.percentile` applies NumPy's default
+linear-interpolation rank convention to the reconstructed order
+statistics, and linear interpolation between two values each within a
+factor ``f`` of their true counterparts stays within the same factor
+``f`` of the interpolated truth.  Hence for samples inside
+``[LO, HI)``::
+
+    digest.percentile(q) / np.percentile(pool, q)  in  [1/sqrt(R), sqrt(R)]
+
+i.e. relative error at most ``REL_ERROR_BOUND = sqrt(R) - 1`` (~1.8% at
+64 bins/decade).  Samples outside ``[LO, HI)`` clamp into the underflow/
+overflow bins (counted exactly; values saturate at the range edge), so
+the bound is conditional on range — 10 decades from 1 microsecond up
+covers any latency this repo can observe.
+
+Serialization is sparse (only occupied bins), versioned, and
+self-describing (``unit`` rides along), sized for embedding on every
+``serve_slo`` event — a serve run touches a handful of bins, not the
+640-bin ladder.  jax-free by construction, like the rest of the
+telemetry read side.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, Optional, Sequence
+
+DIGEST_VERSION = 1
+
+#: Bins per decade of the log-spaced ladder.  64 gives a per-bin growth
+#: ratio of 10**(1/64) ~ 1.0366 and a percentile error bound of
+#: sqrt(10**(1/64)) - 1 ~ 1.8% — far below any SLO threshold the
+#: compare gate would act on.
+BINS_PER_DECADE = 64
+
+#: Smallest representable value (1 microsecond when values are seconds).
+LO = 1e-6
+
+#: Number of decades covered above :data:`LO` (so the range is
+#: ``[1e-6, 1e4)`` — 1 us to ~2.7 hours for second-valued latencies).
+DECADES = 10
+
+#: Per-bin growth ratio.
+RATIO = 10.0 ** (1.0 / BINS_PER_DECADE)
+
+#: Exclusive upper edge of the in-range ladder.
+HI = LO * 10.0 ** DECADES
+
+#: Total in-range bins (underflow/overflow counted separately).
+NUM_BINS = BINS_PER_DECADE * DECADES
+
+#: The documented multiplicative percentile error bound: a digest
+#: percentile is within a factor of ``1 + REL_ERROR_BOUND`` (above or
+#: below) of the same percentile over the pooled raw samples, for
+#: samples inside ``[LO, HI)``.
+REL_ERROR_BOUND = math.sqrt(RATIO) - 1.0
+
+
+def bin_index(value: float) -> int:
+    """The in-range bin holding ``value``; -1 = underflow, NUM_BINS =
+    overflow.  NaN and non-positive values underflow (a latency of
+    exactly 0.0 has no log-spaced home; it clamps to the range floor
+    like any sub-LO sample); +inf overflows like any super-HI sample."""
+    if value == math.inf:
+        return NUM_BINS
+    if not (value > 0.0) or not math.isfinite(value):
+        return -1
+    if value < LO:
+        return -1
+    if value >= HI:
+        return NUM_BINS
+    i = int(math.floor(math.log10(value / LO) * BINS_PER_DECADE))
+    # log10 rounding can land exactly on an edge from either side; clamp
+    # into range rather than trusting the last float ulp.
+    return min(max(i, 0), NUM_BINS - 1)
+
+
+def bin_value(index: int) -> float:
+    """The representative (geometric-midpoint) value of a bin; the
+    underflow/overflow bins saturate at the range edges."""
+    if index < 0:
+        return LO
+    if index >= NUM_BINS:
+        return HI
+    return LO * RATIO ** (index + 0.5)
+
+
+class LatencyDigest:
+    """Sparse fixed-ladder histogram with exact counts.
+
+    ``unit`` is carried for self-description only (the serve path stores
+    request latencies in seconds and per-bucket device times in
+    milliseconds); merging digests with different units is refused —
+    silently pooling seconds into milliseconds would be a 1000x lie.
+    """
+
+    __slots__ = ("unit", "counts", "underflow", "overflow")
+
+    def __init__(self, unit: str = "s"):
+        self.unit = str(unit)
+        self.counts: Dict[int, int] = {}
+        self.underflow = 0
+        self.overflow = 0
+
+    # -- write side -------------------------------------------------------
+
+    def add(self, value: float) -> None:
+        i = bin_index(float(value))
+        if i < 0:
+            self.underflow += 1
+        elif i >= NUM_BINS:
+            self.overflow += 1
+        else:
+            self.counts[i] = self.counts.get(i, 0) + 1
+
+    def extend(self, values: Iterable[float]) -> None:
+        for v in values:
+            self.add(v)
+
+    def merge(self, other: "LatencyDigest") -> "LatencyDigest":
+        """Fold ``other`` into this digest (bin-wise addition) and
+        return self.  Exact: count conservation holds under any merge
+        order (integer addition is associative and commutative)."""
+        if other.unit != self.unit:
+            raise ValueError(
+                f"cannot merge digests with different units: "
+                f"{self.unit!r} vs {other.unit!r}"
+            )
+        for i, c in other.counts.items():
+            self.counts[i] = self.counts.get(i, 0) + int(c)
+        self.underflow += other.underflow
+        self.overflow += other.overflow
+        return self
+
+    # -- read side --------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return sum(self.counts.values()) + self.underflow + self.overflow
+
+    def percentile(self, q: float) -> Optional[float]:
+        """The ``q``-th percentile (0..100) under NumPy's default
+        linear-interpolation rank convention, reconstructed from bin
+        representatives; None when the digest is empty.  Within
+        :data:`REL_ERROR_BOUND` (multiplicative) of ``np.percentile``
+        over the pooled raw samples, for in-range samples (module
+        docstring has the derivation)."""
+        n = self.count
+        if n == 0:
+            return None
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile must be in [0, 100], got {q}")
+        h = (n - 1) * (q / 100.0)
+        lo_rank = int(math.floor(h))
+        hi_rank = min(lo_rank + 1, n - 1)
+        frac = h - lo_rank
+        lo_v = self._order_stat(lo_rank)
+        if frac == 0.0 or hi_rank == lo_rank:
+            return lo_v
+        return lo_v + frac * (self._order_stat(hi_rank) - lo_v)
+
+    def percentiles(self, qs: Sequence[float]):
+        return [self.percentile(q) for q in qs]
+
+    def _order_stat(self, rank: int) -> float:
+        """Representative value of the 0-based ``rank``-th smallest
+        sample (underflow sorts first, overflow last)."""
+        if rank < self.underflow:
+            return bin_value(-1)
+        seen = self.underflow
+        for i in sorted(self.counts):
+            seen += self.counts[i]
+            if rank < seen:
+                return bin_value(i)
+        return bin_value(NUM_BINS)
+
+    # -- serialization ----------------------------------------------------
+
+    def to_payload(self) -> Dict[str, Any]:
+        """Sparse JSON-safe form (JSON object keys are strings)."""
+        payload: Dict[str, Any] = {
+            "v": DIGEST_VERSION,
+            "unit": self.unit,
+            "n": self.count,
+            "bins": {str(i): int(c) for i, c in sorted(self.counts.items())},
+        }
+        if self.underflow:
+            payload["underflow"] = int(self.underflow)
+        if self.overflow:
+            payload["overflow"] = int(self.overflow)
+        return payload
+
+    @classmethod
+    def from_payload(cls, payload: Dict[str, Any]) -> "LatencyDigest":
+        version = payload.get("v")
+        if version != DIGEST_VERSION:
+            raise ValueError(
+                f"unsupported digest version {version!r} "
+                f"(this reader speaks v{DIGEST_VERSION})"
+            )
+        digest = cls(unit=str(payload.get("unit", "s")))
+        for key, c in (payload.get("bins") or {}).items():
+            i = int(key)
+            if not 0 <= i < NUM_BINS:
+                raise ValueError(f"digest bin index {i} out of range")
+            if int(c) < 0:
+                raise ValueError(f"digest bin {i} has negative count {c}")
+            if int(c):
+                digest.counts[i] = int(c)
+        digest.underflow = int(payload.get("underflow", 0))
+        digest.overflow = int(payload.get("overflow", 0))
+        return digest
+
+
+def merge_payloads(payloads: Iterable[Dict[str, Any]],
+                   unit: Optional[str] = None) -> LatencyDigest:
+    """Merge serialized digests (e.g. collected off N replicas'
+    ``serve_slo`` events) into one digest.  ``unit`` pins the expected
+    unit; when omitted the first payload's unit wins and the rest must
+    agree (mixed units refuse, same as :meth:`LatencyDigest.merge`)."""
+    merged: Optional[LatencyDigest] = None
+    for payload in payloads:
+        digest = LatencyDigest.from_payload(payload)
+        if merged is None:
+            merged = LatencyDigest(unit=unit if unit is not None
+                                   else digest.unit)
+        merged.merge(digest)
+    return merged if merged is not None else LatencyDigest(
+        unit=unit if unit is not None else "s")

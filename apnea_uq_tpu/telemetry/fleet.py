@@ -1,0 +1,522 @@
+"""Fleet SLO rollups: replica-aware aggregation over N serve run dirs.
+
+The read side of ISSUE 18's fleet arc, behind ``apnea-uq telemetry
+fleet <run-dir>...``.  Each serving replica writes its own run
+directory; the final ``serve_slo`` event of each carries the mergeable
+latency digest (telemetry/digest.py) overall and per bucket, so this
+module can reconstruct CROSS-REPLICA percentiles from event streams
+alone — exact counts, error bounded by the digest bin width — where
+averaging per-replica percentiles would be statistically meaningless.
+
+Beyond the merged summary the rollup answers the two fleet questions
+the per-process events cannot: *which replica is the outlier* (the
+per-replica attribution table, flagged when a replica's p99 exceeds
+``spread_threshold`` times the replica median) and *is any tenant
+drifting anywhere* (``serve_drift`` verdicts rolled up per tenant
+across replicas, worst verdict wins).
+
+The rollup is emitted as a ``fleet_rollup`` event (plus the
+``fleet_rollup`` registry artifact) into a fresh rollup directory, so
+``telemetry compare`` gates ``fleet.p99_ms`` / ``fleet.windows_per_s``
+/ ``fleet.imbalance_ratio`` between two rollups and ``telemetry
+trend`` carries them as series — through the exact run-dir seam every
+other gateable kind uses.  jax-free like the rest of the read side;
+torn tails and appended multi-run logs are tolerated via the
+``read_events``/``latest_run`` seam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from apnea_uq_tpu.telemetry.digest import REL_ERROR_BOUND, LatencyDigest
+from apnea_uq_tpu.telemetry.runlog import (
+    append_events,
+    latest_run,
+    read_events,
+)
+
+#: A replica whose p99 latency is at least this many times the
+#: replica-median p99 is flagged as the fleet outlier.
+DEFAULT_SPREAD_THRESHOLD = 2.0
+
+#: Worst-verdict-wins ordering for the per-tenant drift rollup.
+_VERDICT_RANK = {"ok": 0, "warn": 1, "drift": 2}
+
+
+class NoFleetTelemetry(ValueError):
+    """A source carries nothing the fleet rollup can aggregate — a
+    usage error (CLI exit 2), never a clean rollup over zero replicas."""
+
+
+@dataclasses.dataclass
+class ReplicaStats:
+    """One replica's contribution, read from its run dir's final
+    ``serve_slo`` (latest run of an appended log)."""
+
+    run_dir: str
+    replica_id: str
+    earlier_runs: int
+    requests: int
+    windows: int
+    batches: int
+    p50_ms: Optional[float]
+    p95_ms: Optional[float]
+    p99_ms: Optional[float]
+    windows_per_s: float
+    requests_per_s: Optional[float]
+    queue_wait_mean_s: float
+    pad_waste: float
+    interval_s: Optional[float]
+    digest: LatencyDigest
+    digest_source: str          # 'serve_slo' | 'serve_request' | 'none'
+    buckets: Dict[str, Dict[str, Any]]
+    drift: Dict[str, Dict[str, Any]]
+    outlier: bool = False
+
+
+@dataclasses.dataclass
+class FleetRollup:
+    """The merged fleet view plus per-replica attribution."""
+
+    replicas: List[ReplicaStats]
+    spread_threshold: float
+    digest: LatencyDigest
+    requests: int
+    windows: int
+    batches: int
+    p50_ms: Optional[float]
+    p95_ms: Optional[float]
+    p99_ms: Optional[float]
+    windows_per_s: float
+    requests_per_s: Optional[float]
+    queue_wait_mean_s: float
+    pad_waste: float
+    imbalance_ratio: Optional[float]
+    outliers: List[str]
+    buckets: Dict[str, Dict[str, Any]]
+    drift: Dict[str, Dict[str, Any]]
+
+
+def _digest_ms(digest: LatencyDigest, q: float) -> Optional[float]:
+    """A digest percentile in milliseconds regardless of the digest's
+    native unit (request latencies store seconds, bucket device times
+    store ms)."""
+    value = digest.percentile(q)
+    if value is None:
+        return None
+    return round(value * 1e3 if digest.unit == "s" else value, 3)
+
+
+def replica_stats(run_dir: str) -> ReplicaStats:
+    """Read one replica's final SLO snapshot.  Raises
+    :class:`NoFleetTelemetry` when the dir has no events or no
+    ``serve_slo`` — a rollup silently skipping a replica would
+    under-report fleet load exactly when a replica is sick."""
+    events = read_events(run_dir)
+    if not events:
+        raise NoFleetTelemetry(
+            f"no events.jsonl events under {run_dir!r} — not a telemetry "
+            f"run directory"
+        )
+    events, earlier = latest_run(events)
+    slo: Optional[Dict[str, Any]] = None
+    for e in events:
+        if e.get("kind") == "serve_slo":
+            slo = e  # append-order overwrite: the LAST snapshot wins
+    if slo is None:
+        raise NoFleetTelemetry(
+            f"{run_dir!r} carries no serve_slo events — not a serve "
+            f"replica run (its latest run has nothing to aggregate)"
+        )
+    digest_source = "serve_slo"
+    payload = slo.get("digest")
+    if isinstance(payload, dict):
+        digest = LatencyDigest.from_payload(payload)
+    else:
+        # Pre-digest serve runs: reconstruct from per-request events so
+        # old replica logs still merge (same values, same bound).
+        digest = LatencyDigest(unit="s")
+        digest_source = "serve_request"
+        for e in events:
+            if (e.get("kind") == "serve_request"
+                    and e.get("latency_s") is not None):
+                digest.add(float(e["latency_s"]))
+        if digest.count == 0:
+            digest_source = "none"
+    drift: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("kind") != "serve_drift":
+            continue
+        tenant = str(e.get("tenant", "default"))
+        drift[tenant] = {  # last verdict per tenant wins, like quality
+            "verdict": str(e.get("verdict", "ok")),
+            "windows": e.get("windows"),
+            "max_psi": e.get("max_psi"),
+            "max_ks": e.get("max_ks"),
+        }
+    interval = slo.get("interval_s")
+    requests = int(slo.get("requests", 0))
+    return ReplicaStats(
+        run_dir=run_dir,
+        replica_id=str(slo.get("replica_id")
+                       or os.path.basename(os.path.normpath(run_dir))),
+        earlier_runs=earlier,
+        requests=requests,
+        windows=int(slo.get("windows", 0)),
+        batches=int(slo.get("batches", 0)),
+        p50_ms=slo.get("p50_ms"),
+        p95_ms=slo.get("p95_ms"),
+        p99_ms=slo.get("p99_ms"),
+        windows_per_s=float(slo.get("windows_per_s", 0.0)),
+        requests_per_s=(round(requests / float(interval), 3)
+                        if interval else None),
+        queue_wait_mean_s=float(slo.get("queue_wait_mean_s", 0.0)),
+        pad_waste=float(slo.get("pad_waste", 0.0)),
+        interval_s=interval,
+        digest=digest,
+        digest_source=digest_source,
+        buckets=dict(slo.get("buckets") or {}),
+        drift=drift,
+    )
+
+
+def _merge_buckets(replicas: Sequence[ReplicaStats]) -> Dict[str, Dict[str, Any]]:
+    merged: Dict[str, Dict[str, Any]] = {}
+    digests: Dict[str, LatencyDigest] = {}
+    for rep in replicas:
+        for key, per in rep.buckets.items():
+            row = merged.setdefault(
+                key, {"batches": 0, "windows": 0, "pad_rows": 0})
+            row["batches"] += int(per.get("batches", 0))
+            row["windows"] += int(per.get("windows", 0))
+            row["pad_rows"] += int(per.get("pad_rows", 0))
+            payload = per.get("digest")
+            if isinstance(payload, dict):
+                digest = LatencyDigest.from_payload(payload)
+                if key in digests:
+                    digests[key].merge(digest)
+                else:
+                    digests[key] = digest
+    for key, row in merged.items():
+        dispatched = row["batches"] * int(key)
+        row["pad_waste"] = (round(row["pad_rows"] / dispatched, 4)
+                            if dispatched else 0.0)
+        digest = digests.get(key)
+        if digest is not None:
+            row["p50_ms"] = _digest_ms(digest, 50.0)
+            row["p95_ms"] = _digest_ms(digest, 95.0)
+            row["p99_ms"] = _digest_ms(digest, 99.0)
+            row["digest"] = digest.to_payload()
+        else:
+            row["p50_ms"] = row["p95_ms"] = row["p99_ms"] = None
+    return {key: merged[key] for key in sorted(merged, key=int)}
+
+
+def _rollup_drift(replicas: Sequence[ReplicaStats]) -> Dict[str, Dict[str, Any]]:
+    """Per-tenant worst-verdict-wins across replicas, with per-replica
+    attribution so 'drift' points at the replica that saw it."""
+    tenants: Dict[str, Dict[str, Any]] = {}
+    for rep in replicas:
+        for tenant, doc in rep.drift.items():
+            row = tenants.setdefault(tenant, {
+                "verdict": "ok", "replicas": {},
+                "max_psi": None, "max_ks": None,
+            })
+            verdict = doc.get("verdict", "ok")
+            row["replicas"][rep.replica_id] = verdict
+            if (_VERDICT_RANK.get(verdict, 0)
+                    > _VERDICT_RANK.get(row["verdict"], 0)):
+                row["verdict"] = verdict
+            for field in ("max_psi", "max_ks"):
+                value = doc.get(field)
+                if value is not None and (row[field] is None
+                                          or value > row[field]):
+                    row[field] = round(float(value), 6)
+    return {tenant: tenants[tenant] for tenant in sorted(tenants)}
+
+
+def build_rollup(
+    run_dirs: Sequence[str],
+    *,
+    spread_threshold: float = DEFAULT_SPREAD_THRESHOLD,
+) -> FleetRollup:
+    """Merge N replica run dirs into one fleet rollup.  Percentiles come
+    from the bin-wise-added digests (within the digest error bound of
+    the pooled raw samples — telemetry/digest.py documents it); counters
+    are exact sums; throughput adds across replicas."""
+    if not run_dirs:
+        raise NoFleetTelemetry("no run directories given")
+    if spread_threshold <= 1.0:
+        raise ValueError(
+            f"spread threshold must be > 1.0 (a multiple of the median "
+            f"replica p99), got {spread_threshold}"
+        )
+    replicas = [replica_stats(d) for d in run_dirs]
+    fleet_digest = LatencyDigest(unit="s")
+    for rep in replicas:
+        fleet_digest.merge(rep.digest)
+    # Batch-weighted queue wait: each replica's mean covers its own
+    # dispatched batches, so batches are the right weights.
+    total_batches = sum(r.batches for r in replicas)
+    queue_wait = (
+        sum(r.queue_wait_mean_s * r.batches for r in replicas)
+        / total_batches if total_batches else 0.0)
+    buckets = _merge_buckets(replicas)
+    # Pad waste, exactly, from the merged bucket tables (pad_rows and
+    # batches*bucket are both exact counters); replicas without bucket
+    # tables fall back to a window-weighted mean of their ratios.
+    dispatched = sum(row["batches"] * int(key)
+                     for key, row in buckets.items())
+    if dispatched:
+        pad_waste = round(
+            sum(row["pad_rows"] for row in buckets.values()) / dispatched, 4)
+    else:
+        total_windows = sum(r.windows for r in replicas)
+        pad_waste = (round(
+            sum(r.pad_waste * r.windows for r in replicas) / total_windows, 4)
+            if total_windows else 0.0)
+    p99s = [r.p99_ms for r in replicas if r.p99_ms is not None]
+    imbalance: Optional[float] = None
+    outliers: List[str] = []
+    if p99s:
+        median = float(np.median(np.asarray(p99s, np.float64)))
+        if median > 0.0:
+            imbalance = round(max(p99s) / median, 3)
+            if len(replicas) > 1:
+                for rep in replicas:
+                    if (rep.p99_ms is not None
+                            and rep.p99_ms >= spread_threshold * median):
+                        rep.outlier = True
+                        outliers.append(rep.replica_id)
+    rps = [r.requests_per_s for r in replicas if r.requests_per_s is not None]
+    return FleetRollup(
+        replicas=replicas,
+        spread_threshold=float(spread_threshold),
+        digest=fleet_digest,
+        requests=sum(r.requests for r in replicas),
+        windows=sum(r.windows for r in replicas),
+        batches=total_batches,
+        p50_ms=_digest_ms(fleet_digest, 50.0),
+        p95_ms=_digest_ms(fleet_digest, 95.0),
+        p99_ms=_digest_ms(fleet_digest, 99.0),
+        windows_per_s=round(sum(r.windows_per_s for r in replicas), 3),
+        requests_per_s=round(sum(rps), 3) if rps else None,
+        queue_wait_mean_s=round(queue_wait, 6),
+        pad_waste=pad_waste,
+        imbalance_ratio=imbalance,
+        outliers=outliers,
+        buckets=buckets,
+        drift=_rollup_drift(replicas),
+    )
+
+
+# ------------------------------------------------------------- read out --
+
+def replica_data(rep: ReplicaStats) -> Dict[str, Any]:
+    return {
+        "run_dir": rep.run_dir,
+        "replica_id": rep.replica_id,
+        "earlier_runs": rep.earlier_runs,
+        "requests": rep.requests,
+        "windows": rep.windows,
+        "batches": rep.batches,
+        "p50_ms": rep.p50_ms,
+        "p95_ms": rep.p95_ms,
+        "p99_ms": rep.p99_ms,
+        "windows_per_s": rep.windows_per_s,
+        "requests_per_s": rep.requests_per_s,
+        "queue_wait_mean_s": rep.queue_wait_mean_s,
+        "pad_waste": rep.pad_waste,
+        "interval_s": rep.interval_s,
+        "digest_source": rep.digest_source,
+        "digest_count": rep.digest.count,
+        "outlier": rep.outlier,
+        "drift": rep.drift,
+    }
+
+
+def rollup_data(rollup: FleetRollup) -> Dict[str, Any]:
+    """The rollup as one JSON-able document — the ``fleet_rollup``
+    registry artifact body and the ``--json`` extra payload."""
+    return {
+        "replicas": [replica_data(r) for r in rollup.replicas],
+        "spread_threshold": rollup.spread_threshold,
+        "requests": rollup.requests,
+        "windows": rollup.windows,
+        "batches": rollup.batches,
+        "p50_ms": rollup.p50_ms,
+        "p95_ms": rollup.p95_ms,
+        "p99_ms": rollup.p99_ms,
+        "windows_per_s": rollup.windows_per_s,
+        "requests_per_s": rollup.requests_per_s,
+        "queue_wait_mean_s": rollup.queue_wait_mean_s,
+        "pad_waste": rollup.pad_waste,
+        "imbalance_ratio": rollup.imbalance_ratio,
+        "outliers": list(rollup.outliers),
+        "digest": rollup.digest.to_payload(),
+        "buckets": rollup.buckets,
+        "drift": rollup.drift,
+    }
+
+
+def _ms(value: Optional[float]) -> str:
+    return "-" if value is None else f"{value}ms"
+
+
+def render_fleet(rollup: FleetRollup) -> str:
+    """The human view: fleet summary, per-replica attribution table,
+    merged bucket table, per-tenant drift rollup."""
+    lines: List[str] = []
+    lines.append(
+        f"fleet: {len(rollup.replicas)} replica(s), {rollup.requests} "
+        f"request(s) / {rollup.windows} window(s) in {rollup.batches} "
+        f"batch(es)")
+    lines.append(
+        f"  p50 {_ms(rollup.p50_ms)}  p95 {_ms(rollup.p95_ms)}  "
+        f"p99 {_ms(rollup.p99_ms)}  (digest-merged, error <= "
+        f"{100 * REL_ERROR_BOUND:.1f}%)")
+    lines.append(
+        f"  {rollup.windows_per_s} windows/s"
+        + (f", {rollup.requests_per_s} req/s" if rollup.requests_per_s
+           is not None else "")
+        + f", queue wait {rollup.queue_wait_mean_s}s, pad waste "
+        + f"{rollup.pad_waste}")
+    if rollup.imbalance_ratio is not None:
+        flagged = (", ".join(rollup.outliers) if rollup.outliers
+                   else "no outliers")
+        lines.append(
+            f"  imbalance ratio {rollup.imbalance_ratio} "
+            f"(max/median replica p99; outlier at >= "
+            f"{rollup.spread_threshold}x): {flagged}")
+    lines.append("")
+    header = (f"  {'replica':<24} {'requests':>8} {'win/s':>9} "
+              f"{'p50_ms':>8} {'p99_ms':>8} {'wait_s':>8} "
+              f"{'pad':>6}  flags")
+    lines.append("replicas:")
+    lines.append(header)
+    for rep in rollup.replicas:
+        flags = []
+        if rep.outlier:
+            flags.append("OUTLIER")
+        if rep.digest_source != "serve_slo":
+            flags.append(f"digest:{rep.digest_source}")
+        if rep.earlier_runs:
+            flags.append(f"+{rep.earlier_runs} earlier run(s)")
+        lines.append(
+            f"  {rep.replica_id:<24} {rep.requests:>8} "
+            f"{rep.windows_per_s:>9} "
+            f"{rep.p50_ms if rep.p50_ms is not None else '-':>8} "
+            f"{rep.p99_ms if rep.p99_ms is not None else '-':>8} "
+            f"{rep.queue_wait_mean_s:>8} {rep.pad_waste:>6}  "
+            f"{' '.join(flags) if flags else '-'}")
+    if rollup.buckets:
+        lines.append("")
+        lines.append("buckets (device-time percentiles, digest-merged):")
+        lines.append(f"  {'bucket':>6} {'batches':>8} {'windows':>8} "
+                     f"{'pad':>6} {'p50_ms':>8} {'p99_ms':>8}")
+        for key, row in rollup.buckets.items():
+            lines.append(
+                f"  {key:>6} {row['batches']:>8} {row['windows']:>8} "
+                f"{row['pad_waste']:>6} "
+                f"{row['p50_ms'] if row['p50_ms'] is not None else '-':>8} "
+                f"{row['p99_ms'] if row['p99_ms'] is not None else '-':>8}")
+    if rollup.drift:
+        lines.append("")
+        lines.append("drift rollup (worst verdict wins):")
+        for tenant, row in rollup.drift.items():
+            per = ", ".join(f"{rid}={v}" for rid, v
+                            in sorted(row["replicas"].items()))
+            lines.append(
+                f"  [{tenant}] {row['verdict']} "
+                f"(max_psi {row['max_psi']}, max_ks {row['max_ks']}; "
+                f"{per})")
+    return "\n".join(lines)
+
+
+def fleet_findings(rollup: FleetRollup):
+    """Outlier replicas and drifted tenants as lint-engine findings, so
+    the shared reporters (text / ``--json`` / ``--format gha``) render
+    the fleet gate with the machinery lint/flow/quality use."""
+    from apnea_uq_tpu.lint.engine import Finding
+
+    findings = []
+    for rep in rollup.replicas:
+        if rep.outlier:
+            findings.append(Finding(
+                rule="fleet-outlier-replica", severity="error",
+                path=rep.run_dir, line=0,
+                message=(
+                    f"replica {rep.replica_id!r} p99 {rep.p99_ms}ms is "
+                    f">= {rollup.spread_threshold}x the replica-median "
+                    f"p99 (fleet imbalance ratio "
+                    f"{rollup.imbalance_ratio})"),
+            ))
+    for tenant, row in rollup.drift.items():
+        if row["verdict"] == "drift":
+            drifted = sorted(rid for rid, v in row["replicas"].items()
+                             if v == "drift")
+            findings.append(Finding(
+                rule="fleet-drift", severity="error",
+                path=rollup.replicas[0].run_dir if rollup.replicas else "",
+                line=0,
+                message=(
+                    f"tenant {tenant!r} rolled up to verdict 'drift' "
+                    f"(max_psi {row['max_psi']}, max_ks {row['max_ks']}) "
+                    f"on replica(s): {', '.join(drifted)}"),
+            ))
+    return findings
+
+
+def fleet_result(rollup: FleetRollup):
+    """The findings wrapped as a :class:`LintResult` for
+    ``emit_result`` — ``files_scanned`` counts replicas."""
+    from apnea_uq_tpu.lint.engine import LintResult
+
+    return LintResult(
+        findings=fleet_findings(rollup),
+        files_scanned=len(rollup.replicas),
+        rules_run=("fleet-outlier-replica", "fleet-drift"),
+        scanned_paths=tuple(r.run_dir for r in rollup.replicas),
+    )
+
+
+def record_rollup(rollup: FleetRollup, out_dir: str) -> None:
+    """Persist the rollup into ``out_dir``: the ``fleet_rollup``
+    registry artifact (atomic JSON + manifest row) plus one
+    ``fleet_rollup`` event in ``<out_dir>/events.jsonl`` — making the
+    rollup dir a first-class source for ``telemetry compare`` and
+    ``telemetry trend`` through the same run-dir seam every other
+    gateable kind rides."""
+    from apnea_uq_tpu.data import registry as registry_mod
+
+    data = rollup_data(rollup)
+    registry = registry_mod.ArtifactRegistry(out_dir)
+    # apnea-lint: disable=artifact-never-consumed -- end product: the rollup document is read by compare/trend through the rollup dir's event stream (load_source) and by operators, not by a registry-loading pipeline stage
+    registry.save_json(registry_mod.FLEET_ROLLUP, data)
+    with append_events(out_dir) as run_log:
+        run_log.event(
+            "fleet_rollup",
+            replicas=len(rollup.replicas),
+            sources=[r.run_dir for r in rollup.replicas],
+            requests=rollup.requests,
+            windows=rollup.windows,
+            batches=rollup.batches,
+            p50_ms=rollup.p50_ms,
+            p95_ms=rollup.p95_ms,
+            p99_ms=rollup.p99_ms,
+            windows_per_s=rollup.windows_per_s,
+            requests_per_s=rollup.requests_per_s,
+            queue_wait_mean_s=rollup.queue_wait_mean_s,
+            pad_waste=rollup.pad_waste,
+            imbalance_ratio=rollup.imbalance_ratio,
+            spread_threshold=rollup.spread_threshold,
+            outliers=list(rollup.outliers),
+            digest=rollup.digest.to_payload(),
+            buckets=rollup.buckets,
+            drift=rollup.drift,
+        )

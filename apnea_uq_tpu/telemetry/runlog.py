@@ -36,6 +36,21 @@ def current_run() -> Optional["RunLog"]:
     return _ACTIVE[-1] if _ACTIVE else None
 
 
+def replica_id() -> str:
+    """This process's serving-replica identity, stamped on every serve
+    event so fleet rollups can attribute latency to the process that
+    produced it.  ``APNEA_UQ_REPLICA_ID`` overrides (the capacity
+    harness names its subprocess replicas); default ``<hostname>-<pid>``
+    — unique per process on a host and stable for the process lifetime.
+    Read per call so tests (and forked replicas) see env changes."""
+    explicit = os.environ.get("APNEA_UQ_REPLICA_ID")
+    if explicit:
+        return explicit
+    import socket
+
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
 def config_hash(config: Any) -> str:
     """sha256 of the canonical JSON serialization of a config dataclass —
     two runs share a hash iff they ran the exact same configuration."""

@@ -441,11 +441,11 @@ _QUALITY_GATE_FIELDS = (
     "passed", "checks", "failures", "baseline", "threshold_pct",
     "psi_threshold", "ks_threshold")
 _SERVE_SLO_FIELDS = (
-    "requests", "windows", "batches", "p50_ms", "p95_ms", "p99_ms",
-    "windows_per_s", "queue_wait_mean_s", "pad_waste", "device_s",
-    "interval_s", "final", "patients", "buckets")
+    "replica_id", "requests", "windows", "batches", "p50_ms", "p95_ms",
+    "p99_ms", "windows_per_s", "queue_wait_mean_s", "pad_waste",
+    "device_s", "interval_s", "final", "patients", "buckets")
 _SERVE_DRIFT_FIELDS = (
-    "tenant", "verdict", "windows", "max_psi", "max_ks",
+    "replica_id", "tenant", "verdict", "windows", "max_psi", "max_ks",
     "max_mean_shift", "worst_channel", "warn_psi", "drift_psi",
     "warn_ks", "drift_ks", "final")
 _SERVE_TRACE_FIELDS = (
@@ -649,6 +649,13 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
             f"is this a telemetry run directory?"
         )
     events, earlier_runs = _latest_run(all_events)
+    return _run_data(run_dir, events, earlier_runs, earlier_runs + 1)
+
+
+def _run_data(run_dir: str, events: List[Dict[str, Any]],
+              earlier_runs: int, run_count: int) -> Dict[str, Any]:
+    """One run's summary document (the body of :func:`summarize_data`,
+    reusable per run for ``--all-runs``)."""
     started = next((e for e in events if e.get("kind") == "run_started"), None)
     finished = [e for e in events if e.get("kind") == "run_finished"]
     topo = (started or {}).get("topology", {})
@@ -680,6 +687,7 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
         "events": len(events),
         "status": finished[-1].get("status") if finished else None,
         "earlier_runs": earlier_runs,
+        "run_count": run_count,
         "stages": rows,
         "epochs": {
             "count": len(epochs),
@@ -715,4 +723,56 @@ def summarize_data(run_dir: str) -> Dict[str, Any]:
         "ingest_progress": section("ingest_progress",
                                    _INGEST_PROGRESS_FIELDS),
         "errors": section("error", ("where", "error")),
+    }
+
+
+def split_runs(events: List[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
+    """Split an appended multi-run log at its ``run_started`` boundaries
+    into per-run event lists, oldest first — :func:`latest_run`'s
+    every-run sibling (``--all-runs``).  Events before the first
+    ``run_started`` (an append-only gate verdict on a torn log, say)
+    stay attached to the first run."""
+    starts = [i for i, e in enumerate(events)
+              if e.get("kind") == "run_started"]
+    if len(starts) <= 1:
+        return [events]
+    bounds = [0] + starts[1:] + [len(events)]
+    return [events[bounds[i]:bounds[i + 1]]
+            for i in range(len(bounds) - 1)]
+
+
+def summarize_all_runs_text(run_dir: str) -> str:
+    """Every run of an appended log rendered back to back, oldest first
+    — so a replica restart (a second ``run_started`` in the same dir)
+    is visible instead of silently hiding all but the latest run."""
+    all_events = read_events(run_dir)
+    if not all_events:
+        raise FileNotFoundError(
+            f"no {EVENTS_FILENAME} events under {run_dir!r} — "
+            f"is this a telemetry run directory?"
+        )
+    runs = split_runs(all_events)
+    blocks = []
+    for i, events in enumerate(runs):
+        blocks.append(f"=== run {i + 1} of {len(runs)} ===")
+        blocks.append(summarize_events(run_dir, events))
+    return "\n".join(blocks)
+
+
+def summarize_all_runs_data(run_dir: str) -> Dict[str, Any]:
+    """Machine-readable ``--all-runs --json``: the run count plus one
+    per-run summary document (oldest first; each shaped exactly like
+    :func:`summarize_data`'s single-run payload)."""
+    all_events = read_events(run_dir)
+    if not all_events:
+        raise FileNotFoundError(
+            f"no {EVENTS_FILENAME} events under {run_dir!r} — "
+            f"is this a telemetry run directory?"
+        )
+    runs = split_runs(all_events)
+    return {
+        "run": os.path.basename(os.path.normpath(run_dir)),
+        "run_count": len(runs),
+        "runs": [_run_data(run_dir, events, i, len(runs))
+                 for i, events in enumerate(runs)],
     }

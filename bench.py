@@ -83,6 +83,19 @@ BENCH_SERVE_REQUESTS scales the request count, default 64;
 BENCH_SERVE_DRIFT_AFTER moves the built-in online-drift cohort shift —
 the loadgen traffic shifts scale/offset from that request on and the
 serve_drift verdict must flip, default halfway, -1 disables),
+BENCH_SKIP_CAPACITY=1 to skip the capacity context (the
+fleet-saturation sweep: K serve replica SUBPROCESSES per offered-rate
+cell, Poisson arrivals, one shared warm program store, each cell
+fleet-merged via telemetry/fleet.py into offered-vs-achieved
+throughput and fleet p99 — the knee is the first cell whose
+achieved/offered ratio drops below 0.95 or whose fleet p99 blows the
+budget; absolutes are backend-bound, the lowest cell's
+achieved/offered ratio gates across the proxy boundary;
+BENCH_CAPACITY_RATES sets the offered fleet req/s cells, default
+"4,8,16"; BENCH_CAPACITY_REPLICAS the replica count, default 2;
+BENCH_CAPACITY_REQUESTS the per-replica request count per cell,
+default 24; BENCH_CAPACITY_P99_BUDGET_MS the knee's latency budget,
+default 0 = ratio-only),
 BENCH_DE_CHUNK for its DE chunk size,
 BENCH_WASTE_EPOCHS for the early-stop-waste context's epoch cap (0
 skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
@@ -1389,6 +1402,162 @@ def bench_serve(run_log, n_passes: int) -> dict:
     return summary
 
 
+#: The keeping-up floor of the capacity sweep: a cell whose fleet
+#: completes fewer than this fraction of its offered requests per
+#: second has saturated — the knee.
+CAPACITY_KEEPUP_RATIO = 0.95
+
+
+def capacity_knee(cells, p99_budget_ms: float = 0.0):
+    """First saturated cell of a capacity curve: achieved/offered below
+    :data:`CAPACITY_KEEPUP_RATIO`, or fleet p99 over the budget when one
+    is set.  Returns ``(knee_offered_rps, reason)`` — ``(None, None)``
+    when the fleet kept up across the whole swept range (a finding too:
+    the knee is beyond max(rates))."""
+    for cell in cells:
+        ratio = cell.get("achieved_ratio")
+        p99 = cell.get("p99_ms")
+        if ratio is not None and ratio < CAPACITY_KEEPUP_RATIO:
+            return (cell["offered_rps"],
+                    f"achieved/offered {ratio} < {CAPACITY_KEEPUP_RATIO}")
+        if p99_budget_ms > 0 and p99 is not None and p99 > p99_budget_ms:
+            return (cell["offered_rps"],
+                    f"fleet p99 {p99}ms > {p99_budget_ms}ms budget")
+    return None, None
+
+
+def bench_capacity(run_log, proxy: bool) -> dict:
+    """Capacity/saturation sweep (ISSUE 18): how much offered load the
+    serving tier absorbs before it stops keeping up.  Each offered-rate
+    cell launches BENCH_CAPACITY_REPLICAS serve replica SUBPROCESSES
+    (``python -m apnea_uq_tpu.serving.replica``) splitting the fleet
+    rate evenly, Poisson arrivals, all sharing ONE warm program store
+    (a warm-up replica pre-pays the compiles, so cells measure serving,
+    not compilation).  Each cell's replica run dirs are merged with
+    telemetry/fleet.py into fleet throughput + p99, yielding the
+    saturation curve: offered vs achieved req/s and p99 vs load.  The
+    knee is the first cell whose achieved/offered ratio drops below
+    0.95, or whose fleet p99 exceeds BENCH_CAPACITY_P99_BUDGET_MS when
+    a budget is set.  Backend-aware, not backend-gated: absolutes
+    (knee rate, peak throughput) are backend-bound; the lowest cell's
+    achieved/offered ratio is a pure keeping-up relative and gates
+    across the CPU-proxy boundary."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from apnea_uq_tpu.telemetry import fleet as fleet_mod
+
+    rates = [float(r) for r in os.environ.get(
+        "BENCH_CAPACITY_RATES", "4,8,16").split(",") if r.strip()]
+    if len(rates) < 3:
+        raise ValueError(
+            f"BENCH_CAPACITY_RATES needs >= 3 offered-rate cells for a "
+            f"curve with a knee, got {rates}")
+    n_replicas = int(os.environ.get("BENCH_CAPACITY_REPLICAS", 2))
+    n_requests = int(os.environ.get("BENCH_CAPACITY_REQUESTS", 24))
+    p99_budget = float(os.environ.get("BENCH_CAPACITY_P99_BUDGET_MS", 0))
+
+    root = tempfile.mkdtemp(prefix="bench_capacity_")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__))]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    # One shared store/cache pair for the whole sweep: the warm-up
+    # replica pays the compiles, every later acquisition is a disk hit
+    # (the multi-replica warm-serve contract under test).
+    env["APNEA_UQ_PROGRAM_STORE_DIR"] = os.path.join(root, "program-store")
+    env["APNEA_UQ_XLA_CACHE_DIR"] = os.path.join(root, "xla-cache")
+    # Replica subprocesses don't read BENCH_PLATFORM (that's this
+    # script's in-process override); hand them the same retarget via
+    # JAX_PLATFORMS, which beats sitecustomize's env default.
+    if os.environ.get("BENCH_PLATFORM"):
+        env["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
+    elif proxy:
+        env["JAX_PLATFORMS"] = "cpu"
+
+    def replica_cmd(run_dir, *, requests, rate, seed):
+        return [
+            sys.executable, "-m", "apnea_uq_tpu.serving.replica",
+            "--run-dir", run_dir, "--requests", str(requests),
+            "--rate", str(rate), "--arrival", "poisson",
+            "--passes", "2", "--seed", str(seed),
+        ]
+
+    def check(proc, tail_len=20):
+        out, _ = proc.communicate(timeout=900)
+        if proc.returncode != 0:
+            tail = "\n".join(out.splitlines()[-tail_len:])
+            raise RuntimeError(
+                f"capacity replica exited {proc.returncode}:\n{tail}")
+
+    try:
+        warm_dir = os.path.join(root, "warmup")
+        warm_env = dict(env, APNEA_UQ_REPLICA_ID="cap-warmup")
+        check(subprocess.Popen(
+            replica_cmd(warm_dir, requests=2, rate=0.0, seed=0),
+            env=warm_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+
+        cells = []
+        for cell_i, offered in enumerate(rates):
+            cell_dirs = []
+            procs = []
+            for r in range(n_replicas):
+                run_dir = os.path.join(root, f"cell{cell_i}", f"rep{r}")
+                cell_dirs.append(run_dir)
+                rep_env = dict(env,
+                               APNEA_UQ_REPLICA_ID=f"cap-c{cell_i}-r{r}")
+                procs.append(subprocess.Popen(
+                    replica_cmd(run_dir, requests=n_requests,
+                                rate=offered / n_replicas,
+                                seed=100 * cell_i + r),
+                    env=rep_env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True))
+            for proc in procs:
+                check(proc)
+            rollup = fleet_mod.build_rollup(cell_dirs)
+            achieved = rollup.requests_per_s or 0.0
+            ratio = round(achieved / offered, 4) if offered else None
+            cell = {
+                "offered_rps": offered,
+                "achieved_rps": achieved,
+                "achieved_ratio": ratio,
+                "windows_per_s": rollup.windows_per_s,
+                "p99_ms": rollup.p99_ms,
+                "queue_wait_mean_s": rollup.queue_wait_mean_s,
+                "imbalance_ratio": rollup.imbalance_ratio,
+            }
+            cells.append(cell)
+            run_log.event(
+                "capacity_cell", offered_rps=offered,
+                achieved_rps=achieved, achieved_ratio=ratio,
+                windows_per_s=rollup.windows_per_s,
+                p99_ms=rollup.p99_ms,
+                imbalance_ratio=rollup.imbalance_ratio,
+                replicas=n_replicas,
+            )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    knee_offered, knee_reason = capacity_knee(cells, p99_budget)
+    return {
+        "replicas": n_replicas,
+        "requests_per_replica": n_requests,
+        "arrival": "poisson",
+        "rates": rates,
+        "p99_budget_ms": p99_budget or None,
+        "cells": cells,
+        # No knee inside the swept range is a finding too: the fleet
+        # kept up everywhere, so the knee is beyond max(rates).
+        "knee_offered_rps": knee_offered,
+        "knee_reason": knee_reason,
+        "peak_windows_per_s": max(
+            (c["windows_per_s"] for c in cells
+             if c["windows_per_s"] is not None), default=None),
+    }
+
+
 def _start_watchdog():
     """Fail loudly instead of hanging the driver's whole budget: the
     tunneled TPU backend can stall indefinitely at device init (observed:
@@ -1488,7 +1657,8 @@ def _run_bench(run_log, proxy: bool) -> dict:
         primary = run("de_train", de_primary, device=True)
         for name in ("mcd", "bootstrap", "streamed", "fused", "mcd_kernel",
                      "de_kernel", "autotune", "compile", "program_audit",
-                     "data_plane", "d2h_accounting", "quality", "serve"):
+                     "data_plane", "d2h_accounting", "quality", "serve",
+                     "capacity"):
             run(name, None, skip=True, reason="BENCH_METRIC=de_train")
     else:
         def mcd():
@@ -1601,6 +1771,12 @@ def _run_bench(run_log, proxy: bool) -> dict:
             reason=("BENCH_SKIP_SERVE"
                     if os.environ.get("BENCH_SKIP_SERVE") else None))
         attach("serve", "serve", serve_v)
+        capacity_v = run(
+            "capacity", lambda: bench_capacity(run_log, proxy),
+            skip=bool(os.environ.get("BENCH_SKIP_CAPACITY")),
+            reason=("BENCH_SKIP_CAPACITY"
+                    if os.environ.get("BENCH_SKIP_CAPACITY") else None))
+        attach("capacity", "capacity", capacity_v)
         autotune_v = run(
             "autotune", lambda: bench_autotune(run_log),
             skip=bool(os.environ.get("BENCH_SKIP_AUTOTUNE")),

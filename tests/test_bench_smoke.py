@@ -59,6 +59,12 @@ SMOKE_ENV = {
     # steady-state MCD pass AFTER the timed reps, profiled into the run
     # dir — cheap at smoke shapes, and proves the profiler path off-TPU.
     "BENCH_PROFILE": "1",
+    # Capacity sweep (ISSUE 18): 3 tiny offered-rate cells, 2 replica
+    # subprocesses each, few requests — enough for a real fleet-merged
+    # saturation curve without dominating the smoke wall-clock.
+    "BENCH_CAPACITY_RATES": "6,12,24",
+    "BENCH_CAPACITY_REPLICAS": "2",
+    "BENCH_CAPACITY_REQUESTS": "6",
 }
 
 
@@ -276,6 +282,24 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
     assert serve_ctx["buckets"], serve_ctx
     for per in serve_ctx["buckets"].values():
         assert per["batches"] >= 1 and per["p50_ms"] is not None
+    # Capacity block (ISSUE 18): K replica subprocesses per offered-rate
+    # cell sharing one warm program store, each cell fleet-merged — the
+    # saturation curve is real measurements, knee or no knee.
+    cap_ctx = ctx["capacity"]
+    assert "error" not in cap_ctx, cap_ctx
+    assert cap_ctx["replicas"] == 2
+    assert cap_ctx["arrival"] == "poisson"
+    assert [c["offered_rps"] for c in cap_ctx["cells"]] == [6.0, 12.0,
+                                                            24.0]
+    for cell in cap_ctx["cells"]:
+        assert cell["achieved_rps"] > 0, cap_ctx
+        assert cell["achieved_ratio"] > 0, cap_ctx
+        assert cell["p99_ms"] > 0 and cell["windows_per_s"] > 0
+        assert cell["imbalance_ratio"] >= 1.0
+    assert cap_ctx["peak_windows_per_s"] > 0
+    if cap_ctx["knee_offered_rps"] is not None:
+        assert cap_ctx["knee_offered_rps"] in [6.0, 12.0, 24.0]
+        assert cap_ctx["knee_reason"]
 
     # Result-v2 envelope (ISSUE 11): schema-versioned payload with
     # backend facts and a per-block status map, every block ok on the
@@ -289,7 +313,7 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
         "mcd", "bootstrap", "streamed", "fused", "mcd_kernel", "de_kernel",
         "autotune", "de_train",
         "earlystop_waste", "compile", "program_audit", "data_plane",
-        "d2h_accounting", "quality", "serve"}, blocks
+        "d2h_accounting", "quality", "serve", "capacity"}, blocks
     assert all(b["seconds"] >= 0 for b in blocks.values()), blocks
 
     # The printed line was assembled from the on-disk progress capture:
@@ -325,7 +349,10 @@ def test_bench_cpu_smoke_end_to_end(tmp_path):
             "serve_drift",
             # The autotune sweep (ISSUE 16): per-cell timings and the
             # per-label winner verdicts land in the same run log.
-            "autotune_cell", "autotune_result"} <= kinds, \
+            "autotune_cell", "autotune_result",
+            # The capacity sweep (ISSUE 18): one fleet-merged event per
+            # offered-rate cell.
+            "capacity_cell"} <= kinds, \
         sorted(kinds)
     # Every block's outcome is mirrored into the run log as it happens.
     block_events = {e["name"]: e["status"] for e in events
@@ -856,6 +883,13 @@ def _stub_blocks(bench_mod, monkeypatch, *, fail=(), values=None):
                              "p99_ms": 10.0, "windows_per_s": 2000.0,
                              "queue_wait_mean_s": 0.001,
                              "pad_waste": 0.375})))
+    monkeypatch.setattr(bench_mod, "bench_capacity", make(
+        "capacity", v("capacity", {
+            "replicas": 2, "arrival": "poisson",
+            "cells": [{"offered_rps": 4.0, "achieved_ratio": 1.0,
+                       "p99_ms": 50.0, "windows_per_s": 10.0}],
+            "knee_offered_rps": None, "knee_reason": None,
+            "peak_windows_per_s": 10.0})))
 
 
 class TestMainDispatch:
@@ -881,6 +915,9 @@ class TestMainDispatch:
                   "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
                   "BENCH_SKIP_QUALITY", "BENCH_SKIP_SERVE",
+                  "BENCH_SKIP_CAPACITY", "BENCH_CAPACITY_RATES",
+                  "BENCH_CAPACITY_REPLICAS", "BENCH_CAPACITY_REQUESTS",
+                  "BENCH_CAPACITY_P99_BUDGET_MS",
                   "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
             monkeypatch.delenv(k, raising=False)
         _stub_blocks(bench_mod, monkeypatch)
@@ -901,7 +938,7 @@ class TestMainDispatch:
                       "de_kernel", "autotune", "de_train",
                       "earlystop_waste", "compile",
                       "program_audit", "data_plane", "d2h_accounting",
-                      "quality", "serve"}
+                      "quality", "serve", "capacity"}
         assert out["context"]["bootstrap_b100_m293k"] == {"speedup": 20.0}
         assert out["context"]["serve"]["pad_waste"] == 0.375
         assert out["context"]["de_kernel"]["xla_vs_pallas"] == 1.0
@@ -922,6 +959,17 @@ class TestMainDispatch:
 
         events = telemetry.read_events(str(self.tmp_path / "bench_run"))
         assert not any(e["kind"].startswith("serve_") for e in events)
+
+    def test_skip_capacity_records_clean_skip(self, monkeypatch, capsys):
+        """ISSUE 18: BENCH_SKIP_CAPACITY=1 skips the capacity sweep
+        cleanly — skipped status with its reason, no capacity context,
+        every other block untouched."""
+        monkeypatch.setenv("BENCH_SKIP_CAPACITY", "1")
+        out = self._run(capsys)
+        assert out["blocks"]["capacity"] == {
+            "status": "skipped", "reason": "BENCH_SKIP_CAPACITY"}
+        assert out["context"]["capacity"] is None
+        assert out["blocks"]["serve"]["status"] == "ok"
 
     def test_skip_de_kernel_records_clean_skip(self, monkeypatch, capsys):
         monkeypatch.setenv("BENCH_SKIP_DE_KERNEL", "1")
@@ -989,6 +1037,9 @@ class TestBlockIsolation:
                   "BENCH_SKIP_COMPILE",
                   "BENCH_SKIP_AUDIT", "BENCH_SKIP_DATA",
                   "BENCH_SKIP_QUALITY", "BENCH_SKIP_SERVE",
+                  "BENCH_SKIP_CAPACITY", "BENCH_CAPACITY_RATES",
+                  "BENCH_CAPACITY_REPLICAS", "BENCH_CAPACITY_REQUESTS",
+                  "BENCH_CAPACITY_P99_BUDGET_MS",
                   "BENCH_CPU_PROXY", "BENCH_WASTE_EPOCHS"):
             monkeypatch.delenv(k, raising=False)
         self.bench_mod = bench_mod
@@ -1088,7 +1139,7 @@ class TestBlockIsolation:
                       "mcd_kernel", "de_kernel", "autotune",
                       "earlystop_waste", "compile",
                       "program_audit", "data_plane", "d2h_accounting",
-                      "quality", "serve")
+                      "quality", "serve", "capacity")
         _stub_blocks(self.bench_mod, monkeypatch)
         good = self._run_to_file(capsys, "good.json")
         _stub_blocks(self.bench_mod, monkeypatch, fail=all_blocks)

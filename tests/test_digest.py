@@ -1,0 +1,242 @@
+"""Mergeable latency digest (ISSUE 18 tentpole): the fixed-bin
+log-spaced histogram every ``serve_slo`` event serializes.
+
+Pins the three properties the fleet rollup leans on:
+
+- exact counts — add/extend/merge never lose or invent samples, under
+  ANY merge order/grouping (bin-wise addition is associative and
+  commutative);
+- bounded percentile error — ``percentile(q)`` lands within the
+  documented multiplicative bound (``REL_ERROR_BOUND``, half a bin
+  ratio) of ``np.percentile`` over the pooled raw samples, merged or
+  not, for every in-range sample set;
+- lossless transport — the sparse JSON payload round-trips bit-exactly
+  and refuses malformed/foreign payloads loudly.
+
+All host-side NumPy — no jax anywhere near this module.
+"""
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.telemetry.digest import (
+    BINS_PER_DECADE,
+    HI,
+    LO,
+    NUM_BINS,
+    RATIO,
+    REL_ERROR_BOUND,
+    LatencyDigest,
+    bin_index,
+    bin_value,
+    merge_payloads,
+)
+
+
+# ------------------------------------------------------------- binning --
+
+
+class TestBinning:
+    def test_bins_are_monotone_and_cover_the_range(self):
+        # Every in-range value lands in a valid bin, and bin index is
+        # monotone in the value.
+        values = np.geomspace(LO, HI * 0.999, 5000)
+        idx = [bin_index(float(v)) for v in values]
+        assert all(0 <= i < NUM_BINS for i in idx)
+        assert idx == sorted(idx)
+        assert idx[0] == 0 and idx[-1] == NUM_BINS - 1
+
+    def test_bin_value_is_inside_its_own_bin(self):
+        for i in (0, 1, 63, 64, 320, NUM_BINS - 1):
+            rep = bin_value(i)
+            assert bin_index(rep) == i
+            lo_edge = LO * RATIO**i
+            assert lo_edge <= rep < lo_edge * RATIO
+
+    def test_out_of_range_and_non_finite_values(self):
+        # Underflow: zero, negatives, NaN (unmeasurable) all clamp low.
+        for v in (0.0, -1.0, LO / 2, float("nan"), float("-inf")):
+            assert bin_index(v) == -1
+        # Overflow clamps high.
+        for v in (HI, HI * 10, float("inf")):
+            assert bin_index(v) == NUM_BINS
+        assert bin_value(-1) == LO
+        assert bin_value(NUM_BINS) == HI
+
+    def test_bin_geometry_constants(self):
+        assert NUM_BINS == BINS_PER_DECADE * 10
+        assert RATIO == pytest.approx(10.0 ** (1.0 / BINS_PER_DECADE))
+        # The documented bound IS half a bin in log space.
+        assert REL_ERROR_BOUND == pytest.approx(np.sqrt(RATIO) - 1.0)
+
+
+# ------------------------------------------------- counts and merging --
+
+
+def _seeded_samples(seed, n=500):
+    rng = np.random.default_rng(seed)
+    kind = seed % 4
+    if kind == 0:
+        return rng.lognormal(mean=-3.0, sigma=1.2, size=n)
+    if kind == 1:
+        return rng.uniform(1e-4, 2.0, size=n)
+    if kind == 2:
+        return rng.exponential(scale=0.05, size=n)
+    return np.full(n, float(rng.uniform(1e-3, 1.0)))  # degenerate
+
+
+class TestCounts:
+    def test_add_extend_count_exactly(self):
+        d = LatencyDigest("s")
+        assert d.count == 0
+        d.add(0.5)
+        d.extend([0.1, 0.2, 0.3])
+        d.extend(np.asarray([1e-9, 1e9]))  # under/overflow still count
+        assert d.count == 6
+
+    def test_merge_orders_conserve_exact_counts(self):
+        # The satellite contract: ANY merge grouping/order yields the
+        # same total count and the same bin table.
+        parts = [_seeded_samples(s) for s in range(6)]
+        digests = []
+        for part in parts:
+            d = LatencyDigest("s")
+            d.extend(part)
+            digests.append(d)
+        total = sum(len(p) for p in parts)
+
+        def fold(order):
+            acc = LatencyDigest("s")
+            for i in order:
+                acc.merge(digests[i])
+            return acc
+
+        base = fold(range(6))
+        assert base.count == total
+        rng = np.random.default_rng(7)
+        for _ in range(5):
+            order = rng.permutation(6)
+            other = fold(order)
+            assert other.count == total
+            assert other.counts == base.counts
+            assert other.underflow == base.underflow
+            assert other.overflow == base.overflow
+
+    def test_merge_with_empty_is_identity(self):
+        d = LatencyDigest("s")
+        d.extend(_seeded_samples(1))
+        before = (dict(d.counts), d.underflow, d.overflow)
+        d.merge(LatencyDigest("s"))
+        assert (dict(d.counts), d.underflow, d.overflow) == before
+        empty = LatencyDigest("s")
+        empty.merge(d)
+        assert empty.count == d.count
+        assert empty.percentile(50) == d.percentile(50)
+
+    def test_unit_mismatch_refused(self):
+        d_s, d_ms = LatencyDigest("s"), LatencyDigest("ms")
+        with pytest.raises(ValueError, match="unit"):
+            d_s.merge(d_ms)
+
+
+# -------------------------------------------------- percentile bound --
+
+
+class TestPercentileBound:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_single_digest_within_documented_bound(self, seed):
+        samples = _seeded_samples(seed)
+        d = LatencyDigest("s")
+        d.extend(samples)
+        for q in (0, 1, 10, 25, 50, 75, 90, 95, 99, 99.9, 100):
+            got = d.percentile(q)
+            want = float(np.percentile(samples, q))
+            assert got == pytest.approx(want, rel=REL_ERROR_BOUND), (
+                f"q={q}: digest {got} vs numpy {want}")
+
+    @pytest.mark.parametrize("n_parts", (2, 3, 7))
+    def test_merged_percentiles_match_pooled_raw_samples(self, n_parts):
+        # The fleet contract: merging per-replica digests reproduces
+        # np.percentile over the POOLED raw samples within the bound —
+        # as if one process had seen all the traffic.
+        parts = [_seeded_samples(10 + i, n=300 + 50 * i)
+                 for i in range(n_parts)]
+        acc = LatencyDigest("s")
+        for part in parts:
+            d = LatencyDigest("s")
+            d.extend(part)
+            acc.merge(d)
+        pooled = np.concatenate(parts)
+        assert acc.count == pooled.size
+        for q in (50, 90, 95, 99):
+            got = acc.percentile(q)
+            want = float(np.percentile(pooled, q))
+            assert got == pytest.approx(want, rel=REL_ERROR_BOUND)
+
+    def test_empty_digest_percentile_is_none(self):
+        d = LatencyDigest("s")
+        assert d.percentile(50) is None
+        assert d.percentiles((50, 99)) == [None, None]
+
+    def test_percentile_argument_validation(self):
+        d = LatencyDigest("s")
+        d.add(0.1)
+        for bad in (-0.1, 100.1):
+            with pytest.raises(ValueError, match="percentile"):
+                d.percentile(bad)
+
+    def test_single_sample_every_percentile_is_its_bin(self):
+        d = LatencyDigest("s")
+        d.add(0.25)
+        rep = d.percentile(50)
+        assert rep == d.percentile(0) == d.percentile(100)
+        assert rep == pytest.approx(0.25, rel=REL_ERROR_BOUND)
+
+
+# ------------------------------------------------------------ payload --
+
+
+class TestPayload:
+    def test_round_trip_is_exact(self):
+        d = LatencyDigest("ms")
+        d.extend(_seeded_samples(3) * 1e3)
+        d.add(0.0)    # underflow
+        d.add(1e12)   # overflow
+        back = LatencyDigest.from_payload(d.to_payload())
+        assert back.unit == "ms"
+        assert back.counts == d.counts
+        assert back.underflow == d.underflow == 1
+        assert back.overflow == d.overflow == 1
+        assert back.percentile(95) == d.percentile(95)
+
+    def test_payload_is_sparse(self):
+        d = LatencyDigest("s")
+        d.add(0.5)
+        payload = d.to_payload()
+        assert len(payload["bins"]) == 1
+        assert "underflow" not in payload and "overflow" not in payload
+        assert payload["n"] == 1
+
+    def test_foreign_and_malformed_payloads_refused(self):
+        with pytest.raises(ValueError, match="version"):
+            LatencyDigest.from_payload({"v": 99, "unit": "s", "bins": {}})
+        with pytest.raises(ValueError):
+            LatencyDigest.from_payload(
+                {"v": 1, "unit": "s", "bins": {str(NUM_BINS + 5): 1}})
+        with pytest.raises(ValueError):
+            LatencyDigest.from_payload(
+                {"v": 1, "unit": "s", "bins": {"3": -2}})
+
+    def test_merge_payloads_helper(self):
+        parts = [_seeded_samples(s) for s in (20, 21)]
+        payloads = []
+        for part in parts:
+            d = LatencyDigest("s")
+            d.extend(part)
+            payloads.append(d.to_payload())
+        merged = merge_payloads(payloads)
+        assert merged.unit == "s"
+        assert merged.count == sum(len(p) for p in parts)
+        with pytest.raises(ValueError, match="unit"):
+            merge_payloads(payloads, unit="ms")
+        assert merge_payloads([], unit="s").count == 0

@@ -1,0 +1,103 @@
+"""Hypothesis property sweep for telemetry/digest.py (ISSUE 18
+satellite): across arbitrary in-range sample sets, merge groupings, and
+merge orders, the digest keeps its three contracts — merged percentiles
+within the documented ``REL_ERROR_BOUND`` of ``np.percentile`` over the
+pooled raw samples, bit-exact count conservation under any merge order
+(including empty digests in the mix), and a lossless payload round
+trip.  Complements tests/test_digest.py's seeded cases with
+generator-driven shrinking counterexamples."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from apnea_uq_tpu.telemetry.digest import (  # noqa: E402
+    HI,
+    LO,
+    REL_ERROR_BOUND,
+    LatencyDigest,
+    merge_payloads,
+)
+
+# In-range latency samples: the documented bound is conditional on
+# [LO, HI) (out-of-range samples clamp, by design), so the property
+# sweep generates inside it.  Spanning 9+ decades keeps the generator
+# honest about bin-ladder coverage.
+_sample = st.floats(min_value=LO, max_value=HI * 0.99,
+                    allow_nan=False, allow_infinity=False)
+_samples = st.lists(_sample, min_size=1, max_size=200)
+_sample_groups = st.lists(st.lists(_sample, min_size=0, max_size=80),
+                          min_size=1, max_size=6)
+_quantile = st.floats(min_value=0.0, max_value=100.0, allow_nan=False)
+
+
+@settings(max_examples=200, deadline=None)
+@given(samples=_samples, q=_quantile)
+def test_percentile_within_documented_bound(samples, q):
+    d = LatencyDigest("s")
+    d.extend(samples)
+    got = d.percentile(q)
+    want = float(np.percentile(np.asarray(samples, np.float64), q))
+    assert got == pytest.approx(want, rel=REL_ERROR_BOUND)
+
+
+@settings(max_examples=100, deadline=None)
+@given(groups=_sample_groups, q=_quantile, seed=st.integers(0, 2**16))
+def test_merged_digest_matches_pooled_samples_any_order(groups, q, seed):
+    digests = []
+    for group in groups:
+        d = LatencyDigest("s")
+        d.extend(group)
+        digests.append(d)
+    order = np.random.default_rng(seed).permutation(len(digests))
+    acc = LatencyDigest("s")
+    for i in order:
+        acc.merge(digests[i])
+    pooled = np.concatenate(
+        [np.asarray(g, np.float64) for g in groups]) if any(
+            groups) else np.asarray([])
+    # Exact conservation, regardless of merge order and empty members.
+    assert acc.count == pooled.size
+    if pooled.size == 0:
+        assert acc.percentile(q) is None
+        return
+    want = float(np.percentile(pooled, q))
+    assert acc.percentile(q) == pytest.approx(want, rel=REL_ERROR_BOUND)
+
+
+@settings(max_examples=100, deadline=None)
+@given(groups=_sample_groups)
+def test_merge_is_order_invariant_bitwise(groups):
+    digests = []
+    for group in groups:
+        d = LatencyDigest("s")
+        d.extend(group)
+        digests.append(d)
+
+    def fold(order):
+        acc = LatencyDigest("s")
+        for i in order:
+            acc.merge(digests[i])
+        return acc
+
+    forward = fold(range(len(digests)))
+    backward = fold(reversed(range(len(digests))))
+    assert forward.counts == backward.counts
+    assert forward.underflow == backward.underflow
+    assert forward.overflow == backward.overflow
+
+
+@settings(max_examples=100, deadline=None)
+@given(samples=_samples)
+def test_payload_round_trip_preserves_everything(samples):
+    d = LatencyDigest("ms")
+    d.extend(samples)
+    back = LatencyDigest.from_payload(d.to_payload())
+    assert back.unit == d.unit
+    assert back.counts == d.counts
+    assert back.count == d.count
+    # And transports through the merge helper unchanged.
+    again = merge_payloads([d.to_payload()])
+    assert again.counts == d.counts

@@ -330,11 +330,11 @@ def test_bench_env_knobs_are_documented():
     assert read, "bench.py reads no BENCH_* knobs? scan is broken"
 
     docstring = ast.get_docstring(tree) or ""
-    documented = set(re.findall(r"BENCH_[A-Z_]+", docstring))
+    documented = set(re.findall(r"BENCH_[A-Z0-9_]+", docstring))
     # The docstring compresses families as BENCH_WINDOWS/PASSES/CHUNK —
     # expand slash-joined suffixes after a BENCH_ prefix (the list may
     # wrap across a line break after a slash).
-    for m in re.finditer(r"BENCH_([A-Z_]+(?:/\s*[A-Z_]+)+)", docstring):
+    for m in re.finditer(r"BENCH_([A-Z0-9_]+(?:/\s*[A-Z0-9_]+)+)", docstring):
         for suffix in re.split(r"/\s*", m.group(1)):
             documented.add(f"BENCH_{suffix}")
     undocumented = read - documented

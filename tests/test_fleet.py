@@ -1,0 +1,547 @@
+"""Fleet telemetry (ISSUE 18): replica-aware SLO aggregation.
+
+Covers the jax-free read side — per-replica stats (torn tails, appended
+multi-run logs, pre-digest replica rebuilds), the merged rollup's exact
+counters and digest-bound percentiles, outlier flagging at a
+configurable spread threshold, the per-tenant worst-verdict drift
+rollup, rollup persistence (registry artifact + appended
+``fleet_rollup`` event), `telemetry compare` gating of ``fleet.*``
+metrics, the `apnea-uq telemetry fleet` CLI exit codes/formats, the
+capacity sweep's knee detection, and the ISSUE 18 acceptance bar: three
+REAL serve replica subprocesses sharing one warm program store, merged
+within the documented digest bound of the pooled raw request latencies,
+with an injected-slow replica flagged through the imbalance ratio and
+two rollups gated against each other on ``fleet.p99_ms``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.telemetry.digest import REL_ERROR_BOUND, LatencyDigest
+from apnea_uq_tpu.telemetry.fleet import (
+    DEFAULT_SPREAD_THRESHOLD,
+    FleetRollup,
+    NoFleetTelemetry,
+    build_rollup,
+    fleet_result,
+    record_rollup,
+    render_fleet,
+    replica_stats,
+    rollup_data,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ fixtures --
+
+
+def _slo_event(seq, *, replica_id, lats, buckets=None, final=True,
+               interval_s=4.0, windows=None, extra=None):
+    digest = LatencyDigest("s")
+    digest.extend(lats)
+    event = {
+        "seq": seq, "ts": 2.0 + seq, "kind": "serve_slo",
+        "replica_id": replica_id,
+        "requests": len(lats), "windows": windows or len(lats),
+        "batches": max(1, len(lats) // 4),
+        "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+        "p95_ms": round(float(np.percentile(lats, 95)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+        "windows_per_s": round((windows or len(lats)) / interval_s, 3),
+        "queue_wait_mean_s": 0.002, "pad_waste": 0.25,
+        "interval_s": interval_s, "final": final,
+        "digest": digest.to_payload(),
+        "buckets": buckets or {},
+    }
+    if extra:
+        event.update(extra)
+    return event
+
+
+def _bucket_row(batches, windows, pad_rows, device_ms):
+    digest = LatencyDigest("ms")
+    digest.extend(device_ms)
+    return {
+        "batches": batches, "windows": windows, "pad_rows": pad_rows,
+        "pad_waste": 0.0, "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+        "digest": digest.to_payload(),
+    }
+
+
+def _write_events(run_dir, events, torn_tail=False):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "events.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+        if torn_tail:
+            f.write('{"seq": 999, "kind": "serve_slo", "requ')
+
+
+def _replica_dir(tmp_path, name, lats, **kw):
+    d = str(tmp_path / name)
+    _write_events(d, [_slo_event(0, replica_id=name, lats=lats, **kw)])
+    return d
+
+
+# ------------------------------------------------------- replica stats --
+
+
+class TestReplicaStats:
+    def test_missing_dir_and_no_serve_slo_raise(self, tmp_path):
+        with pytest.raises(NoFleetTelemetry, match="no events"):
+            replica_stats(str(tmp_path / "nope"))
+        d = str(tmp_path / "train_run")
+        _write_events(d, [{"seq": 0, "kind": "epoch", "loss": 0.5}])
+        with pytest.raises(NoFleetTelemetry, match="serve_slo"):
+            replica_stats(d)
+
+    def test_last_snapshot_wins_and_torn_tail_tolerated(self, tmp_path):
+        d = str(tmp_path / "r0")
+        stale = _slo_event(0, replica_id="r0", lats=[0.1] * 4, final=False)
+        fresh = _slo_event(1, replica_id="r0", lats=[0.1] * 8)
+        _write_events(d, [stale, fresh], torn_tail=True)
+        rep = replica_stats(d)
+        assert rep.requests == 8  # the cumulative LAST snapshot
+        assert rep.replica_id == "r0"
+        assert rep.digest_source == "serve_slo"
+        assert rep.digest.count == 8
+
+    def test_appended_multi_run_log_uses_latest_run(self, tmp_path):
+        d = str(tmp_path / "r0")
+        events = (
+            [{"seq": 0, "kind": "run_started", "stage": "serve"},
+             _slo_event(1, replica_id="old", lats=[9.0] * 4)]
+            + [{"seq": 2, "kind": "run_started", "stage": "serve"},
+               _slo_event(3, replica_id="new", lats=[0.05] * 6)]
+        )
+        _write_events(d, events)
+        rep = replica_stats(d)
+        assert rep.replica_id == "new"
+        assert rep.requests == 6
+        assert rep.earlier_runs == 1
+
+    def test_pre_digest_log_rebuilds_from_serve_request(self, tmp_path):
+        # Old replicas (pre-ISSUE-18) carry no digest payload: the
+        # stats rebuild one from the per-request events, same values.
+        d = str(tmp_path / "r0")
+        lats = [0.01, 0.02, 0.04, 0.08]
+        slo = _slo_event(0, replica_id="r0", lats=lats)
+        del slo["digest"]
+        reqs = [{"seq": i + 1, "kind": "serve_request",
+                 "request_id": f"q{i}", "latency_s": v}
+                for i, v in enumerate(lats)]
+        _write_events(d, reqs + [slo])
+        rep = replica_stats(d)
+        assert rep.digest_source == "serve_request"
+        assert rep.digest.count == 4
+        assert rep.digest.percentile(50) == pytest.approx(
+            float(np.percentile(lats, 50)), rel=REL_ERROR_BOUND)
+
+
+# ------------------------------------------------------------- rollup --
+
+
+class TestBuildRollup:
+    def test_counters_sum_exactly_and_throughput_adds(self, tmp_path):
+        rng = np.random.default_rng(0)
+        dirs = [_replica_dir(tmp_path, f"r{i}",
+                             rng.lognormal(-3.5, 0.4, 50))
+                for i in range(3)]
+        rollup = build_rollup(dirs)
+        assert rollup.requests == 150
+        assert rollup.windows == 150
+        assert rollup.digest.count == 150
+        assert rollup.windows_per_s == pytest.approx(3 * 12.5)
+        assert rollup.requests_per_s == pytest.approx(3 * 12.5)
+
+    def test_percentiles_within_bound_of_pooled_samples(self, tmp_path):
+        rng = np.random.default_rng(1)
+        parts = [rng.lognormal(-3.5, 0.6, 200) * s
+                 for s in (1.0, 1.3, 2.0)]
+        dirs = [_replica_dir(tmp_path, f"r{i}", part)
+                for i, part in enumerate(parts)]
+        rollup = build_rollup(dirs)
+        pooled = np.concatenate(parts)
+        for q, got in ((50, rollup.p50_ms), (95, rollup.p95_ms),
+                       (99, rollup.p99_ms)):
+            want = float(np.percentile(pooled, q)) * 1e3
+            assert got == pytest.approx(
+                want, rel=REL_ERROR_BOUND + 1e-4), f"p{q}"
+
+    def test_outlier_flagged_at_configurable_spread(self, tmp_path):
+        dirs = [
+            _replica_dir(tmp_path, "fast0", [0.010] * 20),
+            _replica_dir(tmp_path, "fast1", [0.012] * 20),
+            _replica_dir(tmp_path, "slow", [0.200] * 20),
+        ]
+        rollup = build_rollup(dirs)  # default threshold 2.0
+        assert rollup.outliers == ["slow"]
+        assert rollup.imbalance_ratio >= 2.0
+        flagged = {r.replica_id: r.outlier for r in rollup.replicas}
+        assert flagged == {"fast0": False, "fast1": False, "slow": True}
+        # A huge threshold un-flags it; the ratio itself is unchanged.
+        relaxed = build_rollup(dirs, spread_threshold=50.0)
+        assert relaxed.outliers == []
+        assert relaxed.imbalance_ratio == rollup.imbalance_ratio
+        findings = fleet_result(rollup).findings
+        assert [f.rule for f in findings] == ["fleet-outlier-replica"]
+        assert findings[0].path == dirs[2]
+
+    def test_single_replica_never_outliers(self, tmp_path):
+        rollup = build_rollup([_replica_dir(tmp_path, "r0", [0.1] * 8)])
+        assert rollup.imbalance_ratio == pytest.approx(1.0)
+        assert rollup.outliers == []
+
+    def test_spread_threshold_and_empty_validation(self, tmp_path):
+        with pytest.raises(NoFleetTelemetry):
+            build_rollup([])
+        d = _replica_dir(tmp_path, "r0", [0.1] * 4)
+        with pytest.raises(ValueError, match="spread threshold"):
+            build_rollup([d], spread_threshold=1.0)
+        assert DEFAULT_SPREAD_THRESHOLD == 2.0
+
+    def test_bucket_tables_merge_exactly(self, tmp_path):
+        d0 = str(tmp_path / "r0")
+        d1 = str(tmp_path / "r1")
+        _write_events(d0, [_slo_event(
+            0, replica_id="r0", lats=[0.01] * 8,
+            buckets={"16": _bucket_row(4, 50, 14, [5.0] * 4)})])
+        _write_events(d1, [_slo_event(
+            0, replica_id="r1", lats=[0.01] * 8,
+            buckets={"16": _bucket_row(2, 30, 2, [50.0] * 2),
+                     "64": _bucket_row(1, 60, 4, [80.0])})])
+        rollup = build_rollup([d0, d1])
+        assert list(rollup.buckets) == ["16", "64"]
+        b16 = rollup.buckets["16"]
+        assert b16["batches"] == 6 and b16["windows"] == 80
+        # pad_waste recomputed from merged counters: 16/(6*16).
+        assert b16["pad_waste"] == pytest.approx(16 / 96, abs=1e-4)
+        # merged device-time digest spans both replicas' regimes
+        assert b16["p99_ms"] == pytest.approx(50.0, rel=REL_ERROR_BOUND)
+
+    def test_drift_rollup_worst_verdict_wins(self, tmp_path):
+        def with_drift(name, verdict, psi):
+            d = str(tmp_path / name)
+            _write_events(d, [
+                _slo_event(0, replica_id=name, lats=[0.01] * 4),
+                {"seq": 1, "kind": "serve_drift", "tenant": "P1",
+                 "verdict": verdict, "windows": 100, "max_psi": psi,
+                 "max_ks": 0.01},
+                {"seq": 2, "kind": "serve_drift", "tenant": "P2",
+                 "verdict": "ok", "windows": 50, "max_psi": 0.01,
+                 "max_ks": 0.005},
+            ])
+            return d
+
+        dirs = [with_drift("r0", "ok", 0.02),
+                with_drift("r1", "drift", 0.9)]
+        rollup = build_rollup(dirs)
+        assert rollup.drift["P1"]["verdict"] == "drift"
+        assert rollup.drift["P1"]["max_psi"] == pytest.approx(0.9)
+        assert rollup.drift["P1"]["replicas"] == {"r0": "ok",
+                                                  "r1": "drift"}
+        assert rollup.drift["P2"]["verdict"] == "ok"
+        findings = fleet_result(rollup).findings
+        assert "fleet-drift" in {f.rule for f in findings}
+        text = render_fleet(rollup)
+        assert "[P1] drift" in text and "r1=drift" in text
+
+
+# ------------------------------------------- persistence and compare --
+
+
+class TestRecordAndCompare:
+    def _rollup_dir(self, tmp_path, tag, scale):
+        rng = np.random.default_rng(42)
+        dirs = [_replica_dir(tmp_path, f"{tag}-r{i}",
+                             rng.lognormal(-3.5, 0.5, 120) * scale)
+                for i in range(2)]
+        out = str(tmp_path / f"{tag}-rollup")
+        record_rollup(build_rollup(dirs), out)
+        return out
+
+    def test_record_rollup_artifact_and_event(self, tmp_path):
+        out = self._rollup_dir(tmp_path, "a", 1.0)
+        doc = json.load(open(os.path.join(out, "fleet_rollup.json")))
+        assert len(doc["replicas"]) == 2
+        assert doc["digest"]["n"] == 240
+        events = [json.loads(line) for line in
+                  open(os.path.join(out, "events.jsonl"))]
+        kinds = [e["kind"] for e in events]
+        # Audit-trail contract: appended events, no new run_started.
+        assert "run_started" not in kinds
+        rollup_events = [e for e in events if e["kind"] == "fleet_rollup"]
+        assert len(rollup_events) == 1
+        assert rollup_events[0]["replicas"] == 2
+        assert rollup_events[0]["requests"] == 240
+        manifest = json.load(open(os.path.join(out, "manifest.json")))
+        assert "fleet_rollup" in json.dumps(manifest)
+
+    def test_compare_gates_fleet_p99_across_two_rollups(self, tmp_path):
+        from apnea_uq_tpu.telemetry.compare import (
+            compare_paths,
+            load_source,
+        )
+
+        fast = self._rollup_dir(tmp_path, "fast", 1.0)
+        slow = self._rollup_dir(tmp_path, "slow", 2.0)
+        metrics, facts = load_source(fast)
+        assert facts["kind"] == "run_dir"
+        assert "fleet.p99_ms" in metrics
+        assert metrics["fleet.p99_ms"].backend_bound
+        # imbalance_ratio: "ratio" would unit-infer higher-better; the
+        # extraction must pin lower-better explicitly.
+        assert metrics["fleet.imbalance_ratio"].higher_better is False
+        assert metrics["fleet.pad_waste"].backend_bound is False
+        comp = compare_paths(fast, slow)
+        worse = {d.name for d in comp.deltas if d.regressed}
+        assert "fleet.p99_ms" in worse
+        # And the other direction improves.
+        back = compare_paths(slow, fast)
+        better = {d.name for d in back.deltas if d.improved}
+        assert "fleet.p99_ms" in better
+
+    def test_trend_ingests_rollup_dir_as_extra_source(self, tmp_path):
+        from apnea_uq_tpu.telemetry import trend
+
+        out = self._rollup_dir(tmp_path, "t", 1.0)
+        point = trend.load_round(out)
+        assert point.status == "ok"
+        traj = trend.build_trajectory([point])
+        names = {m.name for m in traj.metrics}
+        assert "fleet.p99_ms" in names
+        assert "fleet.windows_per_s" in names
+
+
+# ---------------------------------------------------------------- CLI --
+
+
+class TestFleetCLI:
+    def _main(self, argv, capsys):
+        from apnea_uq_tpu.cli.main import main
+
+        code = main(argv)
+        return code, capsys.readouterr().out
+
+    def test_text_json_and_exit_codes(self, tmp_path, capsys):
+        rng = np.random.default_rng(5)
+        dirs = [_replica_dir(tmp_path, f"r{i}",
+                             rng.lognormal(-3.5, 0.4, 40))
+                for i in range(2)]
+        out_dir = str(tmp_path / "rollup")
+        code, out = self._main(
+            ["telemetry", "fleet", *dirs, "--out", out_dir], capsys)
+        assert code == 0
+        assert "fleet: 2 replica(s)" in out
+        assert os.path.exists(os.path.join(out_dir, "fleet_rollup.json"))
+        code, out = self._main(
+            ["telemetry", "fleet", *dirs, "--json"], capsys)
+        assert code == 0
+        doc = json.loads(out)
+        assert len(doc["fleet_rollup"]["replicas"]) == 2
+        assert doc["summary"]["findings"] == 0
+
+    def test_outlier_exits_one_and_gha_format(self, tmp_path, capsys):
+        dirs = [_replica_dir(tmp_path, "fast", [0.01] * 20),
+                _replica_dir(tmp_path, "fast2", [0.011] * 20),
+                _replica_dir(tmp_path, "slow", [0.5] * 20)]
+        code, out = self._main(
+            ["telemetry", "fleet", *dirs, "--format", "gha"], capsys)
+        assert code == 1
+        assert "::error" in out and "fleet-outlier-replica" in out
+        # Relaxing the spread threshold clears the finding.
+        code, _ = self._main(
+            ["telemetry", "fleet", *dirs, "--spread-threshold", "60"],
+            capsys)
+        assert code == 0
+
+    def test_non_telemetry_dir_exits_two(self, tmp_path, capsys):
+        empty = str(tmp_path / "not_a_run")
+        os.makedirs(empty)
+        with pytest.raises(SystemExit) as exc:
+            self._main(["telemetry", "fleet", empty], capsys)
+        assert exc.value.code == 2
+
+
+# -------------------------------------------------- capacity knee math --
+
+
+class TestCapacityKnee:
+    def _knee(self):
+        sys.path.insert(0, REPO)
+        try:
+            from bench import capacity_knee
+        finally:
+            sys.path.remove(REPO)
+        return capacity_knee
+
+    def test_ratio_knee_is_first_saturated_cell(self):
+        capacity_knee = self._knee()
+        cells = [
+            {"offered_rps": 4.0, "achieved_ratio": 1.01, "p99_ms": 50.0},
+            {"offered_rps": 8.0, "achieved_ratio": 0.97, "p99_ms": 90.0},
+            {"offered_rps": 16.0, "achieved_ratio": 0.80, "p99_ms": 400.0},
+            {"offered_rps": 32.0, "achieved_ratio": 0.40, "p99_ms": 900.0},
+        ]
+        knee, reason = capacity_knee(cells)
+        assert knee == 16.0
+        assert "0.8" in reason and "0.95" in reason
+
+    def test_budget_knee_and_no_knee(self):
+        capacity_knee = self._knee()
+        cells = [
+            {"offered_rps": 4.0, "achieved_ratio": 1.0, "p99_ms": 50.0},
+            {"offered_rps": 8.0, "achieved_ratio": 0.99, "p99_ms": 300.0},
+        ]
+        assert capacity_knee(cells) == (None, None)
+        knee, reason = capacity_knee(cells, p99_budget_ms=200.0)
+        assert knee == 8.0 and "budget" in reason
+        assert capacity_knee([], p99_budget_ms=100.0) == (None, None)
+
+    def test_capacity_metrics_refused_across_proxy_boundary(self, tmp_path):
+        # The proxy contract: knee rate and peak throughput are
+        # backend-bound absolutes — a proxy round must not gate them
+        # against a device round; the base achieved ratio still gates.
+        from apnea_uq_tpu.telemetry.compare import compare_paths
+
+        def doc(proxy, knee, ratio):
+            return {
+                "metric": "bench_cpu_proxy" if proxy else "x_throughput",
+                "value": 2 if proxy else 100.0,
+                "unit": "blocks" if proxy else "windows/sec",
+                "vs_baseline": 0, "schema": 2, "proxy": proxy,
+                "backend": {"platform": "cpu" if proxy else "tpu",
+                            "requested": "cpu-proxy" if proxy else "tpu"},
+                "blocks": {"capacity": {"status": "ok", "seconds": 9.0}},
+                "context": {"capacity": {
+                    "cells": [{"offered_rps": 4.0, "achieved_rps": 4.0,
+                               "achieved_ratio": ratio,
+                               "windows_per_s": 12.0, "p99_ms": 80.0,
+                               "imbalance_ratio": 1.0}],
+                    "knee_offered_rps": knee,
+                    "peak_windows_per_s": 12.0}},
+            }
+
+        device = tmp_path / "BENCH_device.json"
+        proxy = tmp_path / "BENCH_proxy.json"
+        device.write_text(json.dumps(doc(False, 32.0, 1.0)))
+        proxy.write_text(json.dumps(doc(True, 4.0, 0.99)))
+        comp = compare_paths(str(device), str(proxy))
+        names = {d.name for d in comp.deltas}
+        assert "capacity.knee_offered_rps" not in names
+        assert "capacity.peak_windows_per_s" not in names
+        assert "capacity.base_achieved_ratio" in names
+
+
+# --------------------------------- acceptance: real replica processes --
+
+
+def _subprocess_env(tmp_path):
+    """Clean replica-subprocess environment: CPU backend, ONE shared
+    program store + XLA cache for the whole fleet (the multi-replica
+    warm contract under test)."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_COMPILATION_CACHE_DIR",
+                        "APNEA_UQ_XLA_CACHE_DIR",
+                        "APNEA_UQ_PROGRAM_STORE_DIR",
+                        "APNEA_UQ_REPLICA_ID",
+                        "XLA_FLAGS")
+           and not k.startswith("BENCH_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["APNEA_UQ_PROGRAM_STORE_DIR"] = str(tmp_path / "program-store")
+    env["APNEA_UQ_XLA_CACHE_DIR"] = str(tmp_path / "xla-cache")
+    return env
+
+
+def test_fleet_acceptance_three_replicas(tmp_path):
+    """ISSUE 18 acceptance: three REAL serve replica subprocesses
+    (python -m apnea_uq_tpu.serving.replica) sharing one warm program
+    store, one of them degraded with an injected per-batch sleep.  The
+    merged rollup's percentiles land within the documented digest bound
+    of np.percentile over the POOLED raw serve_request latencies, the
+    slow replica is flagged through the imbalance ratio, and two
+    rollups (fast-pair baseline vs full-fleet candidate) gate
+    fleet.p99_ms through `telemetry compare`."""
+    from apnea_uq_tpu import telemetry
+    from apnea_uq_tpu.cli.main import main as cli_main
+    from apnea_uq_tpu.telemetry.compare import compare_paths
+
+    env = _subprocess_env(tmp_path)
+    run_dirs = [str(tmp_path / f"rep{i}") for i in range(3)]
+
+    def replica_cmd(i, run_dir):
+        cmd = [sys.executable, "-m", "apnea_uq_tpu.serving.replica",
+               "--run-dir", run_dir, "--requests", "10",
+               "--passes", "2", "--arrival", "poisson",
+               "--rate", "20", "--seed", str(i)]
+        if i == 2:
+            cmd += ["--slow-ms", "500"]  # the degraded replica
+        return cmd
+
+    # Warm-up pays the compiles into the SHARED store; the fleet's
+    # request paths then acquire store hits.
+    warm = subprocess.run(
+        [sys.executable, "-m", "apnea_uq_tpu.serving.replica",
+         "--run-dir", str(tmp_path / "warmup"), "--requests", "2",
+         "--passes", "2"],
+        cwd=REPO, env=dict(env, APNEA_UQ_REPLICA_ID="warmup"),
+        capture_output=True, text=True, timeout=600)
+    assert warm.returncode == 0, warm.stdout[-3000:]
+
+    procs = [subprocess.Popen(
+        replica_cmd(i, d), cwd=REPO,
+        env=dict(env, APNEA_UQ_REPLICA_ID=f"replica-{i}"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i, d in enumerate(run_dirs)]
+    for proc in procs:
+        out, _ = proc.communicate(timeout=600)
+        assert proc.returncode == 0, out[-3000:]
+
+    # Every replica stamped its identity on the serving events.
+    for i, d in enumerate(run_dirs):
+        slos = [e for e in telemetry.read_events(d)
+                if e["kind"] == "serve_slo"]
+        assert slos and slos[-1]["replica_id"] == f"replica-{i}"
+        assert slos[-1]["digest"]["n"] == 10
+
+    rollup = build_rollup(run_dirs)
+    assert rollup.requests == 30
+
+    # The digest-bound contract against POOLED RAW latencies.
+    pooled = [e["latency_s"]
+              for d in run_dirs
+              for e in telemetry.read_events(d)
+              if e["kind"] == "serve_request"]
+    assert len(pooled) == 30
+    for q, got in ((50, rollup.p50_ms), (95, rollup.p95_ms),
+                   (99, rollup.p99_ms)):
+        want = float(np.percentile(pooled, q)) * 1e3
+        assert got == pytest.approx(want, rel=REL_ERROR_BOUND + 1e-3), (
+            f"p{q}: digest {got} vs pooled numpy {want}")
+
+    # The injected 500ms-per-batch replica is the imbalance outlier.
+    assert rollup.outliers == ["replica-2"]
+    assert rollup.imbalance_ratio >= DEFAULT_SPREAD_THRESHOLD
+    assert any(r.outlier for r in rollup.replicas
+               if r.replica_id == "replica-2")
+
+    # Two persisted rollups gate through compare: the fast pair as
+    # baseline, the full fleet (carrying the slow replica) regresses
+    # fleet.p99_ms.
+    fast_dir = str(tmp_path / "rollup-fast")
+    full_dir = str(tmp_path / "rollup-full")
+    record_rollup(build_rollup(run_dirs[:2]), fast_dir)
+    record_rollup(rollup, full_dir)
+    comp = compare_paths(fast_dir, full_dir)
+    regressed = {d.name for d in comp.deltas if d.regressed}
+    assert "fleet.p99_ms" in regressed
+
+    # And the CLI agrees end to end: exit 1, the outlier named.
+    code = cli_main(["telemetry", "fleet", *run_dirs])
+    assert code == 1

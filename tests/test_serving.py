@@ -275,6 +275,36 @@ class TestLoadgen:
         assert [r.rows for r in again] == [r.rows for r in reqs]
         assert np.array_equal(again[0].windows, reqs[0].windows)
 
+    def test_poisson_arrivals_pace_by_seeded_exponential_gaps(self):
+        # ISSUE 18 satellite: --arrival poisson releases request i at
+        # t0 + sum of i seeded exponential(1/rate) gaps (first request
+        # immediately), drawn from a SEPARATE gap rng so the payload
+        # stream stays bit-identical to uniform mode.
+        from apnea_uq_tpu.serving.loadgen import synthetic_requests
+
+        now = [0.0]
+        sleeps = []
+
+        def clock():
+            return now[0]
+
+        def sleep(s):
+            sleeps.append(s)
+            now[0] += s
+
+        reqs = list(synthetic_requests(
+            4, max_windows=2, seed=0, rate=10.0, arrival="poisson",
+            clock=clock, sleep=sleep))
+        gaps = np.random.default_rng((0, 0xA221)).exponential(0.1, 3)
+        assert sleeps == pytest.approx(list(gaps))
+        # Payload identity across arrival modes (same seed).
+        uniform = list(synthetic_requests(
+            4, max_windows=2, seed=0, rate=0.0))
+        assert [r.rows for r in uniform] == [r.rows for r in reqs]
+        assert np.array_equal(uniform[0].windows, reqs[0].windows)
+        with pytest.raises(ValueError, match="arrival"):
+            list(synthetic_requests(2, max_windows=2, arrival="burst"))
+
     def test_ndjson_requests_parse_and_validate(self, tmp_path):
         from apnea_uq_tpu.serving.loadgen import ndjson_requests
 

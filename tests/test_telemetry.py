@@ -397,6 +397,31 @@ class TestSummarize:
         assert "9.99" not in text
         assert "fit                     2.100      2" in text
 
+    def test_all_runs_renders_every_run_oldest_first(self, tmp_path,
+                                                     capsys):
+        """ISSUE 18 satellite: `summarize --all-runs` renders EVERY run
+        of an appended log back to back (oldest first) instead of only
+        the latest, and --json carries the machine-readable run count —
+        so a replica restart is visible, not silently hidden."""
+        from apnea_uq_tpu.cli.main import main
+
+        run_dir = str(tmp_path / "reused")
+        stale = [dict(e) for e in _GOLDEN_EVENTS]
+        stale[3] = {**stale[3], "loss": 9.99}  # a value only run 1 has
+        self._write(run_dir, stale + _GOLDEN_EVENTS)
+        assert main(["telemetry", "summarize", run_dir,
+                     "--all-runs"]) == 0
+        text = capsys.readouterr().out
+        assert "=== run 1 of 2 ===" in text
+        assert "=== run 2 of 2 ===" in text
+        assert "9.99" in text  # run 1's value is back on screen
+        assert text.index("9.99") < text.index("=== run 2 of 2 ===")
+        assert main(["telemetry", "summarize", run_dir, "--all-runs",
+                     "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["run_count"] == 2
+        assert len(doc["runs"]) == 2
+
     def test_errors_and_ensemble_fit_sections(self, tmp_path):
         run_dir = str(tmp_path / "err")
         self._write(run_dir, [
