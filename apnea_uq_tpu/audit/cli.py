@@ -18,7 +18,6 @@ persisted as ``program_audit`` telemetry events, rendered by
 
 from __future__ import annotations
 
-import os
 import sys
 from typing import Any, Dict, Optional
 
@@ -97,10 +96,14 @@ def cmd_audit(args, config) -> int:
 
     # The audit is lowering-only: it never needs an accelerator, and a
     # manifest is only comparable when generated on the same platform
-    # rules — so pin CPU before the first jax import (an already-imported
-    # jax, e.g. under the test rig's virtual CPU mesh, is left alone).
-    if "jax" not in sys.modules:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # rules — so pin the canonical CPU rig before the first jax import
+    # (an already-imported jax, e.g. under the test rig's virtual CPU
+    # mesh, is left alone — the helper no-ops).  Same blessed seam as
+    # topo and `check`, so standalone audit lowers under the exact
+    # environment the meta-gate gives it.
+    from apnea_uq_tpu.utils.env import pin_host_analysis_rig
+
+    pin_host_analysis_rig()
 
     import contextlib
 

@@ -1464,33 +1464,30 @@ def cmd_cohort(args, config) -> int:
 
 
 def cmd_check(args, config) -> int:
-    """The ``apnea-uq check`` meta-gate: lint + flow + audit + topo in
-    one invocation, merged output, one exit code (0 all clean, 1 any
-    findings, 2 any usage error) — so CI needs one step, not four.
-    Each gate runs with its tier-1 defaults; a gate's usage error is
-    reported and the remaining gates still run, so one broken manifest
-    cannot hide another gate's findings."""
+    """The ``apnea-uq check`` meta-gate: lint + flow + audit + topo +
+    conc in one invocation, merged output, one exit code (0 all clean,
+    1 any findings, 2 any usage error) — so CI needs one step, not
+    five.  Each gate runs with its tier-1 defaults; a gate's usage
+    error is reported and the remaining gates still run, so one broken
+    manifest cannot hide another gate's findings."""
     import argparse
 
     # Pin the canonical analysis rig BEFORE any gate touches jax: audit
-    # runs first and would otherwise initialize a 1-device CPU backend,
-    # after which topo's own identical pin (guarded by "jax not yet
+    # runs before topo and would otherwise initialize a 1-device CPU
+    # backend, after which topo's own pin (guarded by "jax not yet
     # imported") can no longer apply and its sweep would see a 1x1
     # topology with no manifest rows — failing the documented
     # `JAX_PLATFORMS=cpu apnea-uq check` recipe on a clean tree.
-    if "jax" not in sys.modules:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-            ).strip()
+    from apnea_uq_tpu.utils.env import pin_host_analysis_rig
+
+    pin_host_analysis_rig()
 
     from apnea_uq_tpu.audit.cli import cmd_audit
     from apnea_uq_tpu.audit.manifest import (
         DEFAULT_MANIFEST_PATH as AUDIT_MANIFEST,
     )
     from apnea_uq_tpu.compilecache.zoo import WARM_GROUPS
+    from apnea_uq_tpu.conc.cli import cmd_conc
     from apnea_uq_tpu.flow.cli import cmd_flow
     from apnea_uq_tpu.flow.manifest import (
         DEFAULT_MANIFEST_PATH as FLOW_MANIFEST,
@@ -1515,6 +1512,7 @@ def cmd_check(args, config) -> int:
         ("topo", lambda: cmd_topo(argparse.Namespace(
             **common, manifest=TOPO_MANIFEST, update_manifest=False,
             update_docs=False, docs=None, run_dir=None), config)),
+        ("conc", lambda: cmd_conc(argparse.Namespace(**common))),
     )
     codes = {}
     for name, run in gates:
@@ -2101,14 +2099,22 @@ def register(sub, add_config_arg, load_config_fn) -> None:
 
     topo_cli.register(sub, add_config_arg, load_config_fn)
 
-    # `check` runs all four static gates in one invocation with merged
+    # `conc` is the fifth rule family (apnea_uq_tpu/conc/): the
+    # concurrency & crash-consistency audit over the thread/process/
+    # crash seams the serving tier grew.  Jax-free like lint/flow — no
+    # --config, pure AST.
+    from apnea_uq_tpu.conc import cli as conc_cli
+
+    conc_cli.register(sub)
+
+    # `check` runs all five static gates in one invocation with merged
     # output and a single exit code — the one-step CI recipe
     # (docs/LINT.md "CI recipe").
     p = sub.add_parser(
         "check",
-        help="Run every static gate — lint + flow + audit + topo — "
-             "with merged output; exit 0 all clean, 1 on any finding, "
-             "2 on any usage error.")
+        help="Run every static gate — lint + flow + audit + topo + "
+             "conc — with merged output; exit 0 all clean, 1 on any "
+             "finding, 2 on any usage error.")
     add_config_arg(p)
     p.add_argument("--format", choices=("text", "gha"), default="text",
                    help="Output format; `gha` concatenates the gates' "

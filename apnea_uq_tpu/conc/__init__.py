@@ -1,0 +1,50 @@
+"""``apnea-uq conc`` — concurrency & crash-consistency audit (ISSUE 19).
+
+Fifth static-analysis family on the lint engine: audit the
+thread/process/crash seams the serving tier grew — shared-state races
+around ``Thread(target=...)`` bodies, blocking work under locks,
+unbounded producer queues, fork-after-jax process pools, stray
+``os.environ`` writes, and the crash-consistency *read* side
+(torn-tolerant state loads, effects-before-commit ordering)
+(:mod:`apnea_uq_tpu.conc.rules`).  The runtime half is the seeded
+schedule-perturbation harness (:mod:`apnea_uq_tpu.conc.perturb`) that
+lets tier-1 drive the same invariants under adversarial interleavings.
+Jax-free end to end.
+"""
+
+from apnea_uq_tpu.conc.rules import CONC_RULES, run_conc_rules
+
+__all__ = ["CONC_RULES", "run_conc_rules", "run_conc"]
+
+
+def run_conc(paths, *, rules=None, repo_root=None):
+    """Programmatic twin of the CLI: lint-engine file loading + conc
+    rules + suppression resolution, returning the same
+    :class:`~apnea_uq_tpu.lint.engine.LintResult` shape the reporters
+    render."""
+    from apnea_uq_tpu.conc.rules import ConcContext
+    from apnea_uq_tpu.lint.engine import (
+        LintContext, LintResult, apply_suppressions, default_repo_root,
+        load_files,
+    )
+
+    paths = list(paths)
+    if not paths:
+        raise ValueError("run_conc needs at least one path")
+    if repo_root is None:
+        repo_root = default_repo_root(paths)
+    files = load_files(paths, repo_root)
+    cc = ConcContext(context=LintContext(files=files, repo_root=repo_root))
+    selected = tuple(dict.fromkeys(rules)) if rules is not None \
+        else tuple(sorted(CONC_RULES))
+    findings = run_conc_rules(cc, rules=selected)
+    by_path = {f.path: f for f in files}
+    findings = [
+        apply_suppressions(f, by_path[f.path]) if f.path in by_path else f
+        for f in findings
+    ]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return LintResult(
+        findings=findings, files_scanned=len(files), rules_run=selected,
+        scanned_paths=tuple(f.path for f in files),
+    )

@@ -1,0 +1,53 @@
+"""The ``apnea-uq conc`` subcommand.
+
+``apnea-uq conc [paths ...] [--json | --format gha] [--rule NAME ...]``
+— exits 0 when every finding is suppressed-with-justification, 1 on
+unsuppressed findings, 2 on usage errors.  With no paths it audits the
+installed package plus the repo's ``bench.py`` — the exact scope the
+tier-1 gate (``tests/test_conc.py``) runs.
+
+Kept jax-free end to end, like ``apnea-uq lint``: the handler imports
+only the conc package, the lint engine, and the shared reporters.
+"""
+
+from __future__ import annotations
+
+from apnea_uq_tpu.telemetry import log
+
+
+def cmd_conc(args) -> int:
+    from apnea_uq_tpu.conc import run_conc
+    from apnea_uq_tpu.lint.cli import default_paths
+    from apnea_uq_tpu.lint.report import emit_result, resolve_format
+
+    fmt = resolve_format(args)
+    paths = args.paths or default_paths()
+    try:
+        result = run_conc(paths, rules=args.rule or None)
+    except (FileNotFoundError, ValueError, SyntaxError) as e:
+        # Usage errors exit 2, distinct from exit 1 = real findings.
+        log(f"apnea-uq conc: {e}")
+        raise SystemExit(2)
+    emit_result(result, fmt)
+    return 1 if result.unsuppressed else 0
+
+
+def register(sub) -> None:
+    """Attach the ``conc`` subcommand to the CLI's subparser registry."""
+    from apnea_uq_tpu.lint.report import add_format_args
+
+    p = sub.add_parser(
+        "conc",
+        help="Concurrency & crash-consistency audit: statically check "
+             "the thread/process/crash seams — shared-state races, "
+             "blocking calls under locks, unbounded producer queues, "
+             "fork-after-jax pools, stray os.environ writes, and "
+             "torn-read/commit-order resume discipline.")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="Files/directories to audit; default: the "
+                        "apnea_uq_tpu package plus bench.py beside it.")
+    add_format_args(p)
+    p.add_argument("--rule", action="append", default=[], metavar="NAME",
+                   help="Run only this conc rule (repeatable); default: "
+                        "all — see docs/LINT.md \"Concurrency rules\".")
+    p.set_defaults(fn=cmd_conc)

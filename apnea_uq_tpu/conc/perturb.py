@@ -1,0 +1,122 @@
+"""Seeded schedule-perturbation hooks — the runtime half of the conc audit.
+
+The static rules claim the serve pump and the stream scorer hold their
+invariants (FIFO order, ``--max-wait-ms`` deadline, exactly-once drift
+folds) under *any* interleaving.  CPython's scheduler on an idle CI box
+explores almost none of them: the producer enqueues everything before
+the consumer wakes, commits never land mid-drain, and the tests pass by
+accident of timing.  This module plants named perturbation points at
+the seams (pump enqueue/dequeue, flush result/commit) that are free
+no-ops in production and, when armed with a seed, inject small
+*deterministic* sleeps — same seed, same delay sequence — so tier-1 can
+drive adversarial schedules reproducibly on CPU.
+
+Arming, either way:
+
+- env: ``APNEA_UQ_PERTURB=<seed>`` (+ optional
+  ``APNEA_UQ_PERTURB_MAX_MS``, default 5) — lets bench/watch runs flip
+  it on without code changes;
+- code: :func:`configure` from a test, :func:`disable` to tear down.
+
+Delays are derived per (seed, point, hit-count) via blake2b, so they do
+not depend on wall-clock, thread identity, or import order.  Jax-free
+by construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+ENV_SEED = "APNEA_UQ_PERTURB"
+ENV_MAX_MS = "APNEA_UQ_PERTURB_MAX_MS"
+DEFAULT_MAX_MS = 5.0
+
+
+class _Perturber:
+    """One process-wide perturbation state: seed, delay ceiling, and a
+    per-point hit counter (the counter is what makes the delay sequence
+    a pure function of the schedule, not of wall-clock)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._seed: Optional[str] = None
+        self._max_ms: float = DEFAULT_MAX_MS
+        self._hits: Dict[str, int] = {}
+        self._env_checked = False
+
+    def configure(self, seed: str, max_delay_ms: float = DEFAULT_MAX_MS) -> None:
+        with self._lock:
+            self._seed = str(seed)
+            self._max_ms = float(max_delay_ms)
+            self._hits = {}
+            self._env_checked = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self._seed = None
+            self._hits = {}
+            self._env_checked = True
+
+    def _maybe_load_env(self) -> None:
+        # Read-only env probe, once; arming from the environment keeps
+        # library code free of os.environ writes (the conc rule's whole
+        # point).
+        if self._env_checked:
+            return
+        self._env_checked = True
+        seed = os.environ.get(ENV_SEED)
+        if seed:
+            self._seed = seed
+            try:
+                self._max_ms = float(os.environ.get(ENV_MAX_MS, DEFAULT_MAX_MS))
+            except ValueError:
+                self._max_ms = DEFAULT_MAX_MS
+
+    def delay_for(self, point: str) -> float:
+        """The sleep (seconds) this hit of `point` gets; 0.0 when disarmed."""
+        with self._lock:
+            self._maybe_load_env()
+            if self._seed is None or self._max_ms <= 0:
+                return 0.0
+            n = self._hits.get(point, 0)
+            self._hits[point] = n + 1
+            digest = hashlib.blake2b(
+                f"{self._seed}:{point}:{n}".encode("utf-8"),
+                digest_size=8).digest()
+            frac = int.from_bytes(digest, "big") / 2.0 ** 64
+            return frac * self._max_ms / 1000.0
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+
+_PERTURBER = _Perturber()
+
+
+def perturb_point(point: str) -> None:
+    """Named scheduling seam.  Free no-op unless armed; armed, sleeps a
+    deterministic seed-derived duration (< max_delay_ms) so concurrent
+    code explores a different — but reproducible — interleaving."""
+    delay = _PERTURBER.delay_for(point)
+    if delay > 0.0:
+        time.sleep(delay)
+
+
+def configure(seed: str, max_delay_ms: float = DEFAULT_MAX_MS) -> None:
+    """Arm perturbation for this process (tests call this directly)."""
+    _PERTURBER.configure(seed, max_delay_ms)
+
+
+def disable() -> None:
+    """Disarm and reset hit counters (test teardown)."""
+    _PERTURBER.disable()
+
+
+def point_hits(point: str) -> int:
+    """How many times a point fired since arming (test introspection)."""
+    return _PERTURBER.hits(point)

@@ -1,0 +1,726 @@
+"""The conc-rule family: static audit of the thread/process/crash seams.
+
+Fifth rule family on the lint engine — same :class:`Finding` type, same
+severities, same ``# apnea-lint: disable=<rule> -- <why>`` suppressions,
+same reporters — but the subject is the *concurrency topology* the
+serving tier grew (PRs 15-18): the daemon pump thread, subprocess
+replicas, spawn-context ingest pools, and the three kill -9-resumable
+state protocols.  These hazards only surface under load, on hardware,
+at 3am; this family makes them a static, pre-run exit code.
+
+Thread/process rules:
+
+- ``thread-shared-mutable-state`` — an attribute or declared
+  global/nonlocal is mutated both inside a ``Thread(target=...)`` body
+  and outside it with no lock held on both sides: a data race the GIL
+  only *sometimes* hides.  ``__init__`` scopes are initialization (the
+  thread does not exist yet) and do not count as racing sites.
+- ``blocking-call-under-lock`` — a subprocess call, a bare
+  ``queue.get()``/``.join()`` with no timeout, or a device sync
+  (``block_until_ready``) inside a ``with <lock>:`` region: every other
+  thread needing that lock now waits on I/O or the device.
+- ``unbounded-producer-queue`` — a ``queue.Queue()`` with no positive
+  ``maxsize`` (or a ``SimpleQueue``, which has none) in a module that
+  starts a thread: the producer can outrun the consumer without bound —
+  the backpressure hole the serve pump's ``maxsize=1024`` closes.
+- ``fork-after-jax-import`` — a process pool / ``multiprocessing``
+  primitive without an explicit spawn (or forkserver) context in a
+  module that imports jax/flax (directly, or transitively through
+  ``apnea_uq_tpu``): fork()ing a multithreaded runtime can deadlock a
+  worker on an inherited lock.  ``data/ingest.py``'s
+  ``mp_context=get_context("spawn")`` pin is the blessed shape.
+- ``env-mutation-in-library`` — an ``os.environ`` write outside the one
+  blessed startup seam (:mod:`apnea_uq_tpu.utils.env`): env mutation is
+  process-global shared state, and duplicated ``XLA_FLAGS`` pins drift
+  apart (the pre-fix ``topo/cli.py`` / ``cli/stages.py`` twins).
+
+Crash-consistency read-side rules (the complement of flow's
+write-discipline rules):
+
+- ``torn-read-protocol`` — state/progress JSON parsed with a raw
+  ``json.load`` instead of the shared torn-tail-tolerant reader
+  (:func:`apnea_uq_tpu.utils.io.read_json_tolerant`): a torn or corrupt
+  snapshot then crash-loops the resume path instead of degrading to a
+  fresh start.
+- ``resume-commit-order`` — a result row written *after* the last
+  atomic state commit of its scope: a crash in that gap loses the row
+  while the committed state claims it was emitted — the at-least-once
+  ordering runs effects first, commit last.
+
+Jax-free by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from apnea_uq_tpu.lint.astwalk import (
+    ScopeWalk,
+    call_name,
+    canonical_call,
+    compatible,
+    dotted_name,
+    import_aliases,
+    scopes,
+)
+from apnea_uq_tpu.lint.engine import (
+    SEVERITIES,
+    Finding,
+    LintContext,
+    Rule,
+    SourceFile,
+)
+
+CONC_RULES: Dict[str, Rule] = {}
+
+#: The ONE module allowed to write ``os.environ`` — the guarded startup
+#: seam every caller (topo sweep, `apnea-uq check`) routes through.  The
+#: env-mutation rule pins this: adding a second mutation site anywhere
+#: in the package is a finding, not a style choice.
+BLESSED_ENV_MODULES = ("apnea_uq_tpu/utils/env.py",)
+
+#: Modules exempt from the torn-read rule: the shared tolerant reader
+#: itself lives here (its internal ``json.load`` IS the protocol).
+BLESSED_READ_MODULES = ("apnea_uq_tpu/utils/io.py",)
+
+#: The reader the torn-read rule points violators at.
+TOLERANT_READER = "apnea_uq_tpu.utils.io.read_json_tolerant"
+
+
+def register_conc_rule(name: str, severity: str, summary: str):
+    """Decorator twin of :func:`apnea_uq_tpu.lint.engine.register_rule`
+    for rules that check the thread/process/crash seams."""
+    if severity not in SEVERITIES:
+        raise ValueError(
+            f"severity must be one of {SEVERITIES}, got {severity!r}")
+
+    def wrap(fn):
+        CONC_RULES[name] = Rule(name=name, severity=severity,
+                                summary=summary, check=fn)
+        return fn
+
+    return wrap
+
+
+@dataclasses.dataclass
+class ConcContext:
+    """Everything a conc rule sees: the parsed in-scope files."""
+
+    context: LintContext
+
+
+def _finding(rule: str, path: str, line: int, message: str) -> Finding:
+    return Finding(rule=rule, severity=CONC_RULES[rule].severity,
+                   path=path, line=int(line), message=message)
+
+
+def _blessed(sf: SourceFile, blessed: Tuple[str, ...]) -> bool:
+    norm = sf.path.replace(os.sep, "/")
+    return any(norm.endswith(b) for b in blessed)
+
+
+# ---------------------------------------------------------- shared walks --
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _segments(text: str) -> List[str]:
+    """Lower-cased alphabetic segments: 'stream_state.json' ->
+    ['stream', 'state', 'json'].  Segment equality (not substring) keeps
+    'pstate'/'estimate' out of the state-marker net."""
+    return [s for s in re.split(r"[^a-zA-Z]+", text.lower()) if s]
+
+
+_STATE_MARKERS = frozenset({"state", "progress"})
+
+
+def _marker_in(text: str) -> bool:
+    return any(s in _STATE_MARKERS for s in _segments(text))
+
+
+def _lockish(expr: ast.AST) -> bool:
+    """True for ``with`` context expressions that read as a lock:
+    ``lock``, ``self._lock``, ``threading.Lock()``, ``some_mutex``."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1].lower()
+    return "lock" in last or "mutex" in last
+
+
+def _stmt_bodies(stmt: ast.stmt) -> Iterator[List[ast.stmt]]:
+    for attr in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, attr, None)
+        if b:
+            yield b
+    for h in getattr(stmt, "handlers", []):
+        yield h.body
+    for c in getattr(stmt, "cases", []):
+        yield c.body
+
+
+def _iter_stmts(body: List[ast.stmt],
+                locked: bool) -> Iterator[Tuple[ast.stmt, bool]]:
+    """Every statement of one scope exactly once, tagged with whether a
+    lexically-enclosing ``with <lock>:`` holds.  Nested function/class
+    bodies are their own scopes and are not descended into."""
+    for stmt in body:
+        if isinstance(stmt, _FN_NODES + (ast.ClassDef,)):
+            continue
+        yield stmt, locked
+        inner = locked
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = locked or any(_lockish(i.context_expr)
+                                  for i in stmt.items)
+        for child in _stmt_bodies(stmt):
+            yield from _iter_stmts(child, inner)
+
+
+def _stmt_exprs(stmt: ast.stmt) -> Iterator[ast.expr]:
+    """Direct expression children of one statement (nested statement
+    bodies excluded — they come back as their own statements)."""
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            yield item.context_expr
+        return
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, ast.expr):
+            yield child
+
+
+def _scope_calls(body: List[ast.stmt]) -> Iterator[Tuple[ast.Call, bool]]:
+    """(call, under_lock) for every call of one scope, exactly once."""
+    for stmt, locked in _iter_stmts(body, False):
+        for expr in _stmt_exprs(stmt):
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    yield node, locked
+
+
+# --------------------------------------------- thread-shared-mutable-state --
+
+@dataclasses.dataclass(frozen=True)
+class _Mutation:
+    kind: str           # "attr" | "name"
+    key: str
+    line: int
+    locked: bool
+
+
+def _declared_names(body: List[ast.stmt]) -> Set[str]:
+    out: Set[str] = set()
+    for stmt, _locked in _iter_stmts(body, False):
+        if isinstance(stmt, (ast.Global, ast.Nonlocal)):
+            out.update(stmt.names)
+    return out
+
+
+def _mutation_targets(stmt: ast.stmt) -> List[ast.expr]:
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    else:
+        return []
+    flat: List[ast.expr] = []
+    for t in targets:
+        flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t])
+    return flat
+
+
+def _scope_mutations(body: List[ast.stmt],
+                     declared: Set[str]) -> List[_Mutation]:
+    """Attribute stores (``self.x = ...``, ``obj.cache[k] = ...``) plus
+    stores to names the scope declared global/nonlocal."""
+    out: List[_Mutation] = []
+    for stmt, locked in _iter_stmts(body, False):
+        for target in _mutation_targets(stmt):
+            if isinstance(target, ast.Subscript):
+                target = target.value
+            if isinstance(target, ast.Attribute):
+                key = dotted_name(target)
+                if key:
+                    out.append(_Mutation("attr", key, target.lineno, locked))
+            elif isinstance(target, ast.Name) and target.id in declared:
+                out.append(_Mutation("name", target.id, target.lineno,
+                                     locked))
+    return out
+
+
+@register_conc_rule(
+    "thread-shared-mutable-state", "error",
+    "an attribute/global mutated both inside a Thread(target=...) body "
+    "and outside it with no lock held on both sides — a data race the "
+    "GIL only sometimes hides",
+)
+def check_thread_shared_state(cc: ConcContext) -> Iterable[Finding]:
+    for sf in cc.context.files:
+        aliases = import_aliases(sf.tree)
+        target_names: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if canonical_call(node, aliases) != "threading.Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    name = dotted_name(kw.value)
+                    if name:
+                        target_names.add(name.rsplit(".", 1)[-1])
+        if not target_names:
+            continue
+        fns = [n for n in ast.walk(sf.tree) if isinstance(n, _FN_NODES)]
+        muts = {id(fn): _scope_mutations(fn.body, _declared_names(fn.body))
+                for fn in fns}
+        for fn in fns:
+            if fn.name not in target_names:
+                continue
+            inside = {id(n) for n in ast.walk(fn) if isinstance(n, _FN_NODES)}
+            peers: Dict[Tuple[str, str], List[_Mutation]] = {}
+            for other in fns:
+                # __init__ runs before the thread exists — that is
+                # initialization, not a racing site.
+                if id(other) in inside or other.name == "__init__":
+                    continue
+                for m in muts[id(other)]:
+                    peers.setdefault((m.kind, m.key), []).append(m)
+            for m in muts[id(fn)]:
+                racing = peers.get((m.kind, m.key))
+                if not racing:
+                    continue
+                if m.locked and all(p.locked for p in racing):
+                    continue
+                lines = sorted({p.line for p in racing})
+                yield _finding(
+                    "thread-shared-mutable-state", sf.path, m.line,
+                    f"'{m.key}' is mutated inside thread target "
+                    f"'{fn.name}' and also at line(s) {lines} outside it "
+                    f"with no lock held on both sides — guard every "
+                    f"mutation with one Lock, or confine the state to "
+                    f"the owning thread and hand results over a queue",
+                )
+
+
+# ------------------------------------------------- blocking-call-under-lock --
+
+def _blocking_reason(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return None
+    cn = canonical_call(call, aliases) or ""
+    if cn.startswith("subprocess."):
+        return f"a subprocess call ({cn})"
+    last = (call_name(call) or "").rsplit(".", 1)[-1]
+    if last == "block_until_ready":
+        return "a device sync (block_until_ready)"
+    if isinstance(call.func, ast.Attribute) and not call.args:
+        if last == "get":
+            for kw in call.keywords:
+                if (kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False):
+                    return None
+            return "a queue .get() with no timeout"
+        if last == "join" and not call.keywords:
+            return "a .join() with no timeout"
+    return None
+
+
+@register_conc_rule(
+    "blocking-call-under-lock", "error",
+    "a subprocess call, bare queue .get()/.join(), or device sync "
+    "inside a `with <lock>:` region — every thread needing the lock "
+    "now waits on I/O or the device",
+)
+def check_blocking_under_lock(cc: ConcContext) -> Iterable[Finding]:
+    for sf in cc.context.files:
+        aliases = import_aliases(sf.tree)
+        for _scope, body in scopes(sf.tree):
+            for call, locked in _scope_calls(body):
+                if not locked:
+                    continue
+                reason = _blocking_reason(call, aliases)
+                if reason:
+                    yield _finding(
+                        "blocking-call-under-lock", sf.path, call.lineno,
+                        f"{reason} runs while a lock is held — move the "
+                        f"blocking work outside the critical section, or "
+                        f"bound it with a timeout",
+                    )
+
+
+# ------------------------------------------------ unbounded-producer-queue --
+
+_BOUNDED_QUEUES = frozenset({
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "multiprocessing.Queue",
+})
+_SIMPLE_QUEUES = frozenset({"queue.SimpleQueue", "multiprocessing.SimpleQueue"})
+
+
+@register_conc_rule(
+    "unbounded-producer-queue", "error",
+    "a queue constructed without a positive maxsize in a module that "
+    "starts a thread — the producer can outrun the consumer without "
+    "bound (no backpressure)",
+)
+def check_unbounded_queue(cc: ConcContext) -> Iterable[Finding]:
+    for sf in cc.context.files:
+        aliases = import_aliases(sf.tree)
+        calls = [n for n in ast.walk(sf.tree) if isinstance(n, ast.Call)]
+        if not any(canonical_call(c, aliases) == "threading.Thread"
+                   for c in calls):
+            continue
+        for c in calls:
+            cn = canonical_call(c, aliases)
+            if cn in _SIMPLE_QUEUES:
+                yield _finding(
+                    "unbounded-producer-queue", sf.path, c.lineno,
+                    f"{cn} has no maxsize at all — a threaded producer "
+                    f"can grow it without bound; use queue.Queue with a "
+                    f"positive maxsize so a fast source back-pressures",
+                )
+                continue
+            if cn not in _BOUNDED_QUEUES:
+                continue
+            size: object = None
+            if c.args:
+                size = (c.args[0].value
+                        if isinstance(c.args[0], ast.Constant) else "dynamic")
+            for kw in c.keywords:
+                if kw.arg == "maxsize":
+                    size = (kw.value.value
+                            if isinstance(kw.value, ast.Constant)
+                            else "dynamic")
+            if size == "dynamic":
+                continue  # computed bound: benefit of the doubt
+            if size is None or (isinstance(size, int) and size <= 0):
+                yield _finding(
+                    "unbounded-producer-queue", sf.path, c.lineno,
+                    f"{cn} without a positive maxsize is unbounded "
+                    f"(maxsize<=0 means infinite) — in a module that "
+                    f"starts a thread this is a backpressure hole; pass "
+                    f"a positive maxsize so the producer blocks instead "
+                    f"of the process growing without bound",
+                )
+
+
+# -------------------------------------------------- fork-after-jax-import --
+
+def _jax_taint(tree: ast.Module) -> Optional[str]:
+    """The import that makes fork() unsafe in this module: jax/flax
+    directly, or any apnea_uq_tpu import (the package loads jax
+    transitively on most paths — the pragmatic approximation)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                top = a.name.split(".")[0]
+                if top in ("jax", "flax"):
+                    return top
+                if top == "apnea_uq_tpu":
+                    return a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level > 0:
+                return "the package (relative import)"
+            if node.module:
+                top = node.module.split(".")[0]
+                if top in ("jax", "flax"):
+                    return top
+                if top == "apnea_uq_tpu":
+                    return node.module
+    return None
+
+
+def _spawn_context_ok(value: ast.expr) -> bool:
+    """True when an mp_context= value is an explicit safe start method:
+    ``multiprocessing.get_context("spawn"|"forkserver")`` (or a name we
+    cannot see through — benefit of the doubt)."""
+    if isinstance(value, ast.Call):
+        last = (call_name(value) or "").rsplit(".", 1)[-1]
+        if last == "get_context" and value.args \
+                and isinstance(value.args[0], ast.Constant):
+            return value.args[0].value in ("spawn", "forkserver")
+        return False
+    return not isinstance(value, ast.Constant)
+
+
+@register_conc_rule(
+    "fork-after-jax-import", "error",
+    "a process pool / multiprocessing primitive without an explicit "
+    "spawn context in a module importing jax (directly or via "
+    "apnea_uq_tpu) — fork()ing a multithreaded runtime can deadlock a "
+    "worker on an inherited lock",
+)
+def check_fork_after_jax(cc: ConcContext) -> Iterable[Finding]:
+    for sf in cc.context.files:
+        taint = _jax_taint(sf.tree)
+        if taint is None:
+            continue
+        aliases = import_aliases(sf.tree)
+        hint = (f"this module imports {taint}; pin "
+                f"mp_context=multiprocessing.get_context('spawn') — the "
+                f"data/ingest.py shape")
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = canonical_call(node, aliases) or ""
+            last = cn.rsplit(".", 1)[-1]
+            if last == "ProcessPoolExecutor":
+                ctx = next((kw.value for kw in node.keywords
+                            if kw.arg == "mp_context"), None)
+                if ctx is None or not _spawn_context_ok(ctx):
+                    yield _finding(
+                        "fork-after-jax-import", sf.path, node.lineno,
+                        f"ProcessPoolExecutor without an explicit spawn "
+                        f"context inherits the platform default (fork on "
+                        f"Linux) — {hint}",
+                    )
+            elif cn in ("multiprocessing.Pool", "multiprocessing.Process"):
+                yield _finding(
+                    "fork-after-jax-import", sf.path, node.lineno,
+                    f"{cn} uses the platform default start method (fork "
+                    f"on Linux) — {hint}",
+                )
+            elif cn == "os.fork":
+                yield _finding(
+                    "fork-after-jax-import", sf.path, node.lineno,
+                    f"os.fork() of a multithreaded runtime can deadlock "
+                    f"the child on an inherited lock — {hint}",
+                )
+            elif last in ("get_context", "set_start_method") and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value == "fork":
+                yield _finding(
+                    "fork-after-jax-import", sf.path, node.lineno,
+                    f"an explicit 'fork' start method is exactly the "
+                    f"unsafe case — {hint}",
+                )
+
+
+# ------------------------------------------------- env-mutation-in-library --
+
+_ENV_MUTATOR_METHODS = frozenset({
+    "setdefault", "update", "pop", "popitem", "clear", "__setitem__",
+})
+
+
+def _is_environ(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    name = dotted_name(node)
+    if name is None:
+        return False
+    head, _, rest = name.partition(".")
+    resolved = aliases.get(head, head)
+    full = f"{resolved}.{rest}" if rest else resolved
+    return full == "os.environ"
+
+
+@register_conc_rule(
+    "env-mutation-in-library", "error",
+    "an os.environ write outside the blessed startup seam "
+    "(apnea_uq_tpu/utils/env.py) — process-global mutable state, and "
+    "duplicated XLA_FLAGS pins drift apart",
+)
+def check_env_mutation(cc: ConcContext) -> Iterable[Finding]:
+    for sf in cc.context.files:
+        if _blessed(sf, BLESSED_ENV_MODULES):
+            continue
+        aliases = import_aliases(sf.tree)
+        hint = ("route through the guarded helper in "
+                "apnea_uq_tpu/utils/env.py (pin_host_analysis_rig) — the "
+                "one blessed mutation site")
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _is_environ(t.value, aliases):
+                        yield _finding(
+                            "env-mutation-in-library", sf.path, t.lineno,
+                            f"os.environ[...] assignment in library code "
+                            f"— {hint}",
+                        )
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _is_environ(t.value, aliases):
+                        yield _finding(
+                            "env-mutation-in-library", sf.path, t.lineno,
+                            f"del os.environ[...] in library code — {hint}",
+                        )
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in _ENV_MUTATOR_METHODS \
+                        and _is_environ(f.value, aliases):
+                    yield _finding(
+                        "env-mutation-in-library", sf.path, node.lineno,
+                        f"os.environ.{f.attr}(...) in library code — "
+                        f"{hint}",
+                    )
+                elif (canonical_call(node, aliases)
+                        in ("os.putenv", "os.unsetenv")):
+                    yield _finding(
+                        "env-mutation-in-library", sf.path, node.lineno,
+                        f"{canonical_call(node, aliases)}(...) in library "
+                        f"code — {hint}",
+                    )
+
+
+# ----------------------------------------------------- torn-read-protocol --
+
+def _has_marker(expr: ast.AST, tainted: Set[str]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            if node.id in tainted or _marker_in(node.id):
+                return True
+        elif isinstance(node, ast.Attribute):
+            if _marker_in(node.attr):
+                return True
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if _marker_in(node.value):
+                return True
+    return False
+
+
+def _is_open_call(call: ast.Call) -> bool:
+    return (call_name(call) or "").rsplit(".", 1)[-1] == "open"
+
+
+@register_conc_rule(
+    "torn-read-protocol", "error",
+    "state/progress JSON parsed with a raw json.load instead of the "
+    "shared torn-tail-tolerant reader — a corrupt snapshot crash-loops "
+    "the resume path instead of degrading to a fresh start",
+)
+def check_torn_read(cc: ConcContext) -> Iterable[Finding]:
+    for sf in cc.context.files:
+        if _blessed(sf, BLESSED_READ_MODULES):
+            continue
+        aliases = import_aliases(sf.tree)
+        for scope, body in scopes(sf.tree):
+            stmts = [s for s, _l in _iter_stmts(body, False)]
+            tainted: Set[str] = set()
+            if isinstance(scope, _FN_NODES):
+                args = scope.args
+                for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                    if _marker_in(a.arg):
+                        tainted.add(a.arg)
+            # Two passes: path taint may chain (path = _progress_path();
+            # then open(path)).
+            for _ in range(2):
+                for stmt in stmts:
+                    if isinstance(stmt, ast.Assign) \
+                            and _has_marker(stmt.value, tainted):
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                tainted.add(t.id)
+            handles: Set[str] = set()
+            for stmt in stmts:
+                if isinstance(stmt, ast.Assign) \
+                        and isinstance(stmt.value, ast.Call) \
+                        and _is_open_call(stmt.value) \
+                        and any(_has_marker(a, tainted)
+                                for a in stmt.value.args):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            handles.add(t.id)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        ce = item.context_expr
+                        if isinstance(ce, ast.Call) and _is_open_call(ce) \
+                                and any(_has_marker(a, tainted)
+                                        for a in ce.args) \
+                                and isinstance(item.optional_vars, ast.Name):
+                            handles.add(item.optional_vars.id)
+            for stmt in stmts:
+                for expr in _stmt_exprs(stmt):
+                    for node in ast.walk(expr):
+                        if not isinstance(node, ast.Call) or not node.args:
+                            continue
+                        if canonical_call(node, aliases) not in (
+                                "json.load", "json.loads"):
+                            continue
+                        arg = node.args[0]
+                        if _has_marker(arg, tainted | handles):
+                            yield _finding(
+                                "torn-read-protocol", sf.path, node.lineno,
+                                f"state/progress snapshot parsed with a "
+                                f"raw json parse — a torn or corrupt "
+                                f"file crash-loops the resume path; "
+                                f"route through {TOLERANT_READER} "
+                                f"(missing/torn/corrupt degrades to the "
+                                f"caller's default)",
+                            )
+
+
+# ---------------------------------------------------- resume-commit-order --
+
+def _is_commit_call(call: ast.Call) -> bool:
+    last = (call_name(call) or "").rsplit(".", 1)[-1]
+    segs = set(_segments(last))
+    if {"atomic", "write"} <= segs:
+        return True
+    if {"save", "state"} <= segs:
+        return True
+    return "progress" in segs and ("write" in segs or "record" in segs)
+
+
+def _is_result_write(call: ast.Call) -> bool:
+    return isinstance(call.func, ast.Attribute) \
+        and call.func.attr in ("write", "writelines")
+
+
+@register_conc_rule(
+    "resume-commit-order", "error",
+    "a result row written after the last atomic state commit of its "
+    "scope — a crash in that gap loses the row while the committed "
+    "state claims it was emitted",
+)
+def check_resume_commit_order(cc: ConcContext) -> Iterable[Finding]:
+    for sf in cc.context.files:
+        if _blessed(sf, BLESSED_READ_MODULES):
+            continue
+        for _scope, body in scopes(sf.tree):
+            walk = ScopeWalk(body)
+            commits = [c for c in walk.calls if _is_commit_call(c.node)]
+            if not commits:
+                continue
+            for w in walk.calls:
+                if not _is_result_write(w.node):
+                    continue
+                covered = any(c.order > w.order
+                              and compatible(c.branch, w.branch)
+                              for c in commits)
+                if not covered:
+                    yield _finding(
+                        "resume-commit-order", sf.path, w.node.lineno,
+                        "result written after the scope's last atomic "
+                        "state commit — the at-least-once ordering is "
+                        "effects first, commit last (a crash in the gap "
+                        "then re-emits instead of silently losing the "
+                        "row); move the write before the commit",
+                    )
+
+
+# ----------------------------------------------------------------- runner --
+
+def run_conc_rules(cc: ConcContext,
+                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    if rules is None:
+        selected: Tuple[str, ...] = tuple(sorted(CONC_RULES))
+    else:
+        selected = tuple(dict.fromkeys(rules))
+    unknown = [r for r in selected if r not in CONC_RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown conc rule(s) {unknown}; "
+            f"available: {sorted(CONC_RULES)}")
+    findings: List[Finding] = []
+    for name in selected:
+        findings.extend(CONC_RULES[name].check(cc))
+    return findings

@@ -538,17 +538,15 @@ def _progress_path(store_dir: str) -> str:
 
 def read_ingest_progress(store_dir: str) -> Dict[str, Dict]:
     """{patient_id: completion record} of a (possibly interrupted) store
-    ingest; tolerates a missing/corrupt file (fresh start)."""
-    import json
+    ingest; tolerates a missing/torn/corrupt file (fresh start) via the
+    shared tolerant reader the conc gate's torn-read rule enforces."""
+    from apnea_uq_tpu.utils.io import read_json_tolerant
 
-    path = _progress_path(store_dir)
-    if not os.path.exists(path):
+    doc = read_json_tolerant(_progress_path(store_dir), default={})
+    if not isinstance(doc, dict):
         return {}
-    try:
-        with open(path) as f:
-            return json.load(f).get("completed", {})
-    except (OSError, ValueError):
-        return {}
+    completed = doc.get("completed", {})
+    return completed if isinstance(completed, dict) else {}
 
 
 def _write_ingest_progress(store_dir: str, completed: Dict[str, Dict]) -> None:

@@ -261,6 +261,7 @@ def serve_requests(
     import queue as queue_mod
     import threading
 
+    from apnea_uq_tpu.conc.perturb import perturb_point
     from apnea_uq_tpu.serving.drift import DEFAULT_TENANT
     from apnea_uq_tpu.telemetry.runlog import replica_id as _replica_id
 
@@ -350,6 +351,11 @@ def serve_requests(
     def pump() -> None:
         try:
             for request in requests:
+                # Schedule-perturbation seam (conc/perturb.py): a no-op
+                # unless a test/env arms a seed, then a deterministic
+                # sub-ms sleep here forces producer/consumer
+                # interleavings an idle box never explores.
+                perturb_point("serve.pump.enqueue")
                 fifo.put(request)
         except BaseException as e:  # noqa: BLE001 — re-raised on the caller
             source_failure.append(e)
@@ -369,6 +375,7 @@ def serve_requests(
             for plan in coalescer.drain(now=clock(), max_wait_s=max_wait_s):
                 dispatch(plan)
             continue
+        perturb_point("serve.pump.dequeue")
         if item is done:
             if source_failure:
                 # The request source raised (e.g. a malformed NDJSON

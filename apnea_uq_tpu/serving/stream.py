@@ -250,10 +250,20 @@ class StreamScorer:
     # -- state ------------------------------------------------------------
 
     def _load_state(self) -> None:
+        from apnea_uq_tpu.utils.io import read_json_tolerant
+
         if not os.path.exists(self.state_path):
             return
-        with open(self.state_path, encoding="utf-8") as fh:
-            doc = json.load(fh)
+        # Torn-tail-tolerant load (the conc gate's torn-read-protocol
+        # rule): a half-written or corrupt snapshot degrades to a fresh
+        # start instead of crash-looping the resume path.  The version/
+        # geometry checks below still raise — those are VALID snapshots
+        # this run must not silently reinterpret.
+        doc = read_json_tolerant(self.state_path)
+        if not isinstance(doc, dict):
+            log(f"stream: state at {self.state_path} is torn or corrupt "
+                f"— starting fresh")
+            return
         if doc.get("version") != STATE_VERSION:
             raise ValueError(
                 f"unsupported stream state version {doc.get('version')!r} "
@@ -303,11 +313,18 @@ class StreamScorer:
         """Score every pending window in max-bucket chunks, append the
         result rows, fold the rollups, THEN commit the ring state — the
         at-least-once ordering (see the module docstring)."""
+        from apnea_uq_tpu.conc.perturb import perturb_point
+
         if not self._pending:
             self._save_state()
             return
         out = self._out()
         while self._pending:
+            # Schedule-perturbation seam (conc/perturb.py): a no-op
+            # unless armed; armed, it stretches the observe->write->
+            # commit gap so crash/replay tests can land inside it
+            # deterministically.
+            perturb_point("stream.flush.chunk")
             chunk = self._pending[:self.engine.ladder.max_bucket]
             del self._pending[:len(chunk)]
             rows = np.stack([w for _p, _t, w, _e in chunk])
@@ -334,6 +351,7 @@ class StreamScorer:
                 pstate.prob_sum += float(decomp["mean_prob"][i])
                 pstate.entropy_sum += float(decomp["total_entropy"][i])
             out.flush()
+        perturb_point("stream.flush.commit")
         self._save_state()
 
     def process_line(self, line: str) -> int:

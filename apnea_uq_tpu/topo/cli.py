@@ -23,6 +23,7 @@ import sys
 from typing import Any, Dict
 
 from apnea_uq_tpu.telemetry import log
+from apnea_uq_tpu.utils.env import pin_host_analysis_rig
 
 
 def topo_program_data(facts) -> Dict[str, Any]:
@@ -113,14 +114,9 @@ def cmd_topo(args, config) -> int:
     if need_programs:
         # The sweep is lowering-only and needs the canonical rig: pin
         # CPU + 8 virtual devices before the first jax import (an
-        # already-imported jax, e.g. under the test rig, is left alone).
-        if "jax" not in sys.modules:
-            os.environ.setdefault("JAX_PLATFORMS", "cpu")
-            flags = os.environ.get("XLA_FLAGS", "")
-            if "xla_force_host_platform_device_count" not in flags:
-                os.environ["XLA_FLAGS"] = (
-                    flags + " --xla_force_host_platform_device_count=8"
-                ).strip()
+        # already-imported jax, e.g. under the test rig, is left alone —
+        # the helper no-ops and returns False).
+        pin_host_analysis_rig()
 
         from apnea_uq_tpu.topo.capture import sweep_topologies
 

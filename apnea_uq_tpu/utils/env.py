@@ -1,0 +1,46 @@
+"""The ONE blessed ``os.environ`` mutation seam.
+
+Host-side analysis CLIs (``apnea-uq topo``, the ``apnea-uq check``
+meta-gate) want an 8-device CPU rig so topology rules can interpret
+sharding layouts without a real accelerator.  That takes two env pins
+(``JAX_PLATFORMS=cpu`` + ``--xla_force_host_platform_device_count=8``)
+applied *before* jax first imports — and for a while the pin was
+copy-pasted into both CLIs, drifting apart one flag at a time.
+
+``apnea-uq conc``'s env-mutation-in-library rule now pins this module
+as the only place in the package allowed to write ``os.environ``
+(:data:`apnea_uq_tpu.conc.rules.BLESSED_ENV_MODULES`); every other
+mutation site is a finding.  Deliberately jax-free: importing jax here
+would defeat the "before jax first imports" guard it implements.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def pin_host_analysis_rig(devices: int = 8) -> bool:
+    """Pin this process to a ``devices``-way CPU rig, if jax has not
+    loaded yet.
+
+    Startup-seam contract: callers invoke this before anything that
+    imports jax.  Once jax is in ``sys.modules`` the flags are inert
+    (the platform is already chosen), so mutating the environment then
+    would be pure shared-state hazard for zero effect — we no-op and
+    return False instead.  ``JAX_PLATFORMS`` is a setdefault (an
+    explicit operator choice wins); the device-count flag is appended
+    only when absent so a caller-provided ``XLA_FLAGS`` survives.
+
+    Returns True when the pins were applied (or already present and we
+    re-affirmed them), False when jax was already imported.
+    """
+    if "jax" in sys.modules:
+        return False
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={int(devices)}"
+        ).strip()
+    return True

@@ -24,6 +24,29 @@ import os
 from typing import Any, Dict
 
 
+def read_json_tolerant(path: str, default: Any = None) -> Any:
+    """Read a JSON snapshot, degrading to ``default`` on *any* torn or
+    missing state: absent file, permission error, truncated tail,
+    garbage bytes.
+
+    The read-side half of the commit protocol above.  Writers here
+    guarantee readers never observe a torn file — but only for crashes
+    *between* syscalls on a POSIX filesystem.  A kill -9 mid-``rename``
+    on a non-journaled store, an out-of-band copy, or a manually edited
+    snapshot can still hand the resume path a half-written document, and
+    resumable state (stream state, ingest progress, bench progress) must
+    treat that as "no snapshot" — a fresh start — not crash-loop on
+    ``json.JSONDecodeError`` forever.  ``apnea-uq conc``'s
+    torn-read-protocol rule pins that every state/progress load routes
+    through here.
+    """
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return default
+
+
 def atomic_write_json(path: str, data: Dict[str, Any], *,
                       sort_keys: bool = True,
                       trailing_newline: bool = False) -> None:

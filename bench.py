@@ -239,14 +239,16 @@ def _atomic_write_json(path: str, data: dict) -> None:
 
 
 def _progress_read() -> dict:
+    """Torn-tail-tolerant progress load (the shared reader the conc
+    gate's torn-read rule enforces): a half-written snapshot degrades to
+    a fresh capture, never a crash-loop."""
+    from apnea_uq_tpu.utils.io import read_json_tolerant
+
     path = _progress_path()
-    if not path or not os.path.exists(path):
+    if not path:
         return {}
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return {}
+    doc = read_json_tolerant(path, default={})
+    return doc if isinstance(doc, dict) else {}
 
 
 def _progress_record(key: str, value: dict) -> dict:

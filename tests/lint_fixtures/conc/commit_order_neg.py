@@ -1,0 +1,28 @@
+"""Negative fixture: resume-commit-order — 0 findings.
+
+The at-least-once ordering (effects first, commit last), the
+empty-flush early return, and a commit-free writer the rule ignores.
+"""
+
+from apnea_uq_tpu.utils.io import atomic_write_json
+
+
+def flush(rows, out, state_path, state):
+    for row in rows:
+        out.write(row + "\n")
+    out.flush()
+    atomic_write_json(state_path, state)  # commit last: crash re-emits
+
+
+def flush_maybe_empty(pending, out, state_path, state):
+    if not pending:
+        atomic_write_json(state_path, state)  # early-return commit
+        return
+    for row in pending:
+        out.write(row + "\n")
+    atomic_write_json(state_path, state)  # the write above is covered here
+
+
+def plain_writer(out, rows):
+    for row in rows:
+        out.write(row)  # no commit anywhere in scope — not resume state

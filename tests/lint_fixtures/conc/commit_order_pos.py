@@ -1,0 +1,19 @@
+"""Positive fixture: resume-commit-order — exactly 2 findings.
+
+Result rows written AFTER the scope's last atomic state commit: a
+crash in the gap loses rows the committed state claims were emitted.
+"""
+
+from apnea_uq_tpu.utils.io import atomic_write_json
+
+
+def flush(rows, out, state_path, state):
+    atomic_write_json(state_path, state)  # commit first...
+    for row in rows:
+        out.write(row + "\n")  # FINDING 1: ...rows written after it
+
+
+def checkpoint(out, state_path, doc):
+    out.write("header\n")  # covered by the commit below — fine
+    atomic_write_json(state_path, doc)
+    out.write("tail\n")  # FINDING 2: after the last commit

@@ -1,0 +1,18 @@
+"""Negative fixture: env-mutation-in-library — 0 findings.
+
+Reads are always fine; only writes are confined to the blessed seam.
+"""
+
+import os
+
+
+def snapshot():
+    flags = os.environ.get("XLA_FLAGS", "")
+    platform = os.environ.get("JAX_PLATFORMS")
+    jax_vars = {k: v for k, v in os.environ.items() if k.startswith("JAX_")}
+    return flags, platform, jax_vars
+
+
+def configured():
+    return "xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", "")

@@ -1,0 +1,10 @@
+"""Positive fixture: env-mutation-in-library — exactly 4 findings."""
+
+import os
+
+
+def configure(flag):
+    os.environ["XLA_FLAGS"] = flag  # FINDING 1: subscript assignment
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # FINDING 2: setdefault
+    del os.environ["TPU_NAME"]  # FINDING 3: del
+    os.putenv("TPU_CHIPS", "8")  # FINDING 4: putenv bypasses os.environ

@@ -1,0 +1,24 @@
+"""Negative fixture: fork-after-jax-import — 0 findings.
+
+The data/ingest.py shape: jax is imported, but every pool pins an
+explicit spawn (or forkserver) context.
+"""
+
+import multiprocessing
+from concurrent.futures import ProcessPoolExecutor
+
+import jax  # noqa: F401
+
+
+def fan_out(jobs):
+    with ProcessPoolExecutor(
+        max_workers=2,
+        mp_context=multiprocessing.get_context("spawn"),
+    ) as pool:
+        list(pool.map(len, jobs))
+
+
+def fan_out_forkserver(jobs):
+    ctx = multiprocessing.get_context("forkserver")
+    with ProcessPoolExecutor(max_workers=2, mp_context=ctx) as pool:
+        list(pool.map(len, jobs))

@@ -1,0 +1,27 @@
+"""Positive fixture: fork-after-jax-import — exactly 4 findings.
+
+This module imports jax, so every default-start-method multiprocessing
+primitive inherits fork() on Linux — into a multithreaded runtime.
+"""
+
+import multiprocessing
+import os
+from concurrent.futures import ProcessPoolExecutor
+
+import jax  # noqa: F401 — the import IS the hazard precondition
+
+
+def fan_out(jobs):
+    with ProcessPoolExecutor(max_workers=2) as pool:  # FINDING 1: no mp_context
+        list(pool.map(len, jobs))
+    with multiprocessing.Pool(2) as pool:  # FINDING 2: default start method
+        pool.map(len, jobs)
+
+
+def explicit_fork(jobs):
+    ctx = multiprocessing.get_context("fork")  # FINDING 3: fork by name
+    return ctx
+
+
+def raw_fork():
+    return os.fork()  # FINDING 4: bare fork of a loaded runtime

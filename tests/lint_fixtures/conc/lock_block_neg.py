@@ -1,0 +1,37 @@
+"""Negative fixture: blocking-call-under-lock — 0 findings.
+
+Blocking work moved outside the critical section, or bounded with a
+timeout inside it.
+"""
+
+import queue
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_q = queue.Queue(maxsize=4)
+
+
+def build():
+    with _lock:
+        marker = True  # critical section holds only fast state flips
+    return subprocess.run(["make"], check=True) if marker else None
+
+
+def drain():
+    with _lock:
+        return _q.get(timeout=1.0)  # bounded: worst case is the timeout
+
+
+def probe():
+    with _lock:
+        try:
+            return _q.get(block=False)  # non-blocking: fine under a lock
+        except queue.Empty:
+            return None
+
+
+def wait_for(worker, proc):
+    with _lock:
+        worker.join(1.0)  # bounded join
+        proc.wait(timeout=5.0)

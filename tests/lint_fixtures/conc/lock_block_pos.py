@@ -1,0 +1,23 @@
+"""Positive fixture: blocking-call-under-lock — exactly 3 findings."""
+
+import queue
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_q = queue.Queue(maxsize=4)
+
+
+def build():
+    with _lock:
+        subprocess.run(["make"], check=True)  # FINDING 1: subprocess under lock
+
+
+def drain():
+    with _lock:
+        return _q.get()  # FINDING 2: bare .get() under lock
+
+
+def wait_for(worker):
+    with _lock:
+        worker.join()  # FINDING 3: bare .join() under lock

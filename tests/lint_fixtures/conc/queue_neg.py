@@ -1,0 +1,16 @@
+"""Negative fixture: unbounded-producer-queue — 0 findings.
+
+Positive constant bounds, a computed bound (benefit of the doubt), and
+the positional-maxsize spelling.
+"""
+
+import queue
+import threading
+
+
+def start(worker, depth):
+    fifo = queue.Queue(maxsize=1024)
+    positional = queue.Queue(64)
+    computed = queue.Queue(maxsize=depth * 2)
+    threading.Thread(target=worker, args=(fifo, positional, computed)).start()
+    return fifo, positional, computed

@@ -1,0 +1,12 @@
+"""Positive fixture: unbounded-producer-queue — exactly 3 findings."""
+
+import queue
+import threading
+
+
+def start(worker):
+    fifo = queue.Queue()  # FINDING 1: no maxsize, fed from a thread below
+    simple = queue.SimpleQueue()  # FINDING 2: SimpleQueue has no maxsize
+    infinite = queue.Queue(maxsize=0)  # FINDING 3: maxsize<=0 means infinite
+    threading.Thread(target=worker, args=(fifo, simple, infinite)).start()
+    return fifo, simple, infinite

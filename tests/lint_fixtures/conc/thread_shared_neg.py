@@ -1,0 +1,36 @@
+"""Negative fixture: thread-shared-mutable-state — 0 findings.
+
+Every cross-thread mutation is lock-guarded on BOTH sides; __init__
+initialization and thread-local state don't count as racing sites.
+"""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.count = 0  # initialization only — the thread doesn't exist yet
+        self._lock = threading.Lock()
+
+    def run(self):
+        local = 0
+        local += 1  # thread-local: never shared
+        with self._lock:
+            self.count += 1
+
+    def poke(self):
+        with self._lock:
+            self.count += 1
+
+    def start(self):
+        threading.Thread(target=self.run, daemon=True).start()
+
+
+def solo_worker():
+    # Mutated only inside the thread body: owned state, no race.
+    results = []
+    results.append(1)
+
+
+def launch():
+    threading.Thread(target=solo_worker, daemon=True).start()

@@ -1,0 +1,36 @@
+"""Positive fixture: thread-shared-mutable-state — exactly 2 findings.
+
+A global and an attribute, each mutated inside a Thread(target=...)
+body AND outside it, with no lock held on either side.
+"""
+
+import threading
+
+total = 0
+
+
+def worker():
+    global total
+    total += 1  # FINDING 1: also mutated in main(), no lock anywhere
+
+
+def main():
+    global total
+    t = threading.Thread(target=worker)
+    t.start()
+    total += 1
+    t.join(timeout=1.0)
+
+
+class Pump:
+    def __init__(self):
+        self.count = 0  # initialization — NOT a racing site
+
+    def run(self):
+        self.count += 1  # FINDING 2: also mutated in poke(), no lock
+
+    def poke(self):
+        self.count += 1
+
+    def start(self):
+        threading.Thread(target=self.run, daemon=True).start()

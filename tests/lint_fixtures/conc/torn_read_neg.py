@@ -1,0 +1,28 @@
+"""Negative fixture: torn-read-protocol — 0 findings.
+
+The blessed tolerant reader, non-state json parses, and a name whose
+'pstate' segment must NOT substring-match the 'state' marker.
+"""
+
+import json
+
+from apnea_uq_tpu.utils.io import read_json_tolerant
+
+
+def load_state(state_path):
+    return read_json_tolerant(state_path, default={})  # the blessed reader
+
+
+def parse_request(line):
+    return json.loads(line)  # a request line is not resumable state
+
+
+def load_manifest(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)  # manifests are versioned artifacts, not state
+
+
+def load_pstate_summary(pstate_path):
+    # 'pstate' is a whole different word: segment matching keeps it out.
+    with open(pstate_path, encoding="utf-8") as f:
+        return json.load(f)

@@ -1,0 +1,27 @@
+"""Positive fixture: torn-read-protocol — exactly 3 findings.
+
+State/progress snapshots parsed with raw json.load: a torn tail
+crash-loops the resume path.
+"""
+
+import json
+import os
+
+
+def load_state(state_path):
+    if not os.path.exists(state_path):
+        return {}
+    with open(state_path, encoding="utf-8") as fh:
+        return json.load(fh)  # FINDING 1: raw load of a state snapshot
+
+
+def read_progress(store_dir):
+    path = os.path.join(store_dir, "ingest_progress.json")
+    with open(path) as f:
+        return json.load(f)  # FINDING 2: handle opened on a progress path
+
+
+def slurp_progress(store_dir):
+    path = os.path.join(store_dir, "ingest_progress.json")
+    with open(path) as f:
+        return json.loads(f.read())  # FINDING 3: loads() of a tainted handle

@@ -1,0 +1,543 @@
+"""`apnea-uq conc` — the concurrency & crash-consistency audit
+(ISSUE 19): per-rule fixture pairs (exact positive counts, zero
+false positives on idiomatic code), the registry pin, the suppression
+round-trip, CLI exit codes/formats, the jax/flax-poisoned SUBPROCESS
+run, the package-wide zero-unsuppressed gate with its suppression audit
+trail and scan-scope pins — plus the runtime half: torn-tail sweeps
+over the shared tolerant reader and the stream-state / ingest-progress
+read paths it guards, and seeded schedule-perturbation stress tests
+driving the serve pump (FIFO + deadline) and the StreamScorer's
+observe->write->commit ordering under adversarial interleavings.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from apnea_uq_tpu.conc import CONC_RULES, run_conc
+from apnea_uq_tpu.conc import perturb
+from apnea_uq_tpu.conc.perturb import _Perturber
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures", "conc")
+PKG = os.path.join(REPO, "apnea_uq_tpu")
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _conc_fixture(name, rule):
+    return run_conc([os.path.join(FIXTURES, name)], rules=[rule],
+                    repo_root=FIXTURES)
+
+
+# ------------------------------------------------------------ rule pairs --
+
+# (rule, positive fixture, exact finding count, negative fixture)
+RULE_FIXTURES = [
+    ("thread-shared-mutable-state",
+     "thread_shared_pos.py", 2, "thread_shared_neg.py"),
+    ("blocking-call-under-lock", "lock_block_pos.py", 3, "lock_block_neg.py"),
+    ("unbounded-producer-queue", "queue_pos.py", 3, "queue_neg.py"),
+    ("fork-after-jax-import", "fork_pos.py", 4, "fork_neg.py"),
+    ("env-mutation-in-library", "env_pos.py", 4, "env_neg.py"),
+    ("torn-read-protocol", "torn_read_pos.py", 3, "torn_read_neg.py"),
+    ("resume-commit-order", "commit_order_pos.py", 2, "commit_order_neg.py"),
+]
+
+
+@pytest.mark.parametrize("rule,pos,count,neg", RULE_FIXTURES,
+                         ids=[r[0] for r in RULE_FIXTURES])
+def test_rule_fixture_pair(rule, pos, count, neg):
+    found = _conc_fixture(pos, rule).unsuppressed
+    assert len(found) == count, (
+        f"{rule} found {len(found)} on {pos}, expected {count}: "
+        f"{[f.render() for f in found]}"
+    )
+    assert all(f.rule == rule for f in found)
+    assert all(f.line > 0 for f in found)  # anchored at a pointable line
+    clean = _conc_fixture(neg, rule).unsuppressed
+    assert not clean, (
+        f"{rule} false-positives on idiomatic code {neg}: "
+        f"{[f.render() for f in clean]}"
+    )
+
+
+def test_registry_ships_exactly_the_documented_rules():
+    assert set(CONC_RULES) == {
+        "thread-shared-mutable-state", "blocking-call-under-lock",
+        "unbounded-producer-queue", "fork-after-jax-import",
+        "env-mutation-in-library", "torn-read-protocol",
+        "resume-commit-order",
+    }
+    for rule in CONC_RULES.values():
+        assert rule.severity in ("error", "warning")
+        assert rule.summary
+
+
+def test_unknown_rule_raises():
+    with pytest.raises(ValueError, match="unknown conc rule"):
+        run_conc([os.path.join(FIXTURES, "env_neg.py")],
+                 rules=["no-such-rule"], repo_root=FIXTURES)
+
+
+def test_suppression_round_trip(tmp_path):
+    """Justified suppressions suppress; a missing justification leaves
+    the finding standing, annotated — the PR-4 discipline verbatim."""
+    src = tmp_path / "startup.py"
+    src.write_text(
+        "import os\n"
+        "\n"
+        "def boot():\n"
+        "    os.environ['JAX_PLATFORMS'] = 'cpu'"
+        "  # apnea-lint: disable=env-mutation-in-library"
+        " -- operator entry point, runs before any import\n"
+        "    os.environ['XLA_FLAGS'] = '-x'"
+        "  # apnea-lint: disable=env-mutation-in-library\n"
+    )
+    result = run_conc([str(src)], rules=["env-mutation-in-library"],
+                      repo_root=str(tmp_path))
+    assert len(result.findings) == 2
+    justified = [f for f in result.findings if f.suppressed]
+    assert len(justified) == 1 and justified[0].line == 4
+    (standing,) = result.unsuppressed
+    assert standing.line == 5
+    assert "lacks a justification" in standing.message
+
+
+# ------------------------------------------------------------------- CLI --
+
+def test_cli_exit_codes_and_text_output(capsys):
+    from apnea_uq_tpu.cli.main import main
+
+    rc = main(["conc", os.path.join(FIXTURES, "env_pos.py")])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "[env-mutation-in-library]" in out and "4 finding(s)" in out
+    assert main(["conc", os.path.join(FIXTURES, "env_neg.py")]) == 0
+
+
+def test_cli_json_and_rule_filter(capsys):
+    from apnea_uq_tpu.cli.main import main
+
+    rc = main(["conc", os.path.join(FIXTURES, "torn_read_pos.py"),
+               "--rule", "torn-read-protocol", "--json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["rules_run"] == ["torn-read-protocol"]
+    assert doc["summary"]["unsuppressed"] == 3
+    assert all(f["rule"] == "torn-read-protocol" for f in doc["findings"])
+
+
+def test_cli_gha_format(capsys):
+    from apnea_uq_tpu.cli.main import main
+
+    rc = main(["conc", os.path.join(FIXTURES, "queue_pos.py"),
+               "--format", "gha"])
+    assert rc == 1
+    assert capsys.readouterr().out.startswith("::error file=")
+    # A clean run emits NO annotation lines (silence = green).
+    rc = main(["conc", os.path.join(FIXTURES, "queue_neg.py"),
+               "--format", "gha"])
+    assert rc == 0
+    assert "::" not in capsys.readouterr().out
+
+
+def test_cli_usage_errors_exit_2(capsys):
+    from apnea_uq_tpu.cli.main import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["conc", os.path.join(FIXTURES, "env_neg.py"),
+              "--rule", "no-such-rule"])
+    assert exc.value.code == 2
+    assert "unknown conc rule" in capsys.readouterr().out
+    with pytest.raises(SystemExit) as exc:
+        main(["conc", os.path.join(FIXTURES, "does_not_exist.py")])
+    assert exc.value.code == 2
+
+
+# ------------------------------------------------------- the tier-1 gate --
+
+def test_package_gate_zero_unsuppressed_findings():
+    """`apnea-uq conc apnea_uq_tpu bench.py` must be clean — the env
+    true positives were FIXED (hoisted into utils/env.py), not
+    suppressed, so the suppression audit trail for this family is
+    empty; any new entry must be reviewed here with its justification."""
+    result = run_conc([PKG, BENCH], repo_root=REPO)
+    assert not result.unsuppressed, "\n".join(
+        f.render() for f in result.unsuppressed
+    )
+    suppressed = sorted(
+        (f.path.replace(os.sep, "/"), f.rule)
+        for f in result.findings if f.suppressed
+    )
+    assert suppressed == []
+    # Scan-scope pins: the seams this family exists to audit, plus the
+    # family's own modules and the blessed env seam it pins — a module
+    # moving out of scope is a silent coverage loss.
+    scanned = {p.replace(os.sep, "/") for p in result.scanned_paths}
+    for rel in ("apnea_uq_tpu/conc/rules.py",
+                "apnea_uq_tpu/conc/cli.py",
+                "apnea_uq_tpu/conc/perturb.py",
+                "apnea_uq_tpu/utils/env.py",
+                "apnea_uq_tpu/utils/io.py",
+                "apnea_uq_tpu/serving/engine.py",
+                "apnea_uq_tpu/serving/stream.py",
+                "apnea_uq_tpu/data/ingest.py",
+                "apnea_uq_tpu/data/_native.py",
+                "apnea_uq_tpu/topo/cli.py",
+                "apnea_uq_tpu/audit/cli.py",
+                "apnea_uq_tpu/cli/stages.py",
+                "bench.py"):
+        assert rel in scanned, f"{rel} moved out of the conc gate's scope"
+
+
+def test_conc_runs_jax_free_in_poisoned_subprocess(tmp_path):
+    """The acceptance bar: `apnea-uq conc` imports no jax/flax.  A
+    REAL subprocess with poisoned jax/flax stubs first on PYTHONPATH
+    (any import of either raises) runs the full package gate clean."""
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    for mod in ("jax", "flax"):
+        (poison / f"{mod}.py").write_text(
+            f"raise ImportError('{mod} is poisoned: the conc gate must "
+            f"never import it')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(poison), REPO] +
+        ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    proc = subprocess.run(
+        [sys.executable, "-m", "apnea_uq_tpu.cli", "conc"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "0 finding(s)" in proc.stdout
+
+
+# ------------------------------------------- torn-tail read-path sweeps --
+
+class TestTolerantReader:
+    def test_every_torn_prefix_degrades_to_default(self, tmp_path):
+        """The kill -9 sweep, read side: truncate a committed snapshot
+        at EVERY byte offset — each torn prefix must yield the caller's
+        default, never an exception."""
+        from apnea_uq_tpu.utils.io import atomic_write_json, read_json_tolerant
+
+        doc = {"version": 1, "completed": {"p1": {"windows": 3}}}
+        path = tmp_path / "state.json"
+        atomic_write_json(str(path), doc)
+        raw = path.read_bytes()
+        assert read_json_tolerant(str(path)) == doc
+        torn = tmp_path / "torn.json"
+        for cut in range(len(raw)):
+            torn.write_bytes(raw[:cut])
+            assert read_json_tolerant(str(torn), default={"fresh": 1}) \
+                == {"fresh": 1}, f"torn prefix of {cut} byte(s) leaked"
+
+    def test_missing_and_garbage_degrade_to_default(self, tmp_path):
+        from apnea_uq_tpu.utils.io import read_json_tolerant
+
+        assert read_json_tolerant(str(tmp_path / "absent.json")) is None
+        bad = tmp_path / "bad.json"
+        bad.write_bytes(b"\x00\xffnot json at all")
+        assert read_json_tolerant(str(bad), default=[]) == []
+
+    def test_ingest_progress_read_path_tolerates_torn_tail(self, tmp_path):
+        """The ingest resume read path routes through the tolerant
+        reader: every torn prefix of a committed progress file reads as
+        a fresh start, a valid one round-trips, and a wrong-shaped doc
+        degrades instead of raising downstream."""
+        from apnea_uq_tpu.data.ingest import (
+            _progress_path,
+            _write_ingest_progress,
+            read_ingest_progress,
+        )
+
+        store = str(tmp_path)
+        completed = {"p1": {"windows": 40}, "p2": {"windows": 7}}
+        _write_ingest_progress(store, completed)
+        assert read_ingest_progress(store) == completed
+        raw = open(_progress_path(store), "rb").read()
+        for cut in range(len(raw)):
+            with open(_progress_path(store), "wb") as f:
+                f.write(raw[:cut])
+            assert read_ingest_progress(store) == {}, (
+                f"torn prefix of {cut} byte(s) did not read as fresh")
+        # Valid JSON, wrong shape: degrade, don't crash the resume.
+        with open(_progress_path(store), "w") as f:
+            json.dump({"completed": "not-a-dict"}, f)
+        assert read_ingest_progress(store) == {}
+        with open(_progress_path(store), "w") as f:
+            json.dump(["not", "a", "dict"], f)
+        assert read_ingest_progress(store) == {}
+
+
+# --------------------------------------- perturbation harness (no jax) --
+
+class TestPerturber:
+    def test_disarmed_is_free(self):
+        p = _Perturber()
+        p.disable()  # explicit: also blocks the env probe
+        assert p.delay_for("any.point") == 0.0
+        assert p.hits("any.point") == 0
+
+    def test_same_seed_same_schedule(self):
+        a, b = _Perturber(), _Perturber()
+        a.configure("seed-1", max_delay_ms=5.0)
+        b.configure("seed-1", max_delay_ms=5.0)
+        da = [a.delay_for("serve.pump.enqueue") for _ in range(16)]
+        db = [b.delay_for("serve.pump.enqueue") for _ in range(16)]
+        assert da == db
+        assert all(0.0 <= d <= 0.005 for d in da)
+        assert len(set(da)) > 1  # hit counter varies the schedule
+        c = _Perturber()
+        c.configure("seed-2", max_delay_ms=5.0)
+        assert [c.delay_for("serve.pump.enqueue") for _ in range(16)] != da
+
+    def test_env_knob_arms_without_code_changes(self, monkeypatch):
+        monkeypatch.setenv(perturb.ENV_SEED, "env-seed")
+        monkeypatch.setenv(perturb.ENV_MAX_MS, "3.5")
+        p = _Perturber()
+        delays = [p.delay_for("x") for _ in range(8)]
+        assert any(d > 0.0 for d in delays)
+        assert all(0.0 <= d <= 0.0035 for d in delays)
+
+    def test_bad_env_max_ms_falls_back_to_default(self, monkeypatch):
+        monkeypatch.setenv(perturb.ENV_SEED, "env-seed")
+        monkeypatch.setenv(perturb.ENV_MAX_MS, "not-a-number")
+        p = _Perturber()
+        assert all(0.0 <= p.delay_for("x") <= perturb.DEFAULT_MAX_MS / 1000.0
+                   for _ in range(8))
+
+
+# ----------------------- schedule-perturbation stress (tiny engine, CPU) --
+
+@pytest.fixture(scope="module")
+def tiny():
+    """Tiny model for the perturbation/torn-state runtime tests
+    (module-scoped so the bucket programs compile once)."""
+    jax = pytest.importorskip("jax")
+    from apnea_uq_tpu.config import ModelConfig, UQConfig
+    from apnea_uq_tpu.models import AlarconCNN1D, init_variables
+
+    model = AlarconCNN1D(ModelConfig(
+        features=(4, 6), kernel_sizes=(3, 3), dropout_rates=(0.2, 0.3)))
+    return {
+        "model": model,
+        "variables": init_variables(model, jax.random.key(0)),
+        "uq": UQConfig(mc_passes=2),
+    }
+
+
+def _engine(tiny):
+    from apnea_uq_tpu.serving.engine import ServingEngine
+
+    return ServingEngine(tiny["model"], tiny["variables"], method="mcd",
+                         uq=tiny["uq"], buckets=(16,), seed=0)
+
+
+@pytest.fixture()
+def armed():
+    """Arm perturbation for one test and always disarm after — a leaked
+    seed would slow every later serving test."""
+    yield perturb.configure
+    perturb.disable()
+
+
+def _stream_lines(patients, n_samples, channels=4):
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    for t in range(n_samples):
+        for pid in patients:
+            yield json.dumps({
+                "patient": pid, "t": float(t),
+                "v": [float(v) for v in rng.normal(size=channels)],
+            })
+
+
+class TestServePumpUnderPerturbation:
+    def test_fifo_completion_and_exact_request_accounting(
+        self, tiny, armed
+    ):
+        """Adversarial producer/consumer interleavings (seeded sleeps at
+        both pump seams) must not reorder completions or lose/duplicate
+        a request — including an overflow spill mid-stream."""
+        import numpy as np
+
+        from apnea_uq_tpu.serving.coalescer import ServeRequest
+        from apnea_uq_tpu.serving.engine import serve_requests
+
+        armed("pump-fifo", max_delay_ms=2.0)
+        eng = _engine(tiny)
+        rng = np.random.default_rng(3)
+        sizes = (3, 20, 1, 16, 7, 2, 33, 5, 11, 4)  # 20/33 spill over b16
+        reqs = [ServeRequest(
+            windows=rng.normal(size=(k, 60, 4)).astype(np.float32),
+            enqueue_t=0.0, request_id=f"r{i:02d}")
+            for i, k in enumerate(sizes)]
+        order = []
+        summary = serve_requests(
+            eng, iter(reqs), max_wait_s=0.0,
+            on_result=lambda req, stats, start: order.append(
+                req.request_id))
+        # A spilled request gets one on_result per chunk; FIFO means the
+        # per-request first-completion order matches enqueue order and
+        # each request's chunks land contiguously.
+        assert list(dict.fromkeys(order)) == [
+            f"r{i:02d}" for i in range(len(sizes))]
+        assert order == sorted(order)
+        assert summary["requests"] == len(sizes)
+        assert summary["windows"] == sum(sizes)
+        # Both seams actually fired under the armed seed.
+        assert perturb.point_hits("serve.pump.enqueue") == len(sizes)
+        assert perturb.point_hits("serve.pump.dequeue") >= len(sizes)
+
+    def test_max_wait_deadline_holds_under_perturbation(self, tiny, armed):
+        """The --max-wait-ms contract survives adversarial schedules: a
+        lone request followed by a source stall still completes within
+        the deadline's regime, not at the stall's end."""
+        import time as time_mod
+
+        import numpy as np
+
+        from apnea_uq_tpu.serving.coalescer import ServeRequest
+        from apnea_uq_tpu.serving.engine import serve_requests
+
+        armed("pump-deadline", max_delay_ms=2.0)
+        eng = _engine(tiny)
+        eng.warm()
+        rng = np.random.default_rng(7)
+        stall_s = 1.0
+
+        def quiet_source():
+            yield ServeRequest(
+                windows=rng.normal(size=(2, 60, 4)).astype(np.float32),
+                enqueue_t=time_mod.perf_counter(), request_id="lone")
+            time_mod.sleep(stall_s)
+
+        latencies = []
+        summary = serve_requests(
+            eng, quiet_source(), max_wait_s=0.02,
+            on_result=lambda req, stats, start: latencies.append(
+                time_mod.perf_counter() - req.enqueue_t))
+        assert summary["requests"] == 1
+        assert latencies[0] < stall_s / 2, latencies
+
+
+class _FoldCounter:
+    """Duck-typed drift monitor: counts observe() folds per tenant and
+    rides the stream snapshot exactly like DriftMonitor (restore/
+    to_json) — the exactly-once accounting probe."""
+
+    def __init__(self):
+        self.folds = {}
+
+    def observe(self, window, tenant=None):
+        self.folds[tenant] = self.folds.get(tenant, 0) + 1
+
+    def to_json(self):
+        return {"folds": dict(self.folds)}
+
+    def restore(self, doc):
+        self.folds = {str(k): int(v)
+                      for k, v in doc.get("folds", {}).items()}
+
+    def flush(self):
+        return False  # no end-of-stream verdict to persist
+
+
+class TestStreamScorerUnderPerturbation:
+    def _scorer(self, tiny, tmp_path, drift=None):
+        from apnea_uq_tpu.serving.stream import StreamScorer
+
+        return StreamScorer(
+            _engine(tiny), state_dir=str(tmp_path / "state"),
+            out_path=str(tmp_path / "out.ndjson"), hop=60, drift=drift)
+
+    def test_exactly_once_folds_and_commit_order_under_perturbation(
+        self, tiny, tmp_path, armed
+    ):
+        """Seeded sleeps stretch the observe->write->commit gaps; the
+        accounting must stay exact: one fold per scored window, rows on
+        disk >= committed count, and a full replay over the committed
+        state folds NOTHING new (the at-least-once overlap is deduped
+        before the monitor sees it)."""
+        armed("stream-commit", max_delay_ms=2.0)
+        lines = list(_stream_lines(("p1", "p2"), 130))
+        drift = _FoldCounter()
+        scorer = self._scorer(tiny, tmp_path, drift=drift)
+        first = scorer.run(iter(lines))
+        assert first["windows"] == 4  # 2 windows x 2 patients
+        assert drift.folds == {"p1": 2, "p2": 2}
+        assert perturb.point_hits("stream.flush.commit") > 0
+        rows = sum(1 for _ in open(tmp_path / "out.ndjson"))
+        assert rows >= 4
+        # Replay into a FRESH scorer restoring the committed snapshot:
+        # zero new windows, zero new folds.
+        drift2 = _FoldCounter()
+        resumed = self._scorer(tiny, tmp_path, drift=drift2)
+        assert drift2.folds == {"p1": 2, "p2": 2}  # restored, not reset
+        second = resumed.run(iter(lines))
+        assert second["windows"] == 0
+        assert drift2.folds == {"p1": 2, "p2": 2}
+
+    def test_same_seed_reproduces_the_same_delay_schedule(self):
+        """Two armed runs with one seed draw identical delay sequences
+        at the same points — the harness is deterministic, so a failure
+        under APNEA_UQ_PERTURB=<seed> replays exactly."""
+        a, b = _Perturber(), _Perturber()
+        for p in (a, b):
+            p.configure("replay-me", max_delay_ms=5.0)
+        points = ["stream.flush.chunk", "stream.flush.commit",
+                  "serve.pump.enqueue"] * 5
+        assert [a.delay_for(pt) for pt in points] == \
+            [b.delay_for(pt) for pt in points]
+
+
+class TestStreamStateTornTail:
+    def test_torn_state_starts_fresh_not_crash_loop(self, tiny, tmp_path):
+        """Kill -9 sweep, stream read side: every torn prefix of a
+        committed stream_state.json must construct a FRESH scorer (and
+        re-score the stream), never raise out of the resume path."""
+        from apnea_uq_tpu.serving.stream import STATE_FILENAME
+
+        lines = list(_stream_lines(("p1",), 60))
+        scorer = self._fresh(tiny, tmp_path)
+        assert scorer.run(iter(lines))["windows"] == 1
+        state_path = tmp_path / "state" / STATE_FILENAME
+        raw = state_path.read_bytes()
+        # A handful of torn prefixes including the pathological ones.
+        for cut in (0, 1, len(raw) // 3, len(raw) // 2, len(raw) - 1):
+            state_path.write_bytes(raw[:cut])
+            fresh = self._fresh(tiny, tmp_path)
+            assert fresh.patients == {}, f"cut={cut} resumed torn state"
+        # And a fresh run over a torn snapshot re-scores cleanly.
+        state_path.write_bytes(raw[:len(raw) // 2])
+        rerun = self._fresh(tiny, tmp_path)
+        assert rerun.run(iter(lines))["windows"] == 1
+
+    def test_valid_but_alien_snapshots_still_refuse_loudly(
+        self, tiny, tmp_path
+    ):
+        """Tolerance is for TORN bytes only: a well-formed snapshot with
+        the wrong version (or geometry) must still refuse to resume —
+        silently reinterpreting it would mis-place every window."""
+        from apnea_uq_tpu.serving.stream import STATE_FILENAME
+
+        scorer = self._fresh(tiny, tmp_path)
+        scorer.run(iter(_stream_lines(("p1",), 60)))
+        state_path = tmp_path / "state" / STATE_FILENAME
+        doc = json.loads(state_path.read_text())
+        doc["version"] = 99
+        state_path.write_text(json.dumps(doc))
+        with pytest.raises(ValueError, match="unsupported stream state"):
+            self._fresh(tiny, tmp_path)
+
+    def _fresh(self, tiny, tmp_path):
+        from apnea_uq_tpu.serving.stream import StreamScorer
+
+        return StreamScorer(
+            _engine(tiny), state_dir=str(tmp_path / "state"),
+            out_path=str(tmp_path / "out.ndjson"), hop=60)

@@ -629,9 +629,9 @@ def test_topo_program_events_and_compare(real_topo_run, tmp_path):
 # ------------------------------------------------- the check meta-gate --
 
 def test_check_merges_exit_codes(monkeypatch, capsys, tiny_config_path):
-    """check = lint + flow + audit + topo with one exit code: 0 all
-    clean, 1 any findings, 2 any usage error (and a usage error never
-    hides another gate's findings)."""
+    """check = lint + flow + audit + topo + conc with one exit code: 0
+    all clean, 1 any findings, 2 any usage error (and a usage error
+    never hides another gate's findings)."""
     from apnea_uq_tpu.cli.main import main
 
     calls = []
@@ -657,15 +657,16 @@ def test_check_merges_exit_codes(monkeypatch, capsys, tiny_config_path):
                 ("lint", "apnea_uq_tpu.lint.cli", "cmd_lint"),
                 ("flow", "apnea_uq_tpu.flow.cli", "cmd_flow"),
                 ("audit", "apnea_uq_tpu.audit.cli", "cmd_audit"),
-                ("topo", "apnea_uq_tpu.topo.cli", "cmd_topo")):
+                ("topo", "apnea_uq_tpu.topo.cli", "cmd_topo"),
+                ("conc", "apnea_uq_tpu.conc.cli", "cmd_conc")):
             monkeypatch.setattr(
                 importlib.import_module(modpath), attr,
                 fake(name, codes[name], raises=name in raises))
 
-    all_clean = {"lint": 0, "flow": 0, "audit": 0, "topo": 0}
+    all_clean = {"lint": 0, "flow": 0, "audit": 0, "topo": 0, "conc": 0}
     patch(all_clean)
     assert main(["check", "--config", tiny_config_path]) == 0
-    assert calls == ["lint", "flow", "audit", "topo"]
+    assert calls == ["lint", "flow", "audit", "topo", "conc"]
     out = capsys.readouterr().out
     assert "== apnea-uq lint ==" in out and "clean" in out
 
@@ -673,8 +674,8 @@ def test_check_merges_exit_codes(monkeypatch, capsys, tiny_config_path):
     assert main(["check", "--config", tiny_config_path]) == 1
     assert "FINDINGS" in capsys.readouterr().out
 
-    # A usage error in audit still runs topo, and 2 wins overall.
+    # A usage error in audit still runs topo + conc, and 2 wins overall.
     patch({**all_clean, "audit": 2, "topo": 1}, raises=("audit",))
     assert main(["check", "--config", tiny_config_path]) == 2
-    assert calls == ["lint", "flow", "audit", "topo"]
+    assert calls == ["lint", "flow", "audit", "topo", "conc"]
     assert "USAGE ERROR" in capsys.readouterr().out
