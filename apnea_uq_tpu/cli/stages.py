@@ -905,6 +905,7 @@ def cmd_serve(args, config) -> int:
                     engine, requests, max_wait_s=args.max_wait_ms / 1e3,
                     slo_every=args.slo_every, on_result=on_result,
                     drift=drift, trace_every=args.trace_every,
+                    trace_slow_ms=args.trace_slow_ms,
                 )
         finally:
             if out_fh is not None:
@@ -950,6 +951,8 @@ def cmd_score(args, config) -> int:
         scorer = StreamScorer(
             engine, state_dir=args.state_dir, out_path=args.out,
             hop=args.hop, run_log=run_log, drift=drift,
+            trace_every=args.trace_every,
+            trace_slow_ms=args.trace_slow_ms,
         )
         with run_log.stage("score_stream"):
             summary = scorer.run(
@@ -1263,6 +1266,49 @@ def cmd_telemetry_fleet(args) -> int:
                 subject="replica(s)",
                 json_extra={"fleet_rollup": fleet_mod.rollup_data(rollup)})
     return 1 if fleet_mod.fleet_findings(rollup) else 0
+
+
+def cmd_telemetry_trace(args) -> int:
+    """Cross-replica critical-path analyzer (ISSUE 20): merge N serve
+    run dirs' serve_trace spans (globally-unique ids, torn tails
+    tolerated), reconstruct per-request waterfalls, attribute latency
+    to queue vs service vs pad overhead at p50/p95/p99 per bucket and
+    per replica, name the replica/phase dominating the fleet tail, and
+    audit tail-based exemplar coverage against the serve_slo counter
+    ledgers.  ``--out DIR`` persists the report as a ``trace_report``
+    event + registry artifact so `telemetry compare` gates
+    trace.queue_share_p99 / trace.service_share_p99 /
+    trace.exemplar_coverage and `telemetry trend` carries them.
+    Findings ride the shared lint reporters (text / ``--json`` /
+    ``--format gha``).  Exit 0 clean, 1 on a collision / missing
+    exemplar / tail-dominating replica, 2 when no source carries spans
+    — never a clean pass over zero spans.  Needs no config and never
+    imports jax."""
+    from apnea_uq_tpu.lint.report import emit_result, resolve_format
+    from apnea_uq_tpu.telemetry import spans as spans_mod
+
+    try:
+        report = spans_mod.build_trace(args.run_dirs)
+    except spans_mod.NoTraceTelemetry as e:
+        log(f"apnea-uq telemetry trace: {e}")
+        raise SystemExit(2)
+    except (FileNotFoundError, ValueError, OSError) as e:
+        raise SystemExit(str(e))
+    if args.out:
+        try:
+            spans_mod.record_trace(report, args.out)
+            log(f"trace report -> {args.out}")
+        except OSError as e:
+            # Best-effort like the fleet rollup: a read-only
+            # destination must not cost the user the analysis.
+            log(f"trace report not recorded in {args.out}: {e}")
+    fmt = resolve_format(args)
+    if fmt == "text":
+        log(spans_mod.render_trace(report))
+    emit_result(spans_mod.trace_result(report), fmt,
+                subject="replica(s)",
+                json_extra={"trace_report": spans_mod.trace_data(report)})
+    return 1 if spans_mod.trace_findings(report) else 0
 
 
 def cmd_telemetry_compare(args) -> int:
@@ -1743,6 +1789,27 @@ def register(sub, add_config_arg, load_config_fn) -> None:
         _add_de_engine_arg(p)
         _add_run_dir_arg(p)
 
+    def _add_trace_args(p):
+        # Shared by `serve` and `score --stream`: the ISSUE 17 head
+        # sampler plus ISSUE 20's tail-based exemplar capture.
+        p.add_argument("--trace-every", type=int, default=0, metavar="N",
+                       help="Sample every N-th completed request into a "
+                            "serve_trace span event: the enqueue -> "
+                            "coalesce -> dispatch -> D2H -> respond "
+                            "waterfall with bucket/pad attribution "
+                            "(0 = off; the first completed request "
+                            "always emits when tracing is on).")
+        p.add_argument("--trace-slow-ms", type=float, default=0.0,
+                       metavar="MS",
+                       help="Tail-based exemplar capture: EVERY request "
+                            "over this latency budget emits its "
+                            "serve_trace waterfall (never sampled "
+                            "away — the trace.exemplar_coverage == 1.0 "
+                            "contract), plus rolling per-bucket p99 "
+                            "outliers through a bounded reservoir "
+                            "(0 = off).  `apnea-uq telemetry trace` "
+                            "audits the coverage across replicas.")
+
     p = add("serve", cmd_serve,
             "Long-lived online UQ scoring: coalesced bucket batches "
             "through AOT-warm fused-stats programs, with SLO telemetry.")
@@ -1772,16 +1839,13 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                         "seeded way to exercise --drift-check (the "
                         "first N requests score PSI ~ 0, the shifted "
                         "cohort flips the serve_drift verdict).")
-    p.add_argument("--trace-every", type=int, default=0, metavar="N",
-                   help="Sample every N-th completed request into a "
-                        "serve_trace span event: the enqueue -> "
-                        "coalesce -> dispatch -> D2H -> respond "
-                        "waterfall with bucket/pad attribution "
-                        "(0 = off).")
+    _add_trace_args(p)
     p.add_argument("--input", default=None,
                    help="NDJSON request source (- = stdin): one "
-                        "{\"id\", \"windows\": [[[ch]x60]xk]} object "
-                        "per line.")
+                        "{\"id\", \"windows\": [[[ch]x60]xk], "
+                        "optional \"trace_id\"} object per line "
+                        "(an inbound trace_id rides into the span id "
+                        "<replica_id>/<trace_id>).")
     p.add_argument("--max-wait-ms", type=float, default=5.0,
                    help="Coalescing deadline: a partial batch "
                         "dispatches once its oldest request has waited "
@@ -1832,6 +1896,7 @@ def register(sub, add_config_arg, load_config_fn) -> None:
                         "latency/crash-loss bound (a slow feed must not "
                         "hold admitted samples hostage to a full "
                         "max-bucket batch).")
+    _add_trace_args(p)
 
     p = add("metrics", cmd_metrics,
             "Print a stored evaluation's aggregates/CIs/accuracy.")
@@ -1948,6 +2013,30 @@ def register(sub, add_config_arg, load_config_fn) -> None:
 
     _fleet_fmt(pf)
     pf.set_defaults(fn=cmd_telemetry_fleet)
+
+    px = tsub.add_parser(
+        "trace",
+        help="Cross-replica critical-path analyzer: merge N serve run "
+             "dirs' serve_trace spans into per-request waterfalls, "
+             "attribute latency (queue/service/pad) at p50/p95/p99 per "
+             "bucket and replica, flag the tail-dominating replica, "
+             "and audit exemplar coverage; exits 1 on a collision, "
+             "missing exemplar, or dominated tail.")
+    px.add_argument("run_dirs", nargs="+", metavar="run_dir",
+                    help="Serve replica run directories (each the "
+                         "--run-dir of one `apnea-uq serve` or replica "
+                         "process; latest run of an appended log, torn "
+                         "tails tolerated).")
+    px.add_argument("--out", default=None, metavar="DIR",
+                    help="Persist the report into DIR as a trace_report "
+                         "event + registry artifact — a run-dir source "
+                         "`telemetry compare` gates "
+                         "(trace.queue_share_p99, "
+                         "trace.service_share_p99, "
+                         "trace.exemplar_coverage) and `telemetry "
+                         "trend` ingests.")
+    _fleet_fmt(px)
+    px.set_defaults(fn=cmd_telemetry_trace)
 
     pc = tsub.add_parser(
         "compare",

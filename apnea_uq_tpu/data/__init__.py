@@ -4,28 +4,15 @@ Replaces the reference's two preprocessing scripts
 (data_prepocessing/preprocess_shhs_raw.py, prepare_numpy_datasets.py) and
 their file-name drift (SURVEY §1) with one versioned artifact registry and
 library-grade stages.
-"""
 
-from apnea_uq_tpu.data.annotations import (
-    RespiratoryEvents,
-    parse_xml_annotations,
-)
-from apnea_uq_tpu.data.edf import EdfSignal, read_edf
-from apnea_uq_tpu.data.feed import prefetch_to_device
-from apnea_uq_tpu.data.ingest import (
-    WindowSet,
-    ingest_directory,
-    ingest_recording,
-    windows_from_reference_csv,
-    windows_to_reference_csv,
-)
-from apnea_uq_tpu.data.prepare import PreparedDatasets, prepare_datasets
-from apnea_uq_tpu.data.registry import ArtifactRegistry
-from apnea_uq_tpu.data.sampling import (
-    grouped_train_test_split,
-    random_undersample,
-    smote_oversample,
-)
+Lazy exports: the artifact registry is imported by jax-free contexts —
+the ``telemetry fleet``/``telemetry trace`` report writers, the
+lint/flow gates — so importing this package must not drag in the
+jax-loaded ``feed`` module (device prefetch) as a side effect.
+Submodule imports (``from apnea_uq_tpu.data import registry``) stay
+jax-free too; only touching ``prefetch_to_device`` (or importing
+``data.feed`` directly) pays the jax import.
+"""
 
 __all__ = [
     "ArtifactRegistry",
@@ -45,3 +32,34 @@ __all__ = [
     "windows_from_reference_csv",
     "windows_to_reference_csv",
 ]
+
+_EXPORTS = {
+    "RespiratoryEvents": "annotations",
+    "parse_xml_annotations": "annotations",
+    "EdfSignal": "edf",
+    "read_edf": "edf",
+    "prefetch_to_device": "feed",
+    "WindowSet": "ingest",
+    "ingest_directory": "ingest",
+    "ingest_recording": "ingest",
+    "windows_from_reference_csv": "ingest",
+    "windows_to_reference_csv": "ingest",
+    "PreparedDatasets": "prepare",
+    "prepare_datasets": "prepare",
+    "ArtifactRegistry": "registry",
+    "grouped_train_test_split": "sampling",
+    "random_undersample": "sampling",
+    "smote_oversample": "sampling",
+}
+
+
+def __getattr__(name):
+    try:
+        module = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(
+        importlib.import_module(f"apnea_uq_tpu.data.{module}"), name)

@@ -38,6 +38,7 @@ SWEEP = "sweep"                                # L7 side: T/N convergence table
 QUALITY_BASELINE = "quality_baseline"          # L2 -> L5: frozen per-channel data fingerprint (drift scoring)
 AUTOTUNE_CONFIG = "autotune_config"            # L5 side: measured kernel tile-geometry winners (ops/autotune.py)
 FLEET_ROLLUP = "fleet_rollup"                  # serve side: cross-replica SLO rollup (telemetry/fleet.py)
+TRACE_REPORT = "trace_report"                  # serve side: cross-replica trace/critical-path report (telemetry/spans.py)
 
 #: Every canonical artifact key, in pipeline order.  The flow gate
 #: (`apnea-uq flow`, apnea_uq_tpu/flow/) keys its producer->consumer
@@ -47,7 +48,7 @@ CANONICAL_KEYS = (
     WINDOWS, TRAIN_STD_SMOTE, TEST_STD_UNBALANCED, TEST_STD_RUS,
     QUALITY_BASELINE, RAW_PREDICTIONS, UQ_STATS, DETAILED_WINDOWS,
     METRICS, PATIENT_SUMMARY, CHECKPOINT, SWEEP, AUTOTUNE_CONFIG,
-    FLEET_ROLLUP,
+    FLEET_ROLLUP, TRACE_REPORT,
 )
 
 

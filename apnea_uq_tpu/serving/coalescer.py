@@ -24,6 +24,8 @@ from typing import Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from apnea_uq_tpu.telemetry.spans import mint_trace_id, span_id_for
+
 # The serving tier's fixed batch-size ladder — the ONE canonical
 # definition, living on the jax-free side so the CLI parser and this
 # host-side coalescer read it without touching jax; uq/predict.py
@@ -32,7 +34,6 @@ import numpy as np
 SERVE_BUCKET_SIZES = (16, 64, 256)
 
 _REQUEST_COUNTER = itertools.count()
-_SPAN_COUNTER = itertools.count()
 
 
 @dataclasses.dataclass
@@ -43,7 +44,10 @@ class ServeRequest:
     bookkeeping: a request's rows may span several batches, and the
     request completes when its LAST row's batch returns.
 
-    ``span_id`` names the request's trace span (auto-assigned); the
+    ``trace_id`` is minted at the request source (or carried inbound on
+    the request line) and ``span_id`` is its globally-unique fleet
+    spelling ``<replica_id>/<trace_id>`` (telemetry/spans.py) — NEVER a
+    bare per-process counter, which collided across replicas; the
     ``trace_*`` fields are the per-request waterfall accumulators the
     serve loop folds batch attribution into (engine.py) and the sampled
     ``serve_trace`` event reports — host bookkeeping only, they never
@@ -56,14 +60,18 @@ class ServeRequest:
     dispatched: int = 0
     done: int = 0
     batches: int = 0
+    trace_id: str = ""
     span_id: str = ""
-    # Span-trace accumulators (ISSUE 17): first-dispatch clock reading,
-    # summed host-dispatch / device(+D2H) attribution across the
-    # request's batches, total pad rows it rode with, largest bucket
-    # touched, and the last program label that scored it.
+    # Span-trace accumulators (ISSUE 17/20): pump-handoff and
+    # first-dispatch clock readings, summed host-dispatch /
+    # device(+D2H) / drift-fold attribution across the request's
+    # batches, total pad rows it rode with, largest bucket touched, and
+    # the last program label that scored it.
+    dequeue_t: Optional[float] = None
     first_dispatch_t: Optional[float] = None
     trace_dispatch_s: float = 0.0
     trace_device_s: float = 0.0
+    trace_drift_s: float = 0.0
     trace_pad_rows: int = 0
     trace_bucket: int = 0
     trace_label: str = ""
@@ -77,8 +85,10 @@ class ServeRequest:
             )
         if not self.request_id:
             self.request_id = f"req-{next(_REQUEST_COUNTER)}"
+        if not self.trace_id:
+            self.trace_id = mint_trace_id()
         if not self.span_id:
-            self.span_id = f"span-{next(_SPAN_COUNTER)}"
+            self.span_id = span_id_for(self.trace_id)
 
     @property
     def rows(self) -> int:

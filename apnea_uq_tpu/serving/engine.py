@@ -225,6 +225,7 @@ def serve_requests(
     clock=time.perf_counter,
     on_result=None,
     trace_every: int = 0,
+    trace_slow_ms: float = 0.0,
     drift=None,
 ) -> Dict[str, Any]:
     """The request-path loop: pull arrivals, coalesce into bucket
@@ -245,6 +246,16 @@ def serve_requests(
     the waterfall is a decomposition of the SLO number, not a parallel
     measurement.
 
+    The sampling verdict lands AT COMPLETION through
+    :class:`~apnea_uq_tpu.telemetry.spans.ExemplarTracer` (ISSUE 20):
+    the first completed request always emits, ``trace_every`` keeps
+    the 1-in-N baseline stream, and ``trace_slow_ms > 0`` arms tail
+    mode — every request over the budget emits unconditionally (the
+    exemplar-coverage contract) plus rolling per-bucket p99 outliers
+    through a bounded reservoir with exact drop counters.  Each
+    ``serve_slo`` snapshot then carries the tracer's counter ledger and
+    recent exemplar span ids as its ``trace`` field.
+
     ``drift`` (a :class:`~apnea_uq_tpu.serving.drift.DriftMonitor`)
     folds every dispatched window into the per-tenant rolling
     fingerprint at dispatch time (tenant = the request's ``patient``,
@@ -264,22 +275,31 @@ def serve_requests(
     from apnea_uq_tpu.conc.perturb import perturb_point
     from apnea_uq_tpu.serving.drift import DEFAULT_TENANT
     from apnea_uq_tpu.telemetry.runlog import replica_id as _replica_id
+    from apnea_uq_tpu.telemetry.spans import (
+        ExemplarTracer,
+        waterfall_children,
+    )
 
     run_log = engine.run_log
     slo = slo or SLOTracker(clock)
     coalescer = coalescer or RequestCoalescer(engine.ladder)
+    tracer = ExemplarTracer(trace_every=trace_every, slow_ms=trace_slow_ms)
     emitted_at = 0
-    completed = 0
 
     def dispatch(plan: BatchPlan) -> None:
-        nonlocal emitted_at, completed
+        nonlocal emitted_at
         now = clock()
         for req, start, end in plan.slices:
             if req.first_dispatch_t is None:
                 req.first_dispatch_t = now
             if drift is not None:
+                # Timed: the fold is host numpy on the request path, so
+                # the waterfall's drift_fold child shows its cost
+                # instead of hiding it inside queue time.
+                drift_t0 = clock()
                 drift.observe(req.windows[start:end],
                               tenant=req.patient or DEFAULT_TENANT)
+                req.trace_drift_s += clock() - drift_t0
         stats = engine.score_batch(
             plan.gather(), bucket=plan.bucket,
             queue_wait_s=plan.queue_wait_s(now), slo=slo,
@@ -310,14 +330,20 @@ def serve_requests(
                         batches=req.batches,
                         latency_s=round(latency, 6),
                     )
-                if (run_log is not None and trace_every > 0
-                        and completed % int(trace_every) == 0):
+                reasons = tracer.decide(bucket=req.trace_bucket,
+                                        latency_s=latency,
+                                        span_id=req.span_id)
+                if run_log is not None and reasons:
                     queue_s = req.first_dispatch_t - req.enqueue_t
                     service_s = done_t - req.first_dispatch_t
+                    d2h_s = max(req.trace_device_s
+                                - req.trace_dispatch_s, 0.0)
+                    end_t = clock()
                     run_log.event(
                         "serve_trace",
                         replica_id=_replica_id(),
                         span_id=req.span_id,
+                        trace_id=req.trace_id,
                         request_id=req.request_id,
                         windows=req.rows,
                         batches=req.batches,
@@ -328,15 +354,28 @@ def serve_requests(
                         service_s=round(service_s, 6),
                         dispatch_s=round(req.trace_dispatch_s, 6),
                         device_s=round(req.trace_device_s, 6),
-                        d2h_s=round(max(req.trace_device_s
-                                        - req.trace_dispatch_s, 0.0), 6),
-                        respond_s=round(clock() - done_t, 6),
+                        d2h_s=round(d2h_s, 6),
+                        respond_s=round(end_t - done_t, 6),
                         latency_s=round(latency, 6),
+                        sampled_for=list(reasons),
+                        exemplar=bool("slow" in reasons
+                                      or "p99" in reasons),
+                        children=waterfall_children(
+                            enqueue_t=req.enqueue_t,
+                            dequeue_t=req.dequeue_t,
+                            first_dispatch_t=req.first_dispatch_t,
+                            done_t=done_t,
+                            end_t=end_t,
+                            dispatch_s=req.trace_dispatch_s,
+                            d2h_s=d2h_s,
+                            drift_s=req.trace_drift_s,
+                        ),
                     )
-                completed += 1
                 if slo.requests - emitted_at >= max(1, int(slo_every)):
                     emitted_at = slo.requests
-                    slo.emit(run_log, final=False)
+                    slo.emit(run_log, final=False,
+                             trace=(tracer.stats() if tracer.enabled
+                                    else None))
 
     # Bounded: a fast source (a big NDJSON file, loadgen at rate=0) must
     # not materialize every pending request's window arrays in memory —
@@ -383,6 +422,10 @@ def serve_requests(
                 # not a silent drain — re-raise on the serving thread.
                 raise source_failure[0]
             break
+        # Pump-handoff clock: splits the waterfall's queue time into
+        # its pump child (source -> serving thread) and coalesce child
+        # (serving thread -> first dispatch).
+        item.dequeue_t = clock()
         coalescer.enqueue(item)
         for plan in coalescer.drain(now=clock(), max_wait_s=max_wait_s):
             dispatch(plan)
@@ -392,4 +435,5 @@ def serve_requests(
         # The tail shorter than one re-score cadence still lands a
         # final verdict per tenant before the summary closes the run.
         drift.flush()
-    return slo.emit(run_log, final=True)
+    return slo.emit(run_log, final=True,
+                    trace=tracer.stats() if tracer.enabled else None)

@@ -103,6 +103,9 @@ def ndjson_requests(path: str, *, time_steps: int = 60,
     """Real-traffic request source for `apnea-uq serve --input`: one
     ``{"id": ..., "windows": [[[c0..c3] x T] x k]}`` NDJSON object per
     line (``-`` = stdin); arrival time is the moment the line is read.
+    An optional ``"trace_id"`` on the line is honored end-to-end — the
+    caller's distributed-tracing context rides into the span id
+    ``<replica_id>/<trace_id>`` instead of a locally-minted one.
     A malformed line raises — a request API, unlike the sample stream,
     has no partial-garbage regime worth limping through."""
     import sys
@@ -125,9 +128,11 @@ def ndjson_requests(path: str, *, time_steps: int = 60,
                 f"request line {i}: windows must be (k, {time_steps}, "
                 f"{channels}), got {windows.shape}"
             )
+        trace_id = doc.get("trace_id")
         yield ServeRequest(windows=windows, enqueue_t=clock(),
                            request_id=str(doc.get("id", f"req-{i}")),
-                           patient=doc.get("patient"))
+                           patient=doc.get("patient"),
+                           trace_id=str(trace_id) if trace_id else "")
 
 
 def run_loadgen(
@@ -143,13 +148,16 @@ def run_loadgen(
     drift_after: Optional[int] = None,
     drift=None,
     trace_every: int = 0,
+    trace_slow_ms: float = 0.0,
 ):
     """Drive ``engine`` with the synthetic stream; returns the final
     SLO summary dict (also emitted as the closing ``serve_slo``).
     ``drift_after``/``drift``/``trace_every`` thread the ISSUE 17
     observability knobs through: injected post-N cohort shift, the
     online drift monitor fed at dispatch, and 1-in-N span tracing;
-    ``arrival`` picks the pacing schedule (see synthetic_requests)."""
+    ``trace_slow_ms`` arms ISSUE 20's tail-based exemplar capture
+    (every over-budget request emits its waterfall); ``arrival`` picks
+    the pacing schedule (see synthetic_requests)."""
     from apnea_uq_tpu.serving.engine import DEFAULT_SLO_EVERY, serve_requests
 
     cfg = engine.model.config
@@ -162,4 +170,5 @@ def run_loadgen(
         engine, requests, max_wait_s=max_wait_s,
         slo_every=slo_every or DEFAULT_SLO_EVERY,
         drift=drift, trace_every=trace_every,
+        trace_slow_ms=trace_slow_ms,
     )

@@ -65,6 +65,14 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Inject an N-ms sleep per dispatched "
                              "batch — the degraded-replica fixture for "
                              "outlier-detection tests.")
+    parser.add_argument("--trace-every", type=int, default=0,
+                        help="Emit a serve_trace waterfall for 1 in N "
+                             "completed requests (0 = off).")
+    parser.add_argument("--trace-slow-ms", type=float, default=0.0,
+                        help="Tail-based exemplar capture: every "
+                             "request over this latency budget emits "
+                             "its waterfall, plus rolling per-bucket "
+                             "p99 outliers (0 = off).")
     return parser
 
 
@@ -106,6 +114,8 @@ def run_replica(argv: Optional[Sequence[str]] = None) -> dict:
             engine, args.requests, max_windows=args.max_windows,
             seed=args.seed, rate=args.rate, arrival=args.arrival,
             slo_every=args.slo_every or None,
+            trace_every=args.trace_every,
+            trace_slow_ms=args.trace_slow_ms,
         )
     return summary
 

@@ -160,12 +160,19 @@ class SLOTracker:
         return out
 
     def emit(self, run_log, *, final: bool = False,
-             patients: Optional[int] = None) -> Dict[str, Any]:
+             patients: Optional[int] = None,
+             trace: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Append one ``serve_slo`` event (cumulative snapshot; the
-        final one is the session summary the gates read)."""
+        final one is the session summary the gates read).  ``trace`` is
+        the exemplar tracer's counter ledger
+        (:meth:`~apnea_uq_tpu.telemetry.spans.ExemplarTracer.stats`):
+        carried verbatim so every SLO line links to its exemplar span
+        ids and the fleet assembler can audit coverage exactly."""
         from apnea_uq_tpu.telemetry.runlog import replica_id
 
         summary = self.summary()
+        if trace is not None:
+            summary["trace"] = dict(trace)
         if run_log is not None:
             fields = dict(summary)
             fields["final"] = bool(final)

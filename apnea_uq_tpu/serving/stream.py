@@ -219,7 +219,10 @@ class StreamScorer:
 
     def __init__(self, engine: ServingEngine, *, state_dir: str,
                  out_path: str, window: Optional[int] = None,
-                 hop: int = 60, run_log=None, drift=None):
+                 hop: int = 60, run_log=None, drift=None,
+                 trace_every: int = 0, trace_slow_ms: float = 0.0):
+        from apnea_uq_tpu.telemetry.spans import ExemplarTracer
+
         self.engine = engine
         self.window = int(window or engine.model.config.time_steps)
         if self.window != engine.model.config.time_steps:
@@ -241,6 +244,13 @@ class StreamScorer:
         # snapshot — ring state and drift window revert (or survive)
         # together, so replayed windows fold in exactly once.
         self.drift = drift
+        # Flush-cycle span tracing (ISSUE 20): one serve_trace span per
+        # flush cycle — the stream's unit of work — with flush/commit
+        # child spans, through the same at-completion exemplar sampler
+        # the serve loop runs (slow flush cycles always leave evidence).
+        self.tracer = ExemplarTracer(trace_every=trace_every,
+                                     slow_ms=trace_slow_ms)
+        self._flushes = 0
         self.patients: Dict[str, _PatientState] = {}
         # (patient, start_t, window array, enqueue clock) awaiting dispatch.
         self._pending: List[Tuple[str, float, np.ndarray, float]] = []
@@ -312,13 +322,27 @@ class StreamScorer:
     def _flush_pending(self) -> None:
         """Score every pending window in max-bucket chunks, append the
         result rows, fold the rollups, THEN commit the ring state — the
-        at-least-once ordering (see the module docstring)."""
+        at-least-once ordering (see the module docstring).  Each flush
+        cycle is one trace span candidate: ``latency_s`` runs from the
+        oldest pending window's admission to the state commit, with
+        flush (score + append) and commit child spans."""
         from apnea_uq_tpu.conc.perturb import perturb_point
+        from apnea_uq_tpu.telemetry.runlog import replica_id
+        from apnea_uq_tpu.telemetry.spans import mint_trace_id, span_id_for
 
         if not self._pending:
             self._save_state()
             return
         out = self._out()
+        clock = time.perf_counter
+        span_oldest = min(e for _p, _t, _w, e in self._pending)
+        flush_start = clock()
+        chunks = 0
+        span_windows = 0
+        span_pad_rows = 0
+        span_bucket = 0
+        span_label = ""
+        span_dispatch_s = span_device_s = span_drift_s = 0.0
         while self._pending:
             # Schedule-perturbation seam (conc/perturb.py): a no-op
             # unless armed; armed, it stretches the observe->write->
@@ -333,12 +357,22 @@ class StreamScorer:
                 # Fold before the state commit below: the rolling
                 # fingerprint and the ring state revert together on a
                 # crash, so a replayed window is never double-counted.
+                drift_t0 = clock()
                 for pid, _t, w, _e in chunk:
                     self.drift.observe(w, tenant=pid)
+                span_drift_s += clock() - drift_t0
             stats = self.engine.score_batch(
                 rows, queue_wait_s=max(0.0, time.perf_counter() - oldest),
                 slo=self.slo,
             )
+            batch = self.engine.last_batch or {}
+            span_dispatch_s += float(batch.get("dispatch_s", 0.0))
+            span_device_s += float(batch.get("device_s", 0.0))
+            span_pad_rows += int(batch.get("pad_rows", 0))
+            span_bucket = max(span_bucket, int(batch.get("bucket", 0)))
+            span_label = str(batch.get("label", ""))
+            chunks += 1
+            span_windows += len(chunk)
             decomp = decomposition_rows(stats)
             for i, (pid, start_t, _w, _e) in enumerate(chunk):
                 record = {"patient": pid, "start_t": start_t}
@@ -351,8 +385,56 @@ class StreamScorer:
                 pstate.prob_sum += float(decomp["mean_prob"][i])
                 pstate.entropy_sum += float(decomp["total_entropy"][i])
             out.flush()
+        scored_t = clock()
         perturb_point("stream.flush.commit")
         self._save_state()
+        committed_t = clock()
+        flush_idx = self._flushes
+        self._flushes += 1
+        trace_id = mint_trace_id()
+        span_id = span_id_for(trace_id)
+        latency_s = committed_t - span_oldest
+        reasons = self.tracer.decide(bucket=span_bucket,
+                                     latency_s=latency_s,
+                                     span_id=span_id)
+        if self.run_log is not None and reasons:
+            d2h_s = max(span_device_s - span_dispatch_s, 0.0)
+            children = [
+                {"phase": "flush",
+                 "start_s": round(max(flush_start - span_oldest, 0.0), 6),
+                 "dur_s": round(max(scored_t - flush_start, 0.0), 6)},
+                {"phase": "commit",
+                 "start_s": round(max(scored_t - span_oldest, 0.0), 6),
+                 "dur_s": round(max(committed_t - scored_t, 0.0), 6)},
+            ]
+            if span_drift_s > 0.0:
+                children.insert(1, {
+                    "phase": "drift_fold",
+                    "start_s": round(max(flush_start - span_oldest,
+                                         0.0), 6),
+                    "dur_s": round(span_drift_s, 6)})
+            self.run_log.event(
+                "serve_trace",
+                replica_id=replica_id(),
+                span_id=span_id,
+                trace_id=trace_id,
+                request_id=f"stream-flush-{flush_idx}",
+                windows=span_windows,
+                batches=chunks,
+                bucket=span_bucket,
+                pad_rows=span_pad_rows,
+                label=span_label,
+                queue_s=round(max(flush_start - span_oldest, 0.0), 6),
+                service_s=round(max(committed_t - flush_start, 0.0), 6),
+                dispatch_s=round(span_dispatch_s, 6),
+                device_s=round(span_device_s, 6),
+                d2h_s=round(d2h_s, 6),
+                respond_s=round(max(committed_t - scored_t, 0.0), 6),
+                latency_s=round(max(latency_s, 0.0), 6),
+                sampled_for=list(reasons),
+                exemplar=bool("slow" in reasons or "p99" in reasons),
+                children=children,
+            )
 
     def process_line(self, line: str) -> int:
         """Admit one NDJSON sample line; returns how many windows it
@@ -416,8 +498,9 @@ class StreamScorer:
             # verdict, then persist the post-flush monitor state.
             if self.drift.flush():
                 self._save_state()
-        summary = self.slo.emit(self.run_log, final=True,
-                                patients=len(self.patients))
+        summary = self.slo.emit(
+            self.run_log, final=True, patients=len(self.patients),
+            trace=self.tracer.stats() if self.tracer.enabled else None)
         for pid, pstate in sorted(self.patients.items()):
             roll = pstate.rollup()
             log(f"stream rollup {pid}: {roll['windows']} window(s), "

@@ -583,6 +583,26 @@ def _metrics_from_events(events: List[Any]) -> Dict[str, Metric]:
                     name = f"fleet.{field}"
                     out[name] = Metric(name, float(e[field]), unit,
                                        higher, backend_bound=bound)
+        elif kind == "trace_report":
+            # Cross-replica trace analysis (telemetry/spans.py, ISSUE
+            # 20): phase shares of tail latency and exemplar coverage
+            # are pure properties of the traffic/schedule, not the
+            # backend -> unbound ratios that cross the CPU-proxy
+            # boundary.  queue_share growing means the tail is waiting,
+            # not computing (a coalescer/load regression);
+            # service_share is the healthy complement; coverage
+            # dropping below 1.0 means over-budget requests lost their
+            # waterfalls.  All need explicit directions: "ratio" would
+            # infer higher-is-better across the board.
+            for field, higher in (
+                    ("queue_share_p99", False),
+                    ("service_share_p99", True),
+                    ("pad_share_p99", False),
+                    ("exemplar_coverage", True)):
+                if e.get(field) is not None:
+                    name = f"trace.{field}"
+                    out[name] = Metric(name, float(e[field]), "ratio",
+                                       higher, backend_bound=False)
         elif kind == "compile_event":
             compile_n += 1
             compile_hits += 1 if e.get("hit") else 0
@@ -629,8 +649,8 @@ def load_source(
                 f"no comparable metrics in source {path!r}: the run's "
                 f"events carry no bench/eval throughput, d2h, "
                 f"memory-peak, compile-cost, data-load, program-audit, "
-                f"topology, quality, drift, serve-drift, serve-SLO, or "
-                f"fleet-rollup metrics"
+                f"topology, quality, drift, serve-drift, serve-SLO, "
+                f"fleet-rollup, or trace-report metrics"
             )
         return metrics, {"kind": "run_dir", "proxy": dir_proxy}
     with open(path) as f:

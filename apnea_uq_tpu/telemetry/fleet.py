@@ -77,6 +77,11 @@ class ReplicaStats:
     buckets: Dict[str, Dict[str, Any]]
     drift: Dict[str, Dict[str, Any]]
     outlier: bool = False
+    # The exemplar tracer's counter ledger off the final serve_slo
+    # (ISSUE 20) — empty for untraced replicas.  Carried so the fleet
+    # table can flag a replica whose over-budget requests lost their
+    # waterfalls without re-reading every event stream.
+    trace: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -182,6 +187,7 @@ def replica_stats(run_dir: str) -> ReplicaStats:
         digest_source=digest_source,
         buckets=dict(slo.get("buckets") or {}),
         drift=drift,
+        trace=dict(slo.get("trace") or {}),
     )
 
 
@@ -337,6 +343,7 @@ def replica_data(rep: ReplicaStats) -> Dict[str, Any]:
         "digest_count": rep.digest.count,
         "outlier": rep.outlier,
         "drift": rep.drift,
+        "trace": rep.trace,
     }
 
 
@@ -405,6 +412,11 @@ def render_fleet(rollup: FleetRollup) -> str:
             flags.append("OUTLIER")
         if rep.digest_source != "serve_slo":
             flags.append(f"digest:{rep.digest_source}")
+        if (rep.trace.get("over_budget") is not None
+                and rep.trace.get("over_budget_traced") is not None
+                and rep.trace["over_budget_traced"]
+                < rep.trace["over_budget"]):
+            flags.append("MISSING-EXEMPLARS")
         if rep.earlier_runs:
             flags.append(f"+{rep.earlier_runs} earlier run(s)")
         lines.append(

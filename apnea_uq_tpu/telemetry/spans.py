@@ -1,0 +1,718 @@
+"""Fleet-wide distributed tracing: span identity, tail-based exemplar
+sampling, and the cross-replica trace assembler.
+
+The write side fixes the two things PR 17's spans could not do at
+fleet scale.  **Identity:** span ids are globally unique —
+``<replica_id>/<trace_id>`` with the trace id minted at the request
+source (or carried inbound on an NDJSON request line), so two replicas
+can never emit colliding ``span-0`` counters and a rollup can join
+spans safely.  **Sampling:** :class:`ExemplarTracer` decides *at
+request completion* whether a span is emitted — the first completed
+request always (a light-load serve must leave evidence), the existing
+1-in-N head stream for baseline coverage, and in tail mode
+(``--trace-slow-ms`` > 0) every request over the latency budget plus
+rolling per-bucket p99 outliers through a bounded per-bucket exemplar
+reservoir with EXACT drop counters.  Over-budget requests are never
+dropped — that is the ``trace.exemplar_coverage == 1.0`` contract the
+bench asserts and ``telemetry trace`` verifies from the event streams.
+
+The read side mirrors ``telemetry/fleet.py``: jax-free, torn tails
+tolerated via ``read_events``, appended logs split via ``latest_run``.
+``build_trace`` merges ``serve_trace`` events across N replica run
+dirs, detects span-id collisions, reconstructs per-request waterfalls,
+computes the phase-attribution breakdown (queue vs service vs pad
+overhead) at p50/p95/p99 per bucket and per replica, and names the
+replica/phase that dominates the fleet tail.  The report persists as
+the ``trace_report`` registry artifact plus a ``trace_report`` event,
+so ``telemetry compare`` gates ``trace.queue_share_p99`` /
+``trace.service_share_p99`` / ``trace.exemplar_coverage``
+(backend-unbound ratios) and ``telemetry trend`` carries them as
+series through the same run-dir seam every gateable kind rides.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apnea_uq_tpu.telemetry.runlog import (
+    append_events,
+    latest_run,
+    read_events,
+    replica_id,
+)
+
+#: Rolling per-bucket latency window the p99-outlier test runs over —
+#: enough samples for a stable tail estimate, bounded memory.
+P99_WINDOW = 512
+
+#: The per-bucket p99 test stays off until the bucket has seen this
+#: many completions: a p99 over 3 samples flags every third request.
+DEFAULT_P99_MIN_SAMPLES = 20
+
+#: Bounded per-bucket budget for p99-tail exemplars (NOT over-budget
+#: ones — those always emit).  Exceeding it increments the bucket's
+#: exact drop counter instead of emitting.
+DEFAULT_RESERVOIR_PER_BUCKET = 32
+
+#: How many exemplar span ids a serve_slo snapshot carries — the SLO
+#: line links to evidence without growing unboundedly.
+DEFAULT_EXEMPLAR_IDS = 64
+
+#: Waterfall phase names (queue vs service vs pad) the attribution
+#: breakdown reports shares for.
+PHASES = ("queue", "service", "pad")
+
+_TRACE_COUNTER = itertools.count()
+
+
+def mint_trace_id() -> str:
+    """A fresh per-process trace id.  Global uniqueness comes from the
+    replica prefix :func:`span_id_for` adds — the counter only has to
+    be unique within one process."""
+    return f"t{next(_TRACE_COUNTER)}"
+
+
+def span_id_for(trace_id: str) -> str:
+    """The globally-unique span id: ``<replica_id>/<trace_id>``.
+    ``replica_id()`` is read per call (``$APNEA_UQ_REPLICA_ID`` else
+    ``<hostname>-<pid>``), so two concurrent replica subprocesses can
+    never collide even when their per-process counters align."""
+    return f"{replica_id()}/{trace_id}"
+
+
+class ExemplarTracer:
+    """The at-completion sampling decision for one serve session.
+
+    ``decide`` is called once per completed request (span) and returns
+    the tuple of sampling reasons — empty means "do not emit":
+
+    * ``"first"`` — the first completed request, unconditionally, so a
+      light-load serve with ``trace_every=50`` and 3 requests still
+      leaves one waterfall (the PR 17 head sampler's blind spot).
+    * ``"every_n"`` — the 1-in-N baseline head stream.
+    * ``"slow"`` — latency exceeded the explicit ``slow_ms`` budget.
+      NEVER dropped; ``over_budget`` / ``over_budget_traced`` count it
+      exactly, and their equality is the exemplar-coverage contract.
+    * ``"p99"`` — tail mode only: latency at or above the bucket's
+      rolling p99 (over the last :data:`P99_WINDOW` completions, once
+      ``p99_min_samples`` have landed), through the bounded per-bucket
+      reservoir.  Reservoir exhaustion increments the bucket's exact
+      ``p99_dropped`` counter instead of emitting.
+
+    Tail mode is armed by ``slow_ms > 0``; the head stream by
+    ``trace_every > 0``; either enables the tracer.
+    """
+
+    def __init__(self, *, trace_every: int = 0, slow_ms: float = 0.0,
+                 reservoir_per_bucket: int = DEFAULT_RESERVOIR_PER_BUCKET,
+                 p99_min_samples: int = DEFAULT_P99_MIN_SAMPLES):
+        self.trace_every = int(trace_every)
+        self.slow_ms = float(slow_ms)
+        self.reservoir_per_bucket = int(reservoir_per_bucket)
+        self.p99_min_samples = int(p99_min_samples)
+        self.completed = 0
+        self.traced = 0
+        self.over_budget = 0
+        self.over_budget_traced = 0
+        self._history: Dict[int, collections.deque] = {}
+        self._p99_taken: Dict[int, int] = {}
+        self._p99_dropped: Dict[int, int] = {}
+        self._exemplars: collections.deque = collections.deque(
+            maxlen=DEFAULT_EXEMPLAR_IDS)
+
+    @property
+    def enabled(self) -> bool:
+        return self.trace_every > 0 or self.slow_ms > 0
+
+    def decide(self, *, bucket: int, latency_s: float,
+               span_id: str) -> Tuple[str, ...]:
+        """The at-completion verdict for one span; advances the rolling
+        state either way.  The span's latency joins the bucket history
+        AFTER the p99 test — a request must not dilute the very tail it
+        is being judged against."""
+        if not self.enabled:
+            return ()
+        reasons: List[str] = []
+        if self.completed == 0:
+            reasons.append("first")
+        elif (self.trace_every > 0
+                and self.completed % self.trace_every == 0):
+            reasons.append("every_n")
+        if self.slow_ms > 0:
+            bucket = int(bucket)
+            lat_ms = float(latency_s) * 1e3
+            hist = self._history.get(bucket)
+            if hist is None:
+                hist = self._history[bucket] = collections.deque(
+                    maxlen=P99_WINDOW)
+            if lat_ms > self.slow_ms:
+                reasons.append("slow")
+                self.over_budget += 1
+                self.over_budget_traced += 1
+            elif (len(hist) >= self.p99_min_samples
+                    and lat_ms >= float(np.percentile(
+                        np.asarray(hist, np.float64), 99.0))):
+                if reasons:
+                    # Already emitting for another reason: tag the
+                    # tail membership without spending reservoir.
+                    reasons.append("p99")
+                elif (self._p99_taken.get(bucket, 0)
+                        < self.reservoir_per_bucket):
+                    self._p99_taken[bucket] = (
+                        self._p99_taken.get(bucket, 0) + 1)
+                    reasons.append("p99")
+                else:
+                    self._p99_dropped[bucket] = (
+                        self._p99_dropped.get(bucket, 0) + 1)
+            hist.append(lat_ms)
+        self.completed += 1
+        if reasons:
+            self.traced += 1
+            self._exemplars.append(str(span_id))
+        return tuple(reasons)
+
+    def stats(self) -> Dict[str, Any]:
+        """The sampling ledger a ``serve_slo`` snapshot carries as its
+        ``trace`` field: exact counters (what completed, what emitted,
+        what the reservoir dropped) plus the recent exemplar span ids
+        linking the SLO line to evidence."""
+        return {
+            "completed": self.completed,
+            "traced": self.traced,
+            "trace_every": self.trace_every,
+            "slow_ms": self.slow_ms,
+            "over_budget": self.over_budget,
+            "over_budget_traced": self.over_budget_traced,
+            "p99_taken": {str(b): n for b, n
+                          in sorted(self._p99_taken.items())},
+            "p99_dropped": {str(b): n for b, n
+                            in sorted(self._p99_dropped.items())},
+            "exemplar_span_ids": list(self._exemplars),
+        }
+
+
+def waterfall_children(*, enqueue_t: float, dequeue_t: Optional[float],
+                       first_dispatch_t: float, done_t: float,
+                       end_t: float, dispatch_s: float, d2h_s: float,
+                       drift_s: float = 0.0) -> List[Dict[str, Any]]:
+    """The child-span list for one request waterfall: each child is
+    ``{"phase", "start_s", "dur_s"}`` with starts relative to the
+    request's enqueue.  ``dequeue_t`` (the pump handoff clock) may be
+    missing — a request dispatched straight off the coalescer skips the
+    pump/coalesce split and reports one combined coalesce child."""
+    children: List[Dict[str, Any]] = []
+
+    def child(phase: str, start: float, dur: float) -> None:
+        children.append({
+            "phase": phase,
+            "start_s": round(max(float(start), 0.0), 6),
+            "dur_s": round(max(float(dur), 0.0), 6),
+        })
+
+    queue_s = first_dispatch_t - enqueue_t
+    if dequeue_t is not None:
+        child("pump", 0.0, dequeue_t - enqueue_t)
+        child("coalesce", dequeue_t - enqueue_t,
+              first_dispatch_t - dequeue_t)
+    else:
+        child("coalesce", 0.0, queue_s)
+    if drift_s > 0.0:
+        child("drift_fold", queue_s, drift_s)
+    child("dispatch", queue_s, dispatch_s)
+    child("d2h", (done_t - enqueue_t) - d2h_s, d2h_s)
+    child("respond", done_t - enqueue_t, end_t - done_t)
+    return children
+
+
+# ---------------------------------------------------------- read side --
+
+class NoTraceTelemetry(ValueError):
+    """A source carries nothing the trace assembler can join — a usage
+    error (CLI exit 2), never a clean report over zero spans."""
+
+
+@dataclasses.dataclass
+class ReplicaTraces:
+    """One replica's contribution: its sampled spans (latest run of an
+    appended log) plus the final ``serve_slo``'s ``trace`` counter
+    ledger when present.  ``spans`` may be empty — a torn tail or a
+    replica run without tracing degrades to a partial fleet view, it
+    never fails the assembly."""
+
+    run_dir: str
+    replica_id: str
+    earlier_runs: int
+    spans: List[Dict[str, Any]]
+    trace_stats: Optional[Dict[str, Any]]
+
+
+@dataclasses.dataclass
+class TraceReport:
+    """The merged fleet trace view: annotated spans, collision ledger,
+    phase attribution at p50/p95/p99, per-replica and per-bucket
+    breakdowns, and the tail verdict."""
+
+    replicas: List[ReplicaTraces]
+    spans: List[Dict[str, Any]]
+    collisions: List[str]
+    phases: Dict[str, Dict[str, Any]]
+    per_replica: List[Dict[str, Any]]
+    buckets: Dict[str, Dict[str, Any]]
+    p99_latency_ms: Optional[float]
+    tail_replica: Optional[str]
+    tail_phase: Optional[str]
+    tail_share: Optional[float]
+    tail_spans: int
+    tail_spans_of_leader: int
+    over_budget: int
+    slow_spans: int
+    exemplar_coverage: Optional[float]
+
+
+def replica_traces(run_dir: str) -> ReplicaTraces:
+    """Read one replica's sampled spans.  Raises
+    :class:`NoTraceTelemetry` only when the dir is not a telemetry run
+    directory at all; a run whose trace events were torn off the tail
+    still contributes whatever survived."""
+    events = read_events(run_dir)
+    if not events:
+        raise NoTraceTelemetry(
+            f"no events.jsonl events under {run_dir!r} — not a telemetry "
+            f"run directory"
+        )
+    events, earlier = latest_run(events)
+    spans: List[Dict[str, Any]] = []
+    slo: Optional[Dict[str, Any]] = None
+    for e in events:
+        kind = e.get("kind")
+        if kind == "serve_trace":
+            spans.append(e)
+        elif kind == "serve_slo":
+            slo = e  # append-order overwrite: the LAST snapshot wins
+    rid: Optional[str] = None
+    for span in spans:
+        if span.get("replica_id"):
+            rid = str(span["replica_id"])
+            break
+    if rid is None and slo is not None and slo.get("replica_id"):
+        rid = str(slo["replica_id"])
+    if rid is None:
+        rid = os.path.basename(os.path.normpath(run_dir))
+    stats = slo.get("trace") if isinstance(slo, dict) else None
+    return ReplicaTraces(
+        run_dir=run_dir,
+        replica_id=rid,
+        earlier_runs=earlier,
+        spans=spans,
+        trace_stats=stats if isinstance(stats, dict) else None,
+    )
+
+
+def _span_shares(span: Dict[str, Any]) -> Optional[Dict[str, float]]:
+    """Queue/service/pad fractions of one span's latency.  Pad overhead
+    is the device time attributed to the pad rows the request rode with
+    (``device_s * pad_rows / (windows + pad_rows)``) — the cost the
+    fixed-bucket ladder pays for zero request-path compiles."""
+    latency = float(span.get("latency_s") or 0.0)
+    if latency <= 0.0:
+        return None
+    queue = max(float(span.get("queue_s") or 0.0), 0.0)
+    service = max(float(span.get("service_s") or 0.0), 0.0)
+    device = max(float(span.get("device_s") or 0.0), 0.0)
+    pad_rows = max(float(span.get("pad_rows") or 0.0), 0.0)
+    windows = max(float(span.get("windows") or 0.0), 0.0)
+    rows = pad_rows + windows
+    pad_s = device * (pad_rows / rows) if rows > 0 else 0.0
+    return {
+        "queue": min(queue / latency, 1.0),
+        "service": min(service / latency, 1.0),
+        "pad": min(pad_s / latency, 1.0),
+    }
+
+
+def _mean_shares(shares: Sequence[Dict[str, float]]) -> Dict[str, float]:
+    out = {}
+    for phase in PHASES:
+        vals = [s[phase] for s in shares]
+        out[f"{phase}_share"] = (round(float(np.mean(vals)), 4)
+                                 if vals else 0.0)
+    return out
+
+
+def build_trace(run_dirs: Sequence[str]) -> TraceReport:
+    """Merge N replica run dirs into one fleet trace report.  Spans
+    join on their globally-unique ids (a duplicate id is a COLLISION
+    finding, never silently merged); the attribution breakdown is over
+    every span with a positive latency."""
+    if not run_dirs:
+        raise NoTraceTelemetry("no run directories given")
+    replicas = [replica_traces(d) for d in run_dirs]
+    merged: List[Dict[str, Any]] = []
+    for rep in replicas:
+        for span in rep.spans:
+            doc = dict(span)
+            doc["_replica"] = rep.replica_id
+            doc["_run_dir"] = rep.run_dir
+            merged.append(doc)
+    if not merged:
+        raise NoTraceTelemetry(
+            "no serve_trace spans in any source — enable tracing on the "
+            "replicas (`--trace-every N` and/or `--trace-slow-ms MS`)"
+        )
+    counts = collections.Counter(
+        str(s.get("span_id")) for s in merged if s.get("span_id"))
+    collisions = sorted(sid for sid, n in counts.items() if n > 1)
+    annotated: List[Dict[str, Any]] = []
+    for span in merged:
+        shares = _span_shares(span)
+        if shares is not None:
+            span["_shares"] = shares
+        annotated.append(span)
+    scored = [s for s in annotated if "_shares" in s]
+    latencies = np.asarray(
+        [float(s["latency_s"]) for s in scored], np.float64)
+    phases: Dict[str, Dict[str, Any]] = {}
+    p99_thr: Optional[float] = None
+    tail: List[Dict[str, Any]] = []
+    if latencies.size:
+        for q in (50.0, 95.0, 99.0):
+            thr = float(np.percentile(latencies, q))
+            subset = [s for s in scored
+                      if float(s["latency_s"]) >= thr]
+            row = {"latency_ms": round(thr * 1e3, 3),
+                   "spans": len(subset)}
+            row.update(_mean_shares([s["_shares"] for s in subset]))
+            phases[f"p{int(q)}"] = row
+        p99_thr = float(np.percentile(latencies, 99.0))
+        tail = [s for s in scored if float(s["latency_s"]) >= p99_thr]
+    # Per-replica attribution: every replica appears (even span-less
+    # torn ones), tail membership against the FLEET p99.
+    per_replica: List[Dict[str, Any]] = []
+    for rep in replicas:
+        mine = [s for s in scored if s["_replica"] == rep.replica_id]
+        mine_tail = [s for s in tail if s["_replica"] == rep.replica_id]
+        row: Dict[str, Any] = {
+            "replica_id": rep.replica_id,
+            "run_dir": rep.run_dir,
+            "earlier_runs": rep.earlier_runs,
+            "spans": len(rep.spans),
+            "tail_spans": len(mine_tail),
+            "max_latency_ms": (round(max(
+                float(s["latency_s"]) for s in mine) * 1e3, 3)
+                if mine else None),
+        }
+        row.update(_mean_shares([s["_shares"] for s in mine]))
+        stats = rep.trace_stats or {}
+        row["over_budget"] = (int(stats["over_budget"])
+                              if "over_budget" in stats else None)
+        row["over_budget_traced"] = (int(stats["over_budget_traced"])
+                                     if "over_budget_traced" in stats
+                                     else None)
+        per_replica.append(row)
+    buckets: Dict[str, Dict[str, Any]] = {}
+    for key in sorted({int(s.get("bucket") or 0) for s in scored}):
+        mine = [s for s in scored if int(s.get("bucket") or 0) == key]
+        mine_tail = [s for s in tail if int(s.get("bucket") or 0) == key]
+        row = {"spans": len(mine), "tail_spans": len(mine_tail)}
+        row.update(_mean_shares([s["_shares"] for s in mine]))
+        buckets[str(key)] = row
+    # The tail verdict: the replica holding the most p99-tail spans
+    # (max tail latency breaks ties), then its dominant phase.
+    tail_replica = tail_phase = None
+    tail_share: Optional[float] = None
+    leader_tail = 0
+    if tail:
+        by_replica: Dict[str, List[Dict[str, Any]]] = {}
+        for s in tail:
+            by_replica.setdefault(s["_replica"], []).append(s)
+        tail_replica = max(
+            by_replica,
+            key=lambda rid: (len(by_replica[rid]),
+                             max(float(s["latency_s"])
+                                 for s in by_replica[rid])))
+        leader = by_replica[tail_replica]
+        leader_tail = len(leader)
+        leader_shares = _mean_shares([s["_shares"] for s in leader])
+        tail_phase = max(
+            PHASES, key=lambda p: leader_shares[f"{p}_share"])
+        tail_share = leader_shares[f"{tail_phase}_share"]
+    # Exemplar coverage: slow-tagged spans FOUND IN THE EVENT STREAMS
+    # against the exact over-budget counters — a torn-off exemplar
+    # shows up as coverage < 1.0, which is the point.
+    slow_spans = sum(
+        1 for s in annotated
+        if "slow" in (s.get("sampled_for") or ()))
+    ledgers = [r.trace_stats for r in replicas if r.trace_stats]
+    over_budget = sum(int(st.get("over_budget", 0)) for st in ledgers)
+    tail_mode = any(float(st.get("slow_ms", 0.0) or 0.0) > 0.0
+                    for st in ledgers)
+    if over_budget > 0:
+        coverage: Optional[float] = round(
+            min(slow_spans / over_budget, 1.0), 4)
+    elif tail_mode:
+        coverage = 1.0
+    else:
+        coverage = None
+    return TraceReport(
+        replicas=replicas,
+        spans=annotated,
+        collisions=collisions,
+        phases=phases,
+        per_replica=per_replica,
+        buckets=buckets,
+        p99_latency_ms=(round(p99_thr * 1e3, 3)
+                        if p99_thr is not None else None),
+        tail_replica=tail_replica,
+        tail_phase=tail_phase,
+        tail_share=tail_share,
+        tail_spans=len(tail),
+        tail_spans_of_leader=leader_tail,
+        over_budget=over_budget,
+        slow_spans=slow_spans,
+        exemplar_coverage=coverage,
+    )
+
+
+# ------------------------------------------------------------- read out --
+
+def _span_data(span: Dict[str, Any]) -> Dict[str, Any]:
+    doc = {k: v for k, v in span.items()
+           if not k.startswith("_") and k not in ("seq", "ts", "stage",
+                                                  "kind")}
+    shares = span.get("_shares")
+    if shares is not None:
+        for phase in PHASES:
+            doc[f"{phase}_share"] = round(shares[phase], 4)
+    doc["replica"] = span.get("_replica")
+    return doc
+
+
+def trace_data(report: TraceReport) -> Dict[str, Any]:
+    """The report as one JSON-able document — the ``trace_report``
+    registry artifact body and the ``--json`` extra payload."""
+    p99 = report.phases.get("p99", {})
+    return {
+        "sources": [r.run_dir for r in report.replicas],
+        "replicas": report.per_replica,
+        "spans": [_span_data(s) for s in report.spans],
+        "span_count": len(report.spans),
+        "collisions": list(report.collisions),
+        "phases": report.phases,
+        "buckets": report.buckets,
+        "p99_latency_ms": report.p99_latency_ms,
+        "queue_share_p99": p99.get("queue_share"),
+        "service_share_p99": p99.get("service_share"),
+        "pad_share_p99": p99.get("pad_share"),
+        "tail_replica": report.tail_replica,
+        "tail_phase": report.tail_phase,
+        "tail_share": report.tail_share,
+        "tail_spans": report.tail_spans,
+        "over_budget": report.over_budget,
+        "slow_spans": report.slow_spans,
+        "exemplar_coverage": report.exemplar_coverage,
+    }
+
+
+def _pct(value: Optional[float]) -> str:
+    return "-" if value is None else f"{100 * value:.1f}%"
+
+
+def _waterfall_line(span: Dict[str, Any]) -> List[str]:
+    reasons = ",".join(span.get("sampled_for") or ()) or "head"
+    lat_ms = round(float(span.get("latency_s") or 0.0) * 1e3, 3)
+    lines = [
+        f"  {span.get('span_id')} [{span.get('request_id')}] "
+        f"{span.get('windows')} win / {span.get('batches')} batch(es) "
+        f"b{span.get('bucket')} pad {span.get('pad_rows')}: "
+        f"{lat_ms}ms ({reasons}, {span.get('label')})"
+    ]
+    for child in span.get("children") or ():
+        lines.append(
+            f"    {child.get('phase'):<12} +{child.get('start_s')}s "
+            f"for {child.get('dur_s')}s")
+    return lines
+
+
+def render_trace(report: TraceReport) -> str:
+    """The human view: fleet span summary, phase attribution at
+    p50/p95/p99, per-replica table, the tail verdict, and the slowest
+    exemplar waterfalls."""
+    lines: List[str] = []
+    lines.append(
+        f"trace: {len(report.replicas)} replica(s), "
+        f"{len(report.spans)} span(s), "
+        f"{len(report.collisions)} collision(s)")
+    if report.phases:
+        lines.append("phase attribution (share of latency, mean over "
+                     "spans at/above the percentile):")
+        for name in ("p50", "p95", "p99"):
+            row = report.phases.get(name)
+            if row is None:
+                continue
+            lines.append(
+                f"  {name}: >= {row['latency_ms']}ms "
+                f"({row['spans']} span(s))  "
+                f"queue {_pct(row['queue_share'])}  "
+                f"service {_pct(row['service_share'])}  "
+                f"pad {_pct(row['pad_share'])}")
+    if report.tail_replica is not None:
+        lines.append(
+            f"tail: {report.tail_replica} {report.tail_phase} phase "
+            f"dominates the fleet p99 ({_pct(report.tail_share)} of "
+            f"latency, {report.tail_spans_of_leader}/{report.tail_spans} "
+            f"tail span(s))")
+    if report.exemplar_coverage is not None:
+        lines.append(
+            f"exemplar coverage {report.exemplar_coverage} "
+            f"({report.over_budget} over-budget request(s), "
+            f"{report.slow_spans} slow exemplar(s))")
+    lines.append("")
+    lines.append("replicas:")
+    lines.append(
+        f"  {'replica':<24} {'spans':>6} {'tail':>5} {'queue':>7} "
+        f"{'service':>8} {'pad':>7} {'over_budget':>12}  flags")
+    for row in report.per_replica:
+        flags = []
+        if (row["over_budget"] is not None
+                and row["over_budget_traced"] is not None
+                and row["over_budget_traced"] < row["over_budget"]):
+            flags.append("MISSING-EXEMPLARS")
+        if not row["spans"]:
+            flags.append("no-spans")
+        if row["earlier_runs"]:
+            flags.append(f"+{row['earlier_runs']} earlier run(s)")
+        over = (row["over_budget"] if row["over_budget"] is not None
+                else "-")
+        lines.append(
+            f"  {row['replica_id']:<24} {row['spans']:>6} "
+            f"{row['tail_spans']:>5} {_pct(row['queue_share']):>7} "
+            f"{_pct(row['service_share']):>8} {_pct(row['pad_share']):>7} "
+            f"{over:>12}  {' '.join(flags) if flags else '-'}")
+    if report.buckets:
+        lines.append("")
+        lines.append("buckets:")
+        lines.append(f"  {'bucket':>6} {'spans':>6} {'tail':>5} "
+                     f"{'queue':>7} {'service':>8} {'pad':>7}")
+        for key, row in report.buckets.items():
+            lines.append(
+                f"  {key:>6} {row['spans']:>6} {row['tail_spans']:>5} "
+                f"{_pct(row['queue_share']):>7} "
+                f"{_pct(row['service_share']):>8} "
+                f"{_pct(row['pad_share']):>7}")
+    slowest = sorted(
+        (s for s in report.spans if s.get("latency_s") is not None),
+        key=lambda s: float(s["latency_s"]), reverse=True)[:3]
+    if slowest:
+        lines.append("")
+        lines.append("slowest waterfalls:")
+        for span in slowest:
+            lines.extend(_waterfall_line(span))
+    return "\n".join(lines)
+
+
+def trace_findings(report: TraceReport):
+    """Collisions, missing exemplars, and a tail-dominating replica as
+    lint-engine findings for the shared reporters (text / ``--json`` /
+    ``--format gha``)."""
+    from apnea_uq_tpu.lint.engine import Finding
+
+    findings = []
+    for sid in report.collisions:
+        mine = [s for s in report.spans if str(s.get("span_id")) == sid]
+        owners = sorted({str(s.get("_run_dir", "")) for s in mine})
+        findings.append(Finding(
+            rule="trace-span-collision", severity="error",
+            path=owners[0] if owners else "", line=0,
+            message=(
+                f"span id {sid!r} appears {len(mine)} times across "
+                f"{', '.join(owners)} — span ids must be globally "
+                f"unique (<replica_id>/<trace_id>)"),
+        ))
+    if (report.exemplar_coverage is not None
+            and report.exemplar_coverage < 1.0):
+        findings.append(Finding(
+            rule="trace-missing-exemplar", severity="error",
+            path=report.replicas[0].run_dir if report.replicas else "",
+            line=0,
+            message=(
+                f"exemplar coverage {report.exemplar_coverage}: only "
+                f"{report.slow_spans} of {report.over_budget} "
+                f"over-budget request(s) carry a waterfall — the event "
+                f"stream lost exemplars (torn tail / killed replica?)"),
+        ))
+    if (len(report.replicas) > 1 and report.tail_replica is not None
+            and report.tail_share is not None
+            and report.tail_share >= 0.5
+            and report.tail_spans > 0
+            and report.tail_spans_of_leader * 2 >= report.tail_spans):
+        run_dir = next(
+            (r.run_dir for r in report.replicas
+             if r.replica_id == report.tail_replica), "")
+        findings.append(Finding(
+            rule="trace-tail-dominated", severity="error",
+            path=run_dir, line=0,
+            message=(
+                f"replica {report.tail_replica!r} {report.tail_phase} "
+                f"phase dominates the fleet p99 tail "
+                f"({report.tail_spans_of_leader}/{report.tail_spans} "
+                f"tail span(s), {report.tail_share} of their latency) "
+                f"— fix that replica/phase first"),
+        ))
+    return findings
+
+
+def trace_result(report: TraceReport):
+    """The findings wrapped as a :class:`LintResult` for
+    ``emit_result`` — ``files_scanned`` counts replicas."""
+    from apnea_uq_tpu.lint.engine import LintResult
+
+    return LintResult(
+        findings=trace_findings(report),
+        files_scanned=len(report.replicas),
+        rules_run=("trace-span-collision", "trace-missing-exemplar",
+                   "trace-tail-dominated"),
+        scanned_paths=tuple(r.run_dir for r in report.replicas),
+    )
+
+
+def record_trace(report: TraceReport, out_dir: str) -> None:
+    """Persist the report into ``out_dir``: the ``trace_report``
+    registry artifact (atomic JSON + manifest row) plus one
+    ``trace_report`` event in ``<out_dir>/events.jsonl`` — making the
+    report dir a first-class source for ``telemetry compare`` and
+    ``telemetry trend`` through the same run-dir seam every other
+    gateable kind rides."""
+    from apnea_uq_tpu.data import registry as registry_mod
+
+    data = trace_data(report)
+    registry = registry_mod.ArtifactRegistry(out_dir)
+    # apnea-lint: disable=artifact-never-consumed -- end product: the trace report is read by compare/trend through the report dir's event stream (load_source) and by operators, not by a registry-loading pipeline stage
+    registry.save_json(registry_mod.TRACE_REPORT, data)
+    p99 = report.phases.get("p99", {})
+    with append_events(out_dir) as run_log:
+        run_log.event(
+            "trace_report",
+            replicas=len(report.replicas),
+            sources=[r.run_dir for r in report.replicas],
+            spans=len(report.spans),
+            collisions=len(report.collisions),
+            p99_latency_ms=report.p99_latency_ms,
+            queue_share_p99=p99.get("queue_share"),
+            service_share_p99=p99.get("service_share"),
+            pad_share_p99=p99.get("pad_share"),
+            tail_replica=report.tail_replica,
+            tail_phase=report.tail_phase,
+            tail_share=report.tail_share,
+            tail_spans=report.tail_spans,
+            over_budget=report.over_budget,
+            slow_spans=report.slow_spans,
+            exemplar_coverage=report.exemplar_coverage,
+            phases=report.phases,
+            buckets=report.buckets,
+        )

@@ -337,7 +337,7 @@ def _render_serve_trace(traces: List[Dict[str, Any]]) -> List[str]:
     traced request (queue_s + service_s = the SLO latency, exactly)."""
     lines = ["serve traces (sampled request waterfalls):"]
     for e in traces:
-        lines.append(
+        line = (
             f"  {e.get('span_id', '?')} [{e.get('request_id', '?')}]"
             f" {e.get('windows', '?')} win / {e.get('batches', '?')}"
             f" batch(es) b{e.get('bucket', '?')}"
@@ -349,6 +349,12 @@ def _render_serve_trace(traces: List[Dict[str, Any]]) -> List[str]:
             f"  (latency {_fmt(e.get('latency_s'), 4)}s,"
             f" {e.get('label', '?')})"
         )
+        reasons = e.get("sampled_for")
+        if reasons:
+            line += f"  [{','.join(str(r) for r in reasons)}]"
+        if e.get("exemplar"):
+            line += "  EXEMPLAR"
+        lines.append(line)
     return lines
 
 
@@ -443,15 +449,16 @@ _QUALITY_GATE_FIELDS = (
 _SERVE_SLO_FIELDS = (
     "replica_id", "requests", "windows", "batches", "p50_ms", "p95_ms",
     "p99_ms", "windows_per_s", "queue_wait_mean_s", "pad_waste",
-    "device_s", "interval_s", "final", "patients", "buckets")
+    "device_s", "interval_s", "final", "patients", "buckets", "trace")
 _SERVE_DRIFT_FIELDS = (
     "replica_id", "tenant", "verdict", "windows", "max_psi", "max_ks",
     "max_mean_shift", "worst_channel", "warn_psi", "drift_psi",
     "warn_ks", "drift_ks", "final")
 _SERVE_TRACE_FIELDS = (
-    "span_id", "request_id", "windows", "batches", "bucket", "pad_rows",
-    "label", "queue_s", "service_s", "dispatch_s", "device_s", "d2h_s",
-    "respond_s", "latency_s")
+    "replica_id", "span_id", "trace_id", "request_id", "windows",
+    "batches", "bucket", "pad_rows", "label", "queue_s", "service_s",
+    "dispatch_s", "device_s", "d2h_s", "respond_s", "latency_s",
+    "sampled_for", "exemplar", "children")
 
 
 def _section(events: List[Dict[str, Any]], kind: str,

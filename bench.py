@@ -82,7 +82,11 @@ the relative pad-waste ratio across the proxy boundary;
 BENCH_SERVE_REQUESTS scales the request count, default 64;
 BENCH_SERVE_DRIFT_AFTER moves the built-in online-drift cohort shift —
 the loadgen traffic shifts scale/offset from that request on and the
-serve_drift verdict must flip, default halfway, -1 disables),
+serve_drift verdict must flip, default halfway, -1 disables;
+BENCH_SERVE_TRACE_EVERY sets the 1-in-N baseline exemplar stream,
+default 8, 0 disables; BENCH_SERVE_TRACE_SLOW_MS arms the tail-based
+exemplar sampler's slow budget, default 250 — the block asserts every
+over-budget request kept its waterfall, the tail-sampling contract),
 BENCH_SKIP_CAPACITY=1 to skip the capacity context (the
 fleet-saturation sweep: K serve replica SUBPROCESSES per offered-rate
 cell, Poisson arrivals, one shared warm program store, each cell
@@ -95,7 +99,11 @@ BENCH_CAPACITY_RATES sets the offered fleet req/s cells, default
 "4,8,16"; BENCH_CAPACITY_REPLICAS the replica count, default 2;
 BENCH_CAPACITY_REQUESTS the per-replica request count per cell,
 default 24; BENCH_CAPACITY_P99_BUDGET_MS the knee's latency budget,
-default 0 = ratio-only),
+default 0 = ratio-only; BENCH_CAPACITY_TRACE_EVERY the per-replica
+1-in-N exemplar stream, default 4, 0 disables;
+BENCH_CAPACITY_TRACE_SLOW_MS the per-replica tail-exemplar budget,
+default 250 — each cell's dirs are trace-merged and the cell carries
+queue/service share at p99 plus exemplar coverage, asserted 1.0),
 BENCH_DE_CHUNK for its DE chunk size,
 BENCH_WASTE_EPOCHS for the early-stop-waste context's epoch cap (0
 skips it), BENCH_BOOT_WINDOWS for the bootstrap context scale,
@@ -1367,7 +1375,14 @@ def bench_serve(run_log, n_passes: int) -> dict:
     unshifted half scores PSI ~ 0) while ``--drift-after``-style cohort
     shift kicks in halfway (BENCH_SERVE_DRIFT_AFTER overrides; -1
     disables) — the final summary carries the flipped verdict, proving
-    drift detection works end to end at bench cadence."""
+    drift detection works end to end at bench cadence.
+
+    Tracing rides along (ISSUE 20): the loadgen runs with exemplar
+    tracing armed (BENCH_SERVE_TRACE_EVERY / BENCH_SERVE_TRACE_SLOW_MS
+    override the 1-in-8 stream and the 250 ms slow budget), and the
+    block asserts the tail-sampling contract — every over-budget
+    request produced an exemplar span (over_budget == over_budget_traced
+    by construction; a mismatch is a sampler bug, not a perf fact)."""
     import numpy as np
 
     from apnea_uq_tpu.analysis.fingerprint import compute_fingerprint
@@ -1380,6 +1395,9 @@ def bench_serve(run_log, n_passes: int) -> dict:
     n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 64))
     drift_after = int(os.environ.get("BENCH_SERVE_DRIFT_AFTER",
                                      n_requests // 2))
+    trace_every = int(os.environ.get("BENCH_SERVE_TRACE_EVERY", 8))
+    trace_slow_ms = float(
+        os.environ.get("BENCH_SERVE_TRACE_SLOW_MS", 250.0))
     cfg = ModelConfig(compute_dtype=_bench_dtype())
     model = AlarconCNN1D(cfg)
     variables = init_variables(model, jax.random.key(0))
@@ -1398,9 +1416,18 @@ def bench_serve(run_log, n_passes: int) -> dict:
     summary = run_loadgen(engine, n_requests, max_windows=4, seed=0,
                           drift_after=drift_after if drift_after >= 0
                           else None,
-                          drift=drift)
+                          drift=drift,
+                          trace_every=trace_every,
+                          trace_slow_ms=trace_slow_ms)
     if drift is not None:
         summary["drift_verdicts"] = drift.verdicts()
+    trace = summary.get("trace") or {}
+    if trace and trace.get("over_budget", 0) != trace.get(
+            "over_budget_traced", 0):
+        raise RuntimeError(
+            f"tail-sampling contract broken: {trace['over_budget']} "
+            f"requests over the {trace_slow_ms}ms budget but only "
+            f"{trace['over_budget_traced']} exemplar spans emitted")
     return summary
 
 
@@ -1443,12 +1470,21 @@ def bench_capacity(run_log, proxy: bool) -> dict:
     a budget is set.  Backend-aware, not backend-gated: absolutes
     (knee rate, peak throughput) are backend-bound; the lowest cell's
     achieved/offered ratio is a pure keeping-up relative and gates
-    across the CPU-proxy boundary."""
+    across the CPU-proxy boundary.
+
+    Tracing rides along (ISSUE 20): every replica runs with exemplar
+    tracing armed (BENCH_CAPACITY_TRACE_EVERY /
+    BENCH_CAPACITY_TRACE_SLOW_MS), each cell's replica dirs are merged
+    with telemetry/spans.py BEFORE the tree is cleaned up, and the
+    block hard-fails when any over-budget request escaped without an
+    exemplar span (coverage < 1.0) or two replicas minted the same
+    span id."""
     import shutil
     import subprocess
     import tempfile
 
     from apnea_uq_tpu.telemetry import fleet as fleet_mod
+    from apnea_uq_tpu.telemetry import spans as spans_mod
 
     rates = [float(r) for r in os.environ.get(
         "BENCH_CAPACITY_RATES", "4,8,16").split(",") if r.strip()]
@@ -1459,6 +1495,9 @@ def bench_capacity(run_log, proxy: bool) -> dict:
     n_replicas = int(os.environ.get("BENCH_CAPACITY_REPLICAS", 2))
     n_requests = int(os.environ.get("BENCH_CAPACITY_REQUESTS", 24))
     p99_budget = float(os.environ.get("BENCH_CAPACITY_P99_BUDGET_MS", 0))
+    trace_every = int(os.environ.get("BENCH_CAPACITY_TRACE_EVERY", 4))
+    trace_slow_ms = float(
+        os.environ.get("BENCH_CAPACITY_TRACE_SLOW_MS", 250.0))
 
     root = tempfile.mkdtemp(prefix="bench_capacity_")
     env = dict(os.environ)
@@ -1484,6 +1523,8 @@ def bench_capacity(run_log, proxy: bool) -> dict:
             "--run-dir", run_dir, "--requests", str(requests),
             "--rate", str(rate), "--arrival", "poisson",
             "--passes", "2", "--seed", str(seed),
+            "--trace-every", str(trace_every),
+            "--trace-slow-ms", str(trace_slow_ms),
         ]
 
     def check(proc, tail_len=20):
@@ -1521,6 +1562,26 @@ def bench_capacity(run_log, proxy: bool) -> dict:
             rollup = fleet_mod.build_rollup(cell_dirs)
             achieved = rollup.requests_per_s or 0.0
             ratio = round(achieved / offered, 4) if offered else None
+            # Trace merge happens here, inside the try: the finally
+            # below rmtree's the replica dirs, so the exemplar contract
+            # must be checked while the serve_trace ledgers still exist.
+            p99_phases = {}
+            coverage = None
+            if trace_every > 0 or trace_slow_ms > 0:
+                report = spans_mod.build_trace(cell_dirs)
+                if report.collisions:
+                    raise RuntimeError(
+                        f"capacity cell {cell_i}: span-id collision "
+                        f"across replicas: "
+                        f"{sorted(report.collisions)[:3]}")
+                coverage = report.exemplar_coverage
+                if coverage is not None and coverage < 1.0:
+                    raise RuntimeError(
+                        f"capacity cell {cell_i}: {report.over_budget} "
+                        f"requests over the {trace_slow_ms}ms budget "
+                        f"but only {report.slow_spans} exemplar spans "
+                        f"(coverage {coverage})")
+                p99_phases = report.phases.get("p99") or {}
             cell = {
                 "offered_rps": offered,
                 "achieved_rps": achieved,
@@ -1529,6 +1590,9 @@ def bench_capacity(run_log, proxy: bool) -> dict:
                 "p99_ms": rollup.p99_ms,
                 "queue_wait_mean_s": rollup.queue_wait_mean_s,
                 "imbalance_ratio": rollup.imbalance_ratio,
+                "queue_share_p99": p99_phases.get("queue_share"),
+                "service_share_p99": p99_phases.get("service_share"),
+                "exemplar_coverage": coverage,
             }
             cells.append(cell)
             run_log.event(
@@ -1538,6 +1602,9 @@ def bench_capacity(run_log, proxy: bool) -> dict:
                 p99_ms=rollup.p99_ms,
                 imbalance_ratio=rollup.imbalance_ratio,
                 replicas=n_replicas,
+                queue_share_p99=p99_phases.get("queue_share"),
+                service_share_p99=p99_phases.get("service_share"),
+                exemplar_coverage=coverage,
             )
     finally:
         shutil.rmtree(root, ignore_errors=True)

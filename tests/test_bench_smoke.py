@@ -113,6 +113,17 @@ def test_readme_smoke_recipe_pins_every_smoke_knob():
         "README smoke recipe lost the `apnea-uq quality check` gate; "
         "the model-quality check is part of the post-eval ritual"
     )
+    # Fleet tracing (ISSUE 20): the tail-attribution assembler and the
+    # flag that arms tail-based exemplar capture are part of the same
+    # serving-observability recipe family as fleet/drift.
+    assert "apnea-uq telemetry trace" in readme, (
+        "README lost the `apnea-uq telemetry trace` fleet-tracing "
+        "recipe"
+    )
+    assert "--trace-slow-ms" in readme, (
+        "README lost the `--trace-slow-ms` tail-exemplar flag; the "
+        "serving recipe must keep teaching tail-based sampling"
+    )
 
 
 def _smoke_env(progress_file: str, run_dir: str) -> dict:

@@ -313,6 +313,7 @@ def test_package_gate_zero_unsuppressed_findings():
     assert suppressed == [
         ("apnea_uq_tpu/cli/stages.py", "artifact-never-consumed"),   # sweep
         ("apnea_uq_tpu/telemetry/fleet.py", "artifact-never-consumed"),  # rollup
+        ("apnea_uq_tpu/telemetry/spans.py", "artifact-never-consumed"),  # trace
         ("apnea_uq_tpu/uq/drivers.py", "artifact-never-consumed"),   # raw
         ("apnea_uq_tpu/uq/drivers.py", "artifact-never-consumed"),   # stats
     ]

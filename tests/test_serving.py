@@ -1524,6 +1524,11 @@ def test_serve_drift_check_traces_and_quality_gate(serving_registry,
     # --- the verdict flip, online: clean cohort ok, shifted drifts.
     drifts = by_kind["serve_drift"]
     assert all(e["tenant"] == "default" for e in drifts)
+    # ISSUE 20 satellite: drift verdicts and trace spans both carry the
+    # emitting process identity — the fleet read side joins on it.
+    rids = {e["replica_id"] for e in drifts} | {
+        e["replica_id"] for e in by_kind["serve_trace"]}
+    assert len(rids) == 1 and all(rids)
     assert drifts[0]["verdict"] == "ok", drifts[0]
     assert drifts[0]["max_psi"] < 0.1
     assert drifts[-1]["verdict"] == "drift", drifts[-1]
@@ -1545,6 +1550,15 @@ def test_serve_drift_check_traces_and_quality_gate(serving_registry,
     traces = by_kind["serve_trace"]
     assert len(traces) == 16
     assert len({t["span_id"] for t in traces}) == len(traces)
+    # ISSUE 20 satellite: the FIRST completed request always emits when
+    # tracing is on (reason "first"), every span id carries the
+    # replica-prefixed <replica_id>/<trace_id> shape, and the sampling
+    # provenance rides each span.
+    assert "first" in traces[0]["sampled_for"]
+    for t in traces:
+        assert t["span_id"] == f"{t['replica_id']}/{t['trace_id']}"
+        assert t["sampled_for"]
+        assert isinstance(t["children"], list) and t["children"]
     req_by_id = {e["request_id"]: e for e in by_kind["serve_request"]}
     for t in traces:
         request = req_by_id[t["request_id"]]

@@ -1,0 +1,487 @@
+"""Per-request span tracing (ISSUE 20): mint, sample, merge.
+
+Covers the jax-free tentpole module ``telemetry/spans.py`` end to end:
+globally-unique ``<replica_id>/<trace_id>`` span ids (pinned across two
+REAL concurrent replica subprocesses — the `_SPAN_COUNTER` collision
+class this PR retires), the at-completion :class:`ExemplarTracer`
+(first-request guarantee, 1-in-N stream, never-dropped slow exemplars
+with exact ``over_budget == over_budget_traced`` counters, bounded
+per-bucket p99 reservoir with exact drop counters), the waterfall
+child-span builder, the cross-replica trace assembler (merge, phase
+attribution, collision/coverage/tail findings, torn-tail byte-prefix
+truncation sweep), report persistence through the registry +
+``trace_report`` event, `telemetry compare` gating of the ``trace.*``
+family, and the `apnea-uq telemetry trace` CLI exit codes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from apnea_uq_tpu.telemetry.spans import (
+    ExemplarTracer,
+    NoTraceTelemetry,
+    build_trace,
+    mint_trace_id,
+    record_trace,
+    replica_traces,
+    span_id_for,
+    trace_data,
+    trace_findings,
+    trace_result,
+    waterfall_children,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ fixtures --
+
+
+def _trace_event(seq, *, replica_id, span_id, latency_s, queue_s,
+                 service_s, device_s=None, bucket=16, windows=4,
+                 pad_rows=0, sampled_for=("every_n",), request_id=None):
+    device = service_s * 0.8 if device_s is None else device_s
+    return {
+        "seq": seq, "ts": 2.0 + seq, "kind": "serve_trace",
+        "replica_id": replica_id, "span_id": span_id,
+        "trace_id": span_id.split("/", 1)[-1],
+        "request_id": request_id or f"req-{seq}",
+        "windows": windows, "batches": 1, "bucket": bucket,
+        "pad_rows": pad_rows, "label": f"mcd_serve_b{bucket}",
+        "queue_s": queue_s, "service_s": service_s,
+        "dispatch_s": service_s * 0.1, "device_s": device,
+        "d2h_s": service_s * 0.1, "respond_s": 0.0001,
+        "latency_s": latency_s, "sampled_for": list(sampled_for),
+        "exemplar": "slow" in sampled_for or "p99" in sampled_for,
+        "children": [{"phase": "coalesce", "start_s": 0.0,
+                      "dur_s": queue_s}],
+    }
+
+
+def _slo_event(seq, *, replica_id, trace=None):
+    e = {"seq": seq, "ts": 2.0 + seq, "kind": "serve_slo",
+         "replica_id": replica_id, "requests": 8, "final": True}
+    if trace is not None:
+        e["trace"] = trace
+    return e
+
+
+def _ledger(*, completed=8, traced=2, slow_ms=100.0, over_budget=0,
+            over_budget_traced=None, exemplars=()):
+    return {
+        "completed": completed, "traced": traced, "trace_every": 4,
+        "slow_ms": slow_ms, "over_budget": over_budget,
+        "over_budget_traced": (over_budget if over_budget_traced is None
+                               else over_budget_traced),
+        "p99_taken": {}, "p99_dropped": {},
+        "exemplar_span_ids": list(exemplars),
+    }
+
+
+def _write_events(run_dir, events):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "events.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def _fast_replica(tmp_path, name, n=4, bucket=16):
+    """A healthy replica: n quick spans + a clean trace ledger."""
+    events = [_trace_event(
+        i, replica_id=name, span_id=f"{name}/t{i}", latency_s=0.010,
+        queue_s=0.004, service_s=0.006, bucket=bucket,
+        sampled_for=("first",) if i == 0 else ("every_n",))
+        for i in range(n)]
+    events.append(_slo_event(n, replica_id=name, trace=_ledger()))
+    d = str(tmp_path / name)
+    _write_events(d, events)
+    return d
+
+
+def _slow_replica(tmp_path, name, n=4, latency=0.500):
+    """A degraded replica: service-dominated slow exemplar spans and an
+    over-budget ledger that matches them exactly."""
+    events = [_trace_event(
+        i, replica_id=name, span_id=f"{name}/t{i}", latency_s=latency,
+        queue_s=latency * 0.05, service_s=latency * 0.95,
+        sampled_for=("first", "slow") if i == 0 else ("slow",))
+        for i in range(n)]
+    events.append(_slo_event(
+        n, replica_id=name,
+        trace=_ledger(over_budget=n,
+                      exemplars=[f"{name}/t{i}" for i in range(n)])))
+    d = str(tmp_path / name)
+    _write_events(d, events)
+    return d
+
+
+# ------------------------------------------------------------- minting --
+
+
+class TestSpanIds:
+    def test_span_id_is_replica_prefixed(self, monkeypatch):
+        monkeypatch.setenv("APNEA_UQ_REPLICA_ID", "rep-a")
+        tid = mint_trace_id()
+        assert span_id_for(tid) == f"rep-a/{tid}"
+        # The counter is monotonic within the process.
+        assert mint_trace_id() != tid
+
+    def test_serve_request_mints_through_spans(self, monkeypatch):
+        from apnea_uq_tpu.serving.coalescer import ServeRequest
+
+        monkeypatch.setenv("APNEA_UQ_REPLICA_ID", "rep-b")
+        req = ServeRequest(np.zeros((1, 4, 2), np.float32), 0.0)
+        assert req.span_id == f"rep-b/{req.trace_id}"
+        # An inbound trace id is honored, never re-minted.
+        req2 = ServeRequest(np.zeros((1, 4, 2), np.float32), 0.0,
+                            trace_id="upstream-7")
+        assert req2.trace_id == "upstream-7"
+        assert req2.span_id == "rep-b/upstream-7"
+
+    def test_no_collision_across_two_concurrent_subprocesses(self,
+                                                             tmp_path):
+        """ISSUE 20 satellite: the retired `_SPAN_COUNTER` was a bare
+        per-process counter, so two replicas' request #7 shared an id.
+        Two REAL subprocesses minting 50 ids each through ServeRequest
+        must now produce 100 distinct span ids."""
+        code = (
+            "import numpy as np\n"
+            "from apnea_uq_tpu.serving.coalescer import ServeRequest\n"
+            "w = np.zeros((1, 4, 2), np.float32)\n"
+            "for _ in range(50):\n"
+            "    print(ServeRequest(w, 0.0).span_id)\n"
+        )
+        env = dict(os.environ, PYTHONPATH=REPO)
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", code], cwd=REPO,
+            env=dict(env, APNEA_UQ_REPLICA_ID=f"twin-{i}"),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            for i in range(2)]
+        ids = []
+        for proc in procs:
+            out, _ = proc.communicate(timeout=120)
+            assert proc.returncode == 0, out[-2000:]
+            ids.extend(out.split())
+        assert len(ids) == 100
+        assert len(set(ids)) == 100, "span ids collided across replicas"
+        # The per-process counters DID align — uniqueness came from the
+        # replica prefix, not luck.
+        assert {i.split("/", 1)[1] for i in ids if i.startswith("twin-0")} \
+            == {i.split("/", 1)[1] for i in ids if i.startswith("twin-1")}
+
+
+# ------------------------------------------------------------- sampler --
+
+
+class TestExemplarTracer:
+    def test_disabled_never_emits(self):
+        tracer = ExemplarTracer()
+        assert not tracer.enabled
+        for i in range(5):
+            assert tracer.decide(bucket=16, latency_s=9.9,
+                                 span_id=f"r/t{i}") == ()
+        assert tracer.stats()["traced"] == 0
+
+    def test_first_request_always_emits(self):
+        tracer = ExemplarTracer(trace_every=50)
+        assert tracer.decide(bucket=16, latency_s=0.01,
+                             span_id="r/t0") == ("first",)
+        # ...and the 1-in-N stream picks up from there.
+        reasons = [tracer.decide(bucket=16, latency_s=0.01,
+                                 span_id=f"r/t{i}")
+                   for i in range(1, 100)]
+        assert sum(1 for r in reasons if r == ("every_n",)) == 1
+        assert tracer.stats()["traced"] == 2
+
+    def test_every_n_stream(self):
+        tracer = ExemplarTracer(trace_every=5)
+        reasons = [tracer.decide(bucket=16, latency_s=0.01,
+                                 span_id=f"r/t{i}") for i in range(20)]
+        assert reasons[0] == ("first",)
+        assert [i for i, r in enumerate(reasons) if r] == [0, 5, 10, 15]
+
+    def test_slow_exemplars_never_dropped(self):
+        tracer = ExemplarTracer(slow_ms=100.0, reservoir_per_bucket=1)
+        slow_ids = []
+        for i in range(40):
+            slow = i % 3 == 0
+            reasons = tracer.decide(
+                bucket=16, latency_s=0.250 if slow else 0.010,
+                span_id=f"r/t{i}")
+            if slow:
+                assert "slow" in reasons  # every one, reservoir or not
+                slow_ids.append(f"r/t{i}")
+        stats = tracer.stats()
+        assert stats["over_budget"] == len(slow_ids) == 14
+        assert stats["over_budget_traced"] == stats["over_budget"]
+        assert set(slow_ids) <= set(stats["exemplar_span_ids"])
+
+    def test_p99_reservoir_bounds_with_exact_drop_counters(self):
+        tracer = ExemplarTracer(slow_ms=10_000.0, reservoir_per_bucket=1,
+                                p99_min_samples=5)
+        # Descending warm latencies: each stays under the rolling p99,
+        # so the reservoir is untouched when the spikes arrive.
+        for i in range(6):
+            tracer.decide(bucket=16, latency_s=0.015 - i * 0.001,
+                          span_id=f"r/t{i}")
+        # First outlier takes the bucket's one reservoir slot...
+        assert tracer.decide(bucket=16, latency_s=0.500,
+                             span_id="r/spike0") == ("p99",)
+        # ...the second is counted, not emitted.
+        assert tracer.decide(bucket=16, latency_s=0.600,
+                             span_id="r/spike1") == ()
+        stats = tracer.stats()
+        assert stats["p99_taken"] == {"16": 1}
+        assert stats["p99_dropped"] == {"16": 1}
+
+    def test_p99_tag_is_free_when_already_emitting(self):
+        tracer = ExemplarTracer(trace_every=1, slow_ms=10_000.0,
+                                reservoir_per_bucket=1, p99_min_samples=5)
+        for i in range(6):
+            tracer.decide(bucket=16, latency_s=0.010, span_id=f"r/t{i}")
+        reasons = tracer.decide(bucket=16, latency_s=0.500,
+                                span_id="r/spike")
+        assert "every_n" in reasons and "p99" in reasons
+        # Tagging tail membership on an already-emitting span spends no
+        # reservoir.
+        assert tracer.stats()["p99_taken"] == {}
+
+
+class TestWaterfallChildren:
+    def test_phases_decompose_the_request(self):
+        children = waterfall_children(
+            enqueue_t=10.0, dequeue_t=10.1, first_dispatch_t=10.3,
+            done_t=10.9, end_t=11.0, dispatch_s=0.2, d2h_s=0.1,
+            drift_s=0.05)
+        phases = [c["phase"] for c in children]
+        assert phases == ["pump", "coalesce", "drift_fold", "dispatch",
+                          "d2h", "respond"]
+        by = {c["phase"]: c for c in children}
+        assert by["pump"]["dur_s"] == pytest.approx(0.1)
+        assert by["coalesce"]["dur_s"] == pytest.approx(0.2)
+        assert by["dispatch"]["start_s"] == pytest.approx(0.3)
+        assert by["respond"]["start_s"] == pytest.approx(0.9)
+        assert by["respond"]["dur_s"] == pytest.approx(0.1)
+
+    def test_missing_dequeue_collapses_to_one_coalesce_child(self):
+        children = waterfall_children(
+            enqueue_t=0.0, dequeue_t=None, first_dispatch_t=0.4,
+            done_t=0.8, end_t=0.8, dispatch_s=0.3, d2h_s=0.0)
+        assert [c["phase"] for c in children] == [
+            "coalesce", "dispatch", "d2h", "respond"]
+        assert children[0]["dur_s"] == pytest.approx(0.4)
+
+
+# ------------------------------------------------------------ assembly --
+
+
+class TestBuildTrace:
+    def test_merge_and_phase_attribution(self, tmp_path):
+        fast = _fast_replica(tmp_path, "fast", n=6)
+        slow = _slow_replica(tmp_path, "slow", n=4)
+        report = build_trace([fast, slow])
+        assert not report.collisions
+        assert len(report.spans) == 10
+        assert {r["replica_id"] for r in report.per_replica} == \
+            {"fast", "slow"}
+        # The tail is the slow replica's service phase.
+        assert report.tail_replica == "slow"
+        assert report.tail_phase == "service"
+        assert report.tail_share >= 0.5
+        assert report.phases["p99"]["service_share"] >= 0.5
+        assert report.p99_latency_ms == pytest.approx(500.0, rel=0.01)
+        # Exemplar contract intact: ledger count == slow spans found.
+        assert report.over_budget == 4
+        assert report.slow_spans == 4
+        assert report.exemplar_coverage == 1.0
+        # The per-bucket table covers every bucket seen.
+        assert set(report.buckets) == {"16"}
+        # ...and the tail-dominated finding names the slow replica.
+        rules = {f.rule for f in trace_findings(report)}
+        assert rules == {"trace-tail-dominated"}
+
+    def test_collision_is_a_finding_never_a_silent_merge(self, tmp_path):
+        d0 = str(tmp_path / "a")
+        d1 = str(tmp_path / "b")
+        # Both replicas claim span id "r/t0" — the retired-counter bug.
+        for d, rid in ((d0, "r"), (d1, "r")):
+            _write_events(d, [
+                _trace_event(0, replica_id=rid, span_id="r/t0",
+                             latency_s=0.01, queue_s=0.004,
+                             service_s=0.006),
+                _slo_event(1, replica_id=rid, trace=_ledger()),
+            ])
+        report = build_trace([d0, d1])
+        assert report.collisions == ["r/t0"]
+        findings = trace_findings(report)
+        assert any(f.rule == "trace-span-collision" for f in findings)
+        result = trace_result(report)
+        assert result.files_scanned == 2
+        assert "trace-span-collision" in result.rules_run
+
+    def test_lost_exemplar_drops_coverage(self, tmp_path):
+        d = str(tmp_path / "r0")
+        # Ledger says 2 over-budget requests, but only one slow span
+        # survived in the stream (the other torn off the tail).
+        _write_events(d, [
+            _trace_event(0, replica_id="r0", span_id="r0/t0",
+                         latency_s=0.400, queue_s=0.02, service_s=0.38,
+                         sampled_for=("first", "slow")),
+            _slo_event(1, replica_id="r0",
+                       trace=_ledger(over_budget=2)),
+        ])
+        report = build_trace([d])
+        assert report.exemplar_coverage == 0.5
+        assert any(f.rule == "trace-missing-exemplar"
+                   for f in trace_findings(report))
+
+    def test_tail_mode_without_slow_requests_is_full_coverage(
+            self, tmp_path):
+        fast = _fast_replica(tmp_path, "fast")
+        report = build_trace([fast])
+        assert report.over_budget == 0
+        assert report.exemplar_coverage == 1.0
+        assert trace_findings(report) == []
+
+    def test_no_sources_and_no_spans_raise(self, tmp_path):
+        with pytest.raises(NoTraceTelemetry):
+            build_trace([])
+        with pytest.raises(NoTraceTelemetry, match="not a telemetry"):
+            build_trace([str(tmp_path / "nope")])
+        d = str(tmp_path / "untraced")
+        _write_events(d, [_slo_event(0, replica_id="r0")])
+        with pytest.raises(NoTraceTelemetry, match="enable tracing"):
+            build_trace([d])
+
+    def test_replica_id_falls_back_span_slo_basename(self, tmp_path):
+        d = str(tmp_path / "dir-name")
+        _write_events(d, [{"seq": 0, "kind": "serve_request"}])
+        assert replica_traces(d).replica_id == "dir-name"
+
+    def test_torn_tail_byte_prefix_sweep(self, tmp_path):
+        """ISSUE 20 satellite: a kill -9 mid-append leaves an arbitrary
+        byte prefix of a replica's events.jsonl.  For EVERY prefix
+        length the assembler must either degrade to a partial report or
+        raise NoTraceTelemetry — never crash, never invent spans."""
+        healthy = _fast_replica(tmp_path, "healthy", n=2)
+        victim = _slow_replica(tmp_path, "victim", n=2)
+        victim_log = os.path.join(victim, "events.jsonl")
+        data = open(victim_log, "rb").read()
+        first_line_end = data.index(b"\n") + 1
+        full = len(build_trace([healthy, victim]).spans)
+        seen_spans = set()
+        for cut in range(len(data) + 1):
+            with open(victim_log, "wb") as f:
+                f.write(data[:cut])
+            try:
+                report = build_trace([healthy, victim])
+            except NoTraceTelemetry:
+                # Legal ONLY while the victim's log holds no complete
+                # line at all (not a telemetry run dir yet); once one
+                # event survives, the assembler must degrade, not die.
+                assert cut < first_line_end, (
+                    f"assembler gave up at prefix {cut} with "
+                    f"parseable events present")
+                continue
+            assert 2 <= len(report.spans) <= full
+            seen_spans.add(len(report.spans))
+            # A torn-off slow exemplar is VISIBLE, not papered over:
+            # whenever the victim's ledger survived but its slow spans
+            # did not, coverage drops below 1.0.
+            victims = [s for s in report.spans
+                       if s.get("replica_id") == "victim"]
+            slow_seen = sum(1 for s in victims
+                            if "slow" in (s.get("sampled_for") or ()))
+            if report.over_budget == 2 and slow_seen < 2:
+                assert report.exemplar_coverage < 1.0
+        # The sweep actually exercised partial states, not just 0/full.
+        assert len(seen_spans) >= 2
+        with open(victim_log, "wb") as f:
+            f.write(data)
+
+
+# --------------------------------------------------- persistence + CLI --
+
+
+class TestReportPersistence:
+    def test_record_trace_event_and_artifact(self, tmp_path):
+        from apnea_uq_tpu.data import registry as registry_mod
+        from apnea_uq_tpu.telemetry.runlog import read_events
+
+        fast = _fast_replica(tmp_path, "fast")
+        slow = _slow_replica(tmp_path, "slow")
+        report = build_trace([fast, slow])
+        out = str(tmp_path / "report")
+        record_trace(report, out)
+        registry = registry_mod.ArtifactRegistry(out)
+        doc = registry.load_json(registry_mod.TRACE_REPORT)
+        assert doc["span_count"] == len(report.spans)
+        assert doc["tail_replica"] == "slow"
+        assert doc["exemplar_coverage"] == 1.0
+        events = [e for e in read_events(out)
+                  if e["kind"] == "trace_report"]
+        assert len(events) == 1
+        assert events[0]["replicas"] == 2
+        assert events[0]["service_share_p99"] == \
+            report.phases["p99"]["service_share"]
+        # trace_data strips runlog plumbing from the span docs.
+        for span in doc["spans"]:
+            assert "seq" not in span and "_shares" not in span
+
+    def test_compare_gates_trace_family(self, tmp_path):
+        from apnea_uq_tpu.telemetry.compare import compare_paths
+
+        # Baseline: healthy fleet.  Candidate: the tail went
+        # queue-bound and an exemplar went missing — both directions
+        # must register as regressions.
+        base_dir = str(tmp_path / "base-report")
+        record_trace(build_trace([
+            _fast_replica(tmp_path, "b0"),
+            _slow_replica(tmp_path, "b1"),
+        ]), base_dir)
+        cand0 = str(tmp_path / "c0")
+        _write_events(cand0, [
+            _trace_event(0, replica_id="c0", span_id="c0/t0",
+                         latency_s=0.500, queue_s=0.45, service_s=0.05,
+                         sampled_for=("first", "slow")),
+            _slo_event(1, replica_id="c0",
+                       trace=_ledger(over_budget=2)),
+        ])
+        cand_dir = str(tmp_path / "cand-report")
+        record_trace(build_trace([cand0]), cand_dir)
+        comp = compare_paths(base_dir, cand_dir)
+        deltas = {d.name: d for d in comp.deltas}
+        assert deltas["trace.queue_share_p99"].regressed
+        assert deltas["trace.exemplar_coverage"].regressed
+
+    def test_cli_exit_codes_and_json(self, tmp_path, capsys):
+        from apnea_uq_tpu.cli.main import main as cli_main
+
+        fast = _fast_replica(tmp_path, "fast")
+        # Clean single replica: no findings, exit 0, --out persists.
+        out_dir = str(tmp_path / "report")
+        assert cli_main(["telemetry", "trace", fast, "--out", out_dir,
+                         "--json"]) == 0
+        out = capsys.readouterr().out
+        assert f"trace report -> {out_dir}" in out
+        doc = json.loads(out[out.index("{"):])
+        assert doc["findings"] == []
+        assert doc["trace_report"]["exemplar_coverage"] == 1.0
+        assert os.path.exists(os.path.join(out_dir, "events.jsonl"))
+        # A dominated tail is a finding: exit 1.
+        slow = _slow_replica(tmp_path, "slow")
+        assert cli_main(["telemetry", "trace", fast, slow]) == 1
+        out = capsys.readouterr().out
+        assert "trace-tail-dominated" in out
+        # No trace telemetry anywhere: usage error, exit 2.
+        bare = str(tmp_path / "bare")
+        _write_events(bare, [_slo_event(0, replica_id="r0")])
+        with pytest.raises(SystemExit) as exc:
+            cli_main(["telemetry", "trace", bare])
+        assert exc.value.code == 2
+        assert "enable tracing" in capsys.readouterr().out
